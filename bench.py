@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Headline benchmark: DLRM random-data training throughput, samples/s/chip.
+
+Mirrors the reference benchmark config (reference:
+examples/cpp/DLRM/run_random.sh:1-10 — batch 256/device, 8 embedding tables
+× 1M rows × 64-d, bot MLP 64-512-512-64, top MLP 576-1024-1024-1024-1) and
+its throughput report (dlrm.cc:197-198: THROUGHPUT = samples*epochs/elapsed).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no numbers (BASELINE.md), so vs_baseline is the
+ratio against the recorded previous round (BENCH_BASELINE file) or 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                               dlrm_strategy, synthetic_batch)
+
+    ndev = len(jax.devices())
+    batch_per_chip = 256
+    batch = batch_per_chip * ndev
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
+    dcfg = DLRMConfig.random_benchmark()
+
+    model = ff.FFModel(cfg)
+    build_dlrm(model, dcfg)
+    strat = dlrm_strategy(model, dcfg, ndev)
+    model.compile(ff.SGDOptimizer(lr=0.01), "mean_squared_error",
+                  ["mse"], strategies=strat)
+    model.init_layers()
+
+    # pre-generate host batches; the loop includes H2D staging like the
+    # reference's zero-copy -> FB scatter (dlrm.cc:486-589)
+    nbatch = 8
+    batches = []
+    for i in range(nbatch):
+        x, y = synthetic_batch(dcfg, batch, seed=i)
+        x["label"] = y
+        batches.append(x)
+
+    # warmup/compile
+    model.train_batch(batches[0])
+    jax.block_until_ready(model.params)
+
+    steps = int(os.environ.get("BENCH_STEPS", "50"))
+    t0 = time.time()
+    for s in range(steps):
+        model.train_batch(batches[s % nbatch])
+    jax.block_until_ready(model.params)
+    elapsed = time.time() - t0
+
+    samples_per_sec = steps * batch / elapsed
+    per_chip = samples_per_sec / ndev
+
+    vs = 1.0
+    base_file = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE")
+    if os.path.exists(base_file):
+        try:
+            vs = per_chip / float(open(base_file).read().strip())
+        except Exception:
+            vs = 1.0
+
+    print(json.dumps({
+        "metric": "dlrm_random_train_throughput_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

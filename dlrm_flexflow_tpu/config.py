@@ -1,0 +1,107 @@
+"""Run configuration and CLI parsing.
+
+Parity with the reference FFConfig (reference: include/config.h:65-103,
+src/runtime/model.cc:1273-1381): epochs, batch size, learning rate, weight
+decay, search budget/alpha, strategy import/export paths, workers-per-node /
+nodes, profiling. The same flag spellings are accepted (`-e/--epochs`,
+`-b/--batch-size`, `--lr/--learning-rate`, `--wd/--weight-decay`,
+`--budget/--search-budget`, `--alpha/--search-alpha`, `--import`,
+`--export`, `--nodes`, `-ll:gpu` → chips per host, `--profiling`), plus
+TPU-specific ones (`--compute-dtype`).
+
+Legion low-level flags other than -ll:gpu (-ll:fsize, -ll:zsize, -ll:cpu,
+-ll:util, -ll:py, -dm:memorize — reference README.md:44-47) are accepted and
+ignored: memory sizing and task-launch memoization are XLA/runtime concerns
+on TPU (jit compile-once/execute-many subsumes -dm:memorize and Legion
+tracing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+
+@dataclass
+class FFConfig:
+    # DefaultConfig values mirror reference model.cc:1273-1289
+    epochs: int = 1
+    batch_size: int = 64
+    learning_rate: float = 0.01
+    weight_decay: float = 0.0001
+    workers_per_node: int = 0          # 0 = all local devices
+    num_nodes: int = 1
+    search_budget: int = 0
+    search_alpha: float = 1.2
+    import_strategy_file: str = ""
+    export_strategy_file: str = ""
+    profiling: bool = False
+    simulation: bool = False
+    seed: int = 0
+    compute_dtype: str = "float32"     # or "bfloat16" for MXU-rate matmuls
+    unparsed: List[str] = field(default_factory=list)
+
+    @property
+    def num_devices(self) -> int:
+        import jax
+        per_node = self.workers_per_node or len(jax.devices())
+        return per_node * self.num_nodes
+
+    @property
+    def jnp_compute_dtype(self):
+        return jnp.bfloat16 if self.compute_dtype == "bfloat16" else jnp.float32
+
+    @staticmethod
+    def parse_args(argv: Optional[List[str]] = None) -> "FFConfig":
+        import sys
+        argv = list(sys.argv[1:] if argv is None else argv)
+        cfg = FFConfig()
+        i = 0
+
+        def take():
+            nonlocal i
+            i += 1
+            if i >= len(argv):
+                raise ValueError(f"flag {argv[i - 1]!r} requires a value")
+            return argv[i]
+
+        while i < len(argv):
+            a = argv[i]
+            if a in ("-e", "--epochs"):
+                cfg.epochs = int(take())
+            elif a in ("-b", "--batch-size"):
+                cfg.batch_size = int(take())
+            elif a in ("--lr", "--learning-rate"):
+                cfg.learning_rate = float(take())
+            elif a in ("--wd", "--weight-decay"):
+                cfg.weight_decay = float(take())
+            elif a in ("--budget", "--search-budget"):
+                cfg.search_budget = int(take())
+            elif a in ("--alpha", "--search-alpha"):
+                cfg.search_alpha = float(take())
+            elif a == "--import":
+                cfg.import_strategy_file = take()
+            elif a == "--export":
+                cfg.export_strategy_file = take()
+            elif a == "--nodes":
+                cfg.num_nodes = int(take())
+            elif a == "-ll:gpu":  # reference flag for devices/node
+                cfg.workers_per_node = int(take())
+            elif a in ("-ll:fsize", "-ll:zsize", "-ll:cpu", "-ll:util",
+                       "-ll:py", "-ll:pysize"):
+                take()  # accepted+ignored (Legion memory/processor sizing)
+            elif a in ("-dm:memorize", "--simulation"):
+                if a == "--simulation":
+                    cfg.simulation = True
+            elif a == "--profiling":
+                cfg.profiling = True
+            elif a == "--seed":
+                cfg.seed = int(take())
+            elif a == "--compute-dtype":
+                cfg.compute_dtype = take()
+            else:
+                cfg.unparsed.append(a)
+            i += 1
+        return cfg

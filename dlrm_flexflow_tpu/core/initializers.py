@@ -1,0 +1,83 @@
+"""Parameter initializers.
+
+Parity with the reference initializer set (reference: include/initializer.h:26-100,
+src/runtime/initializer.cc, initializer_kernel.cu): GlorotUniform, Zero,
+Uniform, Normal, Constant. The reference runs each as a curand GPU task; here
+each is a pure function of a jax PRNG key, executed on-device by XLA at
+`FFModel.init_layers()` time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class GlorotUniform(Initializer):
+    """Glorot/Xavier uniform (reference initializer.cc GlorotUniform::init_task).
+
+    The reference computes fan-in/fan-out from the last two dims of the
+    weight region (initializer_kernel.cu glorot path); we follow the same
+    convention: limit = sqrt(6 / (fan_in + fan_out)).
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if len(shape) >= 3:
+            # conv-style OIHW kernel: fans scale with the receptive field
+            # (reference initializer_kernel.cu rank-3/4 path:
+            # fan = channels x receptive_field)
+            receptive = 1
+            for d in shape[2:]:
+                receptive *= d
+            fan_in, fan_out = shape[1] * receptive, shape[0] * receptive
+        elif len(shape) == 2:
+            fan_in, fan_out = shape[0], shape[1]
+        else:
+            fan_in = fan_out = shape[0]
+        limit = (6.0 / (fan_in + fan_out)) ** 0.5
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class ZeroInitializer(Initializer):
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, seed: int = 0, min_val: float = -0.05, max_val: float = 0.05):
+        self.seed = seed
+        self.min_val = float(min_val)
+        self.max_val = float(max_val)
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, self.min_val, self.max_val)
+
+
+class NormInitializer(Initializer):
+    def __init__(self, seed: int = 0, mean: float = 0.0, stddev: float = 1.0):
+        self.seed = seed
+        self.mean = float(mean)
+        self.stddev = float(stddev)
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return self.mean + self.stddev * jax.random.normal(key, shape, dtype)
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = float(value)
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+DEFAULT_KERNEL_INIT = GlorotUniform
+DEFAULT_BIAS_INIT = ZeroInitializer

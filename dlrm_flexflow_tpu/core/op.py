@@ -1,0 +1,139 @@
+"""Operator base class.
+
+Parity with the reference `Op` (reference: include/model.h:240-281,
+src/runtime/model.cc:256-372): ops are named "<Type>_<guid>" (the name is the
+strategy key), own their input/output tensors and parameters, and expose
+shape/partition queries used by the auto-parallelizer.
+
+TPU-native redesign: the reference Op carries Legion index spaces and
+launches CUDA tasks for init/forward/backward. Here an Op is a pure-function
+factory: `apply(params, inputs)` returns outputs and is traced once into the
+jitted train step; backward comes from jax.grad; "init" is parameter
+initialization. Per-op parallelization is a ParallelConfig lowered to GSPMD
+shardings (parallel/sharding.py) instead of a Legion partition + mapper
+routing.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .initializers import Initializer
+from .tensor import Tensor
+from ..parallel.pconfig import ParallelConfig
+
+
+@dataclass
+class ParamDef:
+    shape: tuple
+    dtype: Any
+    initializer: Initializer
+
+
+class Op:
+    """Base operator. Subclasses set `type_name`, build `self.outputs` in
+    __init__, and implement `apply` (+ optionally param_defs / shardings /
+    flops overrides)."""
+
+    type_name: str = "Op"
+
+    def __init__(self, model, inputs: Sequence[Tensor], name: Optional[str] = None):
+        self.model = model
+        self.guid = model._next_op_guid()
+        # reference op ctors name ops "<Name>_<guid>" (model.cc Op::Op);
+        # that name keys the parallelization strategy (strategy.cc:23-26)
+        self.name = name or f"{self.type_name}_{self.guid}"
+        self.inputs: List[Tensor] = list(inputs)
+        self.outputs: List[Tensor] = []
+        model._register_op(self)
+
+    # ---- graph construction helpers -------------------------------------
+    def _make_output(self, shape, dtype=jnp.float32, idx: int = 0) -> Tensor:
+        t = Tensor(tuple(shape), dtype, owner_op=self, owner_idx=idx,
+                   name=f"{self.name}_out{idx}")
+        return t
+
+    # ---- parameters ------------------------------------------------------
+    def param_defs(self) -> Dict[str, ParamDef]:
+        """Parameter name -> ParamDef. Empty for stateless ops."""
+        return {}
+
+    def init_params(self, key) -> Dict[str, jnp.ndarray]:
+        defs = self.param_defs()
+        if not defs:
+            return {}
+        keys = jax.random.split(key, len(defs))
+        return {n: d.initializer(k, d.shape, d.dtype)
+                for (n, d), k in zip(sorted(defs.items()), keys)}
+
+    # ---- execution -------------------------------------------------------
+    def apply(self, params: Dict[str, jnp.ndarray], xs: List[jnp.ndarray], *,
+              training: bool = False, rng=None) -> List[jnp.ndarray]:
+        raise NotImplementedError
+
+    # ---- parallelization -------------------------------------------------
+    def default_parallel_config(self, num_devices: int) -> ParallelConfig:
+        """Data parallelism over the sample dim (reference
+        Op::get_data_parallel_config, model.cc:282-293)."""
+        return ParallelConfig.data_parallel(self.outputs[0].num_dims, num_devices)
+
+    def candidate_parallel_configs(self, num_devices: int,
+                                   feasible_degrees: List[int]) -> List[ParallelConfig]:
+        """Enumeration used by the MCMC search (reference
+        Op::get_random_parallel_config, model.cc:295-324, draws a random
+        factorization of a random device count over the output dims).
+        Default: sample-dim DP at every feasible degree."""
+        out = []
+        nd = self.outputs[0].num_dims
+        for d in feasible_degrees:
+            if d <= num_devices:
+                degs = [1] * nd
+                degs[0] = d
+                out.append(ParallelConfig(tuple(degs)))
+        return out
+
+    def param_axes(self, pc: ParallelConfig, out_axes) -> Dict[str, tuple]:
+        """Mesh-axis assignment per parameter dim, given the mesh axes
+        already assigned to each output dim (`out_axes[i]` is a tuple of
+        axis names for output dim i). Default: replicated (the reference
+        replicates weights across data-parallel parts and syncs grads via
+        replica regions, model.cc:634-726; GSPMD psums instead)."""
+        return {n: ((),) * len(d.shape) for n, d in self.param_defs().items()}
+
+    # ---- cost model ------------------------------------------------------
+    def flops_per_sample(self) -> float:
+        """Forward FLOPs per sample, for the analytical simulator."""
+        return 0.0
+
+    def output_bytes(self) -> int:
+        t = self.outputs[0]
+        return int(math.prod(t.shape)) * jnp.dtype(t.dtype).itemsize
+
+    def param_bytes(self) -> int:
+        return sum(int(math.prod(d.shape)) * jnp.dtype(d.dtype).itemsize
+                   for d in self.param_defs().values())
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(name={self.name!r}, "
+                f"in={[t.shape for t in self.inputs]}, "
+                f"out={[t.shape for t in self.outputs]})")
+
+
+class InputOp(Op):
+    """Placeholder op owning a model input tensor (the reference creates
+    input tensors directly via FFModel::create_tensor, model.cc:457-553; we
+    give them a producing op so the graph interpreter is uniform)."""
+
+    type_name = "Input"
+
+    def __init__(self, model, shape, dtype, name=None):
+        super().__init__(model, [], name)
+        self.outputs = [self._make_output(shape, dtype)]
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        raise RuntimeError("InputOp is fed externally")

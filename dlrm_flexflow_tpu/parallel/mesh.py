@@ -1,0 +1,65 @@
+"""Device mesh construction with factorized axes.
+
+The reference enumerates physical GPUs/CPUs through the Legion machine model
+and assigns point tasks to them in the mapper (reference:
+src/mapper/mapper.cc:222-322). On TPU the analogous object is a
+`jax.sharding.Mesh`. To let SOAP-style per-op configs pick *any*
+power-of-two partition degree per tensor dim, we build the mesh with one
+axis per prime factor of the device count (e.g. 8 devices → axes
+f0,f1,f2 each of size 2). A partition degree d then maps to a tuple of
+consecutive axes whose sizes multiply to d (parallel/sharding.py), and two
+ops that shard the same logical dim with the same degree land on identical
+device assignments — no spurious resharding.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def _prime_factors(n: int) -> List[int]:
+    fs = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            fs.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        fs.append(n)
+    return fs
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              num_devices: Optional[int] = None) -> Mesh:
+    """Build a factorized mesh over `devices` (default: all jax devices).
+
+    Axis names are "f0", "f1", ... ordered largest factor first so that
+    low-index axes (consumed first by degree assignment) correspond to the
+    most ICI-local device groups under the default device ordering.
+    """
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            devices = devices[:num_devices]
+    devices = list(devices)
+    n = len(devices)
+    factors = sorted(_prime_factors(n), reverse=True) or [1]
+    names = tuple(f"f{i}" for i in range(len(factors)))
+    arr = np.array(devices).reshape(tuple(factors))
+    return Mesh(arr, names)
+
+
+def mesh_axis_sizes(mesh: Mesh) -> List[int]:
+    return [mesh.shape[name] for name in mesh.axis_names]
+
+
+def total_devices(mesh: Mesh) -> int:
+    n = 1
+    for s in mesh_axis_sizes(mesh):
+        n *= s
+    return n

@@ -1,0 +1,69 @@
+"""Per-operator parallelization configs (the SOAP search space on TPU).
+
+Parity with the reference `ParallelConfig {device_type, nDims, dim[],
+device_ids[]}` (reference: include/config.h:41-50) and the per-op strategy
+map keyed by a hash of the op name (reference: src/runtime/strategy.cc:23-94).
+
+TPU-native redesign: the reference maps every *point task* of an op's index
+launch to an explicit GPU id (MPMD placement via the Legion mapper,
+src/mapper/mapper.cc:33-97). Under GSPMD the whole program is SPMD over a
+`jax.sharding.Mesh`; a ParallelConfig here records the partition degree of
+each tensor dimension of the op's output (sample dim first — same dim order
+the reference uses once its reversed Legion coordinates are normalized), and
+compile() lowers it to a `PartitionSpec` over factorized mesh axes
+(parallel/sharding.py). `device_ids` are retained only for strategy-file
+round-tripping; XLA owns placement.
+
+`device_type == "CPU"` marks host-offloaded ops (the reference's hetero
+strategies put embeddings on CPUs, dlrm_strategy_hetero.cc:28-36).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+DEVICE_TPU = "TPU"   # reference: DeviceType::GPU (config.h:41)
+DEVICE_CPU = "CPU"   # reference: DeviceType::CPU — host offload
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Partition degrees per output-tensor dim; degrees[0] is the sample dim
+    for activations. Product of degrees = number of parallel parts."""
+
+    degrees: Tuple[int, ...]
+    device_type: str = DEVICE_TPU
+    device_ids: Tuple[int, ...] = field(default=())
+
+    def __post_init__(self):
+        object.__setattr__(self, "degrees", tuple(int(d) for d in self.degrees))
+        for d in self.degrees:
+            if d < 1:
+                raise ValueError(f"invalid partition degree {d}")
+
+    @property
+    def num_parts(self) -> int:
+        n = 1
+        for d in self.degrees:
+            n *= d
+        return n
+
+    @staticmethod
+    def data_parallel(ndims: int, num_devices: int) -> "ParallelConfig":
+        """Reference Op::get_data_parallel_config (model.cc:282-293): all
+        devices along the sample dim, every other dim unpartitioned."""
+        degrees = [1] * ndims
+        degrees[0] = num_devices
+        return ParallelConfig(tuple(degrees),
+                              device_ids=tuple(range(num_devices)))
+
+    @staticmethod
+    def replicated(ndims: int) -> "ParallelConfig":
+        return ParallelConfig((1,) * ndims)
+
+
+# A strategy is a map from op name ("<Type>_<guid>" or user name — the same
+# key scheme as the reference, where op->name seeds the MappingTagID hash,
+# strategy.cc:23-26) to its ParallelConfig.
+StrategyMap = Dict[str, ParallelConfig]

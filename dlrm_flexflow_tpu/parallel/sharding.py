@@ -1,0 +1,95 @@
+"""ParallelConfig → GSPMD sharding translation.
+
+This is the TPU replacement for the reference's mapper + partition machinery:
+`create_disjoint_partition` equal-block partitions a tensor by the op's
+ParallelConfig (reference: src/runtime/model.cc:555-592) and
+`FFMapper::slice_task` routes each part to its device (mapper.cc:33-97).
+Here the same intent compiles to a `NamedSharding` whose PartitionSpec
+assigns each partitioned tensor dim a tuple of factorized mesh axes
+(parallel/mesh.py); XLA/GSPMD then materializes the placement and inserts
+any op-to-op resharding collectives that Legion's implicit DMA used to do
+(reference: linear.cu:266-292 re-partitions inputs between ops).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+class AxisAssigner:
+    """Maps partition degrees to tuples of mesh axes, consuming axes in mesh
+    order so equal degrees on the same dim index always get the same axes."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.axis_names = list(mesh.axis_names)
+        self.axis_sizes = [mesh.shape[a] for a in self.axis_names]
+
+    def feasible_degrees(self) -> List[int]:
+        """All degrees expressible as a product of a prefix-contiguous run of
+        axes starting anywhere (what assign() below accepts), plus 1."""
+        out = {1}
+        n = len(self.axis_sizes)
+        for i in range(n):
+            p = 1
+            for j in range(i, n):
+                p *= self.axis_sizes[j]
+                out.add(p)
+        return sorted(out)
+
+    def assign(self, degrees: Sequence[int]) -> List[Tuple[str, ...]]:
+        """Assign each dim's degree a tuple of consecutive unused axes.
+
+        Raises ValueError when a degree cannot be formed from the remaining
+        axes (search proposals are filtered through feasible_degrees()).
+        """
+        result: List[Tuple[str, ...]] = []
+        cursor = 0
+        for deg in degrees:
+            if deg == 1:
+                result.append(())
+                continue
+            # find a consecutive run starting at or after cursor whose sizes
+            # multiply to deg
+            start = cursor
+            while start < len(self.axis_sizes):
+                p, j = 1, start
+                while j < len(self.axis_sizes) and p < deg:
+                    p *= self.axis_sizes[j]
+                    j += 1
+                if p == deg:
+                    result.append(tuple(self.axis_names[start:j]))
+                    cursor = j
+                    break
+                start += 1
+            else:
+                raise ValueError(
+                    f"degree {deg} not expressible over mesh axes "
+                    f"{list(zip(self.axis_names, self.axis_sizes))} "
+                    f"(remaining from {cursor})")
+        return result
+
+    @staticmethod
+    def axes_to_spec(axes_per_dim) -> PartitionSpec:
+        """Normalize per-dim axis tuples to a canonical PartitionSpec:
+        None for unsharded dims, scalar for singleton tuples, trailing
+        Nones stripped."""
+        norm = []
+        for t in axes_per_dim:
+            if not t:
+                norm.append(None)
+            elif len(t) == 1:
+                norm.append(t[0])
+            else:
+                norm.append(tuple(t))
+        while norm and norm[-1] is None:
+            norm.pop()
+        return PartitionSpec(*norm)
+
+    def spec(self, degrees: Sequence[int]) -> PartitionSpec:
+        return self.axes_to_spec(self.assign(degrees))
+
+    def sharding(self, degrees: Sequence[int]) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec(degrees))

@@ -1,0 +1,43 @@
+"""Strategy file load/save.
+
+Parity with the reference strategy serialization (reference:
+src/runtime/strategy.proto:5-23 — proto2 `Strategy{ops[]: name, device_type,
+dims[], device_ids[], memory_types[]}`; load/save in
+src/runtime/strategy.cc:96-172, keyed by hash of op name).
+
+Format here is JSON with the same field names as the proto schema (dims →
+partition degrees, mesh axes implied by order), so strategies remain
+human-diffable and round-trip exactly. `.pb`-style binary compat is not
+needed on TPU — the reference's prebuilt .pb files encode GPU device ids
+that have no meaning here.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from .pconfig import ParallelConfig, StrategyMap
+
+
+def save_strategies(path: str, strategies: StrategyMap) -> None:
+    doc = {"ops": [
+        {"name": name,
+         "device_type": pc.device_type,
+         "dims": list(pc.degrees),
+         "device_ids": list(pc.device_ids)}
+        for name, pc in sorted(strategies.items())]}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+
+
+def load_strategies(path: str) -> StrategyMap:
+    with open(path) as f:
+        doc = json.load(f)
+    out: StrategyMap = {}
+    for entry in doc["ops"]:
+        out[entry["name"]] = ParallelConfig(
+            tuple(entry["dims"]),
+            device_type=entry.get("device_type", "TPU"),
+            device_ids=tuple(entry.get("device_ids", ())))
+    return out
