@@ -74,6 +74,13 @@ class FFModel:
         return self._op_guid
 
     def _register_op(self, op: Op):
+        # the op name keys strategies/params/shardings (reference hashes it
+        # into the MappingTagID, strategy.cc:23-26) — collisions corrupt all
+        # three maps, so reject them at build time
+        if any(o.name == op.name for o in self.ops):
+            raise ValueError(
+                f"duplicate op name {op.name!r}; op names must be unique "
+                f"(they key parallelization strategies and parameters)")
         self.ops.append(op)
 
     def create_tensor(self, shape: Sequence[int], dtype=jnp.float32,
@@ -408,8 +415,14 @@ class FFModel:
                 objective, has_aux=True)(params, op_state)
             new_params, new_opt = self.optimizer.update(params, grads,
                                                         opt_state)
+            # CCE metrics expect probabilities; when the graph doesn't end
+            # in a Softmax op, preds are raw logits — normalize them here
+            if "crossentropy" in loss_type and preds_guid == logits_guid:
+                mpreds = jax.nn.softmax(preds.astype(jnp.float32), axis=-1)
+            else:
+                mpreds = preds
             mets = metrics_mod.compute_metrics(metric_names, loss_type,
-                                               preds, batch["label"])
+                                               mpreds, batch["label"])
             mets["loss"] = loss
             return new_params, new_opt, st2, mets
 
@@ -457,13 +470,16 @@ class FFModel:
         self._step = 0
         return self
 
-    def _device_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    def _device_batch(self, batch: Dict[str, np.ndarray],
+                      with_label: bool = True) -> Dict[str, Any]:
         out = {}
         for t in self.input_tensors:
             if t.name in batch:
                 out[t.name] = jax.device_put(
                     batch[t.name], self._out_sharding[t.guid])
-        out["label"] = jax.device_put(batch["label"], self._label_sharding)
+        if with_label:
+            out["label"] = jax.device_put(batch["label"],
+                                          self._label_sharding)
         return out
 
     def train_batch(self, batch: Dict[str, np.ndarray]):
@@ -478,9 +494,7 @@ class FFModel:
         return mets
 
     def forward_batch(self, batch: Dict[str, np.ndarray]):
-        db = {t.name: jax.device_put(batch[t.name],
-                                     self._out_sharding[t.guid])
-              for t in self.input_tensors if t.name in batch}
+        db = self._device_batch(batch, with_label=False)
         return self._eval_step(self.params, self.op_state, db)
 
     def reset_metrics(self):
@@ -491,6 +505,10 @@ class FFModel:
     def forward(self, batch=None):
         if batch is not None:
             self._cur_batch = batch
+        if getattr(self, "_cur_batch", None) is None:
+            raise ValueError(
+                "forward() needs a batch: call forward(batch) once (or use "
+                "a DataLoader's next_batch) before parameterless forward()")
         return self.forward_batch(self._cur_batch)
 
     def zero_gradients(self):
@@ -501,6 +519,8 @@ class FFModel:
     def backward(self, batch=None):
         if batch is not None:
             self._cur_batch = batch
+        if getattr(self, "_cur_batch", None) is None:
+            raise ValueError("backward() needs a batch: call backward(batch)")
         # fused into train_batch in the perf path; parity verb recomputes
         self._pending_update = self._cur_batch
 

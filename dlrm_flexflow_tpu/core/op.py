@@ -50,10 +50,15 @@ class Op:
         self.name = name or f"{self.type_name}_{self.guid}"
         self.inputs: List[Tensor] = list(inputs)
         self.outputs: List[Tensor] = []
-        model._register_op(self)
 
     # ---- graph construction helpers -------------------------------------
     def _make_output(self, shape, dtype=jnp.float32, idx: int = 0) -> Tensor:
+        # registration happens on first output creation, AFTER the subclass
+        # constructor validated its inputs — a throwing constructor leaves
+        # no half-built op in the graph
+        if not getattr(self, "_registered", False):
+            self.model._register_op(self)
+            self._registered = True
         t = Tensor(tuple(shape), dtype, owner_op=self, owner_idx=idx,
                    name=f"{self.name}_out{idx}")
         return t

@@ -45,6 +45,12 @@ def make_mesh(devices: Optional[Sequence] = None,
     if devices is None:
         devices = jax.devices()
         if num_devices is not None:
+            if num_devices > len(devices):
+                raise ValueError(
+                    f"requested {num_devices} devices but only "
+                    f"{len(devices)} are available (use "
+                    f"utils.testing.ensure_cpu_devices to virtualize a "
+                    f"larger CPU mesh for testing)")
             devices = devices[:num_devices]
     devices = list(devices)
     n = len(devices)

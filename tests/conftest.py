@@ -1,0 +1,10 @@
+"""Test fixture: force an 8-device virtual CPU mesh before JAX init.
+
+The reference can only test multi-GPU behavior on real GPUs via SLURM
+(reference: src/ops/tests/test_bootstrap.sh:2); a design goal of this
+framework (SURVEY.md §4) is that ALL distribution logic is testable on CPU.
+"""
+
+from dlrm_flexflow_tpu.utils.testing import ensure_cpu_devices
+
+ensure_cpu_devices(8)

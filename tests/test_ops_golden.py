@@ -1,0 +1,280 @@
+"""Operator golden tests vs PyTorch/NumPy oracles, 1-device and 8-device.
+
+Port of the reference op test suite (reference: src/ops/tests/test_harness.py
+— covered ops batch_matmul, transpose, reshape, tanh, concat, linear, flat,
+each with num_gpu=1 and num_gpu=2 variants). The multi-device variants run
+the SAME golden comparison with an 8-way parallel strategy on the virtual
+CPU mesh — distribution correctness via numerics, like the reference's
+`-ll:gpu 2` runs.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
+
+from harness import assert_close, run_single_op
+
+DEVICE_COUNTS = [1, 8]
+
+
+def rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+@pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+def test_linear_forward_backward(ndev):
+    r = rng(1)
+    x = r.randn(16, 24).astype(np.float32)
+    w = r.randn(24, 8).astype(np.float32)
+    b = r.randn(8).astype(np.float32)
+
+    out, grads = run_single_op(
+        lambda m, ins: m.dense(ins[0], 8, name="lin"),
+        {"x": x}, num_devices=ndev,
+        weights={"lin": {"kernel": w, "bias": b}}, with_grads=True)
+
+    tx = torch.tensor(x, requires_grad=True)
+    tw = torch.tensor(w, requires_grad=True)
+    tb = torch.tensor(b, requires_grad=True)
+    ty = tx @ tw + tb
+    torch.sum(ty ** 2).backward()
+    assert_close(out, ty.detach().numpy(), label="linear fwd")
+    assert_close(grads["params"]["lin"]["kernel"], tw.grad.numpy(),
+                 rtol=1e-4, atol=1e-4, label="linear dW")
+    assert_close(grads["params"]["lin"]["bias"], tb.grad.numpy(),
+                 rtol=1e-4, atol=1e-4, label="linear db")
+    assert_close(grads["inputs"]["x"], tx.grad.numpy(),
+                 rtol=1e-4, atol=1e-4, label="linear dx")
+
+
+@pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+def test_linear_channel_parallel(ndev):
+    """Sample x channel 2-D parallelism (reference linear.cu:188-293)."""
+    r = rng(2)
+    x = r.randn(16, 12).astype(np.float32)
+    w = r.randn(12, 8).astype(np.float32)
+    strategy = {"lin": ParallelConfig((max(ndev // 2, 1), min(2, ndev)))}
+    out, grads = run_single_op(
+        lambda m, ins: m.dense(ins[0], 8, use_bias=False, name="lin"),
+        {"x": x}, num_devices=ndev, strategy=strategy,
+        weights={"lin": {"kernel": w}}, with_grads=True)
+    expected = x @ w
+    assert_close(out, expected, label="linear tp fwd")
+    tx = torch.tensor(x, requires_grad=True)
+    tw = torch.tensor(w, requires_grad=True)
+    torch.sum((tx @ tw) ** 2).backward()
+    assert_close(grads["params"]["lin"]["kernel"], tw.grad.numpy(),
+                 rtol=1e-4, atol=1e-4, label="linear tp dW")
+
+
+@pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+def test_batch_matmul_reference_semantics(ndev):
+    """Reference default C = A^T B: (d,k,m)x(d,k,n)->(d,m,n) (model.h:1350)
+    with the 'ads team target model shape' d,m,n,k=145,265,15,64
+    (test_harness.py:500-510) shrunk 5x for CPU test speed."""
+    d, m, n, k = 29, 53, 15, 16
+    r = rng(3)
+    a = r.randn(d, k, m).astype(np.float32)
+    b = r.randn(d, k, n).astype(np.float32)
+    out, grads = run_single_op(
+        lambda mm, ins: mm.batch_matmul(ins[0], ins[1], name="bmm"),
+        {"a": a, "b": b}, num_devices=ndev, with_grads=True)
+    ta = torch.tensor(a, requires_grad=True)
+    tb = torch.tensor(b, requires_grad=True)
+    ty = torch.matmul(ta.transpose(1, 2), tb)
+    torch.sum(ty ** 2).backward()
+    assert_close(out, ty.detach().numpy(), rtol=1e-4, atol=1e-4,
+                 label="bmm fwd")
+    assert_close(grads["inputs"]["a"], ta.grad.numpy(), rtol=1e-3, atol=1e-3,
+                 label="bmm dA")
+    assert_close(grads["inputs"]["b"], tb.grad.numpy(), rtol=1e-3, atol=1e-3,
+                 label="bmm dB")
+
+
+@pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+def test_transpose(ndev):
+    r = rng(4)
+    x = r.randn(24, 6, 10).astype(np.float32)
+    out, _ = run_single_op(lambda m, ins: m.transpose(ins[0]), {"x": x},
+                           num_devices=ndev)
+    assert_close(out, np.transpose(x, (0, 2, 1)), label="transpose")
+
+
+@pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+def test_reshape_2d_3d(ndev):
+    """2<->3-D reshape, the DLRM dot path (reference reshape tests use
+    144x64x265; shrunk)."""
+    r = rng(5)
+    x = r.randn(16, 60).astype(np.float32)
+    out, _ = run_single_op(lambda m, ins: m.reshape(ins[0], (16, 6, 10)),
+                           {"x": x}, num_devices=ndev)
+    assert_close(out, x.reshape(16, 6, 10), label="reshape")
+
+
+@pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+def test_tanh(ndev):
+    r = rng(6)
+    x = r.randn(16, 32).astype(np.float32)
+    out, _ = run_single_op(lambda m, ins: m.tanh(ins[0]), {"x": x},
+                           num_devices=ndev)
+    assert_close(out, np.tanh(x), rtol=1e-4, atol=1e-6, label="tanh")
+
+
+@pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+def test_concat_and_split(ndev):
+    r = rng(7)
+    xs = {f"x{i}": r.randn(16, 4 + 2 * i).astype(np.float32)
+          for i in range(3)}
+    out, _ = run_single_op(lambda m, ins: m.concat(ins, axis=1),
+                           xs, num_devices=ndev)
+    assert_close(out, np.concatenate(list(xs.values()), axis=1),
+                 label="concat")
+
+    x = r.randn(16, 12).astype(np.float32)
+    model = ff.FFModel(ff.FFConfig(batch_size=16))
+    t = model.create_tensor((16, 12), name="x")
+    outs = model.split(t, [4, 8], axis=1)
+    from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+    model.compile(ff.SGDOptimizer(0.0), "mean_squared_error", ["mse"],
+                  mesh=make_mesh(num_devices=ndev),
+                  final_tensor=outs[1])
+    model.init_layers()
+    env, _ = model._forward_env({}, {}, {"x": x}, False, None)
+    assert_close(env[outs[0].guid], x[:, :4], label="split0")
+    assert_close(env[outs[1].guid], x[:, 4:], label="split1")
+
+
+@pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+def test_flat(ndev):
+    r = rng(8)
+    x = r.randn(8, 3, 4, 5).astype(np.float32)
+    out, _ = run_single_op(lambda m, ins: m.flat(ins[0]), {"x": x},
+                           num_devices=ndev)
+    assert_close(out, x.reshape(8, -1), label="flat")
+
+
+@pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+def test_embedding_sum_and_grad(ndev):
+    """Embedding bag sum + scatter-add gradient (reference
+    embedding.cu:173-224, atomicAdd backward)."""
+    r = rng(9)
+    table = r.randn(50, 8).astype(np.float32)
+    idx = r.randint(0, 50, size=(16, 4)).astype(np.int32)
+    out, grads = run_single_op(
+        lambda m, ins: m.embedding(ins[0], 50, 8, aggr="sum", name="emb"),
+        {"idx": idx}, num_devices=ndev,
+        weights={"emb": {"kernel": table}}, with_grads=True)
+
+    temb = torch.nn.EmbeddingBag(50, 8, mode="sum")
+    with torch.no_grad():
+        temb.weight.copy_(torch.tensor(table))
+    ty = temb(torch.tensor(idx, dtype=torch.long))
+    torch.sum(ty ** 2).backward()
+    assert_close(out, ty.detach().numpy(), rtol=1e-4, atol=1e-5,
+                 label="embedding fwd")
+    assert_close(grads["params"]["emb"]["kernel"], temb.weight.grad.numpy(),
+                 rtol=1e-4, atol=1e-4, label="embedding dW")
+
+
+@pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+def test_embedding_width_sharded(ndev):
+    """Width (out-dim) sharded table — the GSPMD analog of per-table
+    placement."""
+    r = rng(10)
+    table = r.randn(30, 8).astype(np.float32)
+    idx = r.randint(0, 30, size=(16, 2)).astype(np.int32)
+    strategy = {"emb": ParallelConfig((1, min(ndev, 8)))}
+    out, _ = run_single_op(
+        lambda m, ins: m.embedding(ins[0], 30, 8, aggr="avg", name="emb"),
+        {"idx": idx}, num_devices=ndev, strategy=strategy,
+        weights={"emb": {"kernel": table}})
+    expected = table[idx].mean(axis=1)
+    assert_close(out, expected, rtol=1e-5, atol=1e-6,
+                 label="embedding sharded")
+
+
+@pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+def test_conv2d_pool2d(ndev):
+    r = rng(11)
+    x = r.randn(8, 3, 12, 12).astype(np.float32)
+    w = (r.randn(6, 3, 3, 3) * 0.2).astype(np.float32)
+    b = r.randn(6).astype(np.float32)
+    out, _ = run_single_op(
+        lambda m, ins: m.conv2d(ins[0], 6, 3, 3, 1, 1, 1, 1, name="conv"),
+        {"x": x}, num_devices=ndev,
+        weights={"conv": {"kernel": w, "bias": b}})
+    ty = F.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                  stride=1, padding=1)
+    assert_close(out, ty.numpy(), rtol=1e-4, atol=1e-4, label="conv fwd")
+
+    outp, _ = run_single_op(
+        lambda m, ins: m.pool2d(ins[0], 2, 2, 2, 2, 0, 0, pool_type="max"),
+        {"x": x}, num_devices=ndev)
+    tp = F.max_pool2d(torch.tensor(x), 2, 2)
+    assert_close(outp, tp.numpy(), label="maxpool")
+
+    outa, _ = run_single_op(
+        lambda m, ins: m.pool2d(ins[0], 3, 3, 2, 2, 1, 1, pool_type="avg"),
+        {"x": x}, num_devices=ndev)
+    ta = F.avg_pool2d(torch.tensor(x), 3, 2, padding=1,
+                      count_include_pad=False)
+    assert_close(outa, ta.numpy(), rtol=1e-4, atol=1e-5,
+                 label="avgpool exclude-pad")
+
+
+@pytest.mark.parametrize("ndev", DEVICE_COUNTS)
+def test_softmax_elementwise_reverse(ndev):
+    r = rng(12)
+    x = r.randn(16, 10).astype(np.float32)
+    out, _ = run_single_op(lambda m, ins: m.softmax(ins[0]), {"x": x},
+                           num_devices=ndev)
+    assert_close(out, F.softmax(torch.tensor(x), dim=-1).numpy(),
+                 rtol=1e-5, atol=1e-6, label="softmax")
+
+    y = r.randn(16, 10).astype(np.float32)
+    for opname, fn in [("add", np.add), ("subtract", np.subtract),
+                       ("multiply", np.multiply), ("divide", np.divide)]:
+        out, _ = run_single_op(
+            lambda m, ins, o=opname: getattr(m, o)(ins[0], ins[1]),
+            {"a": x, "b": np.abs(y) + 0.5}, num_devices=ndev)
+        assert_close(out, fn(x, np.abs(y) + 0.5), rtol=1e-5, atol=1e-5,
+                     label=opname)
+
+    out, _ = run_single_op(lambda m, ins: m.reverse(ins[0], axis=1),
+                           {"x": x}, num_devices=ndev)
+    assert_close(out, x[:, ::-1], label="reverse")
+
+
+def test_index_select():
+    r = rng(13)
+    x = r.randn(8, 10).astype(np.float32)
+    out, _ = run_single_op(
+        lambda m, ins: m.index_select(ins[0], [7, 2, 2, 0], axis=1),
+        {"x": x})
+    assert_close(out, x[:, [7, 2, 2, 0]], label="index_select")
+
+
+def test_batchnorm_training_matches_torch():
+    r = rng(14)
+    x = r.randn(16, 5, 6, 6).astype(np.float32)
+    model = ff.FFModel(ff.FFConfig(batch_size=16))
+    t = model.create_tensor((16, 5, 6, 6), name="x")
+    out_t = model.batch_norm(t, relu=False, name="bn")
+    model.compile(ff.SGDOptimizer(0.0), "mean_squared_error", ["mse"])
+    model.init_layers()
+    import jax
+    env, new_state = model._forward_env(model.params, model.op_state,
+                                        {"x": x}, True, None)
+    tbn = torch.nn.BatchNorm2d(5, eps=1e-5, momentum=0.1)
+    tbn.train()
+    ty = tbn(torch.tensor(x))
+    assert_close(np.asarray(env[out_t.guid]), ty.detach().numpy(),
+                 rtol=1e-4, atol=1e-4, label="bn train fwd")
+    # running stats: torch uses momentum=0.1 on NEW value (ours: 0.9 on old)
+    assert_close(np.asarray(new_state["bn"]["running_mean"]),
+                 tbn.running_mean.numpy(), rtol=1e-3, atol=1e-4,
+                 label="bn running mean")
