@@ -1,0 +1,164 @@
+"""TPU cost model for the strategy-search simulator.
+
+Parity with the reference device model (reference: include/simulator.h:29-129,
+src/runtime/simulator.cu:21-76 — per-GPU compute devices plus comm devices
+with fixed bandwidths: inter-GPU 20 MB/ms, inter-node 12/numNodes, GPU⇄DRAM
+16, simulator.cu:27-29; per-op times measured by running the real kernels,
+memoized by (op, config) hash, simulator.cc:235-273).
+
+TPU redesign: per-op compute time is a roofline estimate —
+max(FLOPs / MXU_rate, bytes_touched / HBM_bw) — optionally *calibrated* by
+timing the op's compiled XLA subgraph on the real chip (cost_model
+measure=True), which replaces the reference's cudaEvent microbenchmarks.
+XLA fuses ops, so isolated-op timing over-counts; the analytical model is
+the default and measured times refine it (SURVEY.md §7 hard-part #3).
+Comm time uses ICI/DCN bandwidths instead of the reference's constants.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..core.op import InputOp, Op
+from ..parallel.pconfig import ParallelConfig
+
+
+@dataclass
+class TPUSpec:
+    """Per-chip hardware model. Defaults are TPU v5e (public numbers:
+    197 bf16 TFLOP/s MXU, 819 GB/s HBM, 4 ICI links × ~50 GB/s per
+    direction; DCN ~ 25 GB/s per host)."""
+
+    name: str = "v5e"
+    mxu_flops: float = 197e12         # bf16 FLOP/s
+    mxu_flops_f32: float = 49e12      # fp32 FLOP/s
+    hbm_bytes_per_s: float = 819e9
+    ici_bytes_per_s: float = 45e9     # per link per direction
+    ici_links: int = 4
+    dcn_bytes_per_s: float = 25e9
+    mxu_utilization: float = 0.55     # achievable fraction on real workloads
+    hbm_utilization: float = 0.75
+    kernel_launch_s: float = 2e-6     # per-HLO overhead (XLA fused ≈ small)
+
+    @staticmethod
+    def v4() -> "TPUSpec":
+        return TPUSpec(name="v4", mxu_flops=275e12, mxu_flops_f32=69e12,
+                       hbm_bytes_per_s=1228e9, ici_bytes_per_s=50e9,
+                       ici_links=6)
+
+
+class CostModel:
+    """Per-op/per-config compute and comm times, memoized like the
+    reference's hash-keyed measurements (simulator.cc:241-249)."""
+
+    def __init__(self, spec: Optional[TPUSpec] = None,
+                 compute_dtype=jnp.bfloat16, measure: bool = False):
+        self.spec = spec or TPUSpec()
+        self.compute_dtype = compute_dtype
+        self.measure = measure
+        self._cache: Dict[Tuple, float] = {}
+
+    # ---- helpers --------------------------------------------------------
+    def _flops_rate(self) -> float:
+        rate = (self.spec.mxu_flops
+                if jnp.dtype(self.compute_dtype) == jnp.dtype(jnp.bfloat16)
+                else self.spec.mxu_flops_f32)
+        return rate * self.spec.mxu_utilization
+
+    def _hbm_rate(self) -> float:
+        return self.spec.hbm_bytes_per_s * self.spec.hbm_utilization
+
+    @staticmethod
+    def _shard_elems(op: Op, pc: ParallelConfig) -> float:
+        t = op.outputs[0]
+        return math.prod(t.shape) / max(pc.num_parts, 1)
+
+    # ---- per-op compute -------------------------------------------------
+    def op_compute_time(self, op: Op, pc: ParallelConfig,
+                        backward: bool = False) -> float:
+        """Roofline time for one device's shard of `op` (seconds)."""
+        key = (op.name, pc.degrees, backward)
+        if key in self._cache:
+            return self._cache[key]
+
+        batch = op.outputs[0].shape[0] if op.outputs[0].num_dims > 0 else 1
+        flops = op.flops_per_sample() * batch / max(pc.num_parts, 1)
+        # bytes: inputs read + outputs written (+ params read), sharded
+        io_elems = sum(math.prod(t.shape) for t in op.inputs)
+        io_elems += math.prod(op.outputs[0].shape)
+        io_bytes = 4.0 * io_elems / max(pc.num_parts, 1)
+        io_bytes += op.param_bytes()  # params read once per device
+        if backward:
+            # bwd ≈ 2x fwd flops (dX and dW gemms), grads written
+            flops *= 2.0
+            io_bytes *= 2.0
+        t = max(flops / self._flops_rate(), io_bytes / self._hbm_rate())
+        t += self.spec.kernel_launch_s
+        self._cache[key] = t
+        return t
+
+    # ---- comm -----------------------------------------------------------
+    def _ici_allreduce_bw(self) -> float:
+        # bidirectional ring over ICI: effective algorithm bandwidth
+        return self.spec.ici_bytes_per_s * self.spec.ici_links
+
+    def resharding_time(self, tensor_bytes: float, src_pc: ParallelConfig,
+                        dst_pc: ParallelConfig) -> float:
+        """Cost of moving a tensor from the producer's sharding to the
+        consumer's (the reference gets this implicitly from Legion region
+        intersections, simulator.cc:279-326; GSPMD emits collectives)."""
+        if src_pc.degrees == dst_pc.degrees:
+            return 0.0
+        # approximate: every device re-reads its destination shard from
+        # peers — an all-to-all of the full tensor over ICI
+        moved = tensor_bytes * (1.0 - 1.0 / max(src_pc.num_parts,
+                                                dst_pc.num_parts, 1))
+        return moved / self._ici_allreduce_bw()
+
+    def grad_sync_time(self, param_bytes: float, replicas: int) -> float:
+        """All-reduce of a parameter's gradient across `replicas`
+        data-parallel parts (reference: replica regions gathered into the
+        optimizer task, optimizer_kernel.cu:98-104; here a psum ring)."""
+        if replicas <= 1:
+            return 0.0
+        moved = 2.0 * param_bytes * (replicas - 1) / replicas
+        return moved / self._ici_allreduce_bw()
+
+    # ---- measured calibration ------------------------------------------
+    def measure_op(self, op: Op, pc: ParallelConfig) -> float:
+        """Time the op's compiled XLA computation for its shard shape on
+        the real device (reference Op::measure_compute_time, e.g.
+        linear.cu:973-1049: warmup 5 / repeat 10). Memoized."""
+        import time
+
+        import jax
+
+        key = ("measured", op.name, pc.degrees)
+        if key in self._cache:
+            return self._cache[key]
+        shard_shapes = []
+        for t in op.inputs:
+            degs = list(pc.degrees)[:t.num_dims] + [1] * (t.num_dims - len(pc.degrees))
+            shard_shapes.append(tuple(
+                max(s // d, 1) for s, d in zip(t.shape, degs)))
+        params = op.init_params(jax.random.PRNGKey(0)) if op.param_defs() else {}
+        xs = [jnp.zeros(s, t.dtype) for s, t in zip(shard_shapes, op.inputs)]
+        fn = jax.jit(lambda p, xs_: op.apply(p, xs_, training=False))
+        try:
+            fn(params, xs)  # compile+warmup
+            for _ in range(4):
+                fn(params, xs)
+            jax.block_until_ready(fn(params, xs))
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = fn(params, xs)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / 10
+        except Exception:
+            dt = self.op_compute_time(op, pc)
+        self._cache[key] = dt
+        return dt

@@ -1,0 +1,85 @@
+"""MCMC (simulated-annealing) strategy search — the SOAP auto-parallelizer.
+
+Port of the reference search (reference: FFModel::optimize
+src/runtime/model.cc:1093-1144 — start from data-parallel; each iteration
+`rewrite` re-randomizes one op's ParallelConfig (model.cc:1082-1091);
+accept better always, worse with probability exp(-alpha * diff); runs at
+compile() when --budget > 0, exports the best via --export).
+
+The search space per op comes from Op.candidate_parallel_configs — the
+GSPMD analog of Op::get_random_parallel_config (model.cc:295-324) — and
+candidate feasibility is constrained by the factorized mesh axes
+(parallel/sharding.AxisAssigner.feasible_degrees).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, Optional, Tuple
+
+from ..core.op import InputOp
+from ..parallel.pconfig import ParallelConfig, StrategyMap
+from ..parallel.sharding import AxisAssigner
+from .cost_model import CostModel
+from .simulator import Simulator
+
+
+def default_strategy(model, ndev: int) -> StrategyMap:
+    return {op.name: op.default_parallel_config(ndev)
+            for op in model.ops if not isinstance(op, InputOp)}
+
+
+def rewrite(model, strategies: StrategyMap, ndev: int,
+            feasible, rng: random.Random) -> Tuple[StrategyMap, str]:
+    """Re-randomize one op's config (reference FFModel::rewrite,
+    model.cc:1082-1091)."""
+    ops = [op for op in model.ops if not isinstance(op, InputOp)]
+    op = rng.choice(ops)
+    cands = op.candidate_parallel_configs(ndev, feasible)
+    if not cands:
+        return strategies, op.name
+    new = dict(strategies)
+    new[op.name] = rng.choice(cands)
+    return new, op.name
+
+
+def optimize(model, budget: int = 1000, alpha: float = 1.2,
+             ndev: Optional[int] = None,
+             cost_model: Optional[CostModel] = None,
+             seed: int = 0, verbose: bool = False,
+             start: Optional[StrategyMap] = None) -> StrategyMap:
+    """Simulated-annealing search over per-op parallel configs (reference
+    FFModel::optimize, model.cc:1093-1144). Returns the best strategy map.
+    """
+    import math
+
+    from ..parallel.mesh import make_mesh
+
+    if ndev is None:
+        ndev = model.config.num_devices
+    mesh = model.mesh or make_mesh(num_devices=ndev)
+    feasible = AxisAssigner(mesh).feasible_degrees()
+    rng = random.Random(seed)
+    sim = Simulator(model, cost_model)
+
+    current = dict(start or default_strategy(model, ndev))
+    current_t = sim.simulate(current, ndev)
+    best, best_t = dict(current), current_t
+
+    for it in range(budget):
+        proposal, changed = rewrite(model, current, ndev, feasible, rng)
+        t = sim.simulate(proposal, ndev)
+        # reference acceptance: always if faster, else exp(-alpha * diff)
+        # with diff in the simulator's time units (model.cc:1118-1126)
+        diff = (t - current_t) * 1e3  # seconds -> ms, the reference's unit
+        if t < current_t or rng.random() < math.exp(-alpha * diff):
+            current, current_t = proposal, t
+            if t < best_t:
+                best, best_t = dict(proposal), t
+                if verbose:
+                    print(f"[search] iter {it}: {t * 1e3:.3f} ms "
+                          f"(changed {changed})")
+    if verbose:
+        print(f"[search] best simulated step: {best_t * 1e3:.3f} ms "
+              f"vs DP {sim.simulate(default_strategy(model, ndev), ndev) * 1e3:.3f} ms")
+    return best

@@ -1,0 +1,198 @@
+"""Event-driven execution simulator for strategy search.
+
+Port of the reference simulation algorithm (reference:
+src/runtime/simulator.cc:275-448 — build a task graph of fwd/bwd/comm/
+update/barrier SimTasks, then event-driven priority-queue simulation over
+compute and comm devices; weight sync modeled either overlapped with
+compute or bulk-synchronous behind a barrier, simulator.cc:327-408).
+
+The algorithm is pure logic (no CUDA) and ports directly; what changes is
+the device graph: instead of per-GPU compute devices + DRAM hops, the
+devices are (a) one SPMD compute stream per mesh device and (b) one shared
+ICI collective channel (XLA overlaps async collectives with compute, which
+the event-driven queue models naturally by putting comm tasks on the
+channel device). Costs come from search/cost_model.py.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..core.op import InputOp, Op
+from ..parallel.pconfig import ParallelConfig, StrategyMap
+from .cost_model import CostModel
+
+COMM_DEVICE = -1  # the ICI channel pseudo-device
+
+
+@dataclass
+class SimTask:
+    """reference: SimTask in include/simulator.h:29-60."""
+
+    run_time: float
+    device: int
+    name: str = ""
+    ready_time: float = 0.0
+    counter: int = 0                  # unresolved dependencies
+    next_tasks: List["SimTask"] = field(default_factory=list)
+
+    def add_next(self, t: "SimTask"):
+        self.next_tasks.append(t)
+        t.counter += 1
+
+
+class Simulator:
+    """Builds the per-iteration task graph for a model + strategy and
+    simulates its makespan (reference Simulator::simulate_runtime)."""
+
+    def __init__(self, model, cost_model: Optional[CostModel] = None,
+                 overlap_weight_sync: bool = True):
+        self.model = model
+        self.cost = cost_model or CostModel(
+            compute_dtype=model.config.jnp_compute_dtype)
+        self.overlap_weight_sync = overlap_weight_sync
+
+    # ------------------------------------------------------------------
+    def _participants(self, pc: ParallelConfig, ndev: int) -> List[int]:
+        """SPMD: every op runs on all devices, but an op whose config uses
+        fewer parts than devices leaves the rest idle for its duration —
+        modeled by placing tasks only on the participating devices."""
+        return list(range(min(pc.num_parts, ndev)))
+
+    def build_task_graph(self, strategies: StrategyMap, ndev: int):
+        ops = [op for op in self.model.ops if not isinstance(op, InputOp)]
+        tasks: List[SimTask] = []
+        fwd_of: Dict[str, List[SimTask]] = {}
+        bwd_of: Dict[str, List[SimTask]] = {}
+
+        def new_task(rt, dev, name):
+            t = SimTask(run_time=rt, device=dev, name=name)
+            tasks.append(t)
+            return t
+
+        # forward tasks per op per participating device
+        for op in ops:
+            pc = strategies[op.name]
+            ct = self.cost.op_compute_time(op, pc, backward=False)
+            fwd_of[op.name] = [new_task(ct, d, f"fwd:{op.name}")
+                               for d in self._participants(pc, ndev)]
+            # dependency + resharding comm from producers
+            for src in op.inputs:
+                if src.owner_op is None or isinstance(src.owner_op, InputOp):
+                    continue
+                src_pc = strategies[src.owner_op.name]
+                bytes_ = math.prod(src.shape) * 4.0
+                comm_t = self.cost.resharding_time(bytes_, src_pc, pc)
+                if comm_t > 0:
+                    c = new_task(comm_t, COMM_DEVICE,
+                                 f"reshard:{src.owner_op.name}->{op.name}")
+                    for ft in fwd_of[src.owner_op.name]:
+                        ft.add_next(c)
+                    for ft in fwd_of[op.name]:
+                        c.add_next(ft)
+                else:
+                    for sft in fwd_of[src.owner_op.name]:
+                        for ft in fwd_of[op.name]:
+                            sft.add_next(ft)
+
+        # backward tasks (reverse order), mirroring fwd deps
+        for op in reversed(ops):
+            pc = strategies[op.name]
+            ct = self.cost.op_compute_time(op, pc, backward=True)
+            bwd_of[op.name] = [new_task(ct, d, f"bwd:{op.name}")
+                               for d in self._participants(pc, ndev)]
+            # bwd of op depends on bwd of its consumers (grad flow) and on
+            # its own fwd
+            for ft in fwd_of[op.name]:
+                for bt in bwd_of[op.name]:
+                    ft.add_next(bt)
+        consumers: Dict[str, List[Op]] = {}
+        for op in ops:
+            for src in op.inputs:
+                if src.owner_op and not isinstance(src.owner_op, InputOp):
+                    consumers.setdefault(src.owner_op.name, []).append(op)
+        for op in ops:
+            for cons in consumers.get(op.name, []):
+                src_pc = strategies[cons.name]
+                dst_pc = strategies[op.name]
+                bytes_ = math.prod(op.outputs[0].shape) * 4.0
+                comm_t = self.cost.resharding_time(bytes_, src_pc, dst_pc)
+                if comm_t > 0:
+                    c = SimTask(run_time=comm_t, device=COMM_DEVICE,
+                                name=f"reshard_grad:{cons.name}->{op.name}")
+                    tasks.append(c)
+                    for bt in bwd_of[cons.name]:
+                        bt.add_next(c)
+                    for bt in bwd_of[op.name]:
+                        c.add_next(bt)
+                else:
+                    for cbt in bwd_of[cons.name]:
+                        for bt in bwd_of[op.name]:
+                            cbt.add_next(bt)
+
+        # weight sync + update per parameter (reference simulator.cc:327-408)
+        for op in ops:
+            if not op.param_defs():
+                continue
+            pc = strategies[op.name]
+            replicas = pc.degrees[0] if pc.degrees else 1
+            pbytes = op.param_bytes()
+            sync_t = self.cost.grad_sync_time(pbytes, replicas)
+            upd_compute = pbytes / self.cost._hbm_rate() * 3.0  # r/w + mom
+            if sync_t > 0:
+                s = SimTask(run_time=sync_t, device=COMM_DEVICE,
+                            name=f"allreduce:{op.name}")
+                tasks.append(s)
+                for bt in bwd_of[op.name]:
+                    bt.add_next(s)
+                parents = [s]
+            else:
+                parents = bwd_of[op.name]
+            for d in self._participants(pc, ndev):
+                u = SimTask(run_time=upd_compute, device=d,
+                            name=f"update:{op.name}")
+                tasks.append(u)
+                for p in parents:
+                    p.add_next(u)
+        return tasks
+
+    # ------------------------------------------------------------------
+    def simulate(self, strategies: StrategyMap,
+                 ndev: Optional[int] = None) -> float:
+        """Event-driven makespan (reference simulator.cc:410-447): pop the
+        earliest-ready task whose device is free, run it, release deps."""
+        if ndev is None:
+            import numpy as np
+            ndev = int(math.prod(
+                [self.model.mesh.shape[a] for a in self.model.mesh.axis_names])
+            ) if self.model.mesh else 1
+        tasks = self.build_task_graph(strategies, ndev)
+        device_free: Dict[int, float] = {}
+        ready: List = []
+        seq = 0
+        for t in tasks:
+            if t.counter == 0:
+                heapq.heappush(ready, (t.ready_time, seq, t))
+                seq += 1
+        makespan = 0.0
+        done = 0
+        while ready:
+            rt, _, task = heapq.heappop(ready)
+            start = max(rt, device_free.get(task.device, 0.0))
+            end = start + task.run_time
+            device_free[task.device] = end
+            makespan = max(makespan, end)
+            done += 1
+            for nxt in task.next_tasks:
+                nxt.counter -= 1
+                nxt.ready_time = max(nxt.ready_time, end)
+                if nxt.counter == 0:
+                    heapq.heappush(ready, (nxt.ready_time, seq, nxt))
+                    seq += 1
+        if done != len(tasks):
+            raise RuntimeError(
+                f"simulation deadlock: {done}/{len(tasks)} tasks ran")
+        return makespan
