@@ -40,16 +40,17 @@ def canonical_loss(name: str) -> str:
 
 
 def sparse_categorical_crossentropy(logits, labels):
-    """labels: int[batch] or int[batch, 1]; logits: float[batch, classes].
+    """labels: any int shape whose element count equals the number of logit
+    rows (e.g. [batch], [batch, 1], or [batch, seq] against folded
+    [batch*seq, classes] logits as in NMT); logits: float[..., classes].
 
     Reference kernel sparse_categorical_crossentropy_loss_backward writes
     softmax(logits) - onehot(label); grad of this fn reproduces it.
     """
-    if labels.ndim == logits.ndim:
-        labels = labels.reshape(labels.shape[:-1])
-    labels = labels.astype(jnp.int32)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    logits2 = logits.reshape(-1, logits.shape[-1])
+    labels = labels.astype(jnp.int32).reshape(-1)
+    logp = jax.nn.log_softmax(logits2.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
     return jnp.mean(nll)
 
 

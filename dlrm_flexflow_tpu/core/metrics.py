@@ -65,8 +65,9 @@ def compute_metrics(metrics: List[str], loss_type: str, preds, labels) -> Dict[s
     for m in metrics:
         if m == METRICS_ACCURACY:
             if sparse:
-                lab = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
-                correct = jnp.argmax(preds32, axis=-1) == lab
+                lab = labels.astype(jnp.int32).reshape(-1)
+                correct = (jnp.argmax(preds32.reshape(-1, preds32.shape[-1]),
+                                      axis=-1) == lab)
             elif preds32.shape[-1] == 1:
                 # regression-style accuracy: rounded prediction (reference
                 # metrics_functions.cu accuracy for MSE-style labels)
@@ -76,8 +77,9 @@ def compute_metrics(metrics: List[str], loss_type: str, preds, labels) -> Dict[s
                            == jnp.argmax(labels32, axis=-1))
             out["train_correct"] = jnp.sum(correct.astype(jnp.float32))
         elif m == METRICS_SPARSE_CATEGORICAL_CROSSENTROPY:
-            lab = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
-            logp = jnp.log(jnp.clip(preds32, 1e-12, None))
+            lab = labels.astype(jnp.int32).reshape(-1)
+            logp = jnp.log(jnp.clip(preds32.reshape(-1, preds32.shape[-1]),
+                                    1e-12, None))
             out["sparse_cce"] = -jnp.sum(
                 jnp.take_along_axis(logp, lab[:, None], axis=-1))
         elif m == METRICS_CATEGORICAL_CROSSENTROPY:

@@ -174,6 +174,10 @@ class FFModel:
         from ..ops.elementwise import Dropout
         return Dropout(self, input_tensor, rate, seed, name).outputs[0]
 
+    def lstm(self, input_tensor, hidden, name=None):
+        from ..ops.rnn import LSTM
+        return LSTM(self, input_tensor, hidden, name).outputs[0]
+
     def batch_matmul(self, a, b, trans_a=True, trans_b=False, name=None):
         from ..ops.batch_matmul import BatchMatmul
         return BatchMatmul(self, a, b, trans_a, trans_b, name).outputs[0]
@@ -478,8 +482,16 @@ class FFModel:
                 out[t.name] = jax.device_put(
                     batch[t.name], self._out_sharding[t.guid])
         if with_label:
-            out["label"] = jax.device_put(batch["label"],
-                                          self._label_sharding)
+            lab = batch["label"]
+            sh = self._label_sharding
+            # the label tensor's shape can be a folded view of what the user
+            # passes (e.g. NMT feeds (batch, seq) labels against
+            # (batch*seq, 1) logits); re-check divisibility on the real array
+            ndev = int(np.prod([self.mesh.shape[a]
+                                for a in self.mesh.axis_names]))
+            if lab.shape[0] % ndev != 0:
+                sh = NamedSharding(self.mesh, PartitionSpec())
+            out["label"] = jax.device_put(lab, sh)
         return out
 
     def train_batch(self, batch: Dict[str, np.ndarray]):

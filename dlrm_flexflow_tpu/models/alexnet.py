@@ -1,0 +1,33 @@
+"""AlexNet (reference: examples/cpp/AlexNet/alexnet.cc ~450 LoC; python
+analog examples/python/native/alexnet.py:7-70). NCHW, same layer stack:
+conv11x11s4-64 → pool → conv5x5-192 → pool → 3×conv3x3(384/256/256) →
+pool → flat → dense4096 → dense4096 → dense(num_classes) → softmax."""
+
+from __future__ import annotations
+
+from ..core.model import FFModel
+
+
+def build_alexnet(model: FFModel, num_classes: int = 1000,
+                  image_hw: int = 224):
+    batch = model.config.batch_size
+    x = model.create_tensor((batch, 3, image_hw, image_hw), name="image")
+    t = model.conv2d(x, 64, 11, 11, 4, 4, 2, 2, activation="relu",
+                     name="conv1")
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0, name="pool1")
+    t = model.conv2d(t, 192, 5, 5, 1, 1, 2, 2, activation="relu",
+                     name="conv2")
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0, name="pool2")
+    t = model.conv2d(t, 384, 3, 3, 1, 1, 1, 1, activation="relu",
+                     name="conv3")
+    t = model.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation="relu",
+                     name="conv4")
+    t = model.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation="relu",
+                     name="conv5")
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0, name="pool5")
+    t = model.flat(t, name="flat")
+    t = model.dense(t, 4096, activation="relu", name="fc6")
+    t = model.dense(t, 4096, activation="relu", name="fc7")
+    t = model.dense(t, num_classes, name="fc8")
+    out = model.softmax(t, name="prob")
+    return {"image": (batch, 3, image_hw, image_hw)}, out

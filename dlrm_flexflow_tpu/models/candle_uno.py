@@ -1,0 +1,65 @@
+"""CANDLE Uno (reference: examples/cpp/candle_uno/candle_uno.cc ~400 LoC —
+multi-tower MLP: per-feature-set towers built by build_feature_model, concat,
+deep top MLP with residual option, 1-output regression;
+candle_uno.cc:115-126)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..core.model import FFModel
+
+# reference defaults (candle_uno.cc DefaultConfig / feature shapes)
+DEFAULT_FEATURE_SHAPES = {"dose": 1, "cell.rnaseq": 942, "drug.descriptors": 5270,
+                          "drug.fingerprints": 2048}
+DEFAULT_INPUT_FEATURES = ["dose1", "dose2", "cell.rnaseq",
+                          "drug1.descriptors", "drug1.fingerprints",
+                          "drug2.descriptors", "drug2.fingerprints"]
+DENSE_LAYERS = [1000, 1000, 1000]
+DENSE_FEATURE_LAYERS = [1000, 1000, 1000]
+
+
+def _feature_model(model, t, layers, prefix):
+    """reference candle_uno.cc build_feature_model: MLP tower."""
+    for i, w in enumerate(layers):
+        t = model.dense(t, w, activation="relu", name=f"{prefix}_fc{i}")
+    return t
+
+
+def build_candle_uno(model: FFModel,
+                     feature_shapes: Dict[str, int] = None,
+                     input_features: List[str] = None,
+                     dense_layers: List[int] = None,
+                     dense_feature_layers: List[int] = None):
+    feature_shapes = feature_shapes or DEFAULT_FEATURE_SHAPES
+    input_features = input_features or DEFAULT_INPUT_FEATURES
+    dense_layers = dense_layers or DENSE_LAYERS
+    dense_feature_layers = dense_feature_layers or DENSE_FEATURE_LAYERS
+    batch = model.config.batch_size
+
+    # one shared tower per feature *type*, applied to each input feature of
+    # that type (reference builds feature models keyed by shape name)
+    inputs = {}
+    towers = []
+    for feat in input_features:
+        base = feat
+        for k in feature_shapes:
+            if feat == k or (feat[:-1].rstrip(".") in k) or k in feat:
+                base = k
+        # normalize names like drug1.descriptors -> drug.descriptors
+        key = next((k for k in feature_shapes if
+                    feat.replace("1", "").replace("2", "") == k), base)
+        dim = feature_shapes.get(key) or feature_shapes[base]
+        x = model.create_tensor((batch, dim), name=feat)
+        inputs[feat] = (batch, dim)
+        if dim == 1:
+            towers.append(x)  # dose inputs go straight to concat
+        else:
+            towers.append(_feature_model(model, x, dense_feature_layers,
+                                         f"tower_{feat.replace('.', '_')}"))
+    merged = model.concat(towers, axis=1, name="uno_concat")
+    t = merged
+    for i, w in enumerate(dense_layers):
+        t = model.dense(t, w, activation="relu", name=f"top_fc{i}")
+    out = model.dense(t, 1, name="uno_out")
+    return inputs, out

@@ -1,0 +1,110 @@
+"""InceptionV3 (reference: examples/cpp/InceptionV3/inception.cc ~330 LoC —
+the concat-heavy model used to show hybrid SOAP strategies). Full v3
+topology: stem, 3×InceptionA, InceptionB, 4×InceptionC, InceptionD,
+2×InceptionE, global pool, fc, softmax. NCHW."""
+
+from __future__ import annotations
+
+from ..core.model import FFModel
+
+
+def _conv_bn(model, t, ch, kh, kw, sh, sw, ph, pw, name):
+    t = model.conv2d(t, ch, kh, kw, sh, sw, ph, pw, use_bias=False,
+                     name=f"{name}_conv")
+    return model.batch_norm(t, relu=True, name=f"{name}_bn")
+
+
+def _inception_a(model, t, pool_ch, name):
+    b1 = _conv_bn(model, t, 64, 1, 1, 1, 1, 0, 0, f"{name}_b1")
+    b2 = _conv_bn(model, t, 48, 1, 1, 1, 1, 0, 0, f"{name}_b2a")
+    b2 = _conv_bn(model, b2, 64, 5, 5, 1, 1, 2, 2, f"{name}_b2b")
+    b3 = _conv_bn(model, t, 64, 1, 1, 1, 1, 0, 0, f"{name}_b3a")
+    b3 = _conv_bn(model, b3, 96, 3, 3, 1, 1, 1, 1, f"{name}_b3b")
+    b3 = _conv_bn(model, b3, 96, 3, 3, 1, 1, 1, 1, f"{name}_b3c")
+    b4 = model.pool2d(t, 3, 3, 1, 1, 1, 1, pool_type="avg",
+                      name=f"{name}_pool")
+    b4 = _conv_bn(model, b4, pool_ch, 1, 1, 1, 1, 0, 0, f"{name}_b4")
+    return model.concat([b1, b2, b3, b4], axis=1, name=f"{name}_cat")
+
+
+def _inception_b(model, t, name):
+    b1 = _conv_bn(model, t, 384, 3, 3, 2, 2, 0, 0, f"{name}_b1")
+    b2 = _conv_bn(model, t, 64, 1, 1, 1, 1, 0, 0, f"{name}_b2a")
+    b2 = _conv_bn(model, b2, 96, 3, 3, 1, 1, 1, 1, f"{name}_b2b")
+    b2 = _conv_bn(model, b2, 96, 3, 3, 2, 2, 0, 0, f"{name}_b2c")
+    b3 = model.pool2d(t, 3, 3, 2, 2, 0, 0, name=f"{name}_pool")
+    return model.concat([b1, b2, b3], axis=1, name=f"{name}_cat")
+
+
+def _inception_c(model, t, ch7, name):
+    b1 = _conv_bn(model, t, 192, 1, 1, 1, 1, 0, 0, f"{name}_b1")
+    b2 = _conv_bn(model, t, ch7, 1, 1, 1, 1, 0, 0, f"{name}_b2a")
+    b2 = _conv_bn(model, b2, ch7, 1, 7, 1, 1, 0, 3, f"{name}_b2b")
+    b2 = _conv_bn(model, b2, 192, 7, 1, 1, 1, 3, 0, f"{name}_b2c")
+    b3 = _conv_bn(model, t, ch7, 1, 1, 1, 1, 0, 0, f"{name}_b3a")
+    b3 = _conv_bn(model, b3, ch7, 7, 1, 1, 1, 3, 0, f"{name}_b3b")
+    b3 = _conv_bn(model, b3, ch7, 1, 7, 1, 1, 0, 3, f"{name}_b3c")
+    b3 = _conv_bn(model, b3, ch7, 7, 1, 1, 1, 3, 0, f"{name}_b3d")
+    b3 = _conv_bn(model, b3, 192, 1, 7, 1, 1, 0, 3, f"{name}_b3e")
+    b4 = model.pool2d(t, 3, 3, 1, 1, 1, 1, pool_type="avg",
+                      name=f"{name}_pool")
+    b4 = _conv_bn(model, b4, 192, 1, 1, 1, 1, 0, 0, f"{name}_b4")
+    return model.concat([b1, b2, b3, b4], axis=1, name=f"{name}_cat")
+
+
+def _inception_d(model, t, name):
+    b1 = _conv_bn(model, t, 192, 1, 1, 1, 1, 0, 0, f"{name}_b1a")
+    b1 = _conv_bn(model, b1, 320, 3, 3, 2, 2, 0, 0, f"{name}_b1b")
+    b2 = _conv_bn(model, t, 192, 1, 1, 1, 1, 0, 0, f"{name}_b2a")
+    b2 = _conv_bn(model, b2, 192, 1, 7, 1, 1, 0, 3, f"{name}_b2b")
+    b2 = _conv_bn(model, b2, 192, 7, 1, 1, 1, 3, 0, f"{name}_b2c")
+    b2 = _conv_bn(model, b2, 192, 3, 3, 2, 2, 0, 0, f"{name}_b2d")
+    b3 = model.pool2d(t, 3, 3, 2, 2, 0, 0, name=f"{name}_pool")
+    return model.concat([b1, b2, b3], axis=1, name=f"{name}_cat")
+
+
+def _inception_e(model, t, name):
+    b1 = _conv_bn(model, t, 320, 1, 1, 1, 1, 0, 0, f"{name}_b1")
+    b2 = _conv_bn(model, t, 384, 1, 1, 1, 1, 0, 0, f"{name}_b2a")
+    b2a = _conv_bn(model, b2, 384, 1, 3, 1, 1, 0, 1, f"{name}_b2b")
+    b2b = _conv_bn(model, b2, 384, 3, 1, 1, 1, 1, 0, f"{name}_b2c")
+    b2 = model.concat([b2a, b2b], axis=1, name=f"{name}_b2cat")
+    b3 = _conv_bn(model, t, 448, 1, 1, 1, 1, 0, 0, f"{name}_b3a")
+    b3 = _conv_bn(model, b3, 384, 3, 3, 1, 1, 1, 1, f"{name}_b3b")
+    b3a = _conv_bn(model, b3, 384, 1, 3, 1, 1, 0, 1, f"{name}_b3c")
+    b3b = _conv_bn(model, b3, 384, 3, 1, 1, 1, 1, 0, f"{name}_b3d")
+    b3 = model.concat([b3a, b3b], axis=1, name=f"{name}_b3cat")
+    b4 = model.pool2d(t, 3, 3, 1, 1, 1, 1, pool_type="avg",
+                      name=f"{name}_pool")
+    b4 = _conv_bn(model, b4, 192, 1, 1, 1, 1, 0, 0, f"{name}_b4")
+    return model.concat([b1, b2, b3, b4], axis=1, name=f"{name}_cat")
+
+
+def build_inception_v3(model: FFModel, num_classes: int = 1000,
+                       image_hw: int = 299):
+    batch = model.config.batch_size
+    x = model.create_tensor((batch, 3, image_hw, image_hw), name="image")
+    t = _conv_bn(model, x, 32, 3, 3, 2, 2, 0, 0, "stem1")
+    t = _conv_bn(model, t, 32, 3, 3, 1, 1, 0, 0, "stem2")
+    t = _conv_bn(model, t, 64, 3, 3, 1, 1, 1, 1, "stem3")
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0, name="stem_pool1")
+    t = _conv_bn(model, t, 80, 1, 1, 1, 1, 0, 0, "stem4")
+    t = _conv_bn(model, t, 192, 3, 3, 1, 1, 0, 0, "stem5")
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0, name="stem_pool2")
+    t = _inception_a(model, t, 32, "mix0")
+    t = _inception_a(model, t, 64, "mix1")
+    t = _inception_a(model, t, 64, "mix2")
+    t = _inception_b(model, t, "mix3")
+    t = _inception_c(model, t, 128, "mix4")
+    t = _inception_c(model, t, 160, "mix5")
+    t = _inception_c(model, t, 160, "mix6")
+    t = _inception_c(model, t, 192, "mix7")
+    t = _inception_d(model, t, "mix8")
+    t = _inception_e(model, t, "mix9")
+    t = _inception_e(model, t, "mix10")
+    hw = t.shape[2]
+    t = model.pool2d(t, hw, hw, 1, 1, 0, 0, pool_type="avg", name="gap")
+    t = model.flat(t, name="flat")
+    t = model.dense(t, num_classes, name="fc")
+    out = model.softmax(t, name="prob")
+    return {"image": (batch, 3, image_hw, image_hw)}, out

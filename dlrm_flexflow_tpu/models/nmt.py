@@ -1,0 +1,54 @@
+"""NMT LSTM seq2seq (reference: nmt/ — a self-contained pre-FFModel Legion
+RNN framework, ~3,650 LoC: RnnModel with per-cell ParallelConfig placement,
+SharedVariable parameter-server sync, cuDNN LSTM cells, data-parallel
+softmax; nmt/nmt.cc:32-77).
+
+Here NMT is just a model on the unified framework (SURVEY.md §7 step 8:
+"as a model on the new framework, not a second runtime"): reversed source
+(the reference Reverse op's use case) → embedding → stacked encoder LSTMs →
+stacked decoder LSTMs over target embeddings conditioned by concatenating
+the encoder's final-layer outputs (Luong-style simplified) → per-position
+dense softmax. The reference's per-(layer, seq-chunk) device placement
+becomes batch/hidden sharding configs on the LSTM ops."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from ..core.model import FFModel
+
+
+def build_nmt(model: FFModel, src_vocab: int = 32 * 1024,
+              tgt_vocab: int = 32 * 1024, embed_dim: int = 1024,
+              hidden: int = 1024, num_layers: int = 2,
+              src_len: int = 40, tgt_len: int = 40):
+    """Shapes default to the reference scale (nmt/rnn.h: LSTM_PER_NODE_LENGTH
+    chunks over seq len up to 40, 1024-wide cells, 32k vocab)."""
+    batch = model.config.batch_size
+    src = model.create_tensor((batch, src_len), dtype=jnp.int32, name="src")
+    tgt = model.create_tensor((batch, tgt_len), dtype=jnp.int32, name="tgt")
+
+    rsrc = model.reverse(src, axis=1, name="src_rev")
+    senc = model.embedding(rsrc, src_vocab, embed_dim, aggr="none",
+                           name="src_embed")  # (b, s, e)
+    t = senc
+    for i in range(num_layers):
+        t = model.lstm(t, hidden, name=f"enc_lstm{i}")
+    enc_out = t  # (b, s, h)
+
+    demb = model.embedding(tgt, tgt_vocab, embed_dim, aggr="none",
+                           name="tgt_embed")
+    # condition decoder on encoder: concat encoder outputs (aligned by
+    # position, truncated/padded lengths equal here) with target embeddings
+    if src_len != tgt_len:
+        raise ValueError("this NMT build uses src_len == tgt_len")
+    d = model.concat([demb, enc_out], axis=2, name="dec_in")
+    for i in range(num_layers):
+        d = model.lstm(d, hidden, name=f"dec_lstm{i}")
+    # per-position logits: fold seq into batch for the big projection
+    d2 = model.reshape(d, (batch * tgt_len, hidden), name="dec_fold")
+    logits = model.dense(d2, tgt_vocab, name="proj")
+    probs = model.softmax(logits, name="prob")
+    return {"src": (batch, src_len), "tgt": (batch, tgt_len)}, probs

@@ -1,0 +1,76 @@
+"""ResNet (reference: examples/cpp/ResNet/resnet.cc ~400 LoC — bottleneck
+blocks with BatchNorm and residual adds). Builders for ResNet-18/34
+(basic blocks) and ResNet-50 (bottleneck), NCHW."""
+
+from __future__ import annotations
+
+from ..core.model import FFModel
+
+
+def _basic_block(model, t, channels, stride, prefix):
+    shortcut = t
+    u = model.conv2d(t, channels, 3, 3, stride, stride, 1, 1, use_bias=False,
+                     name=f"{prefix}_conv1")
+    u = model.batch_norm(u, relu=True, name=f"{prefix}_bn1")
+    u = model.conv2d(u, channels, 3, 3, 1, 1, 1, 1, use_bias=False,
+                     name=f"{prefix}_conv2")
+    u = model.batch_norm(u, relu=False, name=f"{prefix}_bn2")
+    if stride != 1 or shortcut.shape[1] != channels:
+        shortcut = model.conv2d(shortcut, channels, 1, 1, stride, stride,
+                                0, 0, use_bias=False, name=f"{prefix}_proj")
+        shortcut = model.batch_norm(shortcut, relu=False,
+                                    name=f"{prefix}_projbn")
+    u = model.add(u, shortcut, name=f"{prefix}_add")
+    return model.relu(u, name=f"{prefix}_out")
+
+
+def _bottleneck(model, t, channels, stride, prefix):
+    """reference resnet.cc BottleneckBlock: 1x1 reduce, 3x3, 1x1 expand x4."""
+    shortcut = t
+    u = model.conv2d(t, channels, 1, 1, 1, 1, 0, 0, use_bias=False,
+                     name=f"{prefix}_conv1")
+    u = model.batch_norm(u, relu=True, name=f"{prefix}_bn1")
+    u = model.conv2d(u, channels, 3, 3, stride, stride, 1, 1, use_bias=False,
+                     name=f"{prefix}_conv2")
+    u = model.batch_norm(u, relu=True, name=f"{prefix}_bn2")
+    u = model.conv2d(u, 4 * channels, 1, 1, 1, 1, 0, 0, use_bias=False,
+                     name=f"{prefix}_conv3")
+    u = model.batch_norm(u, relu=False, name=f"{prefix}_bn3")
+    if stride != 1 or shortcut.shape[1] != 4 * channels:
+        shortcut = model.conv2d(shortcut, 4 * channels, 1, 1, stride, stride,
+                                0, 0, use_bias=False, name=f"{prefix}_proj")
+        shortcut = model.batch_norm(shortcut, relu=False,
+                                    name=f"{prefix}_projbn")
+    u = model.add(u, shortcut, name=f"{prefix}_add")
+    return model.relu(u, name=f"{prefix}_out")
+
+
+_CONFIGS = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+}
+
+
+def build_resnet(model: FFModel, depth: int = 50, num_classes: int = 1000,
+                 image_hw: int = 224):
+    kind, blocks = _CONFIGS[depth]
+    block = _basic_block if kind == "basic" else _bottleneck
+    batch = model.config.batch_size
+    x = model.create_tensor((batch, 3, image_hw, image_hw), name="image")
+    t = model.conv2d(x, 64, 7, 7, 2, 2, 3, 3, use_bias=False, name="conv1")
+    t = model.batch_norm(t, relu=True, name="bn1")
+    t = model.pool2d(t, 3, 3, 2, 2, 1, 1, name="pool1")
+    channels = 64
+    for stage, n in enumerate(blocks):
+        for i in range(n):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            t = block(model, t, channels, stride, f"s{stage}b{i}")
+        channels *= 2
+    hw = t.shape[2]
+    t = model.pool2d(t, hw, hw, 1, 1, 0, 0, pool_type="avg", name="gap")
+    t = model.flat(t, name="flat")
+    t = model.dense(t, num_classes, name="fc")
+    out = model.softmax(t, name="prob")
+    return {"image": (batch, 3, image_hw, image_hw)}, out

@@ -1,0 +1,102 @@
+"""Recurrent ops: LSTM layer.
+
+Parity with the reference NMT mini-framework's LSTM (reference: nmt/lstm.cu,
+574 LoC — cuDNN RNN kernels; one op per (layer, word-position) chunk of
+LSTM_PER_NODE_LENGTH=10 cells, nmt/rnn.h:23,58-63, placed per-cell by a
+hand-written GlobalConfig table).
+
+TPU-native redesign: the whole sequence is ONE op whose time loop is a
+`lax.scan` — XLA unrolls nothing, compiles one cell and iterates, keeping
+the (batch, 4*hidden) gate matmuls on the MXU. The reference's per-cell
+device placement (its only sequence-scaling trick) is subsumed by batch/
+hidden sharding; hidden-state TP shards the gate matmul columns. The
+sequence dim itself must stay unpartitioned for the scan (degrees[1] == 1);
+long-sequence scaling on TPU is the job of sequence-parallel attention
+(ops/attention.py), not RNN chunking.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.initializers import DEFAULT_KERNEL_INIT, ZeroInitializer
+from ..core.op import Op, ParamDef
+from ..parallel.pconfig import ParallelConfig
+
+
+class LSTM(Op):
+    """input (batch, seq, in_dim) -> output (batch, seq, hidden) and the
+    final hidden state is discarded (sequence-to-sequence layer form).
+    Gate order i,f,g,o (torch convention, for golden tests)."""
+
+    type_name = "LSTM"
+
+    def __init__(self, model, input_tensor, hidden: int,
+                 name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        if input_tensor.num_dims != 3:
+            raise ValueError("LSTM expects (batch, seq, in_dim)")
+        b, s, d = input_tensor.shape
+        self.in_dim = d
+        self.hidden = int(hidden)
+        self.outputs = [self._make_output((b, s, self.hidden))]
+
+    def param_defs(self) -> Dict[str, ParamDef]:
+        h, d = self.hidden, self.in_dim
+        return {
+            "wx": ParamDef((d, 4 * h), jnp.float32, DEFAULT_KERNEL_INIT()),
+            "wh": ParamDef((h, 4 * h), jnp.float32, DEFAULT_KERNEL_INIT()),
+            "bias": ParamDef((4 * h,), jnp.float32, ZeroInitializer()),
+        }
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        (x,) = xs  # (b, s, d)
+        cdt = self.model.compute_dtype
+        h = self.hidden
+        wx, wh, bias = params["wx"], params["wh"], params["bias"]
+        # precompute input projections for the whole sequence in one big
+        # MXU matmul, then scan only the recurrent part
+        xproj = jnp.einsum("bsd,dk->bsk", x.astype(cdt), wx.astype(cdt),
+                           preferred_element_type=jnp.float32) + bias
+        b = x.shape[0]
+        h0 = jnp.zeros((b, h), jnp.float32)
+        c0 = jnp.zeros((b, h), jnp.float32)
+
+        def cell(carry, xp):
+            hprev, cprev = carry
+            gates = xp + jnp.dot(hprev.astype(cdt), wh.astype(cdt),
+                                 preferred_element_type=jnp.float32)
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c = f * cprev + i * g
+            hcur = o * jnp.tanh(c)
+            return (hcur, c), hcur
+
+        (_, _), hs = lax.scan(cell, (h0, c0),
+                              jnp.swapaxes(xproj, 0, 1))  # (s, b, h)
+        return [jnp.swapaxes(hs, 0, 1).astype(x.dtype)]
+
+    def candidate_parallel_configs(self, num_devices, feasible_degrees):
+        # batch DP x hidden TP; seq dim must stay whole for the scan
+        out = []
+        for ds in feasible_degrees:
+            for dh in feasible_degrees:
+                if ds * dh <= num_devices and self.hidden % max(dh, 1) == 0:
+                    out.append(ParallelConfig((ds, 1, dh)))
+        return out
+
+    def param_axes(self, pc: ParallelConfig, out_axes):
+        ch = out_axes[2] if len(out_axes) >= 3 else ()
+        # gate matrices are (.., 4h): sharding 4h on the hidden axes keeps
+        # each device's gate slice local (i/f/g/o interleave is fine since
+        # split(4) is along the same sharded dim)
+        return {"wx": ((), ch), "wh": ((), ch), "bias": (ch,)}
+
+    def flops_per_sample(self) -> float:
+        s = self.inputs[0].shape[1]
+        return 2.0 * s * 4 * self.hidden * (self.in_dim + self.hidden)
