@@ -174,6 +174,15 @@ class FFModel:
         from ..ops.elementwise import Dropout
         return Dropout(self, input_tensor, rate, seed, name).outputs[0]
 
+    def multihead_attention(self, q, k=None, v=None, embed_dim=None,
+                            num_heads=8, causal=False, name=None):
+        from ..ops.attention import MultiHeadAttention
+        k = q if k is None else k
+        v = q if v is None else v
+        embed_dim = embed_dim or q.shape[-1]
+        return MultiHeadAttention(self, q, k, v, embed_dim, num_heads,
+                                  causal, name).outputs[0]
+
     def lstm(self, input_tensor, hidden, name=None):
         from ..ops.rnn import LSTM
         return LSTM(self, input_tensor, hidden, name).outputs[0]
@@ -338,6 +347,10 @@ class FFModel:
                 out_axes = asn.assign(pc.degrees)
             self._op_pc = getattr(self, "_op_pc", {})
             self._op_pc[op.name] = pc
+            # ops that implement their own collectives (ring attention)
+            # need the resolved config + the mesh axes of their seq dim
+            op._compiled_pc = pc
+            op._seq_axes = tuple(out_axes[1]) if len(out_axes) > 1 else ()
             for t in op.outputs:
                 degs = pc.degrees[:t.num_dims]
                 axes = out_axes[:t.num_dims]

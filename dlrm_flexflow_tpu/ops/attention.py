@@ -1,0 +1,206 @@
+"""Multi-head attention with sequence/context parallelism.
+
+The reference has NO attention ops and no intra-op sequence parallelism
+(reference survey §5.7: nmt/rnn.h:23,58-63 only statically partitions the
+LSTM grid). This op is the designed-in TPU upgrade: long-context scaling via
+
+- **ring attention** (seq-dim sharding, degrees[1] > 1): each device keeps
+  its Q block and passes K/V blocks around the ICI ring with
+  `lax.ppermute` under `shard_map`, accumulating with an online-softmax
+  (flash-style, fp32 running max/sum) — seq length scales linearly with
+  devices, memory per device stays O(seq/p).
+- **head parallelism** (model-dim sharding, degrees[2] > 1): QKV/output
+  projections column/row-sharded Megatron-style; GSPMD inserts the psum.
+- plain DP (degrees[0]) composes with both.
+
+Self-attention: pass the same tensor as q, k, v.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.initializers import DEFAULT_KERNEL_INIT, ZeroInitializer
+from ..core.op import Op, ParamDef
+from ..parallel.pconfig import ParallelConfig
+
+
+def _online_softmax_block(q, k, v, m_prev, num_prev, den_prev, mask):
+    """One K/V block of flash-style attention. q:(b,h,sq,hd) k/v:(b,h,sk,hd);
+    m/num/den are fp32 running stats. mask:(sq,sk) additive (0 or -inf)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32)
+    s = s * (1.0 / math.sqrt(q.shape[-1])) + mask
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows (m == -inf): exp(-inf - -inf) -> use 0
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    scale = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
+    num = num_prev * scale[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
+    den = den_prev * scale + jnp.sum(p, axis=-1)
+    return m_new, num, den
+
+
+def _attention_local(q, k, v, causal, q_offset=0, k_offset=0):
+    """Dense attention on local blocks (single shard or within-block)."""
+    b, h, sq, hd = q.shape
+    sk = k.shape[2]
+    if causal:
+        qpos = q_offset + jnp.arange(sq)[:, None]
+        kpos = k_offset + jnp.arange(sk)[None, :]
+        mask = jnp.where(kpos <= qpos, 0.0, -jnp.inf).astype(jnp.float32)
+    else:
+        mask = jnp.zeros((sq, sk), jnp.float32)
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    num0 = jnp.zeros((b, h, sq, hd), jnp.float32)
+    den0 = jnp.zeros((b, h, sq), jnp.float32)
+    m, num, den = _online_softmax_block(q, k, v, m0, num0, den0, mask)
+    return num / jnp.maximum(den, 1e-20)[..., None]
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool):
+    """Blockwise ring attention under shard_map: q/k/v are LOCAL blocks
+    (b, h, s_local, hd); K/V rotate around `axis_name` via ppermute."""
+    p = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, sl, hd = q.shape
+
+    m = jnp.full((b, h, sl), -jnp.inf, jnp.float32)
+    num = jnp.zeros((b, h, sl, hd), jnp.float32)
+    den = jnp.zeros((b, h, sl), jnp.float32)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def body(r, carry):
+        m, num, den, kr, vr = carry
+        # the K/V block currently held came from device (idx - r) mod p
+        src = (idx - r) % p
+        if causal:
+            qpos = idx * sl + jnp.arange(sl)[:, None]
+            kpos = src * sl + jnp.arange(sl)[None, :]
+            mask = jnp.where(kpos <= qpos, 0.0, -jnp.inf).astype(jnp.float32)
+        else:
+            mask = jnp.zeros((sl, sl), jnp.float32)
+        m, num, den = _online_softmax_block(q, kr, vr, m, num, den, mask)
+        kr = lax.ppermute(kr, axis_name, perm)
+        vr = lax.ppermute(vr, axis_name, perm)
+        return m, num, den, kr, vr
+
+    m, num, den, _, _ = lax.fori_loop(0, p, body, (m, num, den, k, v))
+    return (num / jnp.maximum(den, 1e-20)[..., None]).astype(q.dtype)
+
+
+class MultiHeadAttention(Op):
+    type_name = "MultiHeadAttention"
+
+    def __init__(self, model, q, k, v, embed_dim: int, num_heads: int,
+                 causal: bool = False, name: Optional[str] = None):
+        if q.num_dims != 3:
+            raise ValueError("attention expects (batch, seq, dim) inputs")
+        if embed_dim % num_heads != 0:
+            raise ValueError("embed_dim must divide num_heads")
+        inputs = [q] if (k is q and v is q) else [q, k, v]
+        super().__init__(model, inputs, name)
+        self.self_attention = len(inputs) == 1
+        self.embed_dim = int(embed_dim)
+        self.num_heads = int(num_heads)
+        self.head_dim = self.embed_dim // self.num_heads
+        self.causal = bool(causal)
+        b, s, _ = q.shape
+        self.outputs = [self._make_output((b, s, self.embed_dim))]
+
+    def param_defs(self) -> Dict[str, ParamDef]:
+        dq = self.inputs[0].shape[-1]
+        dkv = self.inputs[-1].shape[-1]
+        e = self.embed_dim
+        init = DEFAULT_KERNEL_INIT()
+        return {
+            "wq": ParamDef((dq, e), jnp.float32, init),
+            "wk": ParamDef((dkv, e), jnp.float32, init),
+            "wv": ParamDef((dkv, e), jnp.float32, init),
+            "wo": ParamDef((e, e), jnp.float32, init),
+            "bo": ParamDef((e,), jnp.float32, ZeroInitializer()),
+        }
+
+    def _split_heads(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.num_heads, self.head_dim).transpose(
+            0, 2, 1, 3)
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        q_in = xs[0]
+        k_in = xs[0] if self.self_attention else xs[1]
+        v_in = xs[0] if self.self_attention else xs[2]
+        cdt = self.model.compute_dtype
+        pe = jnp.float32
+
+        def proj(x, w):
+            return jnp.einsum("bsd,de->bse", x.astype(cdt), w.astype(cdt),
+                              preferred_element_type=pe).astype(cdt)
+
+        q = self._split_heads(proj(q_in, params["wq"]))
+        k = self._split_heads(proj(k_in, params["wk"]))
+        v = self._split_heads(proj(v_in, params["wv"]))
+
+        pc = getattr(self, "_compiled_pc", None)
+        seq_axes = ()
+        if pc is not None and len(pc.degrees) >= 2 and pc.degrees[1] > 1:
+            seq_axes = getattr(self, "_seq_axes", ())
+
+        if seq_axes:
+            # ring attention over the seq-dim mesh axes
+            mesh = self.model.mesh
+            from jax.sharding import PartitionSpec as P
+            axis = seq_axes if len(seq_axes) > 1 else seq_axes[0]
+            spec = P(None, None, axis, None)
+            fn = partial(ring_attention,
+                         axis_name=seq_axes if len(seq_axes) > 1 else seq_axes[0],
+                         causal=self.causal)
+            attn = jax.shard_map(fn, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec, check_vma=False)(q, k, v)
+        else:
+            attn = _attention_local(q, k, v, self.causal).astype(q.dtype)
+
+        b, h, s, hd = attn.shape
+        merged = attn.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+        out = jnp.einsum("bse,ef->bsf", merged.astype(cdt),
+                         params["wo"].astype(cdt),
+                         preferred_element_type=pe) + params["bo"]
+        return [out.astype(q_in.dtype)]
+
+    def candidate_parallel_configs(self, num_devices, feasible_degrees):
+        out = []
+        b, s, _ = self.outputs[0].shape
+        for ds in feasible_degrees:
+            if ds <= num_devices:
+                out.append(ParallelConfig((ds, 1, 1)))          # DP
+        for dseq in feasible_degrees:
+            if 1 < dseq <= num_devices and s % dseq == 0:
+                out.append(ParallelConfig((1, dseq, 1)))        # ring SP
+        for dh in feasible_degrees:
+            if 1 < dh <= num_devices and self.num_heads % dh == 0:
+                out.append(ParallelConfig((1, 1, dh)))          # head TP
+        return out
+
+    def param_axes(self, pc: ParallelConfig, out_axes):
+        ch = out_axes[2] if len(out_axes) >= 3 else ()
+        # head TP: qkv projections column-sharded, wo row-sharded (psum by
+        # GSPMD); bo replicated-ish (sharded on ch like bias)
+        return {"wq": ((), ch), "wk": ((), ch), "wv": ((), ch),
+                "wo": (ch, ()), "bo": ((),)}
+
+    def flops_per_sample(self) -> float:
+        _, s, _ = self.outputs[0].shape
+        e = self.embed_dim
+        # per sample: 4 projections (2*s*e*e each) + QK^T and PV (2*s^2*e each)
+        return 8.0 * s * e * e + 4.0 * s * s * e
