@@ -1,0 +1,27 @@
+"""Keras-compatible frontend.
+
+Parity with the reference Keras compatibility layer (reference:
+python/flexflow/keras — Model/Sequential graph capture translated to
+FFModel add_* calls in `_create_flexflow_layers` (models/base_model.py:
+446-501), fit() training loop with Legion tracing (base_model.py:367-431),
+layers Dense/Conv2D/Pooling/Flatten/Embedding/Concatenate/Add/Activation/
+Dropout/BatchNormalization, optimizers, losses, metrics, callbacks incl.
+the accuracy early-stop hook at base_model.py:416-421).
+
+Graph capture works on batch-less symbolic tensors; the FFModel (with its
+static batch size) is materialized at fit()/compile-time, exactly like the
+reference's deferred translation.
+"""
+
+from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
+                     Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,
+                     Input, MaxPooling2D, Multiply, Subtract)
+from .models import Model, Sequential
+from .callbacks import Callback, EarlyStopping, VerifyMetrics
+from .optimizers import SGD, Adam
+
+__all__ = ["Input", "Dense", "Conv2D", "MaxPooling2D", "AveragePooling2D",
+           "Flatten", "Embedding", "Concatenate", "Add", "Subtract",
+           "Multiply", "Activation", "Dropout", "BatchNormalization",
+           "Model", "Sequential", "Callback", "EarlyStopping",
+           "VerifyMetrics", "SGD", "Adam"]

@@ -1,0 +1,60 @@
+"""Keras callbacks (reference: python/flexflow/keras/callbacks.py and the
+accuracy early-stop hook in base_model.py:416-421)."""
+
+from __future__ import annotations
+
+
+class Callback:
+    stop_training = False
+
+    def on_epoch_end(self, model, epoch: int, metrics: dict):
+        pass
+
+
+class EarlyStopping(Callback):
+    """Stop when a monitored metric stops improving (min_delta/patience),
+    like keras; the reference's built-in hook stops when accuracy crosses a
+    threshold — see VerifyMetrics."""
+
+    def __init__(self, monitor="accuracy", min_delta=0.0, patience=0,
+                 mode="auto"):
+        self.monitor = monitor
+        self.min_delta = float(min_delta)
+        self.patience = int(patience)
+        self.best = None
+        self.wait = 0
+        self.mode = mode
+
+    def _better(self, cur, best):
+        if self.mode == "min" or (self.mode == "auto"
+                                  and "loss" in self.monitor):
+            return cur < best - self.min_delta
+        return cur > best + self.min_delta
+
+    def on_epoch_end(self, model, epoch, metrics):
+        cur = metrics.get(self.monitor)
+        if cur is None:
+            return
+        if self.best is None or self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stop_training = True
+
+
+class VerifyMetrics(Callback):
+    """reference base_model.py:416-421: stop (successfully) once accuracy
+    reaches a threshold; raise if training finished below it (the examples'
+    accuracy assertion, examples/python/keras/accuracy.py)."""
+
+    def __init__(self, metric="accuracy", threshold=0.9):
+        self.metric = metric
+        self.threshold = float(threshold)
+        self.reached = False
+
+    def on_epoch_end(self, model, epoch, metrics):
+        if metrics.get(self.metric, 0.0) >= self.threshold:
+            self.reached = True
+            self.stop_training = True

@@ -1,0 +1,257 @@
+"""Keras layers: symbolic graph capture, translated to FFModel ops at fit().
+
+Reference: python/flexflow/keras/layers/* (Dense core.py, Conv2D/pooling
+convolutional.py, Embedding embeddings.py, merge.py, normalization.py).
+Shapes are batch-less (batch prepended at materialization, like keras).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Tuple
+
+_ktensor_ids = itertools.count()
+
+
+class KTensor:
+    """Symbolic batch-less tensor: shape excludes the batch dim."""
+
+    def __init__(self, shape: Tuple[int, ...], layer=None, dtype="float32"):
+        self.shape = tuple(int(s) for s in shape)
+        self.layer = layer          # producing layer (None for inputs)
+        self.dtype = dtype
+        self.tid = next(_ktensor_ids)
+
+
+def Input(shape, dtype="float32"):
+    """keras.Input (reference: keras input_layer)."""
+    return KTensor(tuple(shape), None, dtype)
+
+
+class Layer:
+    _counters = {}
+
+    def __init__(self, name: Optional[str] = None):
+        cls = type(self).__name__.lower()
+        if name is None:
+            n = Layer._counters.get(cls, 0)
+            Layer._counters[cls] = n + 1
+            name = f"{cls}_{n}" if n else cls
+        self.name = name
+        self.input_tensors: List[KTensor] = []
+        self.output: Optional[KTensor] = None
+
+    def __call__(self, inputs):
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.input_tensors = list(ins)
+        out_shape, dtype = self.compute_output(ins)
+        self.output = KTensor(out_shape, self, dtype)
+        return self.output
+
+    def compute_output(self, ins):
+        raise NotImplementedError
+
+    def materialize(self, model, ff_inputs):
+        """Emit FFModel op(s); ff_inputs are the materialized input
+        Tensors."""
+        raise NotImplementedError
+
+
+def _norm_pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class Dense(Layer):
+    def __init__(self, units, activation=None, use_bias=True, name=None,
+                 kernel_initializer=None, bias_initializer=None):
+        super().__init__(name)
+        self.units = int(units)
+        self.activation = activation
+        self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer
+
+    def compute_output(self, ins):
+        return ins[0].shape[:-1] + (self.units,), ins[0].dtype
+
+    def materialize(self, model, ff_inputs):
+        return model.dense(ff_inputs[0], self.units,
+                           activation=self.activation,
+                           use_bias=self.use_bias,
+                           kernel_initializer=self.kernel_initializer,
+                           bias_initializer=self.bias_initializer,
+                           name=self.name)
+
+
+class Conv2D(Layer):
+    """NCHW like the reference keras layer (channels_first)."""
+
+    def __init__(self, filters, kernel_size, strides=(1, 1),
+                 padding="valid", activation=None, use_bias=True, name=None):
+        super().__init__(name)
+        self.filters = int(filters)
+        self.kernel = _norm_pair(kernel_size)
+        self.strides = _norm_pair(strides)
+        self.padding = padding
+        self.activation = activation
+        self.use_bias = use_bias
+
+    def _pads(self):
+        if self.padding == "same":
+            return (self.kernel[0] // 2, self.kernel[1] // 2)
+        if self.padding == "valid":
+            return (0, 0)
+        return _norm_pair(self.padding)
+
+    def compute_output(self, ins):
+        c, h, w = ins[0].shape
+        ph, pw = self._pads()
+        oh = (h + 2 * ph - self.kernel[0]) // self.strides[0] + 1
+        ow = (w + 2 * pw - self.kernel[1]) // self.strides[1] + 1
+        return (self.filters, oh, ow), ins[0].dtype
+
+    def materialize(self, model, ff_inputs):
+        ph, pw = self._pads()
+        return model.conv2d(ff_inputs[0], self.filters, *self.kernel,
+                            *self.strides, ph, pw,
+                            activation=self.activation,
+                            use_bias=self.use_bias, name=self.name)
+
+
+class _Pool2D(Layer):
+    pool_type = "max"
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding="valid",
+                 name=None):
+        super().__init__(name)
+        self.pool = _norm_pair(pool_size)
+        self.strides = _norm_pair(strides) if strides else self.pool
+        self.padding = padding
+
+    def _pads(self):
+        if self.padding == "same":
+            return (self.pool[0] // 2, self.pool[1] // 2)
+        return (0, 0)
+
+    def compute_output(self, ins):
+        c, h, w = ins[0].shape
+        ph, pw = self._pads()
+        oh = (h + 2 * ph - self.pool[0]) // self.strides[0] + 1
+        ow = (w + 2 * pw - self.pool[1]) // self.strides[1] + 1
+        return (c, oh, ow), ins[0].dtype
+
+    def materialize(self, model, ff_inputs):
+        ph, pw = self._pads()
+        return model.pool2d(ff_inputs[0], *self.pool, *self.strides, ph, pw,
+                            pool_type=self.pool_type, name=self.name)
+
+
+class MaxPooling2D(_Pool2D):
+    pool_type = "max"
+
+
+class AveragePooling2D(_Pool2D):
+    pool_type = "avg"
+
+
+class Flatten(Layer):
+    def compute_output(self, ins):
+        n = 1
+        for s in ins[0].shape:
+            n *= s
+        return (n,), ins[0].dtype
+
+    def materialize(self, model, ff_inputs):
+        return model.flat(ff_inputs[0], name=self.name)
+
+
+class Embedding(Layer):
+    def __init__(self, input_dim, output_dim, name=None):
+        super().__init__(name)
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+
+    def compute_output(self, ins):
+        return ins[0].shape + (self.output_dim,), "float32"
+
+    def materialize(self, model, ff_inputs):
+        return model.embedding(ff_inputs[0], self.input_dim,
+                               self.output_dim, aggr="none", name=self.name)
+
+
+class Concatenate(Layer):
+    def __init__(self, axis=1, name=None):
+        super().__init__(name)
+        self.axis = axis  # axis counts the batch dim, keras-style
+
+    def compute_output(self, ins):
+        ax = self.axis - 1 if self.axis > 0 else len(ins[0].shape) + self.axis
+        shape = list(ins[0].shape)
+        shape[ax] = sum(t.shape[ax] for t in ins)
+        return tuple(shape), ins[0].dtype
+
+    def materialize(self, model, ff_inputs):
+        return model.concat(ff_inputs, axis=self.axis, name=self.name)
+
+
+class _Merge(Layer):
+    op = "add"
+
+    def compute_output(self, ins):
+        return ins[0].shape, ins[0].dtype
+
+    def materialize(self, model, ff_inputs):
+        return getattr(model, self.op)(ff_inputs[0], ff_inputs[1],
+                                       name=self.name)
+
+
+class Add(_Merge):
+    op = "add"
+
+
+class Subtract(_Merge):
+    op = "subtract"
+
+
+class Multiply(_Merge):
+    op = "multiply"
+
+
+class Activation(Layer):
+    def __init__(self, activation, name=None):
+        super().__init__(name)
+        self.activation = activation
+
+    def compute_output(self, ins):
+        return ins[0].shape, ins[0].dtype
+
+    def materialize(self, model, ff_inputs):
+        if self.activation == "softmax":
+            return model.softmax(ff_inputs[0], name=self.name)
+        return model._unary(self.activation, ff_inputs[0], name=self.name)
+
+
+class Dropout(Layer):
+    def __init__(self, rate, seed=0, name=None):
+        super().__init__(name)
+        self.rate = float(rate)
+        self.seed = seed
+
+    def compute_output(self, ins):
+        return ins[0].shape, ins[0].dtype
+
+    def materialize(self, model, ff_inputs):
+        return model.dropout(ff_inputs[0], self.rate, self.seed,
+                             name=self.name)
+
+
+class BatchNormalization(Layer):
+    def __init__(self, relu=False, name=None):
+        super().__init__(name)
+        self.relu = relu
+
+    def compute_output(self, ins):
+        return ins[0].shape, ins[0].dtype
+
+    def materialize(self, model, ff_inputs):
+        return model.batch_norm(ff_inputs[0], relu=self.relu, name=self.name)

@@ -1,0 +1,182 @@
+"""Keras Model/Sequential (reference: python/flexflow/keras/models/
+base_model.py — graph translation at 446-501, fit loop at 367-431 with the
+early-stop accuracy hook at 416-421, throughput print at 427)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..config import FFConfig
+from ..core.model import FFModel
+from .layers import Input, KTensor, Layer
+from .optimizers import _resolve_optimizer
+
+
+class Model:
+    """Functional-API model over symbolic KTensors."""
+
+    def __init__(self, inputs, outputs, name: Optional[str] = None):
+        self.inputs: List[KTensor] = (inputs if isinstance(inputs, list)
+                                      else [inputs])
+        self.output: KTensor = outputs if not isinstance(outputs, list) \
+            else outputs[0]
+        self.name = name or "model"
+        self.optimizer = None
+        self.loss = None
+        self.metrics: List[str] = []
+        self.ffmodel: Optional[FFModel] = None
+
+    # -- keras API ------------------------------------------------------
+    def compile(self, optimizer="sgd", loss="mean_squared_error",
+                metrics=None):
+        self.optimizer = optimizer
+        self.loss = loss
+        self.metrics = metrics or ["mean_squared_error"]
+
+    def _topo_layers(self) -> List[Layer]:
+        order: List[Layer] = []
+        seen = set()
+
+        def visit(t: KTensor):
+            if t.layer is None or id(t.layer) in seen:
+                return
+            seen.add(id(t.layer))
+            for src in t.layer.input_tensors:
+                visit(src)
+            order.append(t.layer)
+
+        visit(self.output)
+        return order
+
+    def _materialize(self, batch_size: int, seed: int = 0) -> FFModel:
+        """reference _create_flexflow_layers: keras graph -> FFModel ops."""
+        cfg = FFConfig(batch_size=batch_size, seed=seed)
+        ff = FFModel(cfg)
+        tmap: Dict[int, object] = {}
+        for i, kt in enumerate(self.inputs):
+            dtype = jnp.int32 if kt.dtype in ("int32", "int64") else jnp.float32
+            tmap[kt.tid] = ff.create_tensor((batch_size,) + kt.shape,
+                                            dtype=dtype, name=f"input_{i}")
+        for layer in self._topo_layers():
+            ins = [tmap[t.tid] for t in layer.input_tensors]
+            tmap[layer.output.tid] = layer.materialize(ff, ins)
+        self.ffmodel = ff
+        self._ff_out = tmap[self.output.tid]
+        return ff
+
+    def fit(self, x, y, batch_size: int = 64, epochs: int = 1,
+            callbacks=None, verbose: bool = True, seed: int = 0):
+        xs = x if isinstance(x, list) else [x]
+        if len(xs) != len(self.inputs):
+            raise ValueError(f"model has {len(self.inputs)} inputs, got "
+                             f"{len(xs)} arrays")
+        ff = self._materialize(batch_size, seed)
+        ff.compile(_resolve_optimizer(self.optimizer), self.loss,
+                   self.metrics, final_tensor=self._ff_out)
+        ff.init_layers()
+        inputs = {f"input_{i}": np.asarray(a) for i, a in enumerate(xs)}
+
+        stop = {"flag": False}
+        cbs = list(callbacks or [])
+
+        def on_epoch(model, epoch, report):
+            for cb in cbs:
+                if hasattr(cb, "on_epoch_end"):
+                    cb.on_epoch_end(self, epoch, report)
+                    if getattr(cb, "stop_training", False):
+                        stop["flag"] = True
+            if stop["flag"]:
+                raise _StopFit()
+
+        try:
+            result = ff.fit(inputs, np.asarray(y), epochs=epochs,
+                            batch_size=batch_size, verbose=verbose,
+                            callbacks=[on_epoch])
+        except _StopFit:
+            result = {"metrics": ff.perf.report()}
+        return result
+
+    def evaluate(self, x, y, batch_size: int = 64):
+        xs = x if isinstance(x, list) else [x]
+        if self.ffmodel is None:
+            ff = self._materialize(batch_size)
+            ff.compile(_resolve_optimizer(self.optimizer or "sgd"),
+                       self.loss or "mean_squared_error", self.metrics or
+                       ["mean_squared_error"], final_tensor=self._ff_out)
+            ff.init_layers()
+        preds = []
+        ff = self.ffmodel
+        n = len(np.asarray(y))
+        for b in range(n // batch_size):
+            sl = slice(b * batch_size, (b + 1) * batch_size)
+            batch = {f"input_{i}": np.asarray(a)[sl]
+                     for i, a in enumerate(xs)}
+            preds.append(np.asarray(ff.forward_batch(batch)))
+        return np.concatenate(preds, axis=0)
+
+    def predict(self, x, batch_size: int = 64):
+        xs = x if isinstance(x, list) else [x]
+        n = len(np.asarray(xs[0]))
+        return self.evaluate(xs, np.zeros((n, 1)), batch_size)
+
+    def summary(self) -> str:
+        lines = [f'Model: "{self.name}"']
+        for layer in self._topo_layers():
+            lines.append(f"  {layer.name:<28} out={layer.output.shape}")
+        return "\n".join(lines)
+
+
+class _StopFit(Exception):
+    pass
+
+
+class Sequential(Model):
+    """reference: keras Sequential — layers stacked on one input."""
+
+    def __init__(self, layers=None, name: Optional[str] = None):
+        self._layers: List[Layer] = []
+        self._input: Optional[KTensor] = None
+        self._out: Optional[KTensor] = None
+        self.name = name or "sequential"
+        self.optimizer = None
+        self.loss = None
+        self.metrics = []
+        self.ffmodel = None
+        for l in layers or []:
+            self.add(l)
+
+    def add(self, layer):
+        if self._input is None:
+            if isinstance(layer, KTensor):
+                self._input = layer
+                self._out = layer
+                return
+            if not hasattr(layer, "input_shape_arg") and \
+               not getattr(layer, "_first_input_shape", None):
+                pass
+        if self._input is None:
+            raise ValueError(
+                "Sequential needs an Input first: Sequential([Input(...), "
+                "Dense(...), ...]) or model.add(Input(shape))")
+        self._out = layer(self._out)
+        self._layers.append(layer)
+
+    @property
+    def inputs(self):
+        return [self._input]
+
+    @inputs.setter
+    def inputs(self, v):
+        pass
+
+    @property
+    def output(self):
+        return self._out
+
+    @output.setter
+    def output(self, v):
+        pass

@@ -1,0 +1,32 @@
+"""Keras optimizer shims (reference: python/flexflow/keras/optimizers.py)."""
+
+from __future__ import annotations
+
+from ..core.optimizers import AdamOptimizer, Optimizer, SGDOptimizer
+
+
+class SGD(SGDOptimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.0, nesterov=False,
+                 weight_decay=0.0):
+        super().__init__(lr=learning_rate, momentum=momentum,
+                         nesterov=nesterov, weight_decay=weight_decay)
+
+
+class Adam(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, beta_1=0.9, beta_2=0.999,
+                 epsilon=1e-8, weight_decay=0.0):
+        super().__init__(alpha=learning_rate, beta1=beta_1, beta2=beta_2,
+                         epsilon=epsilon, weight_decay=weight_decay)
+
+
+def _resolve_optimizer(opt) -> Optimizer:
+    if isinstance(opt, Optimizer):
+        return opt
+    if isinstance(opt, str):
+        name = opt.lower()
+        if name == "sgd":
+            return SGD()
+        if name == "adam":
+            return Adam()
+        raise ValueError(f"unknown optimizer {opt!r}")
+    raise TypeError(f"cannot resolve optimizer from {type(opt)}")

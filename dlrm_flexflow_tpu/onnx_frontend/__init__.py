@@ -1,0 +1,3 @@
+from .model import ONNXModel
+
+__all__ = ["ONNXModel"]

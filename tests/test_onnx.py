@@ -1,0 +1,105 @@
+"""ONNX importer tests. The environment has no `onnx` package (that's why
+the frontend vendors a wire-compatible proto subset), so test files are
+built with the vendored schema itself — field numbers match the official
+onnx.proto, so real exported files parse identically."""
+
+import numpy as np
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.onnx_frontend import ONNXModel
+from dlrm_flexflow_tpu.onnx_frontend import onnx_subset_pb2 as P
+
+
+def _make_tensor(name, arr):
+    t = P.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    t.data_type = 1
+    t.raw_data = arr.astype(np.float32).tobytes()
+    return t
+
+
+def _make_mlp_onnx(path, w1, b1, w2):
+    m = P.ModelProto()
+    m.ir_version = 8
+    g = m.graph
+    g.name = "mlp"
+
+    inp = P.ValueInfoProto()
+    inp.name = "x"
+    inp.type.tensor_type.elem_type = 1
+    for d in (8, 4):
+        dim = inp.type.tensor_type.shape.dim.add()
+        dim.dim_value = d
+    g.input.append(inp)
+
+    g.initializer.extend([_make_tensor("w1", w1), _make_tensor("b1", b1),
+                          _make_tensor("w2", w2)])
+
+    n1 = g.node.add()
+    n1.op_type = "Gemm"
+    n1.name = "fc1"
+    n1.input.extend(["x", "w1", "b1"])
+    n1.output.append("h1")
+    a = n1.attribute.add()
+    a.name = "transB"
+    a.i = 1
+    a.type = 2
+
+    n2 = g.node.add()
+    n2.op_type = "Relu"
+    n2.name = "relu1"
+    n2.input.append("h1")
+    n2.output.append("h2")
+
+    n3 = g.node.add()
+    n3.op_type = "MatMul"
+    n3.name = "fc2"
+    n3.input.extend(["h2", "w2"])
+    n3.output.append("h3")
+
+    n4 = g.node.add()
+    n4.op_type = "Softmax"
+    n4.name = "sm"
+    n4.input.append("h3")
+    n4.output.append("y")
+
+    out = P.ValueInfoProto()
+    out.name = "y"
+    g.output.append(out)
+
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+
+
+def test_onnx_mlp_import_matches_numpy(tmp_path):
+    r = np.random.RandomState(0)
+    w1 = r.randn(6, 4).astype(np.float32)   # Gemm transB: (out, in)
+    b1 = r.randn(6).astype(np.float32)
+    w2 = r.randn(6, 3).astype(np.float32)
+    path = str(tmp_path / "mlp.onnx")
+    _make_mlp_onnx(path, w1, b1, w2)
+
+    om = ONNXModel(path)
+    assert om.input_shapes() == {"x": (8, 4)}
+
+    model = ff.FFModel(ff.FFConfig(batch_size=8))
+    x_t = model.create_tensor((8, 4), name="x")
+    out, loader = om.apply(model, {"x": x_t})
+    assert out.shape == (8, 3)
+    model.compile(ff.SGDOptimizer(0.1), "sparse_categorical_crossentropy",
+                  ["accuracy"], final_tensor=out)
+    model.init_layers()
+    loader(model)
+
+    x = r.randn(8, 4).astype(np.float32)
+    ours = np.asarray(model.forward_batch({"x": x}))
+    h = np.maximum(x @ w1.T + b1, 0.0) @ w2
+    e = np.exp(h - h.max(axis=1, keepdims=True))
+    ref = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    # and it trains
+    mets = model.train_batch({"x": x,
+                              "label": r.randint(0, 3, (8, 1))})
+    assert np.isfinite(float(mets["loss"]))
