@@ -1,0 +1,5 @@
+from .dataloader import (FFBinDataLoader, SingleDataLoader, load_dlrm_hdf5,
+                         write_ffbin)
+
+__all__ = ["SingleDataLoader", "FFBinDataLoader", "write_ffbin",
+           "load_dlrm_hdf5"]

@@ -1,0 +1,89 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+The reference keeps its simulator engine and data loaders in native code
+(reference: src/runtime/simulator.cc, python/flexflow_dataloader.cc); this
+package does the same for the TPU build. Sources live next to this file
+(ffsim.cc, ffloader.cc) and are compiled into one shared library
+`_ffnative.so` at first import; consumers (search/simulator.py,
+data/dataloader.py) fall back to pure-Python paths when the toolchain is
+unavailable, so the framework never hard-requires a compiler.
+
+Rebuilds are automatic when a source file is newer than the library.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["ffsim.cc", "ffloader.cc"]
+_LIB_PATH = os.path.join(_DIR, "_ffnative.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_DIR, s)) > lib_mtime for s in _SOURCES)
+
+
+def _build() -> None:
+    # compile to a per-pid temp file then rename: rename is atomic, so a
+    # concurrent process never dlopens a half-written .so
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", tmp] + [os.path.join(_DIR, s) for s in _SOURCES]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB_PATH)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.ffsim_makespan.restype = c.c_double
+    lib.ffsim_makespan.argtypes = [
+        c.c_int64, c.POINTER(c.c_double), c.POINTER(c.c_int32),
+        c.c_int64, c.POINTER(c.c_int64), c.POINTER(c.c_int64)]
+    lib.ffloader_open.restype = c.c_void_p
+    lib.ffloader_open.argtypes = [c.c_char_p, c.c_int64, c.c_int32,
+                                  c.c_uint64]
+    lib.ffloader_meta.restype = None
+    lib.ffloader_meta.argtypes = [c.c_void_p, c.POINTER(c.c_int64)]
+    lib.ffloader_next.restype = c.c_int64
+    lib.ffloader_next.argtypes = [c.c_void_p, c.POINTER(c.c_float),
+                                  c.POINTER(c.c_int32), c.POINTER(c.c_float)]
+    lib.ffloader_close.restype = None
+    lib.ffloader_close.argtypes = [c.c_void_p]
+    return lib
+
+
+def get_lib():
+    """The bound native library, or None if it cannot be built/loaded."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            if _needs_build():
+                _build()
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except (OSError, subprocess.CalledProcessError, AttributeError):
+            _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,88 @@
+// Native event-driven execution simulator engine.
+//
+// TPU-native re-implementation of the reference's C++ simulation core
+// (reference: src/runtime/simulator.cc:410-447 — pop the earliest-ready
+// SimTask whose device is free, run it, release dependents). The reference
+// keeps this engine in C++ because it sits inside the MCMC search hot loop
+// (one full simulation per proposal, model.cc:1093-1144); we do the same.
+// The task graph is built by the Python Simulator (search/simulator.py)
+// and handed over as flat arrays; device -1 is the shared ICI comm channel.
+//
+// Exposed C ABI (ctypes, see native/__init__.py):
+//   ffsim_makespan(n_tasks, run_time[], device[], n_edges,
+//                  edge_src[], edge_dst[]) -> makespan (or -1.0 on deadlock)
+
+#include <cstdint>
+#include <queue>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct ReadyItem {
+  double ready_time;
+  int64_t seq;
+  int32_t task;
+};
+
+struct ReadyCmp {
+  // min-heap on (ready_time, seq) — matches Python's heapq tuple order so
+  // both engines pick identical task orderings (tie-break by insertion).
+  bool operator()(const ReadyItem& a, const ReadyItem& b) const {
+    if (a.ready_time != b.ready_time) return a.ready_time > b.ready_time;
+    return a.seq > b.seq;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+double ffsim_makespan(int64_t n_tasks, const double* run_time,
+                      const int32_t* device, int64_t n_edges,
+                      const int64_t* edge_src, const int64_t* edge_dst) {
+  std::vector<int32_t> counter(n_tasks, 0);
+  std::vector<double> ready_at(n_tasks, 0.0);
+  // CSR adjacency of the dependency DAG.
+  std::vector<int64_t> head(n_tasks + 1, 0);
+  for (int64_t e = 0; e < n_edges; ++e) head[edge_src[e] + 1]++;
+  for (int64_t t = 0; t < n_tasks; ++t) head[t + 1] += head[t];
+  std::vector<int64_t> adj(n_edges);
+  {
+    std::vector<int64_t> cursor(head.begin(), head.end() - 1);
+    for (int64_t e = 0; e < n_edges; ++e) {
+      adj[cursor[edge_src[e]]++] = edge_dst[e];
+      counter[edge_dst[e]]++;
+    }
+  }
+
+  std::priority_queue<ReadyItem, std::vector<ReadyItem>, ReadyCmp> ready;
+  int64_t seq = 0;
+  for (int64_t t = 0; t < n_tasks; ++t)
+    if (counter[t] == 0) ready.push({0.0, seq++, static_cast<int32_t>(t)});
+
+  std::unordered_map<int32_t, double> device_free;
+  double makespan = 0.0;
+  int64_t done = 0;
+  while (!ready.empty()) {
+    ReadyItem it = ready.top();
+    ready.pop();
+    const int32_t t = it.task;
+    double& free_at = device_free[device[t]];  // default 0.0
+    const double start = it.ready_time > free_at ? it.ready_time : free_at;
+    const double end = start + run_time[t];
+    free_at = end;
+    if (end > makespan) makespan = end;
+    ++done;
+    for (int64_t e = head[t]; e < head[t + 1]; ++e) {
+      const int64_t nxt = adj[e];
+      if (end > ready_at[nxt]) ready_at[nxt] = end;
+      if (--counter[nxt] == 0)
+        ready.push({ready_at[nxt], seq++, static_cast<int32_t>(nxt)});
+    }
+  }
+  if (done != n_tasks) return -1.0;  // cycle in the graph
+  return makespan;
+}
+
+}  // extern "C"

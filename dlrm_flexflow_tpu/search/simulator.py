@@ -161,15 +161,26 @@ class Simulator:
 
     # ------------------------------------------------------------------
     def simulate(self, strategies: StrategyMap,
-                 ndev: Optional[int] = None) -> float:
+                 ndev: Optional[int] = None,
+                 use_native: bool = True) -> float:
         """Event-driven makespan (reference simulator.cc:410-447): pop the
-        earliest-ready task whose device is free, run it, release deps."""
+        earliest-ready task whose device is free, run it, release deps.
+
+        The event loop itself runs in the native C++ engine
+        (native/ffsim.cc) when available — it sits inside the MCMC search
+        hot loop, which is why the reference keeps it native too. The
+        Python loop below is the reference semantics and the fallback.
+        """
         if ndev is None:
             import numpy as np
             ndev = int(math.prod(
                 [self.model.mesh.shape[a] for a in self.model.mesh.axis_names])
             ) if self.model.mesh else 1
         tasks = self.build_task_graph(strategies, ndev)
+        if use_native:
+            ms = self._simulate_native(tasks)
+            if ms is not None:
+                return ms
         device_free: Dict[int, float] = {}
         ready: List = []
         seq = 0
@@ -196,3 +207,37 @@ class Simulator:
             raise RuntimeError(
                 f"simulation deadlock: {done}/{len(tasks)} tasks ran")
         return makespan
+
+    def _simulate_native(self, tasks: List[SimTask]) -> Optional[float]:
+        """Run the event loop in native/ffsim.cc. Returns None when the
+        native library is unavailable (caller falls back to Python)."""
+        from ..native import get_lib
+        lib = get_lib()
+        if lib is None:
+            return None
+        import ctypes
+
+        import numpy as np
+        n = len(tasks)
+        index = {id(t): i for i, t in enumerate(tasks)}
+        run_time = np.empty(n, dtype=np.float64)
+        device = np.empty(n, dtype=np.int32)
+        src_list: List[int] = []
+        dst_list: List[int] = []
+        for i, t in enumerate(tasks):
+            run_time[i] = t.run_time
+            device[i] = t.device
+            for nxt in t.next_tasks:
+                src_list.append(i)
+                dst_list.append(index[id(nxt)])
+        edge_src = np.asarray(src_list, dtype=np.int64)
+        edge_dst = np.asarray(dst_list, dtype=np.int64)
+        ms = lib.ffsim_makespan(
+            n, run_time.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            device.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(edge_src),
+            edge_src.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            edge_dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if ms < 0:
+            raise RuntimeError("simulation deadlock (native engine)")
+        return float(ms)
