@@ -41,6 +41,9 @@ class FFConfig:
     simulation: bool = False
     seed: int = 0
     compute_dtype: str = "float32"     # or "bfloat16" for MXU-rate matmuls
+    # use Pallas kernels for supported ops when running single-chip on TPU
+    # (embedding-bag row-streaming; falls back to XLA lowering otherwise)
+    use_pallas: bool = True
     unparsed: List[str] = field(default_factory=list)
 
     @property

@@ -43,6 +43,22 @@ AGGR_MODE_SUM = "sum"
 AGGR_MODE_AVG = "avg"
 
 
+def _pallas_ok(model, out_dim: int) -> bool:
+    """Use the Pallas row-streaming kernel when it applies: TPU backend,
+    tile-aligned table width, single-chip execution (under a >1-device mesh
+    the op runs inside GSPMD, where the XLA gather lowering shards; the
+    Pallas call would need a shard_map wrapper — future work)."""
+    if not getattr(model.config, "use_pallas", False):
+        return False
+    from .pallas.embedding_kernel import supports
+    if not supports(out_dim):
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    mesh = getattr(model, "mesh", None)
+    return mesh is None or mesh.size <= 1
+
+
 class Embedding(Op):
     """Embedding bag: int indices (batch, bag) -> (batch, out_dim) with
     SUM/AVG aggregation, or (batch, bag, out_dim) with AGGR_MODE_NONE."""
@@ -73,6 +89,10 @@ class Embedding(Op):
     def apply(self, params, xs, *, training=False, rng=None):
         (idx,) = xs
         table = params["kernel"]
+        if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG) and idx.ndim == 2
+                and _pallas_ok(self.model, self.out_dim)):
+            from .pallas.embedding_kernel import embedding_bag
+            return [embedding_bag(table, idx, self.aggr)]
         rows = jnp.take(table, idx.astype(jnp.int32), axis=0)  # (..., bag, d)
         if self.aggr == AGGR_MODE_SUM:
             rows = jnp.sum(rows, axis=-2)
@@ -144,6 +164,11 @@ class EmbeddingBagStacked(Op):
         (idx,) = xs  # (batch, T, bag)
         table = params["kernel"]  # (T, rows, d)
         idx = idx.astype(jnp.int32)
+
+        if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
+                and _pallas_ok(self.model, self.out_dim)):
+            from .pallas.embedding_kernel import stacked_embedding_bag
+            return [stacked_embedding_bag(table, idx, self.aggr)]
 
         # vmap over the table dim: for each table t, gather its own rows for
         # the full batch. With dim-0 sharded params + matching sharding

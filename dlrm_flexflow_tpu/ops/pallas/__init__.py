@@ -1,0 +1,5 @@
+from .embedding_kernel import (embedding_bag, embedding_bag_reference,
+                               stacked_embedding_bag, supports)
+
+__all__ = ["embedding_bag", "embedding_bag_reference",
+           "stacked_embedding_bag", "supports"]

@@ -1,0 +1,191 @@
+"""Pallas TPU embedding-bag kernel.
+
+The reference implements embedding lookup with a custom CUDA gather forward
+and an atomicAdd scatter-add backward (reference: src/ops/embedding.cu:173-224)
+plus an AVX2 CPU embedding-bag path (src/ops/embedding_avx2.cc). The TPU has
+no atomics and gathers are HBM-bandwidth bound, so the design here is:
+
+- forward: a Pallas kernel that keeps the table in HBM and streams exactly
+  the needed rows into VMEM with double-buffered async DMA (two row slots,
+  the next row's DMA in flight while the current row is accumulated) — the
+  TPU analog of the AVX2 embedding-bag blocked loads. Indices arrive via
+  scalar prefetch so row addresses are known before the body runs.
+  Mosaic requires HBM row slices to be exactly one (1, 128) lane tile, so
+  a row of width dim = k*128 is streamed as k chunk-DMAs against a
+  (rows*k, 128) view of the table; tables whose dim is not a multiple of
+  128 fall back to the XLA gather (`embedding_bag_reference`).
+- backward: no atomics — sort the flat indices and segment-sum the incoming
+  gradients (indices_are_sorted lets XLA lower it as a linear pass), which
+  replaces the reference's atomicAdd scatter.
+
+`embedding_bag` is a custom_vjp function usable both standalone and from
+ops/embedding.py. On non-TPU backends pass interpret=True (tests do) or use
+`embedding_bag_reference`, the plain-XLA equivalent and test oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# samples per grid step: one float32 sublane tile
+_TILE_B = 8
+_LANES = 128
+# outstanding row DMAs: random 512 B reads are latency-bound, so keep a
+# deep pipeline of in-flight fetches rather than classic double buffering
+_SLOTS = 8
+
+
+def supports(dim: int) -> bool:
+    """True if the Pallas path handles this table width."""
+    return dim % _LANES == 0
+
+
+def _bag_kernel(bag: int, k: int, idx_ref, table_ref, out_ref, row_buf,
+                sems):
+    """One grid step = _TILE_B samples.
+
+    table_ref is the (rows*k, 128) chunk view resident in HBM; row_buf has
+    _SLOTS (1, 128) VMEM slots holding a deep pipeline of in-flight
+    fetches (DMA j+_SLOTS-1 starts before chunk j is consumed).
+    """
+    tb = out_ref.shape[0]
+    total = tb * bag * k
+    base = pl.program_id(0) * tb * bag
+
+    def dma(j, slot):
+        # j enumerates (sample, chunk, bag) as ((s*k + c)*bag + b); the
+        # chunk of table row idx[s, b] lives at view row idx*k + c
+        s_c, b = j // bag, j % bag
+        s, c = s_c // k, s_c % k
+        view_row = idx_ref[base + s * bag + b] * k + c
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(view_row, 1), :], row_buf.at[slot],
+            sems.at[slot])
+
+    depth = min(_SLOTS - 1, total)
+    for j in range(depth):
+        dma(j, j % _SLOTS).start()
+    for s in range(tb):                # static unroll: all bounds small
+        for c in range(k):
+            acc = jnp.zeros((1, _LANES), jnp.float32)
+            for b in range(bag):
+                j = (s * k + c) * bag + b
+                if j + depth < total:
+                    dma(j + depth, (j + depth) % _SLOTS).start()
+                dma(j, j % _SLOTS).wait()
+                acc = acc + row_buf[j % _SLOTS].astype(jnp.float32)
+            out_ref[pl.ds(s, 1), c * _LANES:(c + 1) * _LANES] = \
+                acc.astype(out_ref.dtype)
+
+
+def _pallas_forward(table: jax.Array, indices: jax.Array,
+                    interpret: bool) -> jax.Array:
+    """(rows, dim) × int(batch, bag) -> (batch, dim) sum-aggregated."""
+    batch, bag = indices.shape
+    rows, dim = table.shape
+    if not supports(dim):
+        raise ValueError(f"pallas embedding_bag needs dim % {_LANES} == 0, "
+                         f"got {dim}; use embedding_bag_reference")
+    k = dim // _LANES
+    padded = ((batch + _TILE_B - 1) // _TILE_B) * _TILE_B
+    idx_flat = jnp.zeros((padded * bag,), jnp.int32)
+    idx_flat = idx_flat.at[: batch * bag].set(
+        indices.astype(jnp.int32).reshape(-1))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(padded // _TILE_B,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((_TILE_B, dim), lambda i, idx: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((_SLOTS, 1, _LANES), table.dtype),
+            pltpu.SemaphoreType.DMA((_SLOTS,)),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_bag_kernel, bag, k),
+        out_shape=jax.ShapeDtypeStruct((padded, dim), table.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(idx_flat, table.reshape(rows * k, _LANES))
+    return out[:batch]
+
+
+def embedding_bag_reference(table, indices, aggr: str = "sum"):
+    """Plain-XLA oracle/fallback: gather + reduce over the bag dim."""
+    rows = jnp.take(table, indices.astype(jnp.int32), axis=0)
+    if aggr == "avg":
+        return jnp.mean(rows, axis=-2)
+    return jnp.sum(rows, axis=-2)
+
+
+def _primal(table, indices, aggr, interpret):
+    out = _pallas_forward(table, indices, interpret)
+    if aggr == "avg":
+        out = out / indices.shape[-1]
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def embedding_bag(table, indices, aggr: str = "sum",
+                  interpret: bool = False):
+    """Embedding bag with a Pallas forward and sorted-segment-sum backward.
+
+    table   : (rows, dim) float, dim % 128 == 0
+    indices : (batch, bag) int
+    returns : (batch, dim), sum or mean over the bag.
+    """
+    return _primal(table, indices, aggr, interpret)
+
+
+def _fwd(table, indices, aggr, interpret):
+    # zero-size residual whose static shape/dtype carry the table spec
+    spec = jnp.zeros((table.shape[0], 0), table.dtype)
+    return _primal(table, indices, aggr, interpret), (indices, spec)
+
+
+def _bwd(aggr, interpret, res, g):
+    indices, spec = res
+    batch, bag = indices.shape
+    gg = jnp.repeat(g, bag, axis=0).astype(jnp.float32)  # (batch*bag, dim)
+    if aggr == "avg":
+        gg = gg / bag
+    flat = indices.astype(jnp.int32).reshape(-1)
+    order = jnp.argsort(flat)
+    dtable = jax.ops.segment_sum(
+        gg[order], flat[order], num_segments=spec.shape[0],
+        indices_are_sorted=True).astype(spec.dtype)
+    # integer indices get a float0 cotangent
+    return dtable, np.zeros(indices.shape, dtype=jax.dtypes.float0)
+
+
+embedding_bag.defvjp(_fwd, _bwd)
+
+
+def stacked_embedding_bag(tables, indices, aggr: str = "sum",
+                          interpret: bool = False):
+    """Fused multi-table bag on the Pallas kernel.
+
+    tables  : (T, rows, dim)
+    indices : (batch, T, bag)
+    returns : (batch, T, dim)
+
+    The T tables are viewed as one (T*rows, dim) table and indices are
+    offset by t*rows — the fused-table trick that turns the reference's
+    per-table kernel launches (one Embedding op per DLRM table) into a
+    single streaming kernel.
+    """
+    T, rows, dim = tables.shape
+    batch = indices.shape[0]
+    offs = (jnp.arange(T, dtype=jnp.int32) * rows)[None, :, None]
+    flat_idx = (indices.astype(jnp.int32) + offs).reshape(batch * T, -1)
+    out = embedding_bag(tables.reshape(T * rows, dim), flat_idx, aggr,
+                        interpret)
+    return out.reshape(batch, T, dim)
