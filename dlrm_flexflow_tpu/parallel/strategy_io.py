@@ -5,22 +5,145 @@ src/runtime/strategy.proto:5-23 — proto2 `Strategy{ops[]: name, device_type,
 dims[], device_ids[], memory_types[]}`; load/save in
 src/runtime/strategy.cc:96-172, keyed by hash of op name).
 
-Format here is JSON with the same field names as the proto schema (dims →
-partition degrees, mesh axes implied by order), so strategies remain
-human-diffable and round-trip exactly. `.pb`-style binary compat is not
-needed on TPU — the reference's prebuilt .pb files encode GPU device ids
-that have no meaning here.
+Two on-disk formats, selected by extension:
+
+- `.json` (default): same field names as the proto schema (dims → partition
+  degrees, mesh axes implied by order) — human-diffable.
+- `.pb`: the reference's binary proto2 wire format, encoded/decoded by a
+  hand-rolled codec below (schema: message Op {required string name = 1;
+  required DeviceType device_type = 2; repeated int32 dims = 3; repeated
+  int32 device_ids = 4; repeated MemoryType memory_types = 5;} wrapped in
+  message Strategy {repeated Op ops = 1;}). This reads the reference's
+  prebuilt strategy files (src/runtime/dlrm_strategy_*.pb) and writes files
+  its proto2 parser accepts — goldens stay interoperable. DeviceType GPU(0)
+  maps to "TPU" here; CPU(1) stays "CPU" (the hetero host-offload case).
 """
 
 from __future__ import annotations
 
 import json
-from typing import Dict
+from typing import Dict, List, Tuple
 
 from .pconfig import ParallelConfig, StrategyMap
 
+# --- proto2 wire-format primitives ---------------------------------------
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = v = 0
+    while True:
+        b = buf[i]
+        i += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, i
+        shift += 7
+        if shift >= 70:
+            raise ValueError("malformed varint")
+
+
+def _encode_op(name: str, device_type: int, dims: List[int],
+               device_ids: List[int]) -> bytes:
+    msg = bytearray()
+    nb = name.encode()
+    msg += b"\x0a" + _varint(len(nb)) + nb          # 1: name (len-delim)
+    msg += b"\x10" + _varint(device_type)           # 2: device_type varint
+    for d in dims:                                  # 3: dims, unpacked
+        msg += b"\x18" + _varint(d)
+    for d in device_ids:                            # 4: device_ids
+        msg += b"\x20" + _varint(d)
+    return bytes(msg)
+
+
+def _decode_message(buf: bytes):
+    """Yield (field_number, wire_type, value) triples; packed repeated
+    varints are handled by the caller."""
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, v
+
+
+def _unpack_varints(payload: bytes) -> List[int]:
+    out, i = [], 0
+    while i < len(payload):
+        v, i = _read_varint(payload, i)
+        out.append(v)
+    return out
+
+
+def save_strategies_pb(path: str, strategies: StrategyMap) -> None:
+    """Write the reference's binary format (reference
+    save_strategies_to_file, src/runtime/strategy.cc:137-172)."""
+    body = bytearray()
+    for name, pc in sorted(strategies.items()):
+        dt = 1 if pc.device_type == "CPU" else 0
+        op = _encode_op(name, dt, list(pc.degrees), list(pc.device_ids))
+        body += b"\x0a" + _varint(len(op)) + op     # Strategy.ops = 1
+    with open(path, "wb") as f:
+        f.write(bytes(body))
+
+
+def load_strategies_pb(path: str) -> StrategyMap:
+    """Read the reference's binary format (reference
+    load_strategies_from_file, src/runtime/strategy.cc:96-135)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    out: StrategyMap = {}
+    for field, wt, v in _decode_message(buf):
+        if field != 1 or wt != 2:
+            continue
+        name, dt, dims, dev_ids = "", 0, [], []
+        for f2, wt2, v2 in _decode_message(v):
+            if f2 == 1:
+                name = v2.decode()
+            elif f2 == 2:
+                dt = v2
+            elif f2 == 3:
+                dims += _unpack_varints(v2) if wt2 == 2 else [v2]
+            elif f2 == 4:
+                dev_ids += _unpack_varints(v2) if wt2 == 2 else [v2]
+        out[name] = ParallelConfig(
+            tuple(dims), device_type="CPU" if dt == 1 else "TPU",
+            device_ids=tuple(dev_ids))
+    return out
+
+
+# --- public API ------------------------------------------------------------
+
 
 def save_strategies(path: str, strategies: StrategyMap) -> None:
+    if path.endswith(".pb"):
+        save_strategies_pb(path, strategies)
+        return
     doc = {"ops": [
         {"name": name,
          "device_type": pc.device_type,
@@ -32,6 +155,8 @@ def save_strategies(path: str, strategies: StrategyMap) -> None:
 
 
 def load_strategies(path: str) -> StrategyMap:
+    if path.endswith(".pb"):
+        return load_strategies_pb(path)
     with open(path) as f:
         doc = json.load(f)
     out: StrategyMap = {}
