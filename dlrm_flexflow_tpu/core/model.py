@@ -98,6 +98,12 @@ class FFModel:
     def dense(self, input_tensor, out_dim, activation=None, use_bias=True,
               kernel_initializer=None, bias_initializer=None, name=None):
         from ..ops.linear import Linear
+        if activation == "softmax":
+            # lower to a separate Softmax op (not a fused epilogue) so the
+            # loss's logits-extraction special case in compile() can see it
+            t = Linear(self, input_tensor, out_dim, "none", use_bias,
+                       kernel_initializer, bias_initializer, name).outputs[0]
+            return self.softmax(t, name=f"{name}_softmax" if name else None)
         return Linear(self, input_tensor, out_dim, activation or "none",
                       use_bias, kernel_initializer, bias_initializer,
                       name).outputs[0]
@@ -510,9 +516,13 @@ class FFModel:
     def train_batch(self, batch: Dict[str, np.ndarray]):
         """One fused train step (forward+backward+update). Returns metrics
         dict of device scalars (async — don't block)."""
-        db = self._device_batch(batch)
+        return self.train_batch_device(self._device_batch(batch))
+
+    def train_batch_device(self, device_batch: Dict):
+        """train_batch for a batch already staged on device (skips the
+        host->device put; used by benchmark loops that pre-stage)."""
         self.params, self.opt_state, self.op_state, mets = self._train_step(
-            self.params, self.opt_state, self.op_state, db,
+            self.params, self.opt_state, self.op_state, device_batch,
             jnp.asarray(self._step, jnp.int32))
         self._step += 1
         self.perf.update({k: v for k, v in mets.items() if k != "loss"})

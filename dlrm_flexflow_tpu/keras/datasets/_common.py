@@ -1,0 +1,69 @@
+"""Shared helpers for dataset loaders: local archive discovery + the
+class-template synthetic generator used when no archive exists."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def find_local(filename: str) -> Optional[str]:
+    """Look for a dataset archive in $FF_DATASETS_DIR, ~/.keras/datasets
+    (the reference loaders' cache dir), and ./datasets."""
+    candidates = []
+    env = os.environ.get("FF_DATASETS_DIR")
+    if env:
+        candidates.append(os.path.join(env, filename))
+    candidates.append(
+        os.path.join(os.path.expanduser("~"), ".keras", "datasets", filename))
+    candidates.append(os.path.join("datasets", filename))
+    for c in candidates:
+        if os.path.exists(c):
+            return c
+    return None
+
+
+def synthetic_images(num_classes: int, shape, n_train: int, n_test: int,
+                     seed: int = 0):
+    """Class-conditional images: one fixed random template per class plus
+    noise.  uint8 in [0, 255] like the real archives."""
+    r = np.random.RandomState(seed)
+    templates = r.randint(0, 200, size=(num_classes,) + tuple(shape))
+
+    def make(n, s):
+        rr = np.random.RandomState(s)
+        y = rr.randint(0, num_classes, size=(n,))
+        noise = rr.randint(0, 56, size=(n,) + tuple(shape))
+        x = np.clip(templates[y] + noise, 0, 255).astype(np.uint8)
+        return x, y.astype(np.int64)
+
+    x_train, y_train = make(n_train, seed + 1)
+    x_test, y_test = make(n_test, seed + 2)
+    return (x_train, y_train), (x_test, y_test)
+
+
+def synthetic_sequences(num_classes: int, vocab: int, maxlen_mean: int,
+                        n_train: int, n_test: int, seed: int = 0):
+    """Class-conditional token sequences: each class draws from a distinct
+    zipf-ish slice of the vocabulary (mimics reuters topic clustering)."""
+    r = np.random.RandomState(seed)
+    # per-class preferred token block
+    blocks = r.randint(4, max(5, vocab - 200), size=(num_classes,))
+
+    def make(n, s):
+        rr = np.random.RandomState(s)
+        y = rr.randint(0, num_classes, size=(n,))
+        seqs = []
+        for i in range(n):
+            length = max(8, int(rr.poisson(maxlen_mean)))
+            base = blocks[y[i]]
+            toks = base + rr.zipf(1.6, size=length)
+            toks = np.clip(toks, 1, vocab - 1)
+            seqs.append([1] + toks.tolist())  # 1 = start marker, like keras
+        return seqs, y.astype(np.int64)
+
+    x_train, y_train = make(n_train, seed + 1)
+    x_test, y_test = make(n_test, seed + 2)
+    return (x_train, y_train), (x_test, y_test)

@@ -1,0 +1,39 @@
+"""CIFAR-10 loader (reference: python/flexflow/keras/datasets/cifar.py +
+cifar10.py — returns uint8 (N, 3, 32, 32) images, channels-first like the
+reference's K.image_data_format()=channels_first examples)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ._common import find_local, synthetic_images
+
+
+def _from_archive(local: str):
+    xs, ys = [], []
+    xt = yt = None
+    with tarfile.open(local) as tf:
+        for m in tf.getmembers():
+            base = os.path.basename(m.name)
+            if base.startswith("data_batch") or base == "test_batch":
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                x = d[b"data"].reshape(-1, 3, 32, 32)
+                y = np.asarray(d[b"labels"], dtype=np.int64)
+                if base == "test_batch":
+                    xt, yt = x, y
+                else:
+                    xs.append(x)
+                    ys.append(y)
+    return (np.concatenate(xs), np.concatenate(ys)), (xt, yt)
+
+
+def load_data(path: str = "cifar-10-python.tar.gz", n_train: int = 5000,
+              n_test: int = 1000):
+    local = find_local(path)
+    if local:
+        return _from_archive(local)
+    return synthetic_images(10, (3, 32, 32), n_train, n_test, seed=32)

@@ -1,0 +1,17 @@
+"""MNIST loader (reference: python/flexflow/keras/datasets/mnist.py —
+returns uint8 (N, 28, 28) images + int labels from mnist.npz)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ._common import find_local, synthetic_images
+
+
+def load_data(path: str = "mnist.npz", n_train: int = 6000,
+              n_test: int = 1000):
+    local = find_local(path)
+    if local:
+        with np.load(local, allow_pickle=True) as f:
+            return (f["x_train"], f["y_train"]), (f["x_test"], f["y_test"])
+    return synthetic_images(10, (28, 28), n_train, n_test, seed=28)

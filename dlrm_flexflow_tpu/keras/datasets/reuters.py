@@ -1,0 +1,35 @@
+"""Reuters newswire topic dataset (reference: python/flexflow/keras/
+datasets/reuters.py — variable-length token sequences, 46 topics)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ._common import find_local, synthetic_sequences
+
+NUM_CLASSES = 46
+
+
+def load_data(path: str = "reuters.npz", num_words: int = 10000,
+              test_split: float = 0.2, n_train: int = 2000,
+              n_test: int = 500):
+    local = find_local(path)
+    if local:
+        with np.load(local, allow_pickle=True) as f:
+            xs, labels = f["x"], f["y"]
+        xs = [[w if w < num_words else 2 for w in seq] for seq in xs]
+        n = int(len(xs) * (1 - test_split))
+        return (xs[:n], labels[:n]), (xs[n:], labels[n:])
+    (xtr, ytr), (xte, yte) = synthetic_sequences(
+        NUM_CLASSES, num_words, maxlen_mean=80,
+        n_train=n_train, n_test=n_test, seed=46)
+    return (xtr, ytr), (xte, yte)
+
+
+def to_bow(seqs, num_words: int) -> np.ndarray:
+    """Bag-of-words featurization used by the reference reuters_mlp
+    example (keras preprocessing Tokenizer sequences_to_matrix)."""
+    out = np.zeros((len(seqs), num_words), dtype=np.float32)
+    for i, s in enumerate(seqs):
+        out[i, np.clip(np.asarray(s, dtype=np.int64), 0, num_words - 1)] = 1.0
+    return out

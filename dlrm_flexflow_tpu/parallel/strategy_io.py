@@ -45,6 +45,8 @@ def _varint(v: int) -> bytes:
 def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
     shift = v = 0
     while True:
+        if i >= len(buf):
+            raise ValueError("truncated varint")
         b = buf[i]
         i += 1
         v |= (b & 0x7F) << shift
@@ -79,14 +81,16 @@ def _decode_message(buf: bytes):
             v, i = _read_varint(buf, i)
         elif wt == 2:
             ln, i = _read_varint(buf, i)
+            if i + ln > len(buf):
+                raise ValueError("truncated length-delimited field")
             v = buf[i:i + ln]
             i += ln
-        elif wt == 5:
-            v = buf[i:i + 4]
-            i += 4
-        elif wt == 1:
-            v = buf[i:i + 8]
-            i += 8
+        elif wt in (5, 1):
+            ln = 4 if wt == 5 else 8
+            if i + ln > len(buf):
+                raise ValueError("truncated fixed-width field")
+            v = buf[i:i + ln]
+            i += ln
         else:
             raise ValueError(f"unsupported wire type {wt}")
         yield field, wt, v
@@ -117,6 +121,13 @@ def load_strategies_pb(path: str) -> StrategyMap:
     load_strategies_from_file, src/runtime/strategy.cc:96-135)."""
     with open(path, "rb") as f:
         buf = f.read()
+    try:
+        return _decode_strategies(buf)
+    except ValueError as e:
+        raise ValueError(f"corrupt strategy file {path!r}: {e}") from None
+
+
+def _decode_strategies(buf: bytes) -> StrategyMap:
     out: StrategyMap = {}
     for field, wt, v in _decode_message(buf):
         if field != 1 or wt != 2:

@@ -150,9 +150,16 @@ def from_torch_module(ff: FFModel, module, input_shapes: Dict[str, tuple],
             elif fn is torch.tanh:
                 env[node.name] = ff.tanh(env[node.args[0].name],
                                          name=node.name)
-            elif fn is torch.nn.functional.softmax:
-                env[node.name] = ff.softmax(env[node.args[0].name],
-                                            name=node.name)
+            elif fn is torch.nn.functional.softmax or fn is torch.softmax:
+                x = env[node.args[0].name]
+                dim = node.kwargs.get("dim")
+                if dim is None and len(node.args) > 1:
+                    dim = node.args[1]
+                if dim is not None and dim not in (-1, len(x.shape) - 1):
+                    raise NotImplementedError(
+                        f"fx import: softmax over dim={dim} (only the last "
+                        f"axis is supported)")
+                env[node.name] = ff.softmax(x, name=node.name)
             else:
                 raise NotImplementedError(
                     f"fx import: unsupported function {fn}")

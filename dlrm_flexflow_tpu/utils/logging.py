@@ -1,0 +1,61 @@
+"""Category logging channels.
+
+Parity with the reference's Legion logger categories (reference:
+`LegionRuntime::Logger::Category log_model` model.cc:22, `log_app`
+dlrm.cc:22, `log_ff_mapper` mapper.cc:18, `log_nmt`; Python `fflogger`,
+python/flexflow/core/flexflow_logger.py). Channels are stdlib loggers
+under the ``ff.`` namespace; verbosity comes from ``$FF_LOG`` ("debug",
+"info", "warning", default "warning") or per-channel
+``$FF_LOG_<CHANNEL>``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
+           "warning": logging.WARNING, "error": logging.ERROR,
+           "spew": logging.DEBUG}
+
+_configured = False
+
+
+def _configure_root():
+    global _configured
+    if _configured:
+        return
+    root = logging.getLogger("ff")
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("[ff.%(name)s] %(levelname)s: %(message)s"))
+    # logger name minus the "ff." prefix for compact channel tags
+    class _Strip(logging.Filter):
+        def filter(self, record):
+            record.name = record.name.removeprefix("ff.")
+            return True
+    handler.addFilter(_Strip())
+    root.addHandler(handler)
+    root.propagate = False
+    root.setLevel(_LEVELS.get(os.environ.get("FF_LOG", "warning").lower(),
+                              logging.WARNING))
+    _configured = True
+
+
+def get_logger(channel: str) -> logging.Logger:
+    """Channel logger, e.g. get_logger("model") ~ reference log_model."""
+    _configure_root()
+    lg = logging.getLogger(f"ff.{channel}")
+    env = os.environ.get(f"FF_LOG_{channel.upper()}")
+    if env:
+        lg.setLevel(_LEVELS.get(env.lower(), logging.WARNING))
+    return lg
+
+
+# pre-declared channels mirroring the reference's categories
+log_model = get_logger("model")
+log_app = get_logger("app")
+log_mapper = get_logger("mapper")
+log_sim = get_logger("sim")
+fflogger = get_logger("python")

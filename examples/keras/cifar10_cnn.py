@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Keras CIFAR-10 CNN (reference: examples/python/keras/cifar10_cnn.py —
+two conv blocks + dense512, channels-first)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import cifar10
+
+
+def main():
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    model = K.Sequential([
+        K.Input((3, 32, 32)),
+        K.Conv2D(32, (3, 3), padding="same", activation="relu"),
+        K.Conv2D(32, (3, 3), activation="relu"),
+        K.MaxPooling2D((2, 2)),
+        K.Dropout(0.25),
+        K.Conv2D(64, (3, 3), padding="same", activation="relu"),
+        K.Conv2D(64, (3, 3), activation="relu"),
+        K.MaxPooling2D((2, 2)),
+        K.Dropout(0.25),
+        K.Flatten(),
+        K.Dense(512, activation="relu"),
+        K.Dropout(0.5),
+        K.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer=K.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, batch_size=64, epochs=3)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Keras Reuters topic-classification MLP (reference:
+examples/python/keras/reuters_mlp.py — bag-of-words 1000-dim input,
+dense512, 46-way softmax)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import reuters
+
+MAX_WORDS = 1000
+
+
+def main():
+    (x_train, y_train), _ = reuters.load_data(num_words=MAX_WORDS)
+    x_train = reuters.to_bow(x_train, MAX_WORDS)
+    y_train = np.asarray(y_train).reshape(-1, 1).astype(np.int32)
+
+    model = K.Sequential([
+        K.Input((MAX_WORDS,)),
+        K.Dense(512, activation="relu"),
+        K.Dropout(0.5),
+        K.Dense(reuters.NUM_CLASSES, activation="softmax"),
+    ])
+    model.compile(optimizer=K.SGD(learning_rate=0.1),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, batch_size=32, epochs=5)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""AlexNet on synthetic images (reference: examples/cpp/AlexNet/alexnet.cc
+and examples/python/native/alexnet.py:7-70).
+
+  python examples/native/alexnet.py -b 64 -e 1 [--image-hw 224]
+"""
+
+import sys
+
+from _common import ff, setup, synthetic_classification, train
+from dlrm_flexflow_tpu.models.alexnet import build_alexnet
+
+
+def main(argv=None):
+    cfg, mesh = setup(argv if argv is not None else sys.argv[1:])
+    hw = 224
+    if "--image-hw" in cfg.unparsed:
+        hw = int(cfg.unparsed[cfg.unparsed.index("--image-hw") + 1])
+    num_classes = 1000 if hw >= 128 else 10
+
+    model = ff.FFModel(cfg)
+    inputs, _ = build_alexnet(model, num_classes=num_classes, image_hw=hw)
+    x, y = synthetic_classification(inputs, num_classes,
+                                    4 * cfg.batch_size, seed=cfg.seed)
+    train(model, x, y, cfg, mesh=mesh)
+
+
+if __name__ == "__main__":
+    main()

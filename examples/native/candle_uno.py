@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""CANDLE Uno multi-tower drug-response MLP on synthetic features
+(reference: examples/cpp/candle_uno/candle_uno.cc:115-126 — per-feature
+towers merged by concat into the top dense stack, MSE regression).
+
+  python examples/native/candle_uno.py -b 64 -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from _common import ff, setup, train
+from dlrm_flexflow_tpu.models.candle_uno import build_candle_uno
+
+
+def main(argv=None):
+    cfg, mesh = setup(argv if argv is not None else sys.argv[1:])
+    model = ff.FFModel(cfg)
+    inputs, _ = build_candle_uno(model)
+    n = 4 * cfg.batch_size
+    r = np.random.RandomState(cfg.seed)
+    x = {k: r.randn(n, d).astype(np.float32) for k, (_, d) in inputs.items()}
+    y = r.rand(n, 1).astype(np.float32)  # growth in [0,1]
+    train(model, x, y, cfg, loss="mean_squared_error", metrics=("mse",),
+          mesh=mesh)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""InceptionV3 on synthetic images (reference:
+examples/cpp/InceptionV3/inception.cc).
+
+  python examples/native/inception_v3.py -b 32 -e 1
+"""
+
+import sys
+
+from _common import ff, setup, synthetic_classification, train
+from dlrm_flexflow_tpu.models.inception import build_inception_v3
+
+
+def main(argv=None):
+    cfg, mesh = setup(argv if argv is not None else sys.argv[1:],
+                      default_batch=32)
+    model = ff.FFModel(cfg)
+    inputs, _ = build_inception_v3(model, num_classes=1000, image_hw=299)
+    x, y = synthetic_classification(inputs, 1000, 2 * cfg.batch_size,
+                                    seed=cfg.seed)
+    train(model, x, y, cfg, mesh=mesh)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""NMT LSTM seq2seq on synthetic token pairs (reference: nmt/nmt.cc:32
+top_level_task — 2-layer 1024-wide encoder/decoder over reversed source,
+per-position softmax; rebuilt as a model on the main framework rather
+than a second runtime).
+
+  python examples/native/nmt.py -b 16 -e 1 --seq-len 40
+"""
+
+import sys
+
+import numpy as np
+
+from _common import ff, setup, train
+from dlrm_flexflow_tpu.models.nmt import build_nmt
+
+
+def main(argv=None):
+    cfg, mesh = setup(argv if argv is not None else sys.argv[1:],
+                      default_batch=16)
+    u = cfg.unparsed
+    seq = int(u[u.index("--seq-len") + 1]) if "--seq-len" in u else 40
+    vocab = int(u[u.index("--vocab") + 1]) if "--vocab" in u else 4096
+
+    model = ff.FFModel(cfg)
+    inputs, _ = build_nmt(model, src_vocab=vocab, tgt_vocab=vocab,
+                          embed_dim=256, hidden=256, num_layers=2,
+                          src_len=seq, tgt_len=seq)
+    n = 2 * cfg.batch_size
+    r = np.random.RandomState(cfg.seed)
+    x = {k: r.randint(0, vocab, size=(n, seq)).astype(np.int32)
+         for k in inputs}
+    # next-token labels: one int per (batch, position), folded like logits
+    y = r.randint(0, vocab, size=(n, seq)).astype(np.int32)
+    train(model, x, y, cfg, loss="sparse_categorical_crossentropy",
+          metrics=("accuracy",), mesh=mesh)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""ResNet-{18,34,50,101} on synthetic images (reference:
+examples/cpp/ResNet/resnet.cc).
+
+  python examples/native/resnet.py -b 64 -e 1 --depth 50 [--image-hw 224]
+"""
+
+import sys
+
+from _common import ff, setup, synthetic_classification, train
+from dlrm_flexflow_tpu.models.resnet import build_resnet
+
+
+def main(argv=None):
+    cfg, mesh = setup(argv if argv is not None else sys.argv[1:])
+    depth, hw = 50, 224
+    u = cfg.unparsed
+    if "--depth" in u:
+        depth = int(u[u.index("--depth") + 1])
+    if "--image-hw" in u:
+        hw = int(u[u.index("--image-hw") + 1])
+    num_classes = 1000 if hw >= 128 else 10
+
+    model = ff.FFModel(cfg)
+    inputs, _ = build_resnet(model, depth=depth, num_classes=num_classes,
+                             image_hw=hw)
+    x, y = synthetic_classification(inputs, num_classes,
+                                    4 * cfg.batch_size, seed=cfg.seed)
+    train(model, x, y, cfg, mesh=mesh)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+#!/bin/bash
+# Reference run_criteo_kaggle.sh:1-8 shapes: 26 tables x 16-d, bot MLP
+# 13-512-256-64-16, top MLP 224-512-256-1, batch 256/device.
+# Pass --data-path criteo.npz (from tools/preprocess_criteo.py) for real data.
+ndev=${NDEV:-$(python -c 'import jax; print(len(jax.devices()))')}
+python "$(dirname "$0")/dlrm.py" \
+    -ll:gpu "$ndev" -b $((256 * ndev)) -e 1 \
+    --arch-embedding-size 1396-550-2481689-687-20-15-204-96-14-1400181-397059-3166985-10-2208-11156-155-4-976-14-1398149-1263872-1246444-13107-336-101-30 \
+    --arch-sparse-feature-size 16 \
+    --arch-mlp-bot 13-512-256-64-16 \
+    --arch-mlp-top 224-512-256-1 \
+    "$@"

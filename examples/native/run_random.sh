@@ -1,0 +1,11 @@
+#!/bin/bash
+# Reference run_random.sh:1-10 shapes: batch 256/device, 8 x 1M-row x 64-d
+# embedding tables, bot MLP 64-512-512-64, top MLP 576-1024-1024-1024-1.
+ndev=${NDEV:-$(python -c 'import jax; print(len(jax.devices()))')}
+python "$(dirname "$0")/dlrm.py" \
+    -ll:gpu "$ndev" -b $((256 * ndev)) -e 1 \
+    --arch-embedding-size 1000000-1000000-1000000-1000000-1000000-1000000-1000000-1000000 \
+    --arch-sparse-feature-size 64 \
+    --arch-mlp-bot 64-512-512-64 \
+    --arch-mlp-top 576-1024-1024-1024-1 \
+    "$@"

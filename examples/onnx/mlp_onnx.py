@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Build a small ONNX file and import it (reference:
+python/flexflow/onnx/model.py node-by-node translation +
+examples/python/onnx). This environment has no `onnx` package, so the
+file is written with the framework's vendored wire-compatible proto
+subset — real exported .onnx files parse identically."""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.onnx_frontend import ONNXModel
+from dlrm_flexflow_tpu.onnx_frontend import onnx_subset_pb2 as P
+
+
+def make_mlp_onnx(path, in_dim=32, hidden=64, out_dim=10, batch=64, seed=0):
+    r = np.random.RandomState(seed)
+    w1 = (r.randn(hidden, in_dim) * 0.1).astype(np.float32)
+    b1 = np.zeros(hidden, np.float32)
+    w2 = (r.randn(out_dim, hidden) * 0.1).astype(np.float32)
+
+    m = P.ModelProto()
+    m.ir_version = 8
+    g = m.graph
+    g.name = "mlp"
+    inp = P.ValueInfoProto()
+    inp.name = "x"
+    inp.type.tensor_type.elem_type = 1
+    for d in (batch, in_dim):
+        dim = inp.type.tensor_type.shape.dim.add()
+        dim.dim_value = d
+    g.input.append(inp)
+
+    for name, arr in (("w1", w1), ("b1", b1), ("w2", w2)):
+        t = P.TensorProto()
+        t.name = name
+        t.dims.extend(arr.shape)
+        t.data_type = 1
+        t.raw_data = arr.tobytes()
+        g.initializer.append(t)
+
+    n1 = g.node.add()
+    n1.op_type = "Gemm"
+    n1.input.extend(["x", "w1", "b1"])
+    n1.output.append("h")
+    a = n1.attribute.add()
+    a.name = "transB"
+    a.i = 1
+    a.type = 2
+    n2 = g.node.add()
+    n2.op_type = "Relu"
+    n2.input.append("h")
+    n2.output.append("hr")
+    n3 = g.node.add()
+    n3.op_type = "Gemm"
+    n3.input.extend(["hr", "w2"])
+    n3.output.append("logits")
+    a = n3.attribute.add()
+    a.name = "transB"
+    a.i = 1
+    a.type = 2
+    n4 = g.node.add()
+    n4.op_type = "Softmax"
+    n4.input.append("logits")
+    n4.output.append("probs")
+    o = P.ValueInfoProto()
+    o.name = "probs"
+    g.output.append(o)
+
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+
+
+def main():
+    batch = 64
+    with tempfile.NamedTemporaryFile(suffix=".onnx", delete=False) as f:
+        path = f.name
+    make_mlp_onnx(path, batch=batch)
+
+    om = ONNXModel(path)
+    model = ff.FFModel(ff.FFConfig(batch_size=batch))
+    x = model.create_tensor((batch, 32), name="x")
+    out, weight_loader = om.apply(model, {"x": x})
+    model.compile(ff.SGDOptimizer(0.1), "sparse_categorical_crossentropy",
+                  ["accuracy"], final_tensor=out)
+    model.init_layers()
+    weight_loader(model)
+
+    r = np.random.RandomState(0)
+    n = 4 * batch
+    xs = r.randn(n, 32).astype(np.float32)
+    ys = r.randint(0, 10, size=(n, 1)).astype(np.int32)
+    model.fit({"x": xs}, ys, epochs=3)
+    os.unlink(path)
+
+
+if __name__ == "__main__":
+    main()

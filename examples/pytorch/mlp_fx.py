@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Import a torch.nn module via torch.fx symbolic trace and train it
+(reference: python/flexflow/torch/fx.py exporter + examples/python/pytorch).
+Weights are transferred, so the first forward matches torch exactly."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import torch
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.torch_frontend import from_torch_module
+
+
+class MLP(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(32, 64)
+        self.fc2 = torch.nn.Linear(64, 10)
+
+    def forward(self, x):
+        return torch.softmax(self.fc2(torch.relu(self.fc1(x))), dim=1)
+
+
+def main():
+    batch = 64
+    net = MLP()
+    model = ff.FFModel(ff.FFConfig(batch_size=batch))
+    _, out, weight_loader = from_torch_module(model, net,
+                                              {"x": (batch, 32)})
+    model.compile(ff.SGDOptimizer(0.1), "sparse_categorical_crossentropy",
+                  ["accuracy"], final_tensor=out)
+    model.init_layers()
+    weight_loader(model)
+
+    # check parity with torch before training
+    r = np.random.RandomState(0)
+    x = r.randn(batch, 32).astype(np.float32)
+    ours = np.asarray(model.forward_batch({"x": x}))
+    with torch.no_grad():
+        theirs = net(torch.tensor(x)).numpy()
+    print("max |ff - torch| =", float(np.abs(ours - theirs).max()))
+
+    n = 4 * batch
+    xs = r.randn(n, 32).astype(np.float32)
+    ys = r.randint(0, 10, size=(n, 1)).astype(np.int32)
+    model.fit({"x": xs}, ys, epochs=3)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Import a text-format graph file and train it (reference:
+python/flexflow/torch/model.py text-format interpreter — lines of
+`name, inputs, output, op_type, params...`)."""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.torch_frontend import PyTorchModel
+
+GRAPH = """\
+x, , x, op_input
+fc1, x, fc1, op_linear, 64
+r1, fc1, r1, op_relu
+fc2, r1, fc2, op_linear, 10
+sm, fc2, sm, op_softmax
+"""
+
+
+def main():
+    batch = 64
+    with tempfile.NamedTemporaryFile("w", suffix=".ff", delete=False) as f:
+        f.write(GRAPH)
+        path = f.name
+    model = ff.FFModel(ff.FFConfig(batch_size=batch))
+    t = model.create_tensor((batch, 32), name="x")
+    out = PyTorchModel(path).apply(model, [t])
+    model.compile(ff.SGDOptimizer(0.1), "sparse_categorical_crossentropy",
+                  ["accuracy"], final_tensor=out)
+
+    r = np.random.RandomState(0)
+    n = 4 * batch
+    xs = r.randn(n, 32).astype(np.float32)
+    ys = r.randint(0, 10, size=(n, 1)).astype(np.int32)
+    model.fit({"x": xs}, ys, epochs=3)
+    os.unlink(path)
+
+
+if __name__ == "__main__":
+    main()

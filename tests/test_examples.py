@@ -1,0 +1,106 @@
+"""Example-script smoke tests (reference: python/test.sh runs ~40 example
+invocations as its e2e suite). Each script runs in-process with tiny
+shapes on the CPU mesh; keras datasets use their synthetic fallback."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _load(relpath):
+    path = os.path.abspath(os.path.join(EXAMPLES, relpath))
+    sys.path.insert(0, os.path.dirname(path))
+    try:
+        spec = importlib.util.spec_from_file_location(
+            os.path.basename(relpath)[:-3] + "_example", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    finally:
+        sys.path.pop(0)
+
+
+def test_dlrm_example_tiny(capsys):
+    mod = _load("native/dlrm.py")
+    mod.main(["-b", "32", "-e", "1",
+              "--arch-embedding-size", "32-32-32-32",
+              "--arch-sparse-feature-size", "4",
+              "--arch-mlp-bot", "4-8-4",
+              "--arch-mlp-top", "20-8-1"])
+    assert "THROUGHPUT" in capsys.readouterr().out
+
+
+def test_dlrm_example_search_export(tmp_path, capsys):
+    out = str(tmp_path / "best.pb")
+    mod = _load("native/dlrm.py")
+    mod.main(["-b", "32", "-e", "1", "--budget", "10", "--export", out,
+              "--arch-embedding-size", "32-32-32-32",
+              "--arch-sparse-feature-size", "4",
+              "--arch-mlp-bot", "4-8-4",
+              "--arch-mlp-top", "20-8-1"])
+    assert os.path.exists(out)
+    # re-run importing the searched strategy
+    mod.main(["-b", "32", "-e", "1", "--import", out,
+              "--arch-embedding-size", "32-32-32-32",
+              "--arch-sparse-feature-size", "4",
+              "--arch-mlp-bot", "4-8-4",
+              "--arch-mlp-top", "20-8-1"])
+    assert "THROUGHPUT" in capsys.readouterr().out
+
+
+def test_alexnet_example_tiny(capsys):
+    mod = _load("native/alexnet.py")
+    mod.main(["-b", "8", "-e", "1", "--image-hw", "32"])
+    assert "THROUGHPUT" in capsys.readouterr().out
+
+
+def test_resnet_example_tiny(capsys):
+    mod = _load("native/resnet.py")
+    mod.main(["-b", "8", "-e", "1", "--depth", "18", "--image-hw", "32"])
+    assert "THROUGHPUT" in capsys.readouterr().out
+
+
+def test_candle_uno_example(capsys):
+    mod = _load("native/candle_uno.py")
+    mod.main(["-b", "16", "-e", "1"])
+    assert "THROUGHPUT" in capsys.readouterr().out
+
+
+def test_nmt_example_tiny(capsys):
+    mod = _load("native/nmt.py")
+    mod.main(["-b", "4", "-e", "1", "--seq-len", "6", "--vocab", "64"])
+    assert "THROUGHPUT" in capsys.readouterr().out
+
+
+def test_onnx_example(capsys):
+    _load("onnx/mlp_onnx.py").main()
+    assert "THROUGHPUT" in capsys.readouterr().out
+
+
+def test_fx_example(capsys):
+    _load("pytorch/mlp_fx.py").main()
+    out = capsys.readouterr().out
+    assert "max |ff - torch|" in out and "THROUGHPUT" in out
+
+
+def test_graphfile_example(capsys):
+    _load("pytorch/mlp_graphfile.py").main()
+    assert "THROUGHPUT" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("script", ["keras/mnist_mlp.py"])
+def test_keras_example(script, capsys, monkeypatch):
+    # shrink the synthetic dataset so the example finishes fast
+    import dlrm_flexflow_tpu.keras.datasets.mnist as mnist
+    orig = mnist.load_data
+    monkeypatch.setattr(
+        mnist, "load_data",
+        lambda *a, **k: orig(n_train=512, n_test=64))
+    _load(script).main()
+    # the VerifyMetrics callback may early-stop before the throughput line
+    out = capsys.readouterr().out
+    assert "THROUGHPUT" in out or "accuracy" in out
