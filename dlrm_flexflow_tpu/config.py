@@ -38,6 +38,7 @@ class FFConfig:
     import_strategy_file: str = ""
     export_strategy_file: str = ""
     profiling: bool = False
+    profile_dir: str = ""              # xprof trace output (jax.profiler)
     simulation: bool = False
     seed: int = 0
     compute_dtype: str = "float32"     # or "bfloat16" for MXU-rate matmuls
@@ -100,6 +101,8 @@ class FFConfig:
                     cfg.simulation = True
             elif a == "--profiling":
                 cfg.profiling = True
+            elif a == "--profile-dir":
+                cfg.profile_dir = take()
             elif a == "--seed":
                 cfg.seed = int(take())
             elif a == "--compute-dtype":

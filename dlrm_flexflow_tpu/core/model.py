@@ -283,6 +283,11 @@ class FFModel:
                     "module (not built yet in this checkout)") from e
             self.strategies = optimize(self, budget=self.config.search_budget,
                                        alpha=self.config.search_alpha)
+        # reference-style generic keys: the reference's DLRM strategies key
+        # ops as "embedding{i}" / "linear" / "concat" / "mse_loss" shared
+        # across ops of a type (dlrm_strategy.py, dlrm_strategy_hetero.cc) —
+        # resolve those for ops without an exact-name entry
+        self._resolve_generic_strategy_keys(ndev)
         # default: data parallelism for every op (reference mapper fallback,
         # mapper.cc:297-311)
         for op in self.ops:
@@ -318,6 +323,59 @@ class FFModel:
         self._build_steps()
         return self
 
+    def _resolve_generic_strategy_keys(self, ndev: int):
+        """Translate reference-keyed strategies onto this graph's ops.
+
+        The reference DLRM strategy files (src/runtime/dlrm_strategy.py,
+        dlrm_strategy_hetero.cc:28-49) key per-table embeddings as
+        "embedding{i}" (dims (1,1), whole table placed on device
+        `device_ids[0]` — model parallelism by placement) and share one
+        "linear"/"concat"/"mse_loss" entry across all ops of that type.
+        GSPMD translation: N tables round-robined over D distinct devices
+        become table-dim sharding of degree D on the stacked embedding (or
+        per-op placement for unfused tables); shared type keys apply to every
+        op of the type; CPU device_type marks host offload.
+        """
+        from ..ops.embedding import Embedding, EmbeddingBagStacked
+        from ..ops.linear import Linear
+        from ..ops.tensor_ops import Concat
+        strategies = self.strategies
+        if not strategies:
+            return
+        emb_keys = sorted((k for k in strategies
+                           if k.startswith("embedding")
+                           and k[len("embedding"):].isdigit()),
+                          key=lambda k: int(k[len("embedding"):]))
+        emb_ops = [op for op in self.ops
+                   if isinstance(op, (Embedding, EmbeddingBagStacked))]
+        for i, op in enumerate(emb_ops):
+            if op.name in strategies:
+                continue
+            if isinstance(op, EmbeddingBagStacked) and emb_keys:
+                pcs = [strategies[k] for k in emb_keys]
+                distinct = {pc.device_ids[:1] for pc in pcs if pc.device_ids}
+                degree = max(1, min(len(distinct), op.num_tables, ndev))
+                dtyp = pcs[0].device_type
+                strategies[op.name] = ParallelConfig(
+                    (1, degree, 1), device_type=dtyp)
+            elif not isinstance(op, EmbeddingBagStacked) and i < len(emb_keys):
+                strategies[op.name] = strategies[emb_keys[i]]
+        for op in self.ops:
+            if isinstance(op, InputOp) or op.name in strategies:
+                continue
+            generic = None
+            if isinstance(op, Linear):
+                generic = "linear"
+            elif isinstance(op, Concat):
+                generic = "concat"
+            if generic and generic in strategies:
+                pc = strategies[generic]
+                nd = op.outputs[0].num_dims
+                degs = tuple(pc.degrees[:nd]) + (1,) * (nd - len(pc.degrees))
+                strategies[op.name] = ParallelConfig(
+                    degs, device_type=pc.device_type,
+                    device_ids=pc.device_ids)
+
     # --- sharding plumbing --------------------------------------------
     def _effective_pc(self, op: Op) -> ParallelConfig:
         """Clamp strategy degrees to divide the actual tensor dims."""
@@ -338,14 +396,26 @@ class FFModel:
         asn = AxisAssigner(self.mesh)
         self._out_sharding: Dict[int, NamedSharding] = {}   # tensor.guid ->
         self._param_sharding: Dict[str, Dict[str, NamedSharding]] = {}
+        # ops host-offloaded by a hetero strategy (device_type "CPU",
+        # reference dlrm_strategy_hetero.cc:28-36): their compute runs under
+        # compute_on("device_host"), with operands staged HBM→host per step —
+        # the analog of the reference's zero-copy-memory staging
+        # (embedding.cu:280-283). Host-RAM *residency* for the params
+        # (pinned_host memory kind) is not enabled: this XLA build crashes
+        # the SPMD partitioner on host-memory-kind shardings and rejects
+        # donation of host buffers, so tables stay HBM-resident.
+        self._host_offload_ops: set = set()
 
         def spec_from_axes(axes_per_dim):
-            return NamedSharding(self.mesh, AxisAssigner.axes_to_spec(axes_per_dim))
+            return NamedSharding(self.mesh,
+                                 AxisAssigner.axes_to_spec(axes_per_dim))
 
         for op in self.ops:
             if isinstance(op, InputOp):
                 continue
             pc = self._effective_pc(op)
+            if pc.device_type == "CPU":
+                self._host_offload_ops.add(op.name)
             try:
                 out_axes = asn.assign(pc.degrees)
             except ValueError:
@@ -371,6 +441,18 @@ class FFModel:
                     pname: spec_from_axes(axes)
                     for pname, axes in p_axes.items()}
 
+        self._propagate_host_offload_to_views()
+        if len(self._host_offload_ops) > 3:
+            import jax as _jax
+            if _jax.default_backend() == "tpu":
+                import warnings
+                warnings.warn(
+                    f"{len(self._host_offload_ops)} ops are host-offloaded; "
+                    "this TPU compiler build is known to crash (SIGABRT) on "
+                    "many separate host-compute regions. Prefer the fused "
+                    "stacked-embedding form (build_dlrm "
+                    "fuse_embeddings=True), which keeps one host region.")
+
         # model inputs: shard the sample dim over all mesh axes when possible
         flat_axes = tuple(self.mesh.axis_names)
         ndev = int(np.prod([self.mesh.shape[a] for a in flat_axes]))
@@ -390,12 +472,39 @@ class FFModel:
             self._label_sharding = NamedSharding(self.mesh, PartitionSpec())
 
     # --- forward interpreter ------------------------------------------
+    def _propagate_host_offload_to_views(self):
+        """Pull zero-FLOP view ops (reshape/flat/transpose) into the host
+        region when every producer of their inputs is host-offloaded.
+
+        Views are free on either side of the boundary, but leaving them on
+        the device puts the host→device transfer *before* the view, and
+        this XLA build miscompiles the view's backward at that seam (a
+        bitcast between the host buffer and the TPU tiled layout hits
+        "Bitcast cannot have different shape sizes"). Running the view on
+        the host moves the transfer after it, which compiles and keeps one
+        boundary per host subgraph.
+        """
+        from ..ops.tensor_ops import Flat, Reshape, Transpose
+        if not self._host_offload_ops:
+            return
+        for op in self.ops:  # construction order is topological
+            if not isinstance(op, (Reshape, Flat, Transpose)):
+                continue
+            producers = [t.owner_op for t in op.inputs]
+            if producers and all(
+                    p is not None and p.name in self._host_offload_ops
+                    for p in producers):
+                self._host_offload_ops.add(op.name)
+
     def _forward_env(self, params, op_state, batch: Dict[str, Any],
                      training: bool, rng):
         """Run the graph, returning tensor.guid -> value and new op_state."""
+        import contextlib
+
         env: Dict[int, Any] = {}
         new_state: Dict[str, Any] = {}
         constrain = jax.lax.with_sharding_constraint
+        host_ops = getattr(self, "_host_offload_ops", set())
         for t in self.input_tensors:
             env[t.guid] = batch[t.name]
         for op in self.ops:
@@ -403,13 +512,41 @@ class FFModel:
                 continue
             xs = [env[t.guid] for t in op.inputs]
             p = params.get(op.name, {})
+            host = op.name in host_ops
+            if host:
+                # hetero host offload (reference CPU device_type +
+                # embedding_avx2.cc CPU kernels): run this op's compute on
+                # the host; operands are explicitly staged HBM→host→HBM,
+                # the analog of the reference's zero-copy-memory staging
+                # (embedding.cu:280-283)
+                from jax.experimental.compute_on import compute_on
+                ctx = compute_on("device_host")
+                xs = [jax.device_put(x, jax.memory.Space.Host) for x in xs]
+                p = {pn: jax.device_put(v, jax.memory.Space.Host)
+                     for pn, v in p.items()}
+            else:
+                ctx = contextlib.nullcontext()
             if hasattr(op, "apply_with_state"):
                 st = op_state.get(op.name, {})
-                outs, st2 = op.apply_with_state(p, st, xs, training=training,
-                                                rng=rng)
+                if host:
+                    st = jax.tree.map(
+                        lambda v: jax.device_put(v, jax.memory.Space.Host),
+                        st)
+                with ctx:
+                    outs, st2 = op.apply_with_state(p, st, xs,
+                                                    training=training,
+                                                    rng=rng)
+                if host:
+                    st2 = jax.tree.map(
+                        lambda v: jax.device_put(v, jax.memory.Space.Device),
+                        st2)
                 new_state[op.name] = st2
             else:
-                outs = op.apply(p, xs, training=training, rng=rng)
+                with ctx:
+                    outs = op.apply(p, xs, training=training, rng=rng)
+            if host:
+                outs = [jax.device_put(o, jax.memory.Space.Device)
+                        for o in outs]
             for t, v in zip(op.outputs, outs):
                 sh = self._out_sharding.get(t.guid)
                 if sh is not None:
@@ -594,23 +731,31 @@ class FFModel:
         self._train_step.lower(self.params, self.opt_state, self.op_state,
                                db, jnp.asarray(0, jnp.int32)).compile()
 
+        if self.config.profiling:
+            # per-op timing report (reference --profiling cudaEvent prints,
+            # linear.cu:499-531)
+            from ..utils.profiling import format_profile, profile_ops
+            print(format_profile(profile_ops(self)))
+
+        from ..utils.profiling import TraceContext
         start = time.time()
         mets = None
-        for epoch in range(epochs):
-            self.reset_metrics()
-            for b in range(num_batches):
-                sl = slice(b * bs, (b + 1) * bs)
-                batch = {k: v[sl] for k, v in inputs.items()}
-                batch["label"] = labels[sl]
-                mets = self.train_batch(batch)
-            if verbose:
-                # host sync happens here only (metrics are async futures)
-                print(f"epoch {epoch}: loss={float(mets['loss']):.6f} "
-                      + self.perf.summary_line())
-            if callbacks:
-                for cb in callbacks:
-                    cb(self, epoch, self.perf.report())
-        jax.block_until_ready(self.params)
+        with TraceContext(self.config.profile_dir or None):
+            for epoch in range(epochs):
+                self.reset_metrics()
+                for b in range(num_batches):
+                    sl = slice(b * bs, (b + 1) * bs)
+                    batch = {k: v[sl] for k, v in inputs.items()}
+                    batch["label"] = labels[sl]
+                    mets = self.train_batch(batch)
+                if verbose:
+                    # host sync happens here only (metrics are async futures)
+                    print(f"epoch {epoch}: loss={float(mets['loss']):.6f} "
+                          + self.perf.summary_line())
+                if callbacks:
+                    for cb in callbacks:
+                        cb(self, epoch, self.perf.report())
+            jax.block_until_ready(self.params)
         elapsed = time.time() - start
         num_samples = num_batches * bs * epochs
         throughput = num_samples / elapsed if elapsed > 0 else float("inf")

@@ -213,9 +213,8 @@ def load_dlrm_hdf5(path: str):
         x_int = np.asarray(f["X_int"], dtype=np.float32)
         x_cat = np.asarray(f["X_cat"], dtype=np.int32)
         y = np.asarray(f["y"], dtype=np.float32).reshape(-1, 1)
-    # log-transform dense features like the reference preprocessing
-    # (examples/cpp/DLRM/preprocess_hdf.py)
-    x_int = np.log1p(np.maximum(x_int, 0.0))
+    # X_int is already log-transformed by the preprocessor
+    # (examples/native/preprocess_hdf.py, reference preprocess_hdf.py)
     if x_cat.ndim == 2:
         x_cat = x_cat[:, :, None]  # (n, T) -> (n, T, bag=1)
     return {"dense": x_int, "sparse": x_cat}, y

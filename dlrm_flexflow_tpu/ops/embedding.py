@@ -43,17 +43,21 @@ AGGR_MODE_SUM = "sum"
 AGGR_MODE_AVG = "avg"
 
 
-def _pallas_ok(model, out_dim: int) -> bool:
+def _pallas_ok(model, out_dim: int, op_name: str = "") -> bool:
     """Use the Pallas row-streaming kernel when it applies: TPU backend,
     tile-aligned table width, single-chip execution (under a >1-device mesh
     the op runs inside GSPMD, where the XLA gather lowering shards; the
-    Pallas call would need a shard_map wrapper — future work)."""
+    Pallas call would need a shard_map wrapper — future work), and NOT
+    host-offloaded (a Mosaic TPU custom call cannot run inside a
+    compute_on("device_host") region)."""
     if not getattr(model.config, "use_pallas", False):
         return False
     from .pallas.embedding_kernel import supports
     if not supports(out_dim):
         return False
     if jax.default_backend() != "tpu":
+        return False
+    if op_name and op_name in getattr(model, "_host_offload_ops", set()):
         return False
     mesh = getattr(model, "mesh", None)
     return mesh is None or mesh.size <= 1
@@ -90,10 +94,14 @@ class Embedding(Op):
         (idx,) = xs
         table = params["kernel"]
         if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG) and idx.ndim == 2
-                and _pallas_ok(self.model, self.out_dim)):
+                and _pallas_ok(self.model, self.out_dim, self.name)):
             from .pallas.embedding_kernel import embedding_bag
             return [embedding_bag(table, idx, self.aggr)]
-        rows = jnp.take(table, idx.astype(jnp.int32), axis=0)  # (..., bag, d)
+        # mode="wrap": modulo-index gather — scalar-only constants, so the
+        # trace stays valid under compute_on host offload (the reference's
+        # CUDA gather does no bounds handling at all, embedding.cu:173-224)
+        rows = jnp.take(table, idx.astype(jnp.int32), axis=0,
+                        mode="wrap")  # (..., bag, d)
         if self.aggr == AGGR_MODE_SUM:
             rows = jnp.sum(rows, axis=-2)
         elif self.aggr == AGGR_MODE_AVG:
@@ -166,7 +174,7 @@ class EmbeddingBagStacked(Op):
         idx = idx.astype(jnp.int32)
 
         if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
-                and _pallas_ok(self.model, self.out_dim)):
+                and _pallas_ok(self.model, self.out_dim, self.name)):
             from .pallas.embedding_kernel import stacked_embedding_bag
             return [stacked_embedding_bag(table, idx, self.aggr)]
 
@@ -174,7 +182,7 @@ class EmbeddingBagStacked(Op):
         # the full batch. With dim-0 sharded params + matching sharding
         # constraints this lowers to per-device local gathers + all-to-all.
         def one_table(tbl, ix):  # tbl (rows, d), ix (batch, bag)
-            rows = jnp.take(tbl, ix, axis=0)  # (batch, bag, d)
+            rows = jnp.take(tbl, ix, axis=0, mode="wrap")  # (batch, bag, d)
             if self.aggr == AGGR_MODE_AVG:
                 return jnp.mean(rows, axis=1)
             return jnp.sum(rows, axis=1)

@@ -17,6 +17,13 @@ Two on-disk formats, selected by extension:
   prebuilt strategy files (src/runtime/dlrm_strategy_*.pb) and writes files
   its proto2 parser accepts — goldens stay interoperable. DeviceType GPU(0)
   maps to "TPU" here; CPU(1) stays "CPU" (the hetero host-offload case).
+
+Dim-order note: the reference stores dims in Legion coordinate order, where
+the SAMPLE dim is LAST (Op::get_data_parallel_config sets
+`dim[nDims-1] = num_parts`, model.cc:282-293; the generated DLRM strategies
+write `dims = [1, gpu]` for data-parallel 2-D ops, dlrm_strategy.py). Our
+ParallelConfig is sample-FIRST (pconfig.py), so the .pb codec reverses the
+dims list on both load and save. JSON files are written sample-first.
 """
 
 from __future__ import annotations
@@ -110,7 +117,8 @@ def save_strategies_pb(path: str, strategies: StrategyMap) -> None:
     body = bytearray()
     for name, pc in sorted(strategies.items()):
         dt = 1 if pc.device_type == "CPU" else 0
-        op = _encode_op(name, dt, list(pc.degrees), list(pc.device_ids))
+        op = _encode_op(name, dt, list(reversed(pc.degrees)),
+                        list(pc.device_ids))
         body += b"\x0a" + _varint(len(op)) + op     # Strategy.ops = 1
     with open(path, "wb") as f:
         f.write(bytes(body))
@@ -143,7 +151,7 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
             elif f2 == 4:
                 dev_ids += _unpack_varints(v2) if wt2 == 2 else [v2]
         out[name] = ParallelConfig(
-            tuple(dims), device_type="CPU" if dt == 1 else "TPU",
+            tuple(reversed(dims)), device_type="CPU" if dt == 1 else "TPU",
             device_ids=tuple(dev_ids))
     return out
 

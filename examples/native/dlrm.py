@@ -73,6 +73,15 @@ def main(argv=None):
         loader = FFBinDataLoader(model, data_path)
         num_batches = loader.num_batches
         next_batch = loader.next_batch
+    elif data_path and (data_path.endswith(".h5")
+                        or data_path.endswith(".hdf5")):
+        # Criteo HDF5 from examples/native/preprocess_hdf.py (reference
+        # dlrm.cc:266-382 reads the same X_int/X_cat/y layout)
+        from dlrm_flexflow_tpu.data import load_dlrm_hdf5
+        x, y = load_dlrm_hdf5(data_path)
+        loader = SingleDataLoader(model, x, y)
+        num_batches = loader.num_batches
+        next_batch = loader.next_batch
     elif data_path:
         d = np.load(data_path)
         loader = SingleDataLoader(
@@ -90,6 +99,13 @@ def main(argv=None):
     # trace in epoch 0 before begin_trace, dlrm.cc:178-185)
     model.train_batch_device(next_batch())
     jax.block_until_ready(model.params)
+
+    if cfg.profiling:
+        # per-op timing table (reference --profiling cudaEvent prints)
+        from dlrm_flexflow_tpu.utils.profiling import (format_profile,
+                                                       profile_ops)
+        print(format_profile(profile_ops(model)))
+    from dlrm_flexflow_tpu.utils.profiling import TraceContext
     # bound the number of in-flight async steps: XLA CPU's in-process
     # collectives can starve when many multi-device executions queue up on
     # few host cores; on real TPUs the device is the bottleneck, so a much
@@ -97,14 +113,15 @@ def main(argv=None):
     throttle = 1 if jax.default_backend() == "cpu" else 16
     t0 = time.time()
     step = 0
-    for _epoch in range(cfg.epochs):
-        model.reset_metrics()
-        for _b in range(num_batches):
-            mets = model.train_batch_device(next_batch())
-            step += 1
-            if step % throttle == 0:
-                jax.block_until_ready(mets["loss"])
-    jax.block_until_ready(model.params)
+    with TraceContext(cfg.profile_dir or None):
+        for _epoch in range(cfg.epochs):
+            model.reset_metrics()
+            for _b in range(num_batches):
+                mets = model.train_batch_device(next_batch())
+                step += 1
+                if step % throttle == 0:
+                    jax.block_until_ready(mets["loss"])
+        jax.block_until_ready(model.params)
     elapsed = time.time() - t0
     n_samples = cfg.epochs * num_batches * cfg.batch_size
     print(f"{model.perf.summary_line()}")

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""DLRM strategy generator — reference dlrm_strategy.py / gen_strategy.sh /
+dlrm_strategy_hetero.cc parity.
+
+The reference generates stand-alone C++ binaries that emit protobuf strategy
+files (src/runtime/dlrm_strategy.py writes dlrm_strategy.cc; gen_strategy.sh
+builds+runs it; dlrm_strategy_hetero.cc is the CPU-embedding variant). Here
+the generator writes the same proto2 wire format directly
+(parallel/strategy_io.py) with the same op-key scheme:
+
+- "embedding{i}"  i < num_emb : dims (1,1) — whole table — round-robin
+  device_ids[i % num_devices]; DeviceType CPU when --hetero (host offload,
+  dlrm_strategy_hetero.cc:28-36).
+- "linear", "mse_loss", "concat": data-parallel over all devices (reference
+  writes Legion-order dims [1, D]; the codec handles the reversal).
+
+The emitted files load through FFModel.compile(--import ...) on this
+framework AND parse with the reference's proto2 schema — and the reference's
+own prebuilt .pb files load here, via the generic-key resolution in
+FFModel._resolve_generic_strategy_keys.
+
+Usage:
+  python gen_strategy.py -g 8 -e 8                 # dlrm_strategy_8embs_8gpus.pb
+  python gen_strategy.py -g 1 -e 8 --hetero -c 1   # dlrm_strategy_8nEmb_1cpu_1gpu.pb
+  python gen_strategy.py -g 8 -e 16 -o out.pb
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
+from dlrm_flexflow_tpu.parallel.strategy_io import save_strategies
+
+
+def build_strategy(num_devices: int, num_emb: int, hetero: bool = False,
+                   num_cpus: int = 1):
+    """Reference dlrm_strategy.cc:242-296 semantics: embeddings round-robin
+    one-whole-table-per-device; linear/mse_loss/concat data-parallel."""
+    strategies = {}
+    for i in range(num_emb):
+        if hetero:
+            strategies[f"embedding{i}"] = ParallelConfig(
+                (1, 1), device_type="CPU", device_ids=(i % max(num_cpus, 1),))
+        else:
+            strategies[f"embedding{i}"] = ParallelConfig(
+                (1, 1), device_ids=(i % num_devices,))
+    dp = ParallelConfig((num_devices, 1),
+                        device_ids=tuple(range(num_devices)))
+    for name in ("linear", "mse_loss", "concat"):
+        strategies[name] = dp
+    return strategies
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-g", "--num-gpu", "--num-devices", dest="num_devices",
+                   type=int, default=8, help="number of TPU chips")
+    p.add_argument("-e", "--num-emb", type=int, default=8,
+                   help="number of embedding tables")
+    p.add_argument("-c", "--num-cpus", type=int, default=1,
+                   help="hetero: number of host (CPU) workers")
+    p.add_argument("--hetero", action="store_true",
+                   help="place embeddings on host CPUs "
+                        "(dlrm_strategy_hetero.cc)")
+    p.add_argument("-o", "--output", default=None,
+                   help="output path (.pb or .json); default uses the "
+                        "reference naming scheme")
+    opts = p.parse_args()
+
+    out = opts.output
+    if out is None:
+        if opts.hetero:
+            out = (f"dlrm_strategy_{opts.num_emb}nEmb_{opts.num_cpus}cpu_"
+                   f"{opts.num_devices}gpu.pb")
+        else:
+            out = f"dlrm_strategy_{opts.num_emb}embs_{opts.num_devices}gpus.pb"
+    s = build_strategy(opts.num_devices, opts.num_emb, opts.hetero,
+                       opts.num_cpus)
+    save_strategies(out, s)
+    print("Created " + out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Regenerate the prebuilt DLRM strategy files under strategies/
+# (reference: src/runtime/gen_strategy.sh builds+runs the generated C++
+# emitters; here the python generator writes the wire format directly).
+set -e
+cd "$(dirname "$0")"
+mkdir -p ../../strategies
+python gen_strategy.py -g 8 -e 8 -o ../../strategies/dlrm_strategy_8embs_8gpus.pb
+python gen_strategy.py -g 8 -e 16 -o ../../strategies/dlrm_strategy_16embs_8gpus.pb
+python gen_strategy.py -g 16 -e 16 -o ../../strategies/dlrm_strategy_16embs_16gpus.pb
+python gen_strategy.py -g 1 -e 8 --hetero -c 1 -o ../../strategies/dlrm_strategy_8nEmb_1cpu_1gpu.pb

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Criteo → HDF5 preprocessing for the DLRM app.
+
+Parity with the reference preprocessor (reference:
+examples/cpp/DLRM/preprocess_hdf.py): converts a preprocessed Criteo
+`.npz` (keys X_cat/X_int/y, the output of facebook dlrm's
+data_utils.getCriteoAdData) into the HDF5 layout the DLRM data loader
+reads (datasets X_cat int64, X_int float32 log-transformed, y float32 —
+reference dlrm.cc:266-382 probes exactly these).
+
+Also accepts raw Criteo Kaggle TSV (label + 13 int + 26 hex-categorical
+columns per line) so the whole pipeline runs without the torch-side
+preprocessing: integers are log1p'd, categoricals are hashed into
+`--hash-size` buckets per feature (the modulus trick the DLRM paper uses).
+
+Usage:
+  python preprocess_hdf.py -i kaggle_processed.npz -o train.h5
+  python preprocess_hdf.py -i train.txt -o train.h5 --hash-size 100000
+"""
+
+import argparse
+
+import numpy as np
+
+
+def convert_npz(path: str):
+    """Reference behavior: X_cat→int64, X_int→log(x+1) float32, y→float32."""
+    data = np.load(path)
+    x_cat = data["X_cat"].astype(np.int64)
+    # clamp negatives before the log transform (Criteo int features go
+    # below -1; log(x+1) would produce NaN)
+    x_int = np.log(np.maximum(data["X_int"].astype(np.float32), 0.0) + 1)
+    y = data["y"].astype(np.float32)
+    return x_int, x_cat, y
+
+
+def convert_tsv(path: str, hash_size: int, num_int: int = 13,
+                num_cat: int = 26):
+    """Raw Criteo Kaggle TSV: label \\t 13 ints \\t 26 hex cats."""
+    labels, ints, cats = [], [], []
+    with open(path) as f:
+        for line in f:
+            cols = line.rstrip("\n").split("\t")
+            if len(cols) < 1 + num_int + num_cat:
+                cols = cols + [""] * (1 + num_int + num_cat - len(cols))
+            labels.append(np.float32(cols[0] or 0))
+            ints.append([max(int(c), 0) if c else 0
+                         for c in cols[1:1 + num_int]])
+            cats.append([int(c, 16) % hash_size if c else 0
+                         for c in cols[1 + num_int:1 + num_int + num_cat]])
+    x_int = np.log(np.asarray(ints, dtype=np.float32) + 1)
+    x_cat = np.asarray(cats, dtype=np.int64)
+    y = np.asarray(labels, dtype=np.float32)
+    return x_int, x_cat, y
+
+
+def write_hdf5(path: str, x_int, x_cat, y):
+    import h5py
+    with h5py.File(path, "w") as hdf:
+        hdf.create_dataset("X_cat", data=x_cat)
+        hdf.create_dataset("X_int", data=x_int)
+        hdf.create_dataset("y", data=y)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-i", "--input", required=True,
+                        help="input .npz (X_cat/X_int/y) or raw Criteo .tsv")
+    parser.add_argument("-o", "--output", required=True,
+                        help="output HDF file")
+    parser.add_argument("--hash-size", type=int, default=10_000_000,
+                        help="per-feature hash buckets for raw TSV input")
+    args = parser.parse_args()
+
+    if args.input.endswith(".npz"):
+        x_int, x_cat, y = convert_npz(args.input)
+    else:
+        x_int, x_cat, y = convert_tsv(args.input, args.hash_size)
+    write_hdf5(args.output, x_int, x_cat, y)
+    print(f"wrote {args.output}: X_int {x_int.shape} X_cat {x_cat.shape} "
+          f"y {y.shape}")
+
+
+if __name__ == "__main__":
+    main()

@@ -5,6 +5,11 @@ The reference can only test multi-GPU behavior on real GPUs via SLURM
 framework (SURVEY.md §4) is that ALL distribution logic is testable on CPU.
 """
 
-from dlrm_flexflow_tpu.utils.testing import ensure_cpu_devices
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlrm_flexflow_tpu.utils.testing import ensure_cpu_devices  # noqa: E402
 
 ensure_cpu_devices(8)

@@ -104,3 +104,101 @@ def test_keras_example(script, capsys, monkeypatch):
     # the VerifyMetrics callback may early-stop before the throughput line
     out = capsys.readouterr().out
     assert "THROUGHPUT" in out or "accuracy" in out
+
+
+class TestPreprocessHdf:
+    """preprocess_hdf.py (reference examples/cpp/DLRM/preprocess_hdf.py
+    parity): npz and raw-TSV inputs → the HDF5 layout load_dlrm_hdf5 reads."""
+
+    def test_npz_roundtrip(self, tmp_path):
+        import subprocess
+        import sys
+
+        import numpy as np
+        from dlrm_flexflow_tpu.data import load_dlrm_hdf5
+        rng = np.random.RandomState(0)
+        npz = str(tmp_path / "in.npz")
+        h5 = str(tmp_path / "out.h5")
+        x_int = rng.randint(0, 100, size=(32, 13))
+        x_cat = rng.randint(0, 1000, size=(32, 26))
+        y = rng.randint(0, 2, size=(32,))
+        np.savez(npz, X_int=x_int, X_cat=x_cat, y=y)
+        subprocess.check_call([sys.executable,
+                               os.path.join(EXAMPLES, "native",
+                                            "preprocess_hdf.py"),
+                               "-i", npz, "-o", h5])
+        x, labels = load_dlrm_hdf5(h5)
+        assert x["dense"].shape == (32, 13)
+        assert x["sparse"].shape == (32, 26, 1)
+        assert labels.shape == (32, 1)
+        np.testing.assert_allclose(
+            x["dense"], np.log(x_int.astype(np.float32) + 1), rtol=1e-6)
+
+    def test_raw_tsv(self, tmp_path):
+        import subprocess
+        import sys
+
+        import numpy as np
+        from dlrm_flexflow_tpu.data import load_dlrm_hdf5
+        tsv = tmp_path / "day.txt"
+        rows = []
+        rng = np.random.RandomState(1)
+        for _ in range(8):
+            label = str(rng.randint(0, 2))
+            ints = [str(rng.randint(0, 50)) for _ in range(13)]
+            cats = ["%08x" % rng.randint(0, 2**31) for _ in range(26)]
+            rows.append("\t".join([label] + ints + cats))
+        tsv.write_text("\n".join(rows) + "\n")
+        h5 = str(tmp_path / "out.h5")
+        subprocess.check_call([sys.executable,
+                               os.path.join(EXAMPLES, "native",
+                                            "preprocess_hdf.py"),
+                               "-i", str(tsv), "-o", h5,
+                               "--hash-size", "1000"])
+        x, labels = load_dlrm_hdf5(h5)
+        assert x["dense"].shape == (8, 13)
+        assert x["sparse"].shape == (8, 26, 1)
+        assert x["sparse"].max() < 1000
+
+    def test_npz_negative_ints_clamped(self, tmp_path):
+        import subprocess
+        import sys
+
+        import numpy as np
+        from dlrm_flexflow_tpu.data import load_dlrm_hdf5
+        npz = str(tmp_path / "in.npz")
+        h5 = str(tmp_path / "out.h5")
+        x_int = np.array([[-3, 0, 5]], dtype=np.int64)
+        np.savez(npz, X_int=x_int, X_cat=np.zeros((1, 2), np.int64),
+                 y=np.zeros((1,)))
+        subprocess.check_call([sys.executable,
+                               os.path.join(EXAMPLES, "native",
+                                            "preprocess_hdf.py"),
+                               "-i", npz, "-o", h5])
+        x, _ = load_dlrm_hdf5(h5)
+        assert np.isfinite(x["dense"]).all()
+
+    def test_dlrm_app_reads_hdf5(self, tmp_path, capsys):
+        """preprocess → dlrm.py --data-path out.h5 end-to-end."""
+        import subprocess
+        import sys
+
+        import numpy as np
+        rng = np.random.RandomState(3)
+        npz = str(tmp_path / "in.npz")
+        h5 = str(tmp_path / "c.h5")
+        np.savez(npz, X_int=rng.randint(0, 50, size=(64, 4)),
+                 X_cat=rng.randint(0, 64, size=(64, 8)),
+                 y=rng.randint(0, 2, size=(64,)))
+        subprocess.check_call([sys.executable,
+                               os.path.join(EXAMPLES, "native",
+                                            "preprocess_hdf.py"),
+                               "-i", npz, "-o", h5])
+        mod = _load("native/dlrm.py")
+        mod.main(["-b", "16", "-e", "1",
+                  "--arch-embedding-size",
+                  "64-64-64-64-64-64-64-64",
+                  "--arch-sparse-feature-size", "8",
+                  "--arch-mlp-bot", "4-16-8", "--arch-mlp-top", "72-16-1",
+                  "--data-path", h5])
+        assert "THROUGHPUT" in capsys.readouterr().out

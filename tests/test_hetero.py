@@ -1,0 +1,94 @@
+"""Hetero (host-offload) strategy tests.
+
+Parity with the reference's heterogeneous DLRM strategy that places the
+embedding tables on CPUs while MLPs run on accelerators (reference:
+src/runtime/dlrm_strategy_hetero.cc:28-49, CPU embedding kernels
+src/ops/embedding_avx2.cc). Here `device_type == "CPU"` in a ParallelConfig
+routes the op's compute through compute_on("device_host") and parks its
+parameters in pinned host memory; numerics must be identical to the
+all-device run.
+"""
+
+import os
+
+import numpy as np
+
+import jax
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm, \
+    synthetic_batch
+from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
+
+
+def _train(strategies, steps=3, ndev=1):
+    dcfg = DLRMConfig(embedding_size=[64] * 8, sparse_feature_size=8,
+                      mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1])
+    model = ff.FFModel(ff.FFConfig(batch_size=16, seed=11))
+    build_dlrm(model, dcfg, fuse_embeddings=False)
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+                  mesh=make_mesh(num_devices=ndev), strategies=strategies)
+    model.init_layers()
+    for s in range(steps):
+        x, y = synthetic_batch(dcfg, 16, seed=s)
+        x["label"] = y
+        model.train_batch(x)
+    return model, jax.tree.map(np.asarray, model.params)
+
+
+class TestHetero:
+    def test_cpu_embedding_strategy_runs_and_matches(self):
+        hetero = {f"emb_{i}": ParallelConfig((1, 1), device_type="CPU")
+                  for i in range(8)}
+        model_h, params_h = _train(hetero)
+        model_d, params_d = _train(None)
+        assert model_h._host_offload_ops == {f"emb_{i}" for i in range(8)}
+        flat_h = jax.tree_util.tree_leaves_with_path(params_h)
+        flat_d = dict(jax.tree_util.tree_leaves_with_path(params_d))
+        for path, v in flat_h:
+            np.testing.assert_allclose(v, flat_d[path], rtol=1e-5, atol=1e-6,
+                                       err_msg=str(path))
+
+    def test_host_compute_in_hlo(self):
+        """The lowered train step must actually carry host-computation
+        annotations for the offloaded embeddings (compute_on lowers to
+        XLA frontend attribute _xla_compute_type="host")."""
+        import jax.numpy as jnp
+        hetero = {f"emb_{i}": ParallelConfig((1, 1), device_type="CPU")
+                  for i in range(8)}
+        model, _ = _train(hetero, steps=1)
+        dcfg = DLRMConfig(embedding_size=[64] * 8, sparse_feature_size=8,
+                          mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1])
+        x, y = synthetic_batch(dcfg, 16, seed=0)
+        x["label"] = y
+        db = model._device_batch(x)
+        hlo = model._train_step.lower(
+            model.params, model.opt_state, model.op_state, db,
+            jnp.asarray(0, jnp.int32)).as_text()
+        assert "_xla_compute_type" in hlo
+
+    def test_hetero_pb_file_drives_offload(self, tmp_path):
+        import subprocess
+        import sys
+        pb = str(tmp_path / "het.pb")
+        subprocess.check_call([sys.executable,
+                               os.path.join(_REPO, "examples", "native",
+                                            "gen_strategy.py"), "-g", "1",
+                               "-e", "8", "--hetero", "-o", pb])
+        dcfg = DLRMConfig(embedding_size=[64] * 8, sparse_feature_size=8,
+                          mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1])
+        cfg = ff.FFConfig(batch_size=16)
+        cfg.import_strategy_file = pb
+        model = ff.FFModel(cfg)
+        build_dlrm(model, dcfg, fuse_embeddings=False)
+        model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+                      mesh=make_mesh(num_devices=1))
+        assert {f"emb_{i}" for i in range(8)} <= model._host_offload_ops
+        model.init_layers()
+        x, y = synthetic_batch(dcfg, 16, seed=0)
+        x["label"] = y
+        mets = model.train_batch(x)
+        assert np.isfinite(float(mets["loss"]))

@@ -10,6 +10,8 @@ import os
 
 import pytest
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
 from dlrm_flexflow_tpu.parallel.strategy_io import (load_strategies,
                                                     load_strategies_pb,
@@ -63,9 +65,12 @@ class TestStrategyIO:
             pc = embs[f"embedding{i}"]
             assert pc.degrees == (1, 1)
             assert pc.device_ids == (i,)
-        # MLP/interaction ops are data-parallel over all 8 devices
+        # MLP/interaction ops are data-parallel over all 8 devices; the
+        # reference writes dims in Legion order (sample LAST: [1, 8]), which
+        # the codec must reverse into our sample-first (8, 1)
         others = [v for k, v in s.items() if not k.startswith("embedding")]
         assert others and all(len(v.device_ids) == 8 for v in others)
+        assert all(v.degrees == (8, 1) for v in others)
 
     @pytest.mark.skipif(not os.path.exists(_REF_PB),
                         reason="reference tree not mounted")
@@ -76,3 +81,58 @@ class TestStrategyIO:
         again = load_strategies_pb(path)
         assert {k: (v.degrees, v.device_ids) for k, v in s.items()} == \
             {k: (v.degrees, v.device_ids) for k, v in again.items()}
+
+
+class TestGenStrategyAndGenericKeys:
+    """gen_strategy.py (reference dlrm_strategy.py/gen_strategy.sh parity)
+    and generic-key resolution onto a real graph."""
+
+    def _compile_dlrm_with(self, strategies_path, fuse=True, ndev=8):
+        import dlrm_flexflow_tpu as ff
+        from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+        from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+        cfg = ff.FFConfig(batch_size=16)
+        cfg.import_strategy_file = strategies_path
+        model = ff.FFModel(cfg)
+        dcfg = DLRMConfig(embedding_size=[64] * 8, sparse_feature_size=8,
+                          mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1])
+        build_dlrm(model, dcfg, fuse_embeddings=fuse)
+        model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                      ["mse"], mesh=make_mesh(num_devices=ndev))
+        return model, dcfg
+
+    def test_generator_matches_reference_scheme(self, tmp_path):
+        import subprocess
+        import sys
+        out = str(tmp_path / "dlrm_strategy_8embs_8gpus.pb")
+        subprocess.check_call([sys.executable,
+                               os.path.join(_REPO, "examples", "native",
+                                            "gen_strategy.py"),
+                               "-g", "8", "-e", "8", "-o", out])
+        s = load_strategies(out)
+        assert s["embedding3"].device_ids == (3,)
+        assert s["linear"].degrees == (8, 1)
+        assert s["concat"].degrees == (8, 1)
+
+    def test_prebuilt_pb_drives_compile_fused(self):
+        """embedding0..7 round-robin over 8 devices → table-parallel stacked
+        embedding (degree 8 on the table dim); linear/concat data-parallel."""
+        model, _ = self._compile_dlrm_with(
+            os.path.join(_REPO, "strategies", "dlrm_strategy_8embs_8gpus.pb"), fuse=True)
+        emb_pc = model.strategies["emb_stack"]
+        assert emb_pc.degrees == (1, 8, 1)
+        lin_pc = model.strategies["bot_dense_0"]
+        assert lin_pc.degrees[0] == 8
+        assert model.strategies["interaction_concat"].degrees[0] == 8
+
+    def test_prebuilt_pb_drives_compile_unfused(self):
+        model, _ = self._compile_dlrm_with(
+            os.path.join(_REPO, "strategies", "dlrm_strategy_8embs_8gpus.pb"), fuse=False)
+        for i in range(8):
+            assert model.strategies[f"emb_{i}"].degrees == (1, 1)
+
+    def test_hetero_pb_marks_cpu(self):
+        s = load_strategies(os.path.join(_REPO, "strategies", "dlrm_strategy_8nEmb_1cpu_1gpu.pb"))
+        for i in range(8):
+            assert s[f"embedding{i}"].device_type == "CPU"
+        assert s["linear"].device_type == "TPU"
