@@ -45,6 +45,11 @@ class FFConfig:
     # use Pallas kernels for supported ops when running single-chip on TPU
     # (embedding-bag row-streaming; falls back to XLA lowering otherwise)
     use_pallas: bool = True
+    # update only the gathered embedding rows under plain SGD instead of
+    # materializing table-sized dense gradients (numerically identical;
+    # avoids streaming the full tables through HBM every step). Disable
+    # with --dense-embedding-update.
+    sparse_embedding_update: bool = True
     unparsed: List[str] = field(default_factory=list)
 
     @property
@@ -107,6 +112,8 @@ class FFConfig:
                 cfg.seed = int(take())
             elif a == "--compute-dtype":
                 cfg.compute_dtype = take()
+            elif a == "--dense-embedding-update":
+                cfg.sparse_embedding_update = False
             else:
                 cfg.unparsed.append(a)
             i += 1

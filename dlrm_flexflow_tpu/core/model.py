@@ -497,8 +497,15 @@ class FFModel:
                 self._host_offload_ops.add(op.name)
 
     def _forward_env(self, params, op_state, batch: Dict[str, Any],
-                     training: bool, rng):
-        """Run the graph, returning tensor.guid -> value and new op_state."""
+                     training: bool, rng, overrides: Optional[Dict] = None,
+                     only_ops: Optional[set] = None):
+        """Run the graph, returning tensor.guid -> value and new op_state.
+
+        `overrides` maps op name -> precomputed output value; the op's
+        compute is skipped and the value used instead (the sparse-update
+        path threads embedding outputs through here so jax.grad yields
+        their cotangents without touching the tables). `only_ops` restricts
+        evaluation to a subset of ops (ancestor subgraphs)."""
         import contextlib
 
         env: Dict[int, Any] = {}
@@ -509,6 +516,14 @@ class FFModel:
             env[t.guid] = batch[t.name]
         for op in self.ops:
             if isinstance(op, InputOp):
+                continue
+            if only_ops is not None and op.name not in only_ops:
+                continue
+            if overrides and op.name in overrides:
+                t = op.outputs[0]
+                v = overrides[op.name]
+                sh = self._out_sharding.get(t.guid)
+                env[t.guid] = constrain(v, sh) if sh is not None else v
                 continue
             xs = [env[t.guid] for t in op.inputs]
             p = params.get(op.name, {})
@@ -555,26 +570,93 @@ class FFModel:
         return env, new_state
 
     # --- jitted steps --------------------------------------------------
+    def _select_sparse_update_ops(self):
+        """Embedding-type ops whose tables can take the touched-rows-only
+        SGD update: plain SGD (no momentum/weight-decay — both terms touch
+        every row), op supports it, not host-offloaded. Disabled by
+        config.sparse_embedding_update=False (--dense-embedding-update)."""
+        from ..ops.embedding import Embedding, EmbeddingBagStacked
+        if not getattr(self.config, "sparse_embedding_update", True):
+            return []
+        opt = self.optimizer
+        if (not isinstance(opt, SGDOptimizer) or opt.momentum != 0.0
+                or opt.weight_decay != 0.0):
+            return []
+        host = getattr(self, "_host_offload_ops", set())
+        return [op for op in self.ops
+                if isinstance(op, (Embedding, EmbeddingBagStacked))
+                and op.supports_sparse_update() and op.name not in host]
+
+    def _ancestor_op_names(self, targets) -> set:
+        out: set = set()
+
+        def visit(op):
+            if isinstance(op, InputOp) or op.name in out:
+                return
+            out.add(op.name)
+            for t in op.inputs:
+                if t.owner_op is not None:
+                    visit(t.owner_op)
+
+        for op in targets:
+            visit(op)
+        return out
+
     def _build_steps(self):
         loss_f = losses_mod.loss_fn(self.loss_type)
         logits_guid = self._logits_tensor.guid
         preds_guid = self._preds_tensor.guid
         metric_names = self.metrics
         loss_type = self.loss_type
+        sparse_ops = self._select_sparse_update_ops()
+        self._sparse_update_ops = [op.name for op in sparse_ops]
+        anc_names = self._ancestor_op_names(sparse_ops)
 
-        def train_step(params, opt_state, op_state, batch, step):
+        def train_step(params, opt_state, op_state, msums, batch, step):
             rng = jax.random.fold_in(jax.random.PRNGKey(self.config.seed),
                                      step)
 
-            def objective(p, st):
-                env, st2 = self._forward_env(p, st, batch, True, rng)
-                loss = loss_f(env[logits_guid], batch["label"])
-                return loss, (env[preds_guid], st2)
+            if sparse_ops:
+                sparse_names = {op.name for op in sparse_ops}
+                p_dense = {k: v for k, v in params.items()
+                           if k not in sparse_names}
+                # phase A (no grad): index pipelines + embedding lookups
+                anc_env, _ = self._forward_env(params, op_state, batch,
+                                               True, rng,
+                                               only_ops=set(anc_names))
+                emb_vals = {op.name: anc_env[op.outputs[0].guid]
+                            for op in sparse_ops}
 
-            (loss, (preds, st2)), grads = jax.value_and_grad(
-                objective, has_aux=True)(params, op_state)
-            new_params, new_opt = self.optimizer.update(params, grads,
-                                                        opt_state)
+                # phase B: differentiate the rest of the graph w.r.t. the
+                # dense params AND the embedding outputs; the tables never
+                # enter the autodiff, so no table-sized dense gradient is
+                # ever materialized
+                def objective(pd, ev, st):
+                    env, st2 = self._forward_env(pd, st, batch, True, rng,
+                                                 overrides=dict(ev))
+                    loss = loss_f(env[logits_guid], batch["label"])
+                    return loss, (env[preds_guid], st2)
+
+                (loss, (preds, st2)), (gd, gev) = jax.value_and_grad(
+                    objective, argnums=(0, 1), has_aux=True)(
+                        p_dense, emb_vals, op_state)
+                new_params, new_opt = self.optimizer.update(p_dense, gd,
+                                                            opt_state)
+                lr = self.optimizer.lr
+                for op in sparse_ops:
+                    xs = [anc_env[t.guid] for t in op.inputs]
+                    new_params[op.name] = op.sparse_sgd_update(
+                        params[op.name], xs, gev[op.name], lr)
+            else:
+                def objective(p, st):
+                    env, st2 = self._forward_env(p, st, batch, True, rng)
+                    loss = loss_f(env[logits_guid], batch["label"])
+                    return loss, (env[preds_guid], st2)
+
+                (loss, (preds, st2)), grads = jax.value_and_grad(
+                    objective, has_aux=True)(params, op_state)
+                new_params, new_opt = self.optimizer.update(params, grads,
+                                                            opt_state)
             # CCE metrics expect probabilities; when the graph doesn't end
             # in a Softmax op, preds are raw logits — normalize them here
             if "crossentropy" in loss_type and preds_guid == logits_guid:
@@ -583,16 +665,36 @@ class FFModel:
                 mpreds = preds
             mets = metrics_mod.compute_metrics(metric_names, loss_type,
                                                mpreds, batch["label"])
+            # accumulate running sums ON DEVICE inside the step (the
+            # reference accumulates in device memory with atomics and folds
+            # once per epoch, metrics_functions.cu:57-135; host-side
+            # accumulation would dispatch extra tiny kernels every step)
+            new_msums = {k: msums[k] + v for k, v in mets.items()}
             mets["loss"] = loss
-            return new_params, new_opt, st2, mets
+            # the step counter stays device-resident across calls (feeding
+            # a fresh host int every step would be one H2D transfer/step)
+            return new_params, new_opt, st2, new_msums, step + 1, mets
 
         def eval_step(params, op_state, batch):
             env, _ = self._forward_env(params, op_state, batch, False, None)
             return env[preds_guid]
 
-        donate = (0, 1, 2)
+        donate = (0, 1, 2, 3)
         self._train_step = jax.jit(train_step, donate_argnums=donate)
         self._eval_step = jax.jit(eval_step)
+        # discover the metric-sum pytree structure with tiny dummies (the
+        # keys depend on metric names + loss type only)
+        dummy_preds = jnp.zeros((2,) + tuple(self._preds_tensor.shape[1:]),
+                                jnp.float32)
+        if self.loss_type == losses_mod.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+            dummy_labels = jnp.zeros((2, 1), jnp.int32)
+        else:
+            dummy_labels = jnp.zeros(dummy_preds.shape, jnp.float32)
+        self._msums_keys = sorted(metrics_mod.compute_metrics(
+            metric_names, loss_type, dummy_preds, dummy_labels).keys())
+
+    def _zero_msums(self):
+        return {k: jnp.zeros((), jnp.float32) for k in self._msums_keys}
 
     # ------------------------------------------------------------------
     # runtime verbs (reference model.cc:942-993)
@@ -628,6 +730,8 @@ class FFModel:
         self.op_state = op_state
         self.opt_state = self.optimizer.init_state(params)
         self._step = 0
+        self._step_dev = None
+        self._msums = None
         return self
 
     def _device_batch(self, batch: Dict[str, np.ndarray],
@@ -658,11 +762,19 @@ class FFModel:
     def train_batch_device(self, device_batch: Dict):
         """train_batch for a batch already staged on device (skips the
         host->device put; used by benchmark loops that pre-stage)."""
-        self.params, self.opt_state, self.op_state, mets = self._train_step(
-            self.params, self.opt_state, self.op_state, device_batch,
-            jnp.asarray(self._step, jnp.int32))
+        if not getattr(self, "_msums", None):
+            self._msums = self._zero_msums()
+        if getattr(self, "_step_dev", None) is None:
+            self._step_dev = jnp.asarray(self._step, jnp.int32)
+        (self.params, self.opt_state, self.op_state, self._msums,
+         self._step_dev, mets) = self._train_step(
+            self.params, self.opt_state, self.op_state, self._msums,
+            device_batch, self._step_dev)
         self._step += 1
-        self.perf.update({k: v for k, v in mets.items() if k != "loss"})
+        # the running sums live on device; PerfMetrics syncs at report().
+        # shallow-copy so perf.reset()/report() mutating perf.sums can
+        # never corrupt the jit carry
+        self.perf.sums = dict(self._msums)
         return mets
 
     def forward_batch(self, batch: Dict[str, np.ndarray]):
@@ -672,6 +784,7 @@ class FFModel:
     def reset_metrics(self):
         """Reference FFModel::reset_metrics (model.cc:934-940)."""
         self.perf.reset()
+        self._msums = None
 
     # --- parity verbs (eager, unfused) --------------------------------
     def forward(self, batch=None):
@@ -729,7 +842,8 @@ class FFModel:
         first["label"] = labels[:bs]
         db = self._device_batch(first)
         self._train_step.lower(self.params, self.opt_state, self.op_state,
-                               db, jnp.asarray(0, jnp.int32)).compile()
+                               self._zero_msums(), db,
+                               jnp.asarray(0, jnp.int32)).compile()
 
         if self.config.profiling:
             # per-op timing report (reference --profiling cudaEvent prints,

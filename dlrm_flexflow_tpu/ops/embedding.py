@@ -132,6 +132,37 @@ class Embedding(Op):
         bag = self.inputs[0].shape[-1] if self.inputs[0].num_dims > 1 else 1
         return float(bag * self.out_dim)  # bandwidth-bound; count adds
 
+    # ---- sparse (touched-rows-only) SGD update -------------------------
+    # The dense path materializes a gradient the size of the whole table
+    # (XLA scatter-add of row cotangents into zeros — the functional analog
+    # of the reference's table-sized gradient region, embedding.cu:95-105)
+    # and then streams the full table through the SGD update. For plain SGD
+    # that traffic is avoidable: dense grad rows are zero except gathered
+    # rows, so  w -= lr*grad  ==  scatter_add(w, idx, -lr*row_ct)  exactly
+    # (duplicate indices accumulate in both). model._build_steps routes
+    # eligible embeddings through this method.
+    def supports_sparse_update(self) -> bool:
+        return self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG, AGGR_MODE_NONE)
+
+    def sparse_sgd_update(self, params, xs, out_ct, lr):
+        """params - lr * d(loss)/d(table), given out_ct = d(loss)/d(output).
+        Touches only the gathered rows."""
+        (idx,) = xs
+        tbl = params["kernel"]
+        idx = idx.astype(jnp.int32) % self.num_entries  # match wrap gather
+        d = self.out_dim
+        ct = out_ct.astype(tbl.dtype)
+        if self.aggr == AGGR_MODE_AVG:
+            ct = ct / idx.shape[-1]
+        if self.aggr == AGGR_MODE_NONE:
+            upd = ct.reshape(-1, d)                     # (batch*bag, d)
+        else:
+            # each row of the bag receives the bag-sum's cotangent
+            upd = jnp.broadcast_to(ct[..., None, :],
+                                   idx.shape + (d,)).reshape(-1, d)
+        new = tbl.at[idx.reshape(-1)].add(-lr * upd)
+        return {"kernel": new}
+
 
 class EmbeddingBagStacked(Op):
     """N same-shape embedding bags fused into one (N, rows, dim) parameter.
@@ -207,3 +238,23 @@ class EmbeddingBagStacked(Op):
     def flops_per_sample(self) -> float:
         bag = self.inputs[0].shape[-1]
         return float(self.num_tables * bag * self.out_dim)
+
+    # ---- sparse (touched-rows-only) SGD update (see Embedding) ---------
+    def supports_sparse_update(self) -> bool:
+        return self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
+
+    def sparse_sgd_update(self, params, xs, out_ct, lr):
+        (idx,) = xs                       # (batch, T, bag)
+        tbl = params["kernel"]            # (T, rows, d)
+        idx = idx.astype(jnp.int32) % self.num_entries
+        ct = out_ct.astype(tbl.dtype)     # (batch, T, d)
+        if self.aggr == AGGR_MODE_AVG:
+            ct = ct / idx.shape[-1]
+        d = self.out_dim
+
+        def one_table(t, ix, c):          # (rows,d), (batch,bag), (batch,d)
+            upd = jnp.broadcast_to(c[:, None, :], ix.shape + (d,))
+            return t.at[ix.reshape(-1)].add(-lr * upd.reshape(-1, d))
+
+        new = jax.vmap(one_table, in_axes=(0, 1, 1))(tbl, idx, ct)
+        return {"kernel": new}

@@ -36,6 +36,21 @@ from dlrm_flexflow_tpu.utils.logging import get_logger
 log_app = get_logger("dlrm")
 
 
+def _check_sparse_bounds(sparse, dcfg):
+    """Fail loudly when categorical indices exceed the configured table
+    sizes: the embedding gather wraps indices modulo the table (silent row
+    aliasing), so a --hash-size / --arch-embedding-size mismatch would
+    otherwise train on wrong rows with a plausible-looking loss."""
+    maxes = sparse.reshape(sparse.shape[0], sparse.shape[1], -1).max(
+        axis=(0, 2))
+    for t, (mx, rows) in enumerate(zip(maxes, dcfg.embedding_size)):
+        if mx >= rows:
+            raise ValueError(
+                f"table {t}: max categorical index {int(mx)} >= configured "
+                f"table size {rows}; regenerate the dataset with a matching "
+                f"--hash-size or fix --arch-embedding-size")
+
+
 def main(argv=None):
     cfg = ff.FFConfig.parse_args(argv)
     dcfg = DLRMConfig.parse_args(cfg.unparsed)
@@ -79,11 +94,13 @@ def main(argv=None):
         # dlrm.cc:266-382 reads the same X_int/X_cat/y layout)
         from dlrm_flexflow_tpu.data import load_dlrm_hdf5
         x, y = load_dlrm_hdf5(data_path)
+        _check_sparse_bounds(x["sparse"], dcfg)
         loader = SingleDataLoader(model, x, y)
         num_batches = loader.num_batches
         next_batch = loader.next_batch
     elif data_path:
         d = np.load(data_path)
+        _check_sparse_bounds(d["sparse"], dcfg)
         loader = SingleDataLoader(
             model, {"dense": d["dense"], "sparse": d["sparse"]}, d["label"])
         num_batches = loader.num_batches

@@ -35,22 +35,42 @@ def convert_npz(path: str):
 
 
 def convert_tsv(path: str, hash_size: int, num_int: int = 13,
-                num_cat: int = 26):
-    """Raw Criteo Kaggle TSV: label \\t 13 ints \\t 26 hex cats."""
-    labels, ints, cats = [], [], []
+                num_cat: int = 26, chunk_rows: int = 1_000_000):
+    """Raw Criteo Kaggle TSV: label \\t 13 ints \\t 26 hex cats.
+
+    Parses in fixed-size chunks into numpy buffers (the Kaggle train.txt is
+    ~45M rows; per-row Python lists would not fit in memory)."""
+    int_chunks, cat_chunks, y_chunks = [], [], []
+    ints = np.zeros((chunk_rows, num_int), np.float32)
+    cats = np.zeros((chunk_rows, num_cat), np.int64)
+    ys = np.zeros((chunk_rows,), np.float32)
+    n = 0
+
+    def flush():
+        nonlocal n
+        if n:
+            int_chunks.append(ints[:n].copy())
+            cat_chunks.append(cats[:n].copy())
+            y_chunks.append(ys[:n].copy())
+            n = 0
+
     with open(path) as f:
         for line in f:
             cols = line.rstrip("\n").split("\t")
             if len(cols) < 1 + num_int + num_cat:
                 cols = cols + [""] * (1 + num_int + num_cat - len(cols))
-            labels.append(np.float32(cols[0] or 0))
-            ints.append([max(int(c), 0) if c else 0
-                         for c in cols[1:1 + num_int]])
-            cats.append([int(c, 16) % hash_size if c else 0
-                         for c in cols[1 + num_int:1 + num_int + num_cat]])
-    x_int = np.log(np.asarray(ints, dtype=np.float32) + 1)
-    x_cat = np.asarray(cats, dtype=np.int64)
-    y = np.asarray(labels, dtype=np.float32)
+            ys[n] = float(cols[0] or 0)
+            for j, c in enumerate(cols[1:1 + num_int]):
+                ints[n, j] = max(int(c), 0) if c else 0
+            for j, c in enumerate(cols[1 + num_int:1 + num_int + num_cat]):
+                cats[n, j] = int(c, 16) % hash_size if c else 0
+            n += 1
+            if n == chunk_rows:
+                flush()
+    flush()
+    x_int = np.log(np.concatenate(int_chunks) + 1)
+    x_cat = np.concatenate(cat_chunks)
+    y = np.concatenate(y_chunks)
     return x_int, x_cat, y
 
 

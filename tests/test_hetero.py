@@ -66,8 +66,8 @@ class TestHetero:
         x["label"] = y
         db = model._device_batch(x)
         hlo = model._train_step.lower(
-            model.params, model.opt_state, model.op_state, db,
-            jnp.asarray(0, jnp.int32)).as_text()
+            model.params, model.opt_state, model.op_state,
+            model._zero_msums(), db, jnp.asarray(0, jnp.int32)).as_text()
         assert "_xla_compute_type" in hlo
 
     def test_hetero_pb_file_drives_offload(self, tmp_path):

@@ -1,0 +1,118 @@
+"""Sparse (touched-rows-only) embedding update tests.
+
+For plain SGD,  w -= lr * dense_grad  equals a scatter-add of the row
+cotangents into the gathered rows (all other rows have zero gradient, and
+duplicate indices accumulate identically in XLA's scatter-add), so the
+sparse path must match the dense path bit-for-bit up to fp reassociation.
+The dense path is the reference's semantics (table-sized gradient region +
+full-table SGD kernel, embedding.cu:95-105 / optimizer_kernel.cu); the
+sparse path is the TPU performance upgrade that avoids streaming multi-GB
+tables through HBM every step.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                           dlrm_strategy, synthetic_batch)
+from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+
+
+def _train(sparse, steps=4, ndev=1, fuse=True, strategies=None, bag=1,
+           optimizer=None):
+    dcfg = DLRMConfig(embedding_size=[64] * 8, sparse_feature_size=8,
+                      embedding_bag_size=bag,
+                      mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1])
+    cfg = ff.FFConfig(batch_size=16, seed=5)
+    cfg.sparse_embedding_update = sparse
+    model = ff.FFModel(cfg)
+    build_dlrm(model, dcfg, fuse_embeddings=fuse)
+    strat = strategies(model, dcfg, ndev) if callable(strategies) else strategies
+    model.compile(optimizer or ff.SGDOptimizer(lr=0.1),
+                  "mean_squared_error", ["mse"],
+                  mesh=make_mesh(num_devices=ndev), strategies=strat)
+    model.init_layers()
+    for s in range(steps):
+        x, y = synthetic_batch(dcfg, 16, seed=s)
+        x["label"] = y
+        model.train_batch(x)
+    return model, jax.tree.map(np.asarray, model.params)
+
+
+def _assert_equal_trees(a, b, rtol=1e-5, atol=1e-6):
+    fa = jax.tree_util.tree_leaves_with_path(a)
+    fb = dict(jax.tree_util.tree_leaves_with_path(b))
+    assert len(fa) == len(fb)
+    for path, v in fa:
+        np.testing.assert_allclose(v, fb[path], rtol=rtol, atol=atol,
+                                   err_msg=str(path))
+
+
+class TestSparseUpdate:
+    def test_enabled_for_plain_sgd(self):
+        model, _ = _train(sparse=True, steps=1)
+        assert model._sparse_update_ops == ["emb_stack"]
+
+    def test_disabled_for_momentum_and_wd(self):
+        m1, _ = _train(sparse=True, steps=1,
+                       optimizer=ff.SGDOptimizer(lr=0.1, momentum=0.9))
+        assert m1._sparse_update_ops == []
+        m2, _ = _train(sparse=True, steps=1,
+                       optimizer=ff.SGDOptimizer(lr=0.1, weight_decay=1e-4))
+        assert m2._sparse_update_ops == []
+
+    @pytest.mark.parametrize("fuse", [True, False])
+    def test_matches_dense_path(self, fuse):
+        _, p_sparse = _train(sparse=True, fuse=fuse)
+        _, p_dense = _train(sparse=False, fuse=fuse)
+        _assert_equal_trees(p_sparse, p_dense)
+
+    def test_matches_dense_path_bag_gt_1(self):
+        """Duplicate rows inside a bag must accumulate like dense grads."""
+        _, p_sparse = _train(sparse=True, bag=4)
+        _, p_dense = _train(sparse=False, bag=4)
+        _assert_equal_trees(p_sparse, p_dense)
+
+    def test_matches_dense_on_8dev_mesh(self):
+        """Sparse update under the table-parallel + DP-MLP strategy on the
+        8-device mesh equals the dense 1-device run."""
+        _, p8 = _train(sparse=True, ndev=8, strategies=dlrm_strategy)
+        _, p1 = _train(sparse=False, ndev=1)
+        _assert_equal_trees(p8, p1, rtol=2e-4, atol=2e-5)
+
+    def test_avg_aggregation(self):
+        dcfg = DLRMConfig(embedding_size=[32] * 4, sparse_feature_size=4,
+                          embedding_bag_size=3,
+                          mlp_bot=[4, 8, 4], mlp_top=[20, 8, 1])
+
+        def run(sparse):
+            cfg = ff.FFConfig(batch_size=8, seed=3)
+            cfg.sparse_embedding_update = sparse
+            model = ff.FFModel(cfg)
+            dense_in = model.create_tensor((8, 4), name="dense")
+            sparse_in = model.create_tensor((8, 4, 3), dtype="int32",
+                                            name="sparse")
+            bot = model.dense(dense_in, 4, activation="relu", name="bot")
+            emb = model.embedding_stacked(sparse_in, 4, 32, 4, aggr="avg",
+                                          name="emb")
+            flat = model.reshape(emb, (8, 16), name="flat")
+            cat = model.concat([bot, flat], axis=1, name="cat")
+            out = model.dense(cat, 1, activation="sigmoid", name="head")
+            model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                          ["mse"], mesh=make_mesh(num_devices=1),
+                          final_tensor=out)
+            model.init_layers()
+            rng = np.random.RandomState(0)
+            for s in range(3):
+                batch = {
+                    "dense": rng.rand(8, 4).astype(np.float32),
+                    "sparse": rng.randint(0, 32, (8, 4, 3)).astype(np.int32),
+                    "label": rng.rand(8, 1).astype(np.float32),
+                }
+                model.train_batch(batch)
+            return jax.tree.map(np.asarray, model.params)
+
+        _assert_equal_trees(run(True), run(False))
