@@ -144,6 +144,15 @@ class FFModel:
                                    num_entries, out_dim, aggr,
                                    kernel_initializer, name).outputs[0]
 
+    def embedding_concat(self, input_tensor, table_sizes, out_dim,
+                         aggr="sum", kernel_initializer=None, name=None):
+        """Non-uniform tables (shared width, different row counts) fused
+        into one concatenated-rows parameter — see ops.embedding
+        EmbeddingBagConcat."""
+        from ..ops.embedding import EmbeddingBagConcat
+        return EmbeddingBagConcat(self, input_tensor, table_sizes, out_dim,
+                                  aggr, kernel_initializer, name).outputs[0]
+
     def concat(self, tensors, axis, name=None):
         from ..ops.tensor_ops import Concat
         return Concat(self, list(tensors), axis, name).outputs[0]
@@ -336,7 +345,8 @@ class FFModel:
         per-op placement for unfused tables); shared type keys apply to every
         op of the type; CPU device_type marks host offload.
         """
-        from ..ops.embedding import Embedding, EmbeddingBagStacked
+        from ..ops.embedding import (Embedding, EmbeddingBagConcat,
+                                     EmbeddingBagStacked)
         from ..ops.linear import Linear
         from ..ops.tensor_ops import Concat
         strategies = self.strategies
@@ -346,19 +356,20 @@ class FFModel:
                            if k.startswith("embedding")
                            and k[len("embedding"):].isdigit()),
                           key=lambda k: int(k[len("embedding"):]))
+        fused_types = (EmbeddingBagStacked, EmbeddingBagConcat)
         emb_ops = [op for op in self.ops
-                   if isinstance(op, (Embedding, EmbeddingBagStacked))]
+                   if isinstance(op, (Embedding,) + fused_types)]
         for i, op in enumerate(emb_ops):
             if op.name in strategies:
                 continue
-            if isinstance(op, EmbeddingBagStacked) and emb_keys:
+            if isinstance(op, fused_types) and emb_keys:
                 pcs = [strategies[k] for k in emb_keys]
                 distinct = {pc.device_ids[:1] for pc in pcs if pc.device_ids}
                 degree = max(1, min(len(distinct), op.num_tables, ndev))
                 dtyp = pcs[0].device_type
                 strategies[op.name] = ParallelConfig(
                     (1, degree, 1), device_type=dtyp)
-            elif not isinstance(op, EmbeddingBagStacked) and i < len(emb_keys):
+            elif not isinstance(op, fused_types) and i < len(emb_keys):
                 strategies[op.name] = strategies[emb_keys[i]]
         for op in self.ops:
             if isinstance(op, InputOp) or op.name in strategies:
@@ -414,6 +425,11 @@ class FFModel:
             if isinstance(op, InputOp):
                 continue
             pc = self._effective_pc(op)
+            # the UNclamped strategy, for ops whose param sharding keys off
+            # the requested (not shape-clamped) degrees — e.g. the
+            # concatenated-rows embedding row-shards on ANY requested table
+            # parallelism even when the output table dim can't split evenly
+            op._raw_pc = self.strategies.get(op.name, pc)
             if pc.device_type == "CPU":
                 self._host_offload_ops.add(op.name)
             try:
@@ -575,7 +591,8 @@ class FFModel:
         SGD update: plain SGD (no momentum/weight-decay — both terms touch
         every row), op supports it, not host-offloaded. Disabled by
         config.sparse_embedding_update=False (--dense-embedding-update)."""
-        from ..ops.embedding import Embedding, EmbeddingBagStacked
+        from ..ops.embedding import (Embedding, EmbeddingBagConcat,
+                                     EmbeddingBagStacked)
         if not getattr(self.config, "sparse_embedding_update", True):
             return []
         opt = self.optimizer
@@ -584,7 +601,8 @@ class FFModel:
             return []
         host = getattr(self, "_host_offload_ops", set())
         return [op for op in self.ops
-                if isinstance(op, (Embedding, EmbeddingBagStacked))
+                if isinstance(op, (Embedding, EmbeddingBagStacked,
+                                   EmbeddingBagConcat))
                 and op.supports_sparse_update() and op.name not in host]
 
     def _ancestor_op_names(self, targets) -> set:

@@ -8,12 +8,16 @@ fork's 3-D batch ops Reshape/Transpose/BatchMatmul), top MLP with sigmoid
 head, MSE loss — and the reference's run configs (run_random.sh,
 run_criteo_kaggle.sh).
 
-TPU-native: with `fuse_embeddings=True` (default when all tables share
-rows×dim) the tables are stacked into one (T, rows, dim) parameter sharded
-on the table dim — the GSPMD form of the reference strategy "each embedding
-whole on one device" (dlrm_strategy.cc:252-256); the batch↔table all-to-all
-the reference got from Legion DMA is emitted by XLA from the sharding
-constraints. MLPs run data-parallel, matmuls in bfloat16 on the MXU.
+TPU-native: embeddings fuse by default (`fuse_embeddings=True`) — uniform
+tables stack into one (T, rows, dim) parameter sharded on the table dim;
+non-uniform tables (Criteo-Kaggle) concatenate row-wise into one
+(sum_rows, dim) parameter that is row-block-sharded. Both are the GSPMD
+form of the reference strategy "each embedding whole on one device"
+(dlrm_strategy.cc:252-256); the batch↔table all-to-all the reference got
+from Legion DMA is emitted by XLA from the sharding constraints. MLPs run
+data-parallel, matmuls in bfloat16 on the MXU. Pass
+`fuse_embeddings=False` for the per-table layout (emb_0..emb_N parameter
+names — needed to resume checkpoints written by per-table builds).
 """
 
 from __future__ import annotations
@@ -168,7 +172,7 @@ def build_dlrm(model: FFModel, cfg: DLRMConfig,
     d = cfg.sparse_feature_size
     uniform = len(set(cfg.embedding_size)) == 1
     if fuse_embeddings is None:
-        fuse_embeddings = uniform
+        fuse_embeddings = True
 
     dense_in = model.create_tensor((batch, cfg.mlp_bot[0]), name="dense")
     sparse_in = model.create_tensor((batch, T, cfg.embedding_bag_size),
@@ -182,6 +186,13 @@ def build_dlrm(model: FFModel, cfg: DLRMConfig,
         embs = [model.embedding_stacked(
             sparse_in, T, cfg.embedding_size[0], d, aggr="sum",
             kernel_initializer=emb_init, name="emb_stack")]  # (b,T,d)
+    elif fuse_embeddings:
+        # non-uniform row counts (e.g. Criteo-Kaggle's 26 tables): fuse
+        # into one concatenated-rows table — a single gather/scatter
+        # instead of T ops
+        embs = [model.embedding_concat(
+            sparse_in, cfg.embedding_size, d, aggr="sum",
+            kernel_initializer=emb_init, name="emb_concat")]  # (b,T,d)
     else:
         cols = model.split(sparse_in, [1] * T, axis=1, name="sparse_split")
         embs = []
@@ -216,6 +227,11 @@ def dlrm_strategy(model: FFModel, cfg: DLRMConfig,
             # divisor of table count and device count
             dt = next(d for d in range(min(num_devices, op.num_tables), 0, -1)
                       if op.num_tables % d == 0 and num_devices % d == 0)
+            strat[op.name] = ParallelConfig((1, dt, 1))
+        elif tname == "EmbeddingBagConcat":
+            # any table-dim degree >1 triggers full-mesh row-block sharding
+            # of the concatenated table (param_axes)
+            dt = 2 if num_devices > 1 else 1
             strat[op.name] = ParallelConfig((1, dt, 1))
         elif tname == "Embedding":
             # width-shard each table's out_dim

@@ -258,3 +258,118 @@ class EmbeddingBagStacked(Op):
 
         new = jax.vmap(one_table, in_axes=(0, 1, 1))(tbl, idx, ct)
         return {"kernel": new}
+
+
+class EmbeddingBagConcat(Op):
+    """N embedding bags with a SHARED width but DIFFERENT row counts,
+    concatenated row-wise into one (sum_rows_padded, dim) parameter; each
+    lookup adds its table's row offset. This is the non-uniform-table form
+    of EmbeddingBagStacked and the natural TPU layout for Criteo-Kaggle's
+    26 tables (4 … 3.1M rows × 16-d, run_criteo_kaggle.sh): the reference
+    places each table whole on one device (dlrm_strategy.cc:252-256); here
+    the concatenated rows are block-sharded over the mesh, all 26 gathers
+    fuse into ONE gather and the sparse update into ONE scatter.
+
+    input: int (batch, num_tables, bag)  ->  output (batch, num_tables, dim)
+    """
+
+    type_name = "EmbedConcat"
+
+    # row padding so the concatenated row count divides any power-of-two
+    # mesh (and most mixed meshes)
+    _ROW_PAD = 8192
+
+    def __init__(self, model, input_tensor, table_sizes, out_dim: int,
+                 aggr: str = AGGR_MODE_SUM, kernel_initializer=None,
+                 name: Optional[str] = None):
+        super().__init__(model, [input_tensor], name)
+        assert input_tensor.num_dims == 3, "expect (batch, num_tables, bag)"
+        self.table_sizes = tuple(int(s) for s in table_sizes)
+        self.num_tables = len(self.table_sizes)
+        assert input_tensor.shape[1] == self.num_tables
+        self.out_dim = int(out_dim)
+        self.aggr = aggr
+        self.kernel_initializer = kernel_initializer or GlorotUniform()
+        total = sum(self.table_sizes)
+        self.total_rows = -(-total // self._ROW_PAD) * self._ROW_PAD
+        offs = [0]
+        for s in self.table_sizes[:-1]:
+            offs.append(offs[-1] + s)
+        self._offsets = tuple(offs)
+        batch = input_tensor.shape[0]
+        self.outputs = [self._make_output(
+            (batch, self.num_tables, self.out_dim))]
+
+    def param_defs(self):
+        return {"kernel": ParamDef((self.total_rows, self.out_dim),
+                                   jnp.float32, self.kernel_initializer)}
+
+    def _global_indices(self, idx):
+        """Per-table modulo (wrap semantics like the gathers above) then
+        offset into the concatenated rows."""
+        sizes = jnp.asarray(self.table_sizes, jnp.int32)[None, :, None]
+        offs = jnp.asarray(self._offsets, jnp.int32)[None, :, None]
+        return idx.astype(jnp.int32) % sizes + offs       # (batch, T, bag)
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        (idx,) = xs                        # (batch, T, bag)
+        tbl = params["kernel"]             # (total_rows, d)
+        g = self._global_indices(idx)
+        batch, T, bag = g.shape
+        if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
+                and _pallas_ok(self.model, self.out_dim, self.name)):
+            # one Pallas row-stream over the concatenated table; per-table
+            # bags become the kernel's bag dim via (batch*T, bag) indices
+            from .pallas.embedding_kernel import embedding_bag
+            out = embedding_bag(tbl, g.reshape(batch * T, bag), self.aggr)
+            return [out.reshape(batch, T, self.out_dim)]
+        rows = jnp.take(tbl, g.reshape(-1), axis=0,
+                        mode="wrap").reshape(g.shape + (self.out_dim,))
+        if self.aggr == AGGR_MODE_AVG:
+            return [jnp.mean(rows, axis=2)]
+        return [jnp.sum(rows, axis=2)]     # (batch, T, d)
+
+    def candidate_parallel_configs(self, num_devices, feasible_degrees):
+        # same divisibility filter as EmbeddingBagStacked so the degrees
+        # the search costs are the degrees compile() executes (the clamp in
+        # _effective_pc would otherwise silently rewrite them)
+        out = []
+        for ds in feasible_degrees:
+            for dt in feasible_degrees:
+                if ds * dt <= num_devices and self.num_tables % max(dt, 1) == 0:
+                    out.append(ParallelConfig((ds, dt, 1)))
+        return out
+
+    def param_axes(self, pc: ParallelConfig, out_axes):
+        # table parallelism = row-block sharding of the concatenated rows.
+        # Keyed off the RAW (unclamped) strategy degrees: the output's
+        # table dim often can't split evenly (26 tables on 8 chips), but
+        # the padded row count always can — and sharding the rows is the
+        # memory-scaling point of placing tables across devices. GSPMD
+        # inserts the gather/scatter collectives.
+        raw = getattr(self, "_raw_pc", None) or pc
+        if len(raw.degrees) >= 2 and raw.degrees[1] > 1:
+            rows_axes = tuple(self.model.mesh.axis_names)
+        else:
+            rows_axes = ()
+        return {"kernel": (rows_axes, ())}
+
+    def flops_per_sample(self) -> float:
+        bag = self.inputs[0].shape[-1]
+        return float(self.num_tables * bag * self.out_dim)
+
+    # ---- sparse (touched-rows-only) SGD update (see Embedding) ---------
+    def supports_sparse_update(self) -> bool:
+        return self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
+
+    def sparse_sgd_update(self, params, xs, out_ct, lr):
+        (idx,) = xs                        # (batch, T, bag)
+        tbl = params["kernel"]             # (total_rows, d)
+        g = self._global_indices(idx)
+        ct = out_ct.astype(tbl.dtype)      # (batch, T, d)
+        if self.aggr == AGGR_MODE_AVG:
+            ct = ct / g.shape[-1]
+        upd = jnp.broadcast_to(ct[..., None, :], g.shape + (self.out_dim,))
+        new = tbl.at[g.reshape(-1)].add(
+            -lr * upd.reshape(-1, self.out_dim))
+        return {"kernel": new}
