@@ -60,14 +60,18 @@ def main():
     # measure several windows and report the best one: the jitted step is
     # ~0.1 ms, and a shared/tunneled chip sees external interference that
     # only ever slows a window down
-    steps = int(os.environ.get("BENCH_STEPS", "500"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "500")))
     windows = int(os.environ.get("BENCH_WINDOWS", "5"))
     best = 0.0
     for _w in range(windows):
         t0 = time.time()
+        mets = None
         for s in range(steps):
-            model.train_batch_device(batches[s % nbatch])
-        jax.block_until_ready(model.params)
+            mets = model.train_batch_device(batches[s % nbatch])
+        # host readback forces TRUE completion of the whole window —
+        # block_until_ready alone does not wait on some experimental
+        # PJRT backends (observed on the axon tunnel)
+        float(mets["loss"])
         elapsed = time.time() - t0
         best = max(best, steps * batch / elapsed)
 

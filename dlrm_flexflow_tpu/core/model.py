@@ -425,11 +425,6 @@ class FFModel:
             if isinstance(op, InputOp):
                 continue
             pc = self._effective_pc(op)
-            # the UNclamped strategy, for ops whose param sharding keys off
-            # the requested (not shape-clamped) degrees — e.g. the
-            # concatenated-rows embedding row-shards on ANY requested table
-            # parallelism even when the output table dim can't split evenly
-            op._raw_pc = self.strategies.get(op.name, pc)
             if pc.device_type == "CPU":
                 self._host_offload_ops.add(op.name)
             try:
@@ -452,7 +447,13 @@ class FFModel:
                     spec_from_axes(axes) if ok else
                     NamedSharding(self.mesh, PartitionSpec()))
             if op.param_defs():
-                p_axes = op.param_axes(pc, out_axes)
+                # raw_pc = the UNclamped strategy, for ops whose param
+                # sharding keys off the requested (not shape-clamped)
+                # degrees — e.g. the concatenated-rows embedding row-shards
+                # on ANY requested table parallelism even when the output
+                # table dim can't split evenly
+                p_axes = op.param_axes(
+                    pc, out_axes, raw_pc=self.strategies.get(op.name, pc))
                 self._param_sharding[op.name] = {
                     pname: spec_from_axes(axes)
                     for pname, axes in p_axes.items()}
@@ -621,6 +622,10 @@ class FFModel:
         return out
 
     def _build_steps(self):
+        # drop any AOT executables compiled against the previous step
+        # function (a re-compile() with a new optimizer/loss/strategies
+        # must not keep training with the old one)
+        self._train_step_execs = {}
         loss_f = losses_mod.loss_fn(self.loss_type)
         logits_guid = self._logits_tensor.guid
         preds_guid = self._preds_tensor.guid
@@ -712,7 +717,12 @@ class FFModel:
             metric_names, loss_type, dummy_preds, dummy_labels).keys())
 
     def _zero_msums(self):
-        return {k: jnp.zeros((), jnp.float32) for k in self._msums_keys}
+        # committed replicated: the AOT executable cache requires inputs
+        # with deterministic shardings (uncommitted scalars would pin to
+        # device 0 and mismatch the executable on the next call)
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        return {k: jax.device_put(jnp.zeros((), jnp.float32), rep)
+                for k in self._msums_keys}
 
     # ------------------------------------------------------------------
     # runtime verbs (reference model.cc:942-993)
@@ -733,9 +743,9 @@ class FFModel:
                     key, sub = jax.random.split(key)
                     p = op.init_params(sub)
                     shards = self._param_sharding.get(op.name, {})
+                    rep = NamedSharding(self.mesh, PartitionSpec())
                     params[op.name] = {
-                        n: jax.device_put(v, shards.get(n)) if shards.get(n)
-                        else v
+                        n: jax.device_put(v, shards.get(n) or rep)
                         for n, v in p.items()}
                 if hasattr(op, "state_defs"):
                     key, sub = jax.random.split(key)
@@ -783,11 +793,37 @@ class FFModel:
         if not getattr(self, "_msums", None):
             self._msums = self._zero_msums()
         if getattr(self, "_step_dev", None) is None:
-            self._step_dev = jnp.asarray(self._step, jnp.int32)
+            self._step_dev = jax.device_put(
+                jnp.asarray(self._step, jnp.int32),
+                NamedSharding(self.mesh, PartitionSpec()))
+        args = (self.params, self.opt_state, self.op_state, self._msums,
+                device_batch, self._step_dev)
+        # hot loop: call the AOT-compiled executable directly — the pjit
+        # python dispatch re-validates the big param pytree every call,
+        # which costs more than the step itself on fast models. Keyed by
+        # the batch signature so alternating shapes (e.g. a remainder
+        # batch) each compile once.
+        key = tuple(sorted((k, v.shape, str(v.dtype))
+                           for k, v in device_batch.items()))
+        execs = getattr(self, "_train_step_execs", None)
+        if execs is None:
+            execs = self._train_step_execs = {}
+        exec_ = execs.get(key)
+        if exec_ is None:
+            exec_ = execs[key] = self._train_step.lower(*args).compile()
+        try:
+            outs = exec_(*args)
+        except ValueError as e:
+            # GSPMD may give step outputs different shardings than the
+            # initial inputs; one recompile against the propagated
+            # shardings reaches the fixed point (the sharding check runs
+            # before execution, so donated buffers are still intact)
+            if "disagree" not in str(e):
+                raise
+            exec_ = execs[key] = self._train_step.lower(*args).compile()
+            outs = exec_(*args)
         (self.params, self.opt_state, self.op_state, self._msums,
-         self._step_dev, mets) = self._train_step(
-            self.params, self.opt_state, self.op_state, self._msums,
-            device_batch, self._step_dev)
+         self._step_dev, mets) = outs
         self._step += 1
         # the running sums live on device; PerfMetrics syncs at report().
         # shallow-copy so perf.reset()/report() mutating perf.sums can

@@ -102,7 +102,8 @@ class Op:
                 out.append(ParallelConfig(tuple(degs)))
         return out
 
-    def param_axes(self, pc: ParallelConfig, out_axes) -> Dict[str, tuple]:
+    def param_axes(self, pc: ParallelConfig, out_axes,
+                   raw_pc=None) -> Dict[str, tuple]:
         """Mesh-axis assignment per parameter dim, given the mesh axes
         already assigned to each output dim (`out_axes[i]` is a tuple of
         axis names for output dim i). Default: replicated (the reference

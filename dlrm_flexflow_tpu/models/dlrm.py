@@ -78,6 +78,22 @@ class DLRMConfig:
         )
 
     @staticmethod
+    def terabyte() -> "DLRMConfig":
+        """Criteo-Terabyte (MLPerf DLRM) shapes: 26 tables up to ~40M rows
+        × 128-d, bot 13-512-256-128, top 1024-1024-512-256-1. The driver's
+        north-star config (BASELINE.md): ≥1.5× pure-DP on v5e-64."""
+        return DLRMConfig(
+            embedding_size=[39884406, 39043, 17289, 7420, 20263, 3, 7120,
+                            1543, 63, 38532951, 2953546, 403346, 10, 2208,
+                            11938, 155, 4, 976, 14, 39979771, 25641295,
+                            39664984, 585935, 12972, 108, 36],
+            embedding_bag_size=1,
+            sparse_feature_size=128,
+            mlp_bot=[13, 512, 256, 128],
+            mlp_top=[1024, 1024, 512, 256, 1],
+        )
+
+    @staticmethod
     def parse_args(argv: List[str]) -> "DLRMConfig":
         cfg = DLRMConfig()
         i = 0

@@ -192,7 +192,8 @@ class MultiHeadAttention(Op):
                 out.append(ParallelConfig((1, 1, dh)))          # head TP
         return out
 
-    def param_axes(self, pc: ParallelConfig, out_axes):
+    def param_axes(self, pc: ParallelConfig, out_axes,
+                   raw_pc=None):
         ch = out_axes[2] if len(out_axes) >= 3 else ()
         # head TP: qkv projections column-sharded, wo row-sharded (psum by
         # GSPMD); bo replicated-ish (sharded on ch like bias)

@@ -70,14 +70,16 @@ class Conv2D(Op):
     def apply(self, params, xs, *, training=False, rng=None):
         (x,) = xs
         cdt = self.model.compute_dtype
+        # no preferred_element_type upcast: jax's conv transpose rule
+        # rejects mixed dtypes (fp32 cotangent vs bf16 operands), so emit a
+        # bf16-out conv (MXU still accumulates fp32 internally) and upcast
         y = lax.conv_general_dilated(
             x.astype(cdt), params["kernel"].astype(cdt),
             window_strides=self.stride,
             padding=[(self.padding[0], self.padding[0]),
                      (self.padding[1], self.padding[1])],
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            feature_group_count=self.groups,
-            preferred_element_type=jnp.float32)
+            feature_group_count=self.groups).astype(jnp.float32)
         if self.use_bias:
             y = y + params["bias"][None, :, None, None]
         return [apply_activation(y, self.activation).astype(x.dtype)]
@@ -99,7 +101,8 @@ class Conv2D(Op):
                     out.append(ParallelConfig((ds, dc, 1, 1)))
         return out
 
-    def param_axes(self, pc: ParallelConfig, out_axes):
+    def param_axes(self, pc: ParallelConfig, out_axes,
+                   raw_pc=None):
         ch = out_axes[1] if len(out_axes) >= 2 else ()
         out = {"kernel": (ch, (), (), ())}
         if self.use_bias:

@@ -43,17 +43,35 @@ AGGR_MODE_SUM = "sum"
 AGGR_MODE_AVG = "avg"
 
 
-def _pallas_ok(model, out_dim: int, op_name: str = "") -> bool:
-    """Use the Pallas row-streaming kernel when it applies: TPU backend,
-    tile-aligned table width, single-chip execution (under a >1-device mesh
-    the op runs inside GSPMD, where the XLA gather lowering shards; the
-    Pallas call would need a shard_map wrapper — future work), and NOT
-    host-offloaded (a Mosaic TPU custom call cannot run inside a
-    compute_on("device_host") region)."""
+def _pack_factor(dim: int, rows: int) -> int:
+    """Rows per 128-lane tile for the packed storage of narrow tables
+    (1 when the width is already lane-aligned or doesn't divide 128)."""
+    if dim < 128 and 128 % dim == 0:
+        r = 128 // dim
+        if rows % r == 0:
+            return r
+    return 1
+
+
+def _packed_gather(tbl, ix, r, d):
+    """Gather logical rows `ix` from a packed (rows/r, r*d) table."""
+    prow, sub = ix // r, ix % r
+    t128 = jnp.take(tbl, prow, axis=0, mode="wrap")     # (..., r*d)
+    t = t128.reshape(ix.shape + (r, d))
+    return jnp.take_along_axis(
+        t, sub[..., None, None], axis=-2)[..., 0, :]    # (..., d)
+
+
+def _pallas_gate(model, op_name: str, width_ok: bool) -> bool:
+    """Shared gate for ALL Pallas kernel routing: opted in, TPU backend,
+    supported width, not host-offloaded (a Mosaic TPU custom call cannot
+    run inside a compute_on("device_host") region), single-chip execution
+    (under a >1-device mesh the op runs inside GSPMD, where the XLA
+    lowering shards; the Pallas call would need a shard_map wrapper —
+    future work)."""
     if not getattr(model.config, "use_pallas", False):
         return False
-    from .pallas.embedding_kernel import supports
-    if not supports(out_dim):
+    if not width_ok:
         return False
     if jax.default_backend() != "tpu":
         return False
@@ -61,6 +79,19 @@ def _pallas_ok(model, out_dim: int, op_name: str = "") -> bool:
         return False
     mesh = getattr(model, "mesh", None)
     return mesh is None or mesh.size <= 1
+
+
+def _pallas_scatter_ok(model, out_dim: int, op_name: str = "") -> bool:
+    """Gate for the Pallas RMW scatter kernel: XLA's TPU scatter lowers to
+    a serialized loop (~250 ms for 2k rows on an 8M-row table)."""
+    from .pallas.embedding_kernel import scatter_supports
+    return _pallas_gate(model, op_name, scatter_supports(out_dim))
+
+
+def _pallas_ok(model, out_dim: int, op_name: str = "") -> bool:
+    """Gate for the Pallas row-streaming gather kernel."""
+    from .pallas.embedding_kernel import supports
+    return _pallas_gate(model, op_name, supports(out_dim))
 
 
 class Embedding(Op):
@@ -123,7 +154,8 @@ class Embedding(Op):
                     out.append(ParallelConfig(tuple(degs)))
         return out
 
-    def param_axes(self, pc: ParallelConfig, out_axes):
+    def param_axes(self, pc: ParallelConfig, out_axes,
+                   raw_pc=None):
         # width sharding follows the output channel axes; rows replicated
         ch = out_axes[-1] if len(out_axes) >= 2 else ()
         return {"kernel": ((), ch)}
@@ -160,7 +192,11 @@ class Embedding(Op):
             # each row of the bag receives the bag-sum's cotangent
             upd = jnp.broadcast_to(ct[..., None, :],
                                    idx.shape + (d,)).reshape(-1, d)
-        new = tbl.at[idx.reshape(-1)].add(-lr * upd)
+        if _pallas_scatter_ok(self.model, d, self.name):
+            from .pallas.embedding_kernel import scatter_add_rows
+            new = scatter_add_rows(tbl, idx.reshape(-1), -lr * upd)
+        else:
+            new = tbl.at[idx.reshape(-1)].add(-lr * upd)
         return {"kernel": new}
 
 
@@ -191,20 +227,49 @@ class EmbeddingBagStacked(Op):
         self.out_dim = int(out_dim)
         self.aggr = aggr
         self.kernel_initializer = kernel_initializer or GlorotUniform()
+        # lane packing: narrow rows (d < 128 dividing 128) are stored
+        # r-per-128-lane-tile as (T, rows/r, r*d) so the TPU keeps the
+        # natural row-major tiled layout — an unpacked (rows, d) table gets
+        # a transposed lane-packing layout from XLA, which forces
+        # whole-table transpose copies at every Pallas kernel boundary
+        self._pack = _pack_factor(self.out_dim, self.num_entries)
         batch = input_tensor.shape[0]
         self.outputs = [self._make_output((batch, self.num_tables, self.out_dim))]
 
     def param_defs(self):
+        r = self._pack
         return {"kernel": ParamDef(
-            (self.num_tables, self.num_entries, self.out_dim), jnp.float32,
-            self.kernel_initializer)}
+            (self.num_tables, self.num_entries // r, self.out_dim * r),
+            jnp.float32, self.kernel_initializer)}
+
+    def init_params(self, key):
+        # initialize each table at its LOGICAL (rows, d) shape so
+        # shape-dependent initializers (Glorot fans) match the unfused
+        # per-table ops, then pack
+        keys = jax.random.split(key, self.num_tables)
+        tables = jnp.stack([
+            self.kernel_initializer(
+                k, (self.num_entries, self.out_dim), jnp.float32)
+            for k in keys])
+        return {"kernel": self.pack_kernel(tables)}
+
+    def unpack_kernel(self, kernel):
+        """(T, rows/r, r*d) stored form -> logical (T, rows, d)."""
+        return kernel.reshape(self.num_tables, self.num_entries,
+                              self.out_dim)
+
+    def pack_kernel(self, logical):
+        r = self._pack
+        return logical.reshape(self.num_tables, self.num_entries // r,
+                               self.out_dim * r)
 
     def apply(self, params, xs, *, training=False, rng=None):
         (idx,) = xs  # (batch, T, bag)
-        table = params["kernel"]  # (T, rows, d)
-        idx = idx.astype(jnp.int32)
+        table = params["kernel"]  # (T, rows/r, r*d)
+        idx = idx.astype(jnp.int32) % self.num_entries
+        r, d = self._pack, self.out_dim
 
-        if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
+        if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG) and r == 1
                 and _pallas_ok(self.model, self.out_dim, self.name)):
             from .pallas.embedding_kernel import stacked_embedding_bag
             return [stacked_embedding_bag(table, idx, self.aggr)]
@@ -212,8 +277,11 @@ class EmbeddingBagStacked(Op):
         # vmap over the table dim: for each table t, gather its own rows for
         # the full batch. With dim-0 sharded params + matching sharding
         # constraints this lowers to per-device local gathers + all-to-all.
-        def one_table(tbl, ix):  # tbl (rows, d), ix (batch, bag)
-            rows = jnp.take(tbl, ix, axis=0, mode="wrap")  # (batch, bag, d)
+        def one_table(tbl, ix):  # tbl (rows/r, r*d), ix (batch, bag)
+            if r == 1:
+                rows = jnp.take(tbl, ix, axis=0, mode="wrap")
+            else:
+                rows = _packed_gather(tbl, ix, r, d)       # (batch, bag, d)
             if self.aggr == AGGR_MODE_AVG:
                 return jnp.mean(rows, axis=1)
             return jnp.sum(rows, axis=1)
@@ -230,7 +298,8 @@ class EmbeddingBagStacked(Op):
                     out.append(ParallelConfig((ds, dt, 1)))
         return out
 
-    def param_axes(self, pc: ParallelConfig, out_axes):
+    def param_axes(self, pc: ParallelConfig, out_axes,
+                   raw_pc=None):
         # table dim of the param follows output dim 1's axes
         t_axes = out_axes[1] if len(out_axes) >= 2 else ()
         return {"kernel": (t_axes, (), ())}
@@ -245,16 +314,36 @@ class EmbeddingBagStacked(Op):
 
     def sparse_sgd_update(self, params, xs, out_ct, lr):
         (idx,) = xs                       # (batch, T, bag)
-        tbl = params["kernel"]            # (T, rows, d)
+        tbl = params["kernel"]            # (T, rows/r, r*d)
         idx = idx.astype(jnp.int32) % self.num_entries
         ct = out_ct.astype(tbl.dtype)     # (batch, T, d)
         if self.aggr == AGGR_MODE_AVG:
             ct = ct / idx.shape[-1]
-        d = self.out_dim
+        r, d = self._pack, self.out_dim
+        T, rows = self.num_tables, self.num_entries
 
-        def one_table(t, ix, c):          # (rows,d), (batch,bag), (batch,d)
+        if _pallas_scatter_ok(self.model, d if r == 1 else 128, self.name):
+            # one fused scatter over the packed (T*rows/r, 128|r*d) view;
+            # global unpacked row g = t*rows + ix keeps g//r, g%r aligned
+            # with the per-table packing because rows % r == 0
+            from .pallas.embedding_kernel import (scatter_add_rows,
+                                                  scatter_add_rows_packed)
+            offs = (jnp.arange(T, dtype=jnp.int32) * rows)[None, :, None]
+            gidx = (idx + offs).reshape(-1)
+            upd = jnp.broadcast_to(
+                ct[..., None, :], idx.shape + (d,)).reshape(-1, d)
+            view = tbl.reshape(T * rows // r, r * d)
+            if r == 1:
+                new = scatter_add_rows(view, gidx, -lr * upd)
+            else:
+                new = scatter_add_rows_packed(view, gidx, -lr * upd, d)
+            return {"kernel": new.reshape(tbl.shape)}
+
+        def one_table(t, ix, c):   # (rows/r, r*d), (batch,bag), (batch,d)
             upd = jnp.broadcast_to(c[:, None, :], ix.shape + (d,))
-            return t.at[ix.reshape(-1)].add(-lr * upd.reshape(-1, d))
+            tu = t.reshape(rows, d)
+            tu = tu.at[ix.reshape(-1)].add(-lr * upd.reshape(-1, d))
+            return tu.reshape(t.shape)
 
         new = jax.vmap(one_table, in_axes=(0, 1, 1))(tbl, idx, ct)
         return {"kernel": new}
@@ -296,13 +385,39 @@ class EmbeddingBagConcat(Op):
         for s in self.table_sizes[:-1]:
             offs.append(offs[-1] + s)
         self._offsets = tuple(offs)
+        # lane packing (see EmbeddingBagStacked): total_rows is a power-of-
+        # two multiple of any pack factor, so narrow rows always pack
+        self._pack = _pack_factor(self.out_dim, self.total_rows)
         batch = input_tensor.shape[0]
         self.outputs = [self._make_output(
             (batch, self.num_tables, self.out_dim))]
 
     def param_defs(self):
-        return {"kernel": ParamDef((self.total_rows, self.out_dim),
-                                   jnp.float32, self.kernel_initializer)}
+        r = self._pack
+        return {"kernel": ParamDef(
+            (self.total_rows // r, self.out_dim * r), jnp.float32,
+            self.kernel_initializer)}
+
+    def init_params(self, key):
+        # per-table init at each table's LOGICAL (rows_t, d) shape:
+        # one Glorot over the fused multi-million-row shape would collapse
+        # small tables' scale to ~0 versus the unfused per-table ops
+        keys = jax.random.split(key, self.num_tables + 1)
+        parts = [self.kernel_initializer(
+            keys[i], (rows, self.out_dim), jnp.float32)
+            for i, rows in enumerate(self.table_sizes)]
+        pad = self.total_rows - sum(self.table_sizes)
+        if pad:
+            parts.append(jnp.zeros((pad, self.out_dim), jnp.float32))
+        return {"kernel": self.pack_kernel(jnp.concatenate(parts))}
+
+    def unpack_kernel(self, kernel):
+        """(total_rows/r, r*d) stored form -> logical (total_rows, d)."""
+        return kernel.reshape(self.total_rows, self.out_dim)
+
+    def pack_kernel(self, logical):
+        r = self._pack
+        return logical.reshape(self.total_rows // r, self.out_dim * r)
 
     def _global_indices(self, idx):
         """Per-table modulo (wrap semantics like the gathers above) then
@@ -313,18 +428,22 @@ class EmbeddingBagConcat(Op):
 
     def apply(self, params, xs, *, training=False, rng=None):
         (idx,) = xs                        # (batch, T, bag)
-        tbl = params["kernel"]             # (total_rows, d)
+        tbl = params["kernel"]             # (total_rows/r, r*d)
         g = self._global_indices(idx)
         batch, T, bag = g.shape
-        if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
+        r, d = self._pack, self.out_dim
+        if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG) and r == 1
                 and _pallas_ok(self.model, self.out_dim, self.name)):
             # one Pallas row-stream over the concatenated table; per-table
             # bags become the kernel's bag dim via (batch*T, bag) indices
             from .pallas.embedding_kernel import embedding_bag
             out = embedding_bag(tbl, g.reshape(batch * T, bag), self.aggr)
             return [out.reshape(batch, T, self.out_dim)]
-        rows = jnp.take(tbl, g.reshape(-1), axis=0,
-                        mode="wrap").reshape(g.shape + (self.out_dim,))
+        if r == 1:
+            rows = jnp.take(tbl, g.reshape(-1), axis=0,
+                            mode="wrap").reshape(g.shape + (d,))
+        else:
+            rows = _packed_gather(tbl, g, r, d)   # (batch, T, bag, d)
         if self.aggr == AGGR_MODE_AVG:
             return [jnp.mean(rows, axis=2)]
         return [jnp.sum(rows, axis=2)]     # (batch, T, d)
@@ -340,14 +459,15 @@ class EmbeddingBagConcat(Op):
                     out.append(ParallelConfig((ds, dt, 1)))
         return out
 
-    def param_axes(self, pc: ParallelConfig, out_axes):
+    def param_axes(self, pc: ParallelConfig, out_axes,
+                   raw_pc=None):
         # table parallelism = row-block sharding of the concatenated rows.
         # Keyed off the RAW (unclamped) strategy degrees: the output's
         # table dim often can't split evenly (26 tables on 8 chips), but
         # the padded row count always can — and sharding the rows is the
         # memory-scaling point of placing tables across devices. GSPMD
         # inserts the gather/scatter collectives.
-        raw = getattr(self, "_raw_pc", None) or pc
+        raw = raw_pc or pc
         if len(raw.degrees) >= 2 and raw.degrees[1] > 1:
             rows_axes = tuple(self.model.mesh.axis_names)
         else:
@@ -369,7 +489,20 @@ class EmbeddingBagConcat(Op):
         ct = out_ct.astype(tbl.dtype)      # (batch, T, d)
         if self.aggr == AGGR_MODE_AVG:
             ct = ct / g.shape[-1]
-        upd = jnp.broadcast_to(ct[..., None, :], g.shape + (self.out_dim,))
-        new = tbl.at[g.reshape(-1)].add(
-            -lr * upd.reshape(-1, self.out_dim))
+        r, d = self._pack, self.out_dim
+        upd = jnp.broadcast_to(ct[..., None, :], g.shape + (d,))
+        upd = upd.reshape(-1, d)
+        if _pallas_scatter_ok(self.model, d if r == 1 else 128, self.name):
+            from .pallas.embedding_kernel import (scatter_add_rows,
+                                                  scatter_add_rows_packed)
+            if r == 1:
+                new = scatter_add_rows(tbl, g.reshape(-1), -lr * upd)
+            else:
+                new = scatter_add_rows_packed(tbl, g.reshape(-1),
+                                              -lr * upd, d)
+        elif r == 1:
+            new = tbl.at[g.reshape(-1)].add(-lr * upd)
+        else:
+            new = self.pack_kernel(
+                self.unpack_kernel(tbl).at[g.reshape(-1)].add(-lr * upd))
         return {"kernel": new}

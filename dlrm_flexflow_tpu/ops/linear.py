@@ -76,7 +76,8 @@ class Linear(Op):
                     out.append(ParallelConfig(tuple(degs)))
         return out
 
-    def param_axes(self, pc: ParallelConfig, out_axes):
+    def param_axes(self, pc: ParallelConfig, out_axes,
+                   raw_pc=None):
         # channel (last output dim) partition shards the kernel's out dim and
         # the bias *on the same mesh axes* as the activation's channel dim;
         # sample partition replicates weights (grad psum by GSPMD)

@@ -169,6 +169,170 @@ def _bwd(aggr, interpret, res, g):
 embedding_bag.defvjp(_fwd, _bwd)
 
 
+def scatter_supports(dim: int) -> bool:
+    """Row widths the scatter-add kernel handles: a whole number of lane
+    tiles, or an exact divisor of one tile."""
+    return dim % _LANES == 0 or _LANES % dim == 0
+
+
+def _scatter_unique_kernel(idx_ref, upd_ref, tbl_ref, out_ref, bufs,
+                           rsems, wsems):
+    """One grid step applies _TILE_B tile updates, pipelined.
+
+    PRECONDITION (established by scatter_add_rows' dedup pre-pass): all
+    view-row targets with row >= 0 are DISTINCT, so the 8 RMWs of a block
+    are independent: issue all reads, then add+write-back, then drain.
+    row < 0 marks a padding slot and is skipped. The reference needed
+    atomicAdd for this (embedding.cu:173-224); here distinctness replaces
+    atomicity.
+    """
+    i = pl.program_id(0)
+
+    def rd(s, row):
+        return pltpu.make_async_copy(
+            out_ref.at[pl.ds(row, 1), :], bufs.at[s], rsems.at[s])
+
+    def wr(s, row):
+        return pltpu.make_async_copy(
+            bufs.at[s], out_ref.at[pl.ds(row, 1), :], wsems.at[s])
+
+    for s in range(_TILE_B):            # static unroll: issue all reads
+        row = idx_ref[i * _TILE_B + s]
+
+        @pl.when(row >= 0)
+        def _():
+            rd(s, row).start()
+    for s in range(_TILE_B):            # add + async write-back
+        row = idx_ref[i * _TILE_B + s]
+
+        @pl.when(row >= 0)
+        def _():
+            rd(s, row).wait()
+            bufs[s] = (bufs[s] + upd_ref[pl.ds(s, 1), :]).astype(bufs.dtype)
+            wr(s, row).start()
+    for s in range(_TILE_B):            # drain before the next block
+        row = idx_ref[i * _TILE_B + s]
+
+        @pl.when(row >= 0)
+        def _():
+            wr(s, row).wait()
+
+
+def scatter_add_rows(table: jax.Array, indices: jax.Array,
+                     updates: jax.Array,
+                     interpret: bool = False) -> jax.Array:
+    """table.at[indices].add(updates) for (rows, dim) tables — a Pallas
+    in-place RMW kernel with an XLA dedup pre-pass.
+
+    XLA's TPU scatter lowers to a serialized update loop that costs
+    hundreds of ms for a few thousand rows on a multi-GB table. Here:
+    (1) updates are expressed as (view_row, 128-lane tile) pairs — k
+    chunks per row for wide tables, rotated d-wide slices for narrow ones;
+    (2) duplicates are combined by sort + segment-sum (the sorted-segment
+    trick that replaces the reference's atomicAdd backward); (3) a Pallas
+    kernel streams the distinct tiles through a pipelined
+    read-modify-write, touching only the updated bytes of HBM.
+
+    table   : (rows, dim) float32
+    indices : (n,) int — duplicates allowed
+    updates : (n, dim) — same width as the table
+    """
+    rows, dim = table.shape
+    (n,) = indices.shape
+    if not scatter_supports(dim):
+        return table.at[indices].add(updates.astype(table.dtype))
+    indices = indices.astype(jnp.int32)
+    updates = updates.astype(table.dtype)
+    if dim % _LANES == 0:
+        k = dim // _LANES
+        view = table.reshape(rows * k, _LANES)
+        # (n, dim) -> (n*k, 128) chunk tiles at view rows idx*k + c
+        tile_rows = (indices[:, None] * k
+                     + jnp.arange(k, dtype=jnp.int32)[None, :]).reshape(-1)
+        tile_upds = updates.reshape(n * k, _LANES)
+    else:
+        r_per_tile = _LANES // dim
+        if rows % r_per_tile:
+            # padding the view would copy the whole table — not worth it
+            return table.at[indices].add(updates)
+        view = table.reshape(rows // r_per_tile, _LANES)
+        tile_rows = indices // r_per_tile
+        offs = (indices % r_per_tile) * dim
+        padded = jnp.pad(updates, ((0, 0), (0, _LANES - dim)))
+        tile_upds = jax.vmap(jnp.roll)(padded, offs)
+    out = _dedup_and_scatter(view, tile_rows, tile_upds, interpret)
+    return out.reshape(-1, dim)[:rows]
+
+
+def scatter_add_rows_packed(view: jax.Array, indices: jax.Array,
+                            updates: jax.Array, dim: int,
+                            interpret: bool = False) -> jax.Array:
+    """Scatter d-wide row updates into an ALREADY-PACKED (vrows, 128) view
+    (the lane-packed parameter layout of the fused embedding ops —
+    128 // dim unpacked rows per view row). Avoids the whole-table layout
+    transposes XLA inserts when a narrow (rows, d) table is reshaped at
+    the kernel boundary.
+
+    view    : (vrows, 128) — packed table, 128 % dim == 0
+    indices : (n,) int in UNPACKED row space — duplicates allowed
+    updates : (n, dim)
+    """
+    r_per_tile = _LANES // dim
+    indices = indices.astype(jnp.int32)
+    tile_rows = indices // r_per_tile
+    offs = (indices % r_per_tile) * dim
+    padded = jnp.pad(updates.astype(view.dtype),
+                     ((0, 0), (0, _LANES - dim)))
+    tile_upds = jax.vmap(jnp.roll)(padded, offs)
+    return _dedup_and_scatter(view, tile_rows, tile_upds, interpret)
+
+
+def _dedup_and_scatter(view, tile_rows, tile_upds, interpret):
+    m = tile_rows.shape[0]
+    # dedup: combine same-tile updates so the kernel sees distinct rows
+    order = jnp.argsort(tile_rows)
+    srows = tile_rows[order]
+    supds = tile_upds[order]
+    first = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                             srows[1:] != srows[:-1]])
+    seg = jnp.cumsum(first) - 1                      # (m,) segment ids
+    summed = jax.ops.segment_sum(supds, seg, num_segments=m,
+                                 indices_are_sorted=True)
+    target = jax.ops.segment_max(srows, seg, num_segments=m,
+                                 indices_are_sorted=True)
+    num_unique = seg[-1] + 1
+    valid = jnp.arange(m) < num_unique
+    target = jnp.where(valid, target, -1).astype(jnp.int32)
+
+    pad_n = (-m) % _TILE_B
+    if pad_n:
+        target = jnp.pad(target, (0, pad_n), constant_values=-1)
+        summed = jnp.pad(summed, ((0, pad_n), (0, 0)))
+        m += pad_n
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m // _TILE_B,),
+        in_specs=[
+            pl.BlockSpec((_TILE_B, _LANES), lambda i, idx: (i, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((_TILE_B, 1, _LANES), view.dtype),
+            pltpu.SemaphoreType.DMA((_TILE_B,)),
+            pltpu.SemaphoreType.DMA((_TILE_B,)),
+        ],
+    )
+    return pl.pallas_call(
+        _scatter_unique_kernel,
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(target, summed.astype(view.dtype), view)
+
+
 def stacked_embedding_bag(tables, indices, aggr: str = "sum",
                           interpret: bool = False):
     """Fused multi-table bag on the Pallas kernel.

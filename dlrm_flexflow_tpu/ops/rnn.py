@@ -90,7 +90,8 @@ class LSTM(Op):
                     out.append(ParallelConfig((ds, 1, dh)))
         return out
 
-    def param_axes(self, pc: ParallelConfig, out_axes):
+    def param_axes(self, pc: ParallelConfig, out_axes,
+                   raw_pc=None):
         ch = out_axes[2] if len(out_axes) >= 3 else ()
         # gate matrices are (.., 4h): sharding 4h on the hidden axes keeps
         # each device's gate slice local (i/f/g/o interleave is fine since

@@ -67,6 +67,28 @@ def restore_checkpoint(model, path: str):
         elif k.startswith("state/"):
             state_flat[k[len("state/"):]] = data[k]
     params = _unflatten(params_flat)
+    # validate against the model's parameter spec before overwriting
+    # anything: a mismatch (e.g. a checkpoint from a per-table or
+    # pre-lane-packing embedding layout) must fail HERE with a clear
+    # message, not as an opaque shape error inside the next train step
+    if model.params is not None:
+        for opname, pdict in params.items():
+            cur = model.params.get(opname)
+            if cur is None:
+                raise ValueError(
+                    f"checkpoint has parameters for op {opname!r} which "
+                    f"does not exist in this model (built with different "
+                    f"fuse_embeddings / graph options?)")
+            for n, v in pdict.items():
+                if n in cur and tuple(cur[n].shape) != tuple(v.shape):
+                    raise ValueError(
+                        f"checkpoint param {opname}/{n} has shape "
+                        f"{tuple(v.shape)} but the model expects "
+                        f"{tuple(cur[n].shape)}. Embedding tables are "
+                        f"stored lane-packed — rebuild the model with the "
+                        f"options used when the checkpoint was written, "
+                        f"or convert via the op's unpack_kernel/"
+                        f"pack_kernel helpers.")
     # re-shard parameters per compile-time shardings
     for opname, pdict in params.items():
         shards = model._param_sharding.get(opname, {})

@@ -105,3 +105,23 @@ def test_strategy_export_import_through_compile(tmp_path):
     m2.compile(ff.SGDOptimizer(0.1), "mean_squared_error", ["mse"],
                mesh=make_mesh(num_devices=8))
     assert m2.strategies["emb_stack"] == m1.strategies["emb_stack"]
+
+
+def test_terabyte_64chip_northstar():
+    """BASELINE.md north star: DLRM-Terabyte on a simulated v5e-64 — the
+    table-parallel strategy (and anything the search finds) must beat pure
+    data parallelism by >= 1.5x in the simulator. DP all-reduces ~1 TB of
+    table gradients per step; table parallelism moves only activations."""
+    dcfg = DLRMConfig.terabyte()
+    model = ff.FFModel(ff.FFConfig(batch_size=256 * 64,
+                                   compute_dtype="bfloat16"))
+    build_dlrm(model, dcfg)
+    model.mesh = make_mesh(num_devices=8)   # mesh only gates feasibility
+    sim = Simulator(model)
+    dp = default_strategy(model, 64)
+    hand = dlrm_strategy(model, dcfg, 64)
+    for k, v in dp.items():
+        hand.setdefault(k, v)
+    t_dp = sim.simulate(dp, 64)
+    t_hand = sim.simulate(hand, 64)
+    assert t_hand * 1.5 < t_dp, (t_hand, t_dp)

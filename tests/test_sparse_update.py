@@ -151,15 +151,17 @@ class TestEmbeddingBagConcat:
         import numpy as np
         m_concat, dcfg = self._build(fuse=True)
         m_split, _ = self._build(fuse=False)
-        # copy the per-table kernels into the concatenated rows
+        # copy the per-table kernels into the concatenated rows (the param
+        # is stored lane-packed; go through the op's unpack/pack helpers)
         op = m_concat.get_layer_by_name("emb_concat")
-        kernel = np.asarray(m_concat.params["emb_concat"]["kernel"]).copy()
+        kernel = np.asarray(op.unpack_kernel(
+            m_concat.params["emb_concat"]["kernel"])).copy()
         off = 0
         for i, rows in enumerate(self.SIZES):
             kernel[off:off + rows] = np.asarray(
                 m_split.params[f"emb_{i}"]["kernel"])
             off += rows
-        m_concat.params["emb_concat"]["kernel"] = kernel
+        m_concat.params["emb_concat"]["kernel"] = op.pack_kernel(kernel)
         # align the MLP weights too
         for name in list(m_split.params):
             if name.startswith(("bot_", "top_")):
