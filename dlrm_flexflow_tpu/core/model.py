@@ -803,8 +803,9 @@ class FFModel:
         # which costs more than the step itself on fast models. Keyed by
         # the batch signature so alternating shapes (e.g. a remainder
         # batch) each compile once.
-        key = tuple(sorted((k, v.shape, str(v.dtype))
-                           for k, v in device_batch.items()))
+        key = tuple(sorted(
+            (k, v.shape, str(v.dtype), str(getattr(v, "sharding", None)))
+            for k, v in device_batch.items()))
         execs = getattr(self, "_train_step_execs", None)
         if execs is None:
             execs = self._train_step_execs = {}

@@ -402,7 +402,7 @@ class EmbeddingBagConcat(Op):
         # per-table init at each table's LOGICAL (rows_t, d) shape:
         # one Glorot over the fused multi-million-row shape would collapse
         # small tables' scale to ~0 versus the unfused per-table ops
-        keys = jax.random.split(key, self.num_tables + 1)
+        keys = jax.random.split(key, self.num_tables)
         parts = [self.kernel_initializer(
             keys[i], (rows, self.out_dim), jnp.float32)
             for i, rows in enumerate(self.table_sizes)]

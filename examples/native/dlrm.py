@@ -52,6 +52,12 @@ def _check_sparse_bounds(sparse, dcfg):
 
 
 def main(argv=None):
+    if os.environ.get("NUM_PROCESSES") or os.environ.get(
+            "COORDINATOR_ADDRESS"):
+        # multi-host launch (reference run_summit.sh over GASNet)
+        from dlrm_flexflow_tpu.parallel.distributed import \
+            initialize_distributed
+        initialize_distributed()
     cfg = ff.FFConfig.parse_args(argv)
     dcfg = DLRMConfig.parse_args(cfg.unparsed)
     data_path = None

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Multi-host DLRM launch (reference: examples/cpp/DLRM/run_summit.sh jsrun
+# launch over GASNet; here every host runs the same SPMD program and JAX's
+# distributed runtime carries cross-host traffic over DCN).
+#
+# On a Cloud TPU pod slice, run on EVERY worker (jax auto-detects the
+# coordinator):
+#   python examples/native/dlrm.py -b $((256 * NUM_CHIPS)) -e 2 \
+#       --arch-embedding-size 1000000-...(8x) --arch-sparse-feature-size 64 \
+#       --arch-mlp-bot 64-512-512-64 --arch-mlp-top 576-1024-1024-1024-1
+#
+# On a generic cluster, export on each host:
+#   export COORDINATOR_ADDRESS=host0:1234 NUM_PROCESSES=4 PROCESS_ID=<rank>
+# and call dlrm_flexflow_tpu.parallel.distributed.initialize_distributed()
+# before building the model (dlrm.py does this when NUM_PROCESSES is set).
+#
+# This script demonstrates the 2-process form on one machine with CPU
+# devices (smoke only):
+set -e
+cd "$(dirname "$0")/../.."
+for RANK in 0 1; do
+  COORDINATOR_ADDRESS=127.0.0.1:12355 NUM_PROCESSES=2 PROCESS_ID=$RANK \
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+  python examples/native/dlrm.py -b 64 -e 1 \
+      --arch-embedding-size 64-64-64-64 --arch-sparse-feature-size 8 \
+      --arch-mlp-bot 4-16-8 --arch-mlp-top 40-16-1 &
+done
+wait

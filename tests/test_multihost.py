@@ -1,0 +1,65 @@
+"""Multi-host runtime tests (single-process forms; reference multi-node =
+GASNet + control replication, README.md:18-20, model.cc:1384-1409).
+
+The hybrid-mesh layout and host-local→global batch assembly are exercised
+on the virtual CPU mesh: with process_count == 1 the global batch equals
+the local one, and `num_slices` stands in for DCN domains.
+"""
+
+import numpy as np
+
+import jax
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                           dlrm_strategy, synthetic_batch)
+from dlrm_flexflow_tpu.parallel.distributed import (
+    global_batch_from_host_local, make_multihost_mesh)
+
+
+class TestMultihostMesh:
+    def test_dcn_axis_first(self):
+        mesh = make_multihost_mesh(num_slices=2)
+        assert mesh.axis_names[0] == "dcn"
+        assert mesh.shape["dcn"] == 2
+        assert mesh.size == 8
+
+    def test_single_slice_degenerates(self):
+        mesh = make_multihost_mesh(num_slices=1)
+        assert mesh.shape["dcn"] == 1
+        assert mesh.size == 8
+
+    def test_uneven_slices_rejected(self):
+        import pytest
+        with pytest.raises(ValueError):
+            make_multihost_mesh(num_slices=3)
+
+    def test_trains_dlrm_on_hybrid_mesh(self):
+        """Full sharded train step over the dcn+ici mesh: table-parallel
+        embeddings within slices, data-parallel across everything."""
+        mesh = make_multihost_mesh(num_slices=2)
+        dcfg = DLRMConfig(embedding_size=[64] * 8, sparse_feature_size=8,
+                          mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1])
+        model = ff.FFModel(ff.FFConfig(batch_size=16, seed=2))
+        build_dlrm(model, dcfg)
+        model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                      ["mse"], mesh=mesh,
+                      strategies=dlrm_strategy(model, dcfg, 8))
+        model.init_layers()
+        x, y = synthetic_batch(dcfg, 16, seed=0)
+        x["label"] = y
+        mets = model.train_batch(x)
+        assert np.isfinite(float(mets["loss"]))
+
+
+class TestGlobalBatch:
+    def test_single_process_equals_device_put(self):
+        mesh = make_multihost_mesh(num_slices=2)
+        rng = np.random.RandomState(0)
+        local = {"dense": rng.rand(16, 4).astype(np.float32)}
+        out = global_batch_from_host_local(local, mesh)
+        assert out["dense"].shape == (16, 4)
+        np.testing.assert_array_equal(np.asarray(out["dense"]),
+                                      local["dense"])
+        # sharded over all axes on dim 0
+        assert out["dense"].sharding.spec[0] is not None
