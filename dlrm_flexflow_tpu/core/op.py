@@ -124,6 +124,14 @@ class Op:
         return sum(int(math.prod(d.shape)) * jnp.dtype(d.dtype).itemsize
                    for d in self.param_defs().values())
 
+    def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
+        """Parameter bytes ONE DEVICE streams through HBM in one training
+        step — what the cost model should charge. Defaults to the full
+        parameter size (dense ops read every weight, whatever the batch
+        partitioning); sparse-update embeddings override with this shard's
+        gathered-rows traffic."""
+        return self.param_bytes()
+
     def __repr__(self):
         return (f"{type(self).__name__}(name={self.name!r}, "
                 f"in={[t.shape for t in self.inputs]}, "

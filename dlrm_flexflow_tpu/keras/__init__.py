@@ -19,6 +19,7 @@ from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
 from .models import Model, Sequential
 from .callbacks import Callback, EarlyStopping, VerifyMetrics
 from .optimizers import SGD, Adam
+from . import initializers, losses, metrics, preprocessing, utils
 
 __all__ = ["Input", "Dense", "Conv2D", "MaxPooling2D", "AveragePooling2D",
            "Flatten", "Embedding", "Concatenate", "Add", "Subtract",

@@ -1,0 +1,27 @@
+"""Keras-compat initializer aliases (reference:
+python/flexflow/keras/initializers.py) over the core initializers."""
+
+from __future__ import annotations
+
+from ..core.initializers import (ConstantInitializer, GlorotUniform,
+                                 Initializer, NormInitializer,
+                                 UniformInitializer, ZeroInitializer)
+
+DefaultInitializer = None  # layer picks its own default (reference sem.)
+Zeros = ZeroInitializer
+
+
+class RandomUniform(UniformInitializer):
+    def __init__(self, minval=-0.05, maxval=0.05, seed=None):
+        super().__init__(min_val=minval, max_val=maxval)
+
+
+class RandomNormal(NormInitializer):
+    def __init__(self, mean=0.0, stddev=0.05, seed=None):
+        super().__init__(mean=mean, stddev=stddev)
+
+
+Constant = ConstantInitializer
+
+__all__ = ["Initializer", "DefaultInitializer", "Zeros", "GlorotUniform",
+           "RandomUniform", "RandomNormal", "Constant"]

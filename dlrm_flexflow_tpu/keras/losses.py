@@ -1,0 +1,23 @@
+"""Keras-compat loss descriptors (reference: python/flexflow/keras/losses.py
+— thin classes whose `type` string selects the core loss)."""
+
+from __future__ import annotations
+
+
+class Loss:
+    type: str = ""
+
+    def __init__(self, name: str = ""):
+        self.name = name or self.type
+
+
+class CategoricalCrossentropy(Loss):
+    type = "categorical_crossentropy"
+
+
+class SparseCategoricalCrossentropy(Loss):
+    type = "sparse_categorical_crossentropy"
+
+
+class MeanSquaredError(Loss):
+    type = "mean_squared_error"

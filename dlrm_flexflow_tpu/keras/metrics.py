@@ -1,0 +1,36 @@
+"""Keras-compat metric descriptors (reference:
+python/flexflow/keras/metrics.py — thin classes whose `type` string selects
+the core metric)."""
+
+from __future__ import annotations
+
+
+class Metric:
+    type: str = ""
+
+    def __init__(self, name: str = ""):
+        self.name = name or self.type
+
+
+class Accuracy(Metric):
+    type = "accuracy"
+
+
+class CategoricalCrossentropy(Metric):
+    type = "categorical_crossentropy"
+
+
+class SparseCategoricalCrossentropy(Metric):
+    type = "sparse_categorical_crossentropy"
+
+
+class MeanSquaredError(Metric):
+    type = "mean_squared_error"
+
+
+class RootMeanSquaredError(Metric):
+    type = "root_mean_squared_error"
+
+
+class MeanAbsoluteError(Metric):
+    type = "mean_absolute_error"

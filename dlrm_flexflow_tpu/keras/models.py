@@ -34,8 +34,11 @@ class Model:
     def compile(self, optimizer="sgd", loss="mean_squared_error",
                 metrics=None):
         self.optimizer = optimizer
-        self.loss = loss
-        self.metrics = metrics or ["mean_squared_error"]
+        # accept keras-style Loss/Metric objects (reference losses.py /
+        # metrics.py classes carry a `type` string) as well as plain strings
+        self.loss = getattr(loss, "type", loss)
+        metrics = metrics or ["mean_squared_error"]
+        self.metrics = [getattr(m, "type", m) for m in metrics]
 
     def _topo_layers(self) -> List[Layer]:
         order: List[Layer] = []

@@ -1,0 +1,68 @@
+"""Sequence preprocessing (reference: python/flexflow/keras/preprocessing/
+sequence.py re-exports keras_preprocessing; implemented natively here)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pad_sequences(sequences, maxlen=None, dtype="int32", padding="pre",
+                  truncating="pre", value=0.0):
+    """Pad/truncate a list of variable-length sequences to a 2-D array."""
+    lengths = [len(s) for s in sequences]
+    if maxlen is None:
+        maxlen = max(lengths) if lengths else 0
+    out = np.full((len(sequences), maxlen), value, dtype=dtype)
+    for i, s in enumerate(sequences):
+        if not len(s):
+            continue
+        s = list(s)
+        if len(s) > maxlen:
+            s = s[-maxlen:] if truncating == "pre" else s[:maxlen]
+        if padding == "pre":
+            out[i, -len(s):] = s
+        else:
+            out[i, :len(s)] = s
+    return out
+
+
+def make_sampling_table(size, sampling_factor=1e-5):
+    """Zipf-based word-sampling probability table (word2vec-style)."""
+    gamma = 0.577
+    rank = np.arange(size)
+    rank[0] = 1
+    inv_fq = rank * (np.log(rank) + gamma) + 0.5 - 1.0 / (12.0 * rank)
+    f = sampling_factor * inv_fq
+    return np.minimum(1.0, f / np.sqrt(f))
+
+
+def skipgrams(sequence, vocabulary_size, window_size=4, negative_samples=1.0,
+              shuffle=True, sampling_table=None, seed=None):
+    """(word, context) couples with binary labels, plus negative samples."""
+    couples, labels = [], []
+    for i, wi in enumerate(sequence):
+        if not wi:
+            continue
+        if sampling_table is not None:
+            if sampling_table[wi] < np.random.random():
+                continue
+        window_start = max(0, i - window_size)
+        window_end = min(len(sequence), i + window_size + 1)
+        for j in range(window_start, window_end):
+            if j != i and sequence[j]:
+                couples.append([wi, sequence[j]])
+                labels.append(1)
+    if negative_samples > 0:
+        num_neg = int(len(labels) * negative_samples)
+        words = [c[0] for c in couples]
+        rng = np.random.RandomState(seed)
+        rng.shuffle(words)
+        couples += [[w, rng.randint(1, vocabulary_size)]
+                    for w in words[:num_neg]]
+        labels += [0] * num_neg
+    if shuffle:
+        rng = np.random.RandomState(seed)
+        order = rng.permutation(len(couples))
+        couples = [couples[i] for i in order]
+        labels = [labels[i] for i in order]
+    return couples, labels

@@ -1,0 +1,85 @@
+"""Text preprocessing (reference: python/flexflow/keras/preprocessing/
+text.py re-exports keras_preprocessing; implemented natively here)."""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+
+def text_to_word_sequence(text, filters='!"#$%&()*+,-./:;<=>?@[\\]^_`{|}~\t\n',
+                          lower=True, split=" "):
+    if lower:
+        text = text.lower()
+    table = str.maketrans({c: split for c in filters})
+    return [w for w in text.translate(table).split(split) if w]
+
+
+def hashing_trick(text, n, hash_function=None, **kwargs):
+    if hash_function is None:
+        hash_function = hash
+    elif hash_function == "md5":
+        hash_function = lambda w: int(  # noqa: E731
+            hashlib.md5(w.encode()).hexdigest(), 16)
+    words = text_to_word_sequence(text, **kwargs)
+    return [(hash_function(w) % (n - 1) + 1) for w in words]
+
+
+def one_hot(text, n, **kwargs):
+    return hashing_trick(text, n, hash_function=hash, **kwargs)
+
+
+class Tokenizer:
+    """Word-index tokenizer (fit_on_texts / texts_to_sequences /
+    texts_to_matrix subset)."""
+
+    def __init__(self, num_words=None, oov_token=None, **kwargs):
+        self.num_words = num_words
+        self.oov_token = oov_token
+        self.word_counts = OrderedDict()
+        self.word_index = {}
+        self._kwargs = kwargs
+
+    def fit_on_texts(self, texts):
+        for text in texts:
+            for w in text_to_word_sequence(text, **self._kwargs):
+                self.word_counts[w] = self.word_counts.get(w, 0) + 1
+        sorted_words = sorted(self.word_counts, key=self.word_counts.get,
+                              reverse=True)
+        offset = 1
+        if self.oov_token is not None:
+            self.word_index[self.oov_token] = 1
+            offset = 2
+        for i, w in enumerate(sorted_words):
+            self.word_index[w] = i + offset
+
+    def texts_to_sequences(self, texts):
+        out = []
+        limit = self.num_words
+        for text in texts:
+            seq = []
+            for w in text_to_word_sequence(text, **self._kwargs):
+                i = self.word_index.get(w)
+                if i is None:
+                    if self.oov_token is not None:
+                        seq.append(self.word_index[self.oov_token])
+                    continue
+                if limit and i >= limit:
+                    if self.oov_token is not None:
+                        seq.append(self.word_index[self.oov_token])
+                    continue
+                seq.append(i)
+            out.append(seq)
+        return out
+
+    def texts_to_matrix(self, texts, mode="binary"):
+        import numpy as np
+        n = self.num_words or (len(self.word_index) + 1)
+        m = np.zeros((len(texts), n), np.float32)
+        for r, seq in enumerate(self.texts_to_sequences(texts)):
+            for i in seq:
+                if mode == "count":
+                    m[r, i] += 1.0
+                else:
+                    m[r, i] = 1.0
+        return m

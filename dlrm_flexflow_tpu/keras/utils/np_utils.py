@@ -1,0 +1,17 @@
+"""Numpy utilities (reference: python/flexflow/keras/utils/np_utils.py)."""
+
+import numpy as np
+
+
+def to_categorical(y, num_classes=None, dtype="float32"):
+    y = np.asarray(y, dtype="int64").ravel()
+    if num_classes is None:
+        num_classes = int(y.max()) + 1
+    out = np.zeros((y.shape[0], num_classes), dtype=dtype)
+    out[np.arange(y.shape[0]), y] = 1
+    return out
+
+
+def normalize(x, axis=-1, order=2):
+    norm = np.linalg.norm(x, ord=order, axis=axis, keepdims=True)
+    return x / np.maximum(norm, np.finfo(np.float64).eps)

@@ -62,6 +62,23 @@ def _packed_gather(tbl, ix, r, d):
         t, sub[..., None, None], axis=-2)[..., 0, :]    # (..., d)
 
 
+def _sparse_update_active(op) -> bool:
+    """Whether the touched-rows-only update will actually run for `op`
+    (mirrors FFModel._select_sparse_update_ops; optimizer may be unset
+    when the search costs ops pre-compile — assume the common plain-SGD
+    case then)."""
+    if not getattr(op.model.config, "sparse_embedding_update", True):
+        return False
+    if not op.supports_sparse_update():
+        return False
+    opt = getattr(op.model, "optimizer", None)
+    if opt is None:
+        return True
+    from ..core.optimizers import SGDOptimizer
+    return (isinstance(opt, SGDOptimizer) and opt.momentum == 0.0
+            and opt.weight_decay == 0.0)
+
+
 def _pallas_gate(model, op_name: str, width_ok: bool) -> bool:
     """Shared gate for ALL Pallas kernel routing: opted in, TPU backend,
     supported width, not host-offloaded (a Mosaic TPU custom call cannot
@@ -163,6 +180,14 @@ class Embedding(Op):
     def flops_per_sample(self) -> float:
         bag = self.inputs[0].shape[-1] if self.inputs[0].num_dims > 1 else 1
         return float(bag * self.out_dim)  # bandwidth-bound; count adds
+
+    def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
+        if not _sparse_update_active(self):
+            return self.param_bytes()   # dense grad+update streams the table
+        # gather read + sparse-update read/write of this shard's rows only
+        batch = self.inputs[0].shape[0]
+        bag = self.inputs[0].shape[-1] if self.inputs[0].num_dims > 1 else 1
+        return int(3 * batch * bag * self.out_dim * 4 // max(num_parts, 1))
 
     # ---- sparse (touched-rows-only) SGD update -------------------------
     # The dense path materializes a gradient the size of the whole table
@@ -307,6 +332,13 @@ class EmbeddingBagStacked(Op):
     def flops_per_sample(self) -> float:
         bag = self.inputs[0].shape[-1]
         return float(self.num_tables * bag * self.out_dim)
+
+    def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
+        if not _sparse_update_active(self):
+            return self.param_bytes()
+        batch, _, bag = self.inputs[0].shape
+        return int(3 * batch * self.num_tables * bag * self.out_dim * 4
+                   // max(num_parts, 1))
 
     # ---- sparse (touched-rows-only) SGD update (see Embedding) ---------
     def supports_sparse_update(self) -> bool:
@@ -477,6 +509,13 @@ class EmbeddingBagConcat(Op):
     def flops_per_sample(self) -> float:
         bag = self.inputs[0].shape[-1]
         return float(self.num_tables * bag * self.out_dim)
+
+    def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
+        if not _sparse_update_active(self):
+            return self.param_bytes()
+        batch, _, bag = self.inputs[0].shape
+        return int(3 * batch * self.num_tables * bag * self.out_dim * 4
+                   // max(num_parts, 1))
 
     # ---- sparse (touched-rows-only) SGD update (see Embedding) ---------
     def supports_sparse_update(self) -> bool:

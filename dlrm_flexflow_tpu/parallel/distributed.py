@@ -59,8 +59,16 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         # single host, or TPU pod with full auto-detection
         try:
             jax.distributed.initialize()
-        except (ValueError, RuntimeError):
-            pass  # not a distributed environment
+        except (ValueError, RuntimeError) as e:
+            # could be "not a distributed environment" — but could also be
+            # a genuine pod-bootstrap failure, which would silently
+            # degrade to N independent single-host jobs. Surface it.
+            import warnings
+            warnings.warn(
+                f"jax.distributed.initialize() auto-detection failed "
+                f"({e}); continuing single-process. If this is a "
+                f"multi-host launch, set COORDINATOR_ADDRESS/"
+                f"NUM_PROCESSES/PROCESS_ID explicitly.")
         return
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,

@@ -91,7 +91,10 @@ class CostModel:
         io_elems = sum(math.prod(t.shape) for t in op.inputs)
         io_elems += math.prod(op.outputs[0].shape)
         io_bytes = 4.0 * io_elems / max(pc.num_parts, 1)
-        io_bytes += op.param_bytes()  # params read once per device
+        # params: bytes this shard actually streams per step (a sparse-
+        # update embedding touches only its gathered rows, not the
+        # multi-GB table)
+        io_bytes += op.param_bytes_touched_per_step(max(pc.num_parts, 1))
         if backward:
             # bwd ≈ 2x fwd flops (dX and dW gemms), grads written
             flops *= 2.0

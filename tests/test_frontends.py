@@ -179,3 +179,65 @@ def test_get_set_weights():
     set_weights(m, "fc", new)
     out = np.asarray(m.forward_batch({"x": np.ones((4, 3), np.float32)}))
     np.testing.assert_allclose(out[:, 0], 3.0 * np.ones(4), rtol=1e-5)
+
+
+class TestKerasAuxModules:
+    """losses/metrics/initializers/preprocessing/np_utils parity
+    (reference python/flexflow/keras/{losses,metrics,initializers,
+    preprocessing,utils})."""
+
+    def test_loss_metric_objects_in_compile(self):
+        import numpy as np
+
+        from dlrm_flexflow_tpu import keras
+        model = keras.Sequential([
+            keras.Input((4,)),
+            keras.Dense(8, activation="relu"),
+            keras.Dense(3, activation="softmax"),
+        ])
+        model.compile(
+            optimizer=keras.SGD(learning_rate=0.05),
+            loss=keras.losses.SparseCategoricalCrossentropy(),
+            metrics=[keras.metrics.Accuracy(),
+                     keras.metrics.SparseCategoricalCrossentropy()])
+        rng = np.random.RandomState(0)
+        x = rng.rand(64, 4).astype(np.float32)
+        y = rng.randint(0, 3, (64, 1)).astype(np.int32)
+        out = model.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        assert out["throughput"] > 0
+
+    def test_pad_sequences_and_tokenizer(self):
+        from dlrm_flexflow_tpu.keras.preprocessing.sequence import \
+            pad_sequences
+        from dlrm_flexflow_tpu.keras.preprocessing.text import (Tokenizer,
+                                                                one_hot)
+        p = pad_sequences([[1, 2, 3], [4]], maxlen=2)
+        assert p.tolist() == [[2, 3], [0, 4]]
+        p = pad_sequences([[1], [2, 3]], maxlen=3, padding="post")
+        assert p.tolist() == [[1, 0, 0], [2, 3, 0]]
+        t = Tokenizer(num_words=10)
+        t.fit_on_texts(["the cat sat on the mat", "the dog"])
+        seqs = t.texts_to_sequences(["the cat", "the dog"])
+        assert seqs[0][0] == seqs[1][0] == t.word_index["the"]
+        assert all(0 < i < 10 for s in seqs for i in s)
+        oh = one_hot("hello world", 50)
+        assert len(oh) == 2 and all(0 < i < 50 for i in oh)
+
+    def test_np_utils(self):
+        import numpy as np
+
+        from dlrm_flexflow_tpu.keras.utils import normalize, to_categorical
+        cat = to_categorical([1, 0, 2], num_classes=4)
+        assert cat.shape == (3, 4) and cat[0, 1] == 1
+        n = normalize(np.array([[3.0, 4.0]]))
+        np.testing.assert_allclose(n, [[0.6, 0.8]], rtol=1e-6)
+
+    def test_initializer_aliases(self):
+        import jax
+
+        from dlrm_flexflow_tpu.keras import initializers
+        k = jax.random.PRNGKey(0)
+        v = initializers.RandomUniform(minval=-1, maxval=1)(k, (8, 8))
+        assert float(v.min()) >= -1 and float(v.max()) <= 1
+        z = initializers.Zeros()(k, (4,))
+        assert float(abs(z).max()) == 0.0
