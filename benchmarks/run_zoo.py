@@ -41,14 +41,13 @@ def _measure(model, batch_dict, batch_size, steps=30, windows=3):
     return best
 
 
-def bench_dlrm_random(quick):
+def _bench_dlrm(cfg_factory, quick):
     import dlrm_flexflow_tpu as ff
-    from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
-                                               dlrm_strategy,
+    from dlrm_flexflow_tpu.models.dlrm import (build_dlrm, dlrm_strategy,
                                                synthetic_batch)
     batch = 256
     cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
-    dcfg = DLRMConfig.random_benchmark()
+    dcfg = cfg_factory()
     model = ff.FFModel(cfg)
     build_dlrm(model, dcfg)
     model.compile(ff.SGDOptimizer(lr=0.01), "mean_squared_error", ["mse"],
@@ -57,24 +56,16 @@ def bench_dlrm_random(quick):
     x, y = synthetic_batch(dcfg, batch)
     x["label"] = y
     return _measure(model, x, batch, steps=10 if quick else 50)
+
+
+def bench_dlrm_random(quick):
+    from dlrm_flexflow_tpu.models.dlrm import DLRMConfig
+    return _bench_dlrm(DLRMConfig.random_benchmark, quick)
 
 
 def bench_dlrm_criteo(quick):
-    import dlrm_flexflow_tpu as ff
-    from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
-                                               dlrm_strategy,
-                                               synthetic_batch)
-    batch = 256
-    cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
-    dcfg = DLRMConfig.criteo_kaggle()
-    model = ff.FFModel(cfg)
-    build_dlrm(model, dcfg)
-    model.compile(ff.SGDOptimizer(lr=0.01), "mean_squared_error", ["mse"],
-                  strategies=dlrm_strategy(model, dcfg, 1))
-    model.init_layers()
-    x, y = synthetic_batch(dcfg, batch)
-    x["label"] = y
-    return _measure(model, x, batch, steps=10 if quick else 50)
+    from dlrm_flexflow_tpu.models.dlrm import DLRMConfig
+    return _bench_dlrm(DLRMConfig.criteo_kaggle, quick)
 
 
 def _image_batch(batch, hw, classes=1000, seed=0):

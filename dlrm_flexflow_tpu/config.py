@@ -35,6 +35,17 @@ class FFConfig:
     num_nodes: int = 1
     search_budget: int = 0
     search_alpha: float = 1.2
+    # calibrate the search cost model by timing each op's compiled XLA
+    # subgraph on the real device (reference Op::measure_compute_time
+    # microbenchmarks, simulator.cc:235-273) instead of pure roofline
+    search_measure: bool = False
+    # jax.debug_nans: fail fast on NaNs (the TPU-native stand-in for the
+    # reference's reliance on Legion region privileges + asserts for
+    # catching bad numerics, SURVEY.md §5.2). Tri-state: None leaves the
+    # process-global jax flag untouched; True/False set it explicitly
+    # (it is a PROCESS-global switch — enabling it affects every model
+    # in the process until another model sets it False)
+    debug_nans: Optional[bool] = None
     import_strategy_file: str = ""
     export_strategy_file: str = ""
     profiling: bool = False
@@ -114,6 +125,10 @@ class FFConfig:
                 cfg.compute_dtype = take()
             elif a == "--dense-embedding-update":
                 cfg.sparse_embedding_update = False
+            elif a == "--measure-ops":
+                cfg.search_measure = True
+            elif a == "--debug-nans":
+                cfg.debug_nans = True
             else:
                 cfg.unparsed.append(a)
             i += 1

@@ -46,6 +46,9 @@ from .tensor import Tensor
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None):
         self.config = config or FFConfig()
+        if getattr(self.config, "debug_nans", None) is not None:
+            jax.config.update("jax_debug_nans",
+                              bool(self.config.debug_nans))
         self._op_guid = 0
         self.ops: List[Op] = []          # topological (construction) order
         self.input_tensors: List[Tensor] = []
@@ -290,8 +293,14 @@ class FFModel:
                 raise NotImplementedError(
                     "--budget strategy search requires the search.mcmc "
                     "module (not built yet in this checkout)") from e
+            cm = None
+            if self.config.search_measure:
+                from ..search.cost_model import CostModel
+                cm = CostModel(compute_dtype=self.compute_dtype,
+                               measure=True)
             self.strategies = optimize(self, budget=self.config.search_budget,
-                                       alpha=self.config.search_alpha)
+                                       alpha=self.config.search_alpha,
+                                       cost_model=cm)
         # reference-style generic keys: the reference's DLRM strategies key
         # ops as "embedding{i}" / "linear" / "concat" / "mse_loss" shared
         # across ops of a type (dlrm_strategy.py, dlrm_strategy_hetero.cc) —

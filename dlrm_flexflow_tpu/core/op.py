@@ -124,6 +124,28 @@ class Op:
         return sum(int(math.prod(d.shape)) * jnp.dtype(d.dtype).itemsize
                    for d in self.param_defs().values())
 
+    def input_shard_shapes(self, pc: ParallelConfig) -> List[tuple]:
+        """Per-device input shapes under `pc`, for measured cost-model
+        microbenchmarks. Default: shard only the sample dim by degrees[0]
+        (output degrees applied positionally to input dims would split the
+        wrong axes for rank-mismatched ops); ops whose inputs follow other
+        sharded dims override (must stay consistent with
+        param_shard_shapes so apply() traces)."""
+        ds = max(pc.degrees[0] if pc.degrees else 1, 1)
+        return [
+            (max(t.shape[0] // ds, 1),) + tuple(t.shape[1:])
+            if t.num_dims > 0 else t.shape
+            for t in self.inputs]
+
+    def param_shard_shapes(self, pc: ParallelConfig,
+                           ndev: Optional[int] = None) -> Dict[str, tuple]:
+        """Per-device parameter shapes under `pc` (for measured cost-model
+        microbenchmarks and the simulator's HBM-capacity check). `ndev` is
+        the total device count, for ops whose sharding spans the whole
+        mesh rather than pc.num_parts. Default: FULL shapes (replicated
+        weights — the common DP case); model-parallel ops override."""
+        return {n: tuple(d.shape) for n, d in self.param_defs().items()}
+
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         """Parameter bytes ONE DEVICE streams through HBM in one training
         step — what the cost model should charge. Defaults to the full

@@ -71,6 +71,8 @@ def _sparse_update_active(op) -> bool:
         return False
     if not op.supports_sparse_update():
         return False
+    if op.name in getattr(op.model, "_host_offload_ops", set()):
+        return False   # host-offloaded tables take the dense path
     opt = getattr(op.model, "optimizer", None)
     if opt is None:
         return True
@@ -180,6 +182,11 @@ class Embedding(Op):
     def flops_per_sample(self) -> float:
         bag = self.inputs[0].shape[-1] if self.inputs[0].num_dims > 1 else 1
         return float(bag * self.out_dim)  # bandwidth-bound; count adds
+
+    def param_shard_shapes(self, pc: ParallelConfig, ndev=None):
+        # width sharding splits out_dim by the last degree
+        dc = pc.degrees[-1] if len(pc.degrees) > 1 else 1
+        return {"kernel": (self.num_entries, max(self.out_dim // dc, 1))}
 
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
@@ -332,6 +339,21 @@ class EmbeddingBagStacked(Op):
     def flops_per_sample(self) -> float:
         bag = self.inputs[0].shape[-1]
         return float(self.num_tables * bag * self.out_dim)
+
+    def input_shard_shapes(self, pc: ParallelConfig):
+        # indices follow the output's (sample, table) sharding so measured
+        # microbenchmarks trace at consistent per-device shapes
+        ds = max(pc.degrees[0] if pc.degrees else 1, 1)
+        dt = pc.degrees[1] if len(pc.degrees) > 1 else 1
+        batch, T, bag = self.inputs[0].shape
+        return [(max(batch // ds, 1), max(T // max(dt, 1), 1), bag)]
+
+    def param_shard_shapes(self, pc: ParallelConfig, ndev=None):
+        # table-dim sharding by degrees[1]
+        dt = pc.degrees[1] if len(pc.degrees) > 1 else 1
+        r = self._pack
+        return {"kernel": (max(self.num_tables // dt, 1),
+                           self.num_entries // r, self.out_dim * r)}
 
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
@@ -509,6 +531,15 @@ class EmbeddingBagConcat(Op):
     def flops_per_sample(self) -> float:
         bag = self.inputs[0].shape[-1]
         return float(self.num_tables * bag * self.out_dim)
+
+    def param_shard_shapes(self, pc: ParallelConfig, ndev=None):
+        # any table parallelism row-shards the concatenated table over the
+        # WHOLE mesh (param_axes), not just pc.num_parts
+        full = ndev or (self.model.mesh.size if self.model.mesh else 1)
+        dt = full if (len(pc.degrees) > 1 and pc.degrees[1] > 1) else 1
+        r = self._pack
+        return {"kernel": (max(self.total_rows // r // max(dt, 1), 1),
+                           self.out_dim * r)}
 
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):

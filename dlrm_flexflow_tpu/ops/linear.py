@@ -76,6 +76,15 @@ class Linear(Op):
                     out.append(ParallelConfig(tuple(degs)))
         return out
 
+    def param_shard_shapes(self, pc: ParallelConfig, ndev=None):
+        # channel TP splits the kernel/bias out dim by degrees[1]
+        dc = pc.degrees[1] if len(pc.degrees) > 1 else 1
+        shapes = {n: list(d.shape) for n, d in self.param_defs().items()}
+        if dc > 1:
+            for v in shapes.values():
+                v[-1] = max(v[-1] // dc, 1)
+        return {n: tuple(v) for n, v in shapes.items()}
+
     def param_axes(self, pc: ParallelConfig, out_axes,
                    raw_pc=None):
         # channel (last output dim) partition shards the kernel's out dim and

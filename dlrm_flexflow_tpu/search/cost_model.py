@@ -43,6 +43,7 @@ class TPUSpec:
     mxu_utilization: float = 0.55     # achievable fraction on real workloads
     hbm_utilization: float = 0.75
     kernel_launch_s: float = 2e-6     # per-HLO overhead (XLA fused ≈ small)
+    hbm_capacity_bytes: float = 16e9  # v5e HBM per chip
 
     @staticmethod
     def v4() -> "TPUSpec":
@@ -85,6 +86,18 @@ class CostModel:
         if key in self._cache:
             return self._cache[key]
 
+        if self.measure:
+            # calibrated mode: time the op's compiled subgraph on the real
+            # device (reference Op::measure_compute_time); backward ≈ 2×
+            # forward, the same ratio the analytical model assumes
+            t = self.measure_op(op, pc) * (2.0 if backward else 1.0)
+        else:
+            t = self._roofline_time(op, pc, backward)
+        self._cache[key] = t
+        return t
+
+    def _roofline_time(self, op: Op, pc: ParallelConfig,
+                       backward: bool = False) -> float:
         batch = op.outputs[0].shape[0] if op.outputs[0].num_dims > 0 else 1
         flops = op.flops_per_sample() * batch / max(pc.num_parts, 1)
         # bytes: inputs read + outputs written (+ params read), sharded
@@ -100,9 +113,7 @@ class CostModel:
             flops *= 2.0
             io_bytes *= 2.0
         t = max(flops / self._flops_rate(), io_bytes / self._hbm_rate())
-        t += self.spec.kernel_launch_s
-        self._cache[key] = t
-        return t
+        return t + self.spec.kernel_launch_s
 
     # ---- comm -----------------------------------------------------------
     def _ici_allreduce_bw(self) -> float:
@@ -143,12 +154,13 @@ class CostModel:
         key = ("measured", op.name, pc.degrees)
         if key in self._cache:
             return self._cache[key]
-        shard_shapes = []
-        for t in op.inputs:
-            degs = list(pc.degrees)[:t.num_dims] + [1] * (t.num_dims - len(pc.degrees))
-            shard_shapes.append(tuple(
-                max(s // d, 1) for s, d in zip(t.shape, degs)))
-        params = op.init_params(jax.random.PRNGKey(0)) if op.param_defs() else {}
+        # inputs and params are built at the per-device shapes the op
+        # declares for this config (the two hooks stay mutually consistent
+        # so apply() traces at the sharded shapes)
+        shard_shapes = op.input_shard_shapes(pc)
+        params = ({n: jnp.zeros(s, jnp.float32)
+                   for n, s in op.param_shard_shapes(pc).items()}
+                  if op.param_defs() else {})
         xs = [jnp.zeros(s, t.dtype) for s, t in zip(shard_shapes, op.inputs)]
         fn = jax.jit(lambda p, xs_: op.apply(p, xs_, training=False))
         try:
@@ -162,6 +174,6 @@ class CostModel:
             jax.block_until_ready(out)
             dt = (time.perf_counter() - t0) / 10
         except Exception:
-            dt = self.op_compute_time(op, pc)
+            dt = self._roofline_time(op, pc)
         self._cache[key] = dt
         return dt

@@ -70,9 +70,19 @@ def optimize(model, budget: int = 1000, alpha: float = 1.2,
         proposal, changed = rewrite(model, current, ndev, feasible, rng)
         t = sim.simulate(proposal, ndev)
         # reference acceptance: always if faster, else exp(-alpha * diff)
-        # with diff in the simulator's time units (model.cc:1118-1126)
-        diff = (t - current_t) * 1e3  # seconds -> ms, the reference's unit
-        if t < current_t or rng.random() < math.exp(-alpha * diff):
+        # with diff in the simulator's time units (model.cc:1118-1126).
+        # Infeasible (inf-cost) states need care: inf - inf is NaN, which
+        # would reject every move and freeze the walk — accept free moves
+        # within the infeasible region so the search can escape it.
+        if not math.isfinite(t) and not math.isfinite(current_t):
+            accept = True
+        elif t < current_t:
+            accept = True
+        else:
+            diff = (t - current_t) * 1e3   # s -> ms, the reference's unit
+            accept = (math.isfinite(diff)
+                      and rng.random() < math.exp(-alpha * diff))
+        if accept:
             current, current_t = proposal, t
             if t < best_t:
                 best, best_t = dict(proposal), t

@@ -140,8 +140,14 @@ class Simulator:
             pc = strategies[op.name]
             replicas = pc.degrees[0] if pc.degrees else 1
             pbytes = op.param_bytes()
-            sync_t = self.cost.grad_sync_time(pbytes, replicas)
-            upd_compute = pbytes / self.cost._hbm_rate() * 3.0  # r/w + mom
+            # per-device bytes: dense params are sharded over the
+            # non-sample degrees; sparse-update embeddings stream only
+            # their touched rows (min() picks whichever applies)
+            nonsample = max(pc.num_parts // max(replicas, 1), 1)
+            touched = op.param_bytes_touched_per_step(max(pc.num_parts, 1))
+            dev_bytes = min(pbytes / nonsample, touched)
+            sync_t = self.cost.grad_sync_time(dev_bytes, replicas)
+            upd_compute = dev_bytes / self.cost._hbm_rate() * 3.0  # r/w+mom
             if sync_t > 0:
                 s = SimTask(run_time=sync_t, device=COMM_DEVICE,
                             name=f"allreduce:{op.name}")
@@ -160,6 +166,21 @@ class Simulator:
         return tasks
 
     # ------------------------------------------------------------------
+    def fits_memory(self, strategies: StrategyMap, ndev: int) -> bool:
+        """Per-device parameter bytes (at each op's sharded shapes) must
+        fit the chip's HBM, with 25% headroom for activations/temps."""
+        import math as _math
+        total = 0.0
+        for op in self.model.ops:
+            if isinstance(op, InputOp) or not op.param_defs():
+                continue
+            pc = strategies.get(op.name)
+            if pc is None:
+                continue
+            for shape in op.param_shard_shapes(pc, ndev).values():
+                total += _math.prod(shape) * 4.0
+        return total <= 0.75 * self.cost.spec.hbm_capacity_bytes
+
     def simulate(self, strategies: StrategyMap,
                  ndev: Optional[int] = None,
                  use_native: bool = True) -> float:
@@ -176,6 +197,12 @@ class Simulator:
             ndev = int(math.prod(
                 [self.model.mesh.shape[a] for a in self.model.mesh.axis_names])
             ) if self.model.mesh else 1
+        if not self.fits_memory(strategies, ndev):
+            # infeasible placement: params exceed per-chip HBM (pure DP on
+            # DLRM-Terabyte replicates ~1 TB of tables); an infinite
+            # makespan makes the MCMC reject it like the reference rejects
+            # illegal configs
+            return float("inf")
         tasks = self.build_task_graph(strategies, ndev)
         if use_native:
             ms = self._simulate_native(tasks)

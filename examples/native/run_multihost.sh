@@ -19,6 +19,7 @@
 set -e
 cd "$(dirname "$0")/../.."
 PIDS=()
+trap '[ "${#PIDS[@]}" -gt 0 ] && kill "${PIDS[@]}" 2>/dev/null || true' EXIT
 for RANK in 0 1; do
   COORDINATOR_ADDRESS=127.0.0.1:12355 NUM_PROCESSES=2 PROCESS_ID=$RANK \
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
@@ -27,5 +28,8 @@ for RANK in 0 1; do
       --arch-mlp-bot 4-16-8 --arch-mlp-top 40-16-1 &
   PIDS+=($!)
 done
-# argument-less `wait` would mask a crashed rank
-for PID in "${PIDS[@]}"; do wait "$PID"; done
+# argument-less `wait` would mask a crashed rank; collect every status so
+# a failure still reaps the other rank (the EXIT trap kills stragglers)
+STATUS=0
+for PID in "${PIDS[@]}"; do wait "$PID" || STATUS=$?; done
+exit $STATUS

@@ -33,9 +33,15 @@ def test_simulator_deterministic_and_sane():
 
 
 def test_table_parallel_beats_dp_in_simulation():
-    """The core SOAP claim on DLRM: table-parallel embeddings beat pure DP
-    (which all-reduces the full 2 GB of tables every step)."""
+    """The core SOAP claim on DLRM under DENSE embedding updates (the
+    reference's world — momentum/Adam, or --dense-embedding-update):
+    table-parallel embeddings beat pure DP, which all-reduces the full
+    2 GB of tables every step. (With the sparse touched-rows update this
+    framework adds, plain-SGD DP becomes comm-cheap — see
+    test_sparse_updates_make_dp_cheap — and the table-parallel advantage
+    shifts to HBM capacity, see the terabyte test.)"""
     model, dcfg = _bench_model()
+    model.optimizer = ff.SGDOptimizer(lr=0.1, momentum=0.9)  # dense world
     sim = Simulator(model)
     dp = default_strategy(model, 8)
     hand = dlrm_strategy(model, dcfg, 8)
@@ -44,8 +50,23 @@ def test_table_parallel_beats_dp_in_simulation():
     assert sim.simulate(hand, 8) < 0.7 * sim.simulate(dp, 8)
 
 
+def test_sparse_updates_make_dp_cheap():
+    """Plain-SGD sparse updates remove the full-table gradient sync, so
+    simulated DP on the 8x1M benchmark is feasible and fast."""
+    model, dcfg = _bench_model()
+    model.optimizer = ff.SGDOptimizer(lr=0.1)  # sparse world
+    sim = Simulator(model)
+    dense_model, _ = _bench_model()
+    dense_model.optimizer = ff.SGDOptimizer(lr=0.1, momentum=0.9)
+    t_sparse = sim.simulate(default_strategy(model, 8), 8)
+    t_dense = Simulator(dense_model).simulate(
+        default_strategy(dense_model, 8), 8)
+    assert t_sparse < t_dense
+
+
 def test_mcmc_rediscovers_table_parallelism():
     model, dcfg = _bench_model()
+    model.optimizer = ff.SGDOptimizer(lr=0.1, momentum=0.9)  # dense world
     sim = Simulator(model)
     dp = default_strategy(model, 8)
     found = optimize(model, budget=300, alpha=1.2, ndev=8, seed=0)
@@ -109,9 +130,11 @@ def test_strategy_export_import_through_compile(tmp_path):
 
 def test_terabyte_64chip_northstar():
     """BASELINE.md north star: DLRM-Terabyte on a simulated v5e-64 — the
-    table-parallel strategy (and anything the search finds) must beat pure
-    data parallelism by >= 1.5x in the simulator. DP all-reduces ~1 TB of
-    table gradients per step; table parallelism moves only activations."""
+    table-parallel strategy must beat pure data parallelism by >= 1.5x.
+    With this framework's sparse updates DP's comm is cheap, but DP must
+    REPLICATE ~1 TB of tables per chip, which cannot fit 16 GB of HBM —
+    the simulator's capacity model prices it infeasible, while the
+    row-sharded table-parallel strategy runs."""
     dcfg = DLRMConfig.terabyte()
     model = ff.FFModel(ff.FFConfig(batch_size=256 * 64,
                                    compute_dtype="bfloat16"))
@@ -124,4 +147,27 @@ def test_terabyte_64chip_northstar():
         hand.setdefault(k, v)
     t_dp = sim.simulate(dp, 64)
     t_hand = sim.simulate(hand, 64)
+    assert t_hand < float("inf"), "table-parallel must fit and run"
     assert t_hand * 1.5 < t_dp, (t_hand, t_dp)
+
+
+def test_measured_cost_model_search():
+    """--measure-ops wiring: search with a measuring CostModel (reference
+    measure_compute_time microbenchmarks) runs end-to-end."""
+    from dlrm_flexflow_tpu.search.cost_model import CostModel
+    dcfg = DLRMConfig(embedding_size=[32] * 4, sparse_feature_size=4,
+                      mlp_bot=[4, 8, 4], mlp_top=[20, 8, 1])
+    model = ff.FFModel(ff.FFConfig(batch_size=16))
+    build_dlrm(model, dcfg)
+    model.mesh = make_mesh(num_devices=8)
+    cm = CostModel(measure=True)
+    found = optimize(model, budget=20, alpha=1.2, ndev=8, cost_model=cm,
+                     seed=1)
+    assert found  # produced a strategy for every op
+    # measured timings were actually taken and memoized
+    assert any(k[0] == "measured" for k in cm._cache)
+
+
+def test_config_flags():
+    cfg = ff.FFConfig.parse_args(["--measure-ops", "--debug-nans"])
+    assert cfg.search_measure and cfg.debug_nans
