@@ -77,8 +77,9 @@ class Linear(Op):
         return out
 
     def param_shard_shapes(self, pc: ParallelConfig, ndev=None):
-        # channel TP splits the kernel/bias out dim by degrees[1]
-        dc = pc.degrees[1] if len(pc.degrees) > 1 else 1
+        # channel TP splits the kernel/bias out dim by the LAST degree
+        # (candidate_parallel_configs/param_axes put channel TP there)
+        dc = pc.degrees[-1] if len(pc.degrees) > 1 else 1
         shapes = {n: list(d.shape) for n, d in self.param_defs().items()}
         if dc > 1:
             for v in shapes.values():

@@ -143,9 +143,11 @@ class Simulator:
             # per-device bytes: dense params are sharded over the
             # non-sample degrees; sparse-update embeddings stream only
             # their touched rows (min() picks whichever applies)
-            nonsample = max(pc.num_parts // max(replicas, 1), 1)
+            shard_bytes = sum(
+                math.prod(shape) * 4.0
+                for shape in op.param_shard_shapes(pc, ndev).values())
             touched = op.param_bytes_touched_per_step(max(pc.num_parts, 1))
-            dev_bytes = min(pbytes / nonsample, touched)
+            dev_bytes = min(shard_bytes, touched)
             sync_t = self.cost.grad_sync_time(dev_bytes, replicas)
             upd_compute = dev_bytes / self.cost._hbm_rate() * 3.0  # r/w+mom
             if sync_t > 0:
@@ -169,7 +171,6 @@ class Simulator:
     def fits_memory(self, strategies: StrategyMap, ndev: int) -> bool:
         """Per-device parameter bytes (at each op's sharded shapes) must
         fit the chip's HBM, with 25% headroom for activations/temps."""
-        import math as _math
         total = 0.0
         for op in self.model.ops:
             if isinstance(op, InputOp) or not op.param_defs():
@@ -178,7 +179,7 @@ class Simulator:
             if pc is None:
                 continue
             for shape in op.param_shard_shapes(pc, ndev).values():
-                total += _math.prod(shape) * 4.0
+                total += math.prod(shape) * 4.0
         return total <= 0.75 * self.cost.spec.hbm_capacity_bytes
 
     def simulate(self, strategies: StrategyMap,
@@ -199,9 +200,9 @@ class Simulator:
             ) if self.model.mesh else 1
         if not self.fits_memory(strategies, ndev):
             # infeasible placement: params exceed per-chip HBM (pure DP on
-            # DLRM-Terabyte replicates ~1 TB of tables); an infinite
-            # makespan makes the MCMC reject it like the reference rejects
-            # illegal configs
+            # DLRM-Terabyte replicates ~96 GB of tables, ~6x its HBM); an
+            # infinite makespan makes the MCMC reject it like the reference
+            # rejects illegal configs
             return float("inf")
         tasks = self.build_task_graph(strategies, ndev)
         if use_native:

@@ -278,3 +278,63 @@ def test_batchnorm_training_matches_torch():
     assert_close(np.asarray(new_state["bn"]["running_mean"]),
                  tbn.running_mean.numpy(), rtol=1e-3, atol=1e-4,
                  label="bn running mean")
+
+
+class TestEmbeddingBagConcatGolden:
+    """EmbeddingBagConcat vs a torch.nn.functional.embedding_bag oracle:
+    forward values and the sparse SGD update against torch's dense-grad
+    SGD step, per table (the §3.5 harness pattern for the fused op)."""
+
+    def test_forward_and_sgd_step_vs_torch(self):
+        import dlrm_flexflow_tpu as ff
+        from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+        sizes = [40, 7, 300, 12]
+        d, batch, bag, lr = 8, 16, 3, 0.1
+        rng = np.random.RandomState(0)
+        tables = [rng.rand(s, d).astype(np.float32) for s in sizes]
+        idx = np.stack([rng.randint(0, s, (batch, bag)) for s in sizes],
+                       axis=1).astype(np.int32)          # (batch, T, bag)
+        label = rng.rand(batch, len(sizes) * d).astype(np.float32)
+
+        # framework: concat op + identity head, MSE loss, 1 sparse SGD step
+        cfg = ff.FFConfig(batch_size=batch)
+        model = ff.FFModel(cfg)
+        sp = model.create_tensor((batch, len(sizes), bag), dtype="int32",
+                                 name="sparse")
+        emb = model.embedding_concat(sp, sizes, d, aggr="sum",
+                                     name="embc")
+        out = model.reshape(emb, (batch, len(sizes) * d), name="flat")
+        model.compile(ff.SGDOptimizer(lr=lr), "mean_squared_error", ["mse"],
+                      mesh=make_mesh(num_devices=1), final_tensor=out)
+        model.init_layers()
+        op = model.get_layer_by_name("embc")
+        kernel = np.asarray(op.unpack_kernel(
+            model.params["embc"]["kernel"])).copy()
+        off = 0
+        for t, s in zip(tables, sizes):
+            kernel[off:off + s] = t
+            off += s
+        model.params["embc"]["kernel"] = op.pack_kernel(kernel)
+        fwd = np.asarray(model.forward_batch({"sparse": idx}))
+        model.train_batch({"sparse": idx, "label": label})
+        got = np.asarray(op.unpack_kernel(model.params["embc"]["kernel"]))
+
+        # torch oracle
+        tts = [torch.tensor(t, requires_grad=True) for t in tables]
+        outs = [F.embedding_bag(torch.tensor(idx[:, i].astype(np.int64)),
+                                tts[i], mode="sum")
+                for i in range(len(sizes))]
+        tout = torch.cat(outs, dim=1)
+        np.testing.assert_allclose(fwd, tout.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        # MSE semantics (core/losses.py): per-sample summed squared error,
+        # mean over the batch (reference mseloss grad = 2*(p-l)/batch)
+        loss = torch.mean(
+            torch.sum((tout - torch.tensor(label)) ** 2, dim=1))
+        loss.backward()
+        off = 0
+        for t, tt, s in zip(tables, tts, sizes):
+            want = t - lr * tt.grad.numpy()
+            np.testing.assert_allclose(got[off:off + s], want,
+                                       rtol=1e-4, atol=1e-6)
+            off += s

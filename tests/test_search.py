@@ -132,7 +132,7 @@ def test_terabyte_64chip_northstar():
     """BASELINE.md north star: DLRM-Terabyte on a simulated v5e-64 — the
     table-parallel strategy must beat pure data parallelism by >= 1.5x.
     With this framework's sparse updates DP's comm is cheap, but DP must
-    REPLICATE ~1 TB of tables per chip, which cannot fit 16 GB of HBM —
+    REPLICATE ~96 GB of tables per chip, which cannot fit 16 GB of HBM —
     the simulator's capacity model prices it infeasible, while the
     row-sharded table-parallel strategy runs."""
     dcfg = DLRMConfig.terabyte()
