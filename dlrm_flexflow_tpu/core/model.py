@@ -811,9 +811,28 @@ class FFModel:
         # python dispatch re-validates the big param pytree every call,
         # which costs more than the step itself on fast models. Keyed by
         # the batch signature so alternating shapes (e.g. a remainder
-        # batch) each compile once.
+        # batch) each compile once; stringifying shardings is the slow
+        # part, so memoize it by sharding-object identity (the model's
+        # sharding objects are long-lived)
+        smemo = getattr(self, "_sharding_str_memo", None)
+        if smemo is None:
+            smemo = self._sharding_str_memo = {}
+
+        def _shs(v):
+            sh = getattr(v, "sharding", None)
+            hit = smemo.get(id(sh))
+            if hit is not None and hit[0] is sh:
+                return hit[1]
+            if len(smemo) > 256:
+                smemo.clear()
+            s = str(sh)
+            # pin the sharding object so a GC'd id can't alias a
+            # different sharding to a stale string
+            smemo[id(sh)] = (sh, s)
+            return s
+
         key = tuple(sorted(
-            (k, v.shape, str(v.dtype), str(getattr(v, "sharding", None)))
+            (k, v.shape, v.dtype.name, _shs(v))
             for k, v in device_batch.items()))
         execs = getattr(self, "_train_step_execs", None)
         if execs is None:

@@ -81,13 +81,10 @@ def _sparse_update_active(op) -> bool:
             and opt.weight_decay == 0.0)
 
 
-def _pallas_gate(model, op_name: str, width_ok: bool) -> bool:
-    """Shared gate for ALL Pallas kernel routing: opted in, TPU backend,
+def _pallas_common(model, op_name: str, width_ok: bool) -> bool:
+    """Checks shared by every Pallas routing gate: opted in, TPU backend,
     supported width, not host-offloaded (a Mosaic TPU custom call cannot
-    run inside a compute_on("device_host") region), single-chip execution
-    (under a >1-device mesh the op runs inside GSPMD, where the XLA
-    lowering shards; the Pallas call would need a shard_map wrapper —
-    future work)."""
+    run inside a compute_on("device_host") region)."""
     if not getattr(model.config, "use_pallas", False):
         return False
     if not width_ok:
@@ -96,8 +93,45 @@ def _pallas_gate(model, op_name: str, width_ok: bool) -> bool:
         return False
     if op_name and op_name in getattr(model, "_host_offload_ops", set()):
         return False
+    return True
+
+
+def _pallas_gate(model, op_name: str, width_ok: bool) -> bool:
+    """Single-chip Pallas gate (under a >1-device mesh the op runs inside
+    GSPMD where the direct Pallas call cannot; the multi-chip scatter goes
+    through _row_shard_axes + shard_map instead)."""
+    if not _pallas_common(model, op_name, width_ok):
+        return False
     mesh = getattr(model, "mesh", None)
     return mesh is None or mesh.size <= 1
+
+
+def _row_shard_axes(op, d: int):
+    """Mesh axes over which `op`'s packed table rows are block-sharded —
+    when the multi-chip Pallas scatter can run (TPU, pallas on, not host-
+    offloaded, lane-packable width, table actually sharded on dim 0).
+    Returns None when the single-chip or XLA path should be used."""
+    model = op.model
+    mesh = getattr(model, "mesh", None)
+    if mesh is None or mesh.size <= 1:
+        return None
+    width_ok = d <= 128 and 128 % d == 0
+    if not _pallas_common(model, op.name, width_ok):
+        return None
+    # the sharded kernel assumes the LANE-PACKED layout; an unpacked
+    # narrow table (rows not divisible by 128//d) must not be routed here
+    expected_r = 128 // d
+    if getattr(op, "_pack", 1) != expected_r:
+        return None
+    sh = getattr(model, "_param_sharding", {}).get(op.name, {}).get("kernel")
+    if sh is None or not len(sh.spec) or not sh.spec[0]:
+        return None
+    spec0 = sh.spec[0]
+    axes = (spec0,) if isinstance(spec0, str) else tuple(spec0)
+    nsh = 1
+    for a in axes:
+        nsh *= mesh.shape[a]
+    return axes if nsh > 1 else None
 
 
 def _pallas_scatter_ok(model, out_dim: int, op_name: str = "") -> bool:
@@ -376,6 +410,22 @@ class EmbeddingBagStacked(Op):
         r, d = self._pack, self.out_dim
         T, rows = self.num_tables, self.num_entries
 
+        shard_axes = _row_shard_axes(self, d)
+        if shard_axes is not None and (T * rows // r) % (
+                math.prod(self.model.mesh.shape[a]
+                          for a in shard_axes)) == 0:
+            # multi-chip: table-dim-sharded packed view; every shard masks
+            # the global updates to its row block and runs the local RMW
+            # kernel under shard_map
+            from .pallas.embedding_kernel import sharded_scatter_add_packed
+            offs = (jnp.arange(T, dtype=jnp.int32) * rows)[None, :, None]
+            gidx = (idx + offs).reshape(-1)
+            upd = jnp.broadcast_to(
+                ct[..., None, :], idx.shape + (d,)).reshape(-1, d)
+            new = sharded_scatter_add_packed(
+                self.model.mesh, shard_axes,
+                tbl.reshape(T * rows // r, r * d), gidx, -lr * upd, d)
+            return {"kernel": new.reshape(tbl.shape)}
         if _pallas_scatter_ok(self.model, d if r == 1 else 128, self.name):
             # one fused scatter over the packed (T*rows/r, 128|r*d) view;
             # global unpacked row g = t*rows + ix keeps g//r, g%r aligned
@@ -562,7 +612,15 @@ class EmbeddingBagConcat(Op):
         r, d = self._pack, self.out_dim
         upd = jnp.broadcast_to(ct[..., None, :], g.shape + (d,))
         upd = upd.reshape(-1, d)
-        if _pallas_scatter_ok(self.model, d if r == 1 else 128, self.name):
+        shard_axes = _row_shard_axes(self, d)
+        if shard_axes is not None and (self.total_rows // r) % (
+                math.prod(self.model.mesh.shape[a]
+                          for a in shard_axes)) == 0:
+            from .pallas.embedding_kernel import sharded_scatter_add_packed
+            new = sharded_scatter_add_packed(
+                self.model.mesh, shard_axes, tbl, g.reshape(-1),
+                -lr * upd, d)
+        elif _pallas_scatter_ok(self.model, d if r == 1 else 128, self.name):
             from .pallas.embedding_kernel import (scatter_add_rows,
                                                   scatter_add_rows_packed)
             if r == 1:

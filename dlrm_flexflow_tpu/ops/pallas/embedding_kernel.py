@@ -333,6 +333,62 @@ def _dedup_and_scatter(view, tile_rows, tile_upds, interpret):
     )(target, summed.astype(view.dtype), view)
 
 
+def sharded_scatter_add_packed(mesh, row_axes, view, indices, updates,
+                               dim: int, interpret: bool = False):
+    """Multi-chip form of scatter_add_rows_packed: the packed (vrows, 128)
+    view is row-block sharded over `row_axes` of `mesh`; indices/updates
+    are replicated. Under shard_map each device masks the updates to its
+    row block (masked slots get row = -1, which the kernel skips) and
+    runs the single-chip RMW kernel on its local block — the multi-chip
+    analog of the reference's per-device atomicAdd into its own table
+    replica partition (embedding.cu:173-224).
+
+    view    : (vrows, 128) global packed table
+    indices : (n,) int32 in UNPACKED row space, replicated
+    updates : (n, dim), replicated
+    """
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map as _shard_map
+
+        def smap(f, in_specs, out_specs):
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+    except (ImportError, TypeError):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def smap(f, in_specs, out_specs):
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
+    r_per_tile = _LANES // dim
+    vrows = view.shape[0]
+    nshards = 1
+    for a in row_axes:
+        nshards *= mesh.shape[a]
+    block = vrows // nshards             # packed rows per shard
+
+    def local_update(tbl_shard, idx, upd):
+        # linear shard index over the row axes
+        import jax as _jax
+        sid = jnp.zeros((), jnp.int32)
+        for a in row_axes:
+            sid = sid * mesh.shape[a] + _jax.lax.axis_index(a)
+        lo = sid * block * r_per_tile          # unpacked-row lower bound
+        hi = lo + block * r_per_tile
+        local = idx - lo
+        in_block = (idx >= lo) & (idx < hi)
+        local = jnp.where(in_block, local, -(r_per_tile + 1))
+        return scatter_add_rows_packed(tbl_shard, local, upd, dim,
+                                       interpret=interpret)
+
+    return smap(
+        local_update,
+        in_specs=(P(tuple(row_axes)), P(), P()),
+        out_specs=P(tuple(row_axes)),
+    )(view, indices.astype(jnp.int32), updates)
+
+
 def stacked_embedding_bag(tables, indices, aggr: str = "sum",
                           interpret: bool = False):
     """Fused multi-table bag on the Pallas kernel.
