@@ -934,17 +934,46 @@ class FFModel:
             from ..utils.profiling import format_profile, profile_ops
             print(format_profile(profile_ops(self)))
 
+        # stage the whole dataset's batches on device once when it fits —
+        # the reference's design (the ENTIRE dataset lives in zero-copy
+        # memory and the hot loop scatters device-side, dlrm.cc:384-589);
+        # otherwise fall back to per-batch host→device staging
+        dataset_bytes = sum(v.nbytes for v in inputs.values()) + labels.nbytes
+        staged = None
+        if dataset_bytes <= 2e9:
+            staged = []
+            for b in range(num_batches):
+                sl = slice(b * bs, (b + 1) * bs)
+                batch = {k: v[sl] for k, v in inputs.items()}
+                batch["label"] = labels[sl]
+                staged.append(self._device_batch(batch))
+
         from ..utils.profiling import TraceContext
+        # bound in-flight async steps: XLA CPU's in-process collectives can
+        # starve when many multi-device executions queue up on few host
+        # cores (on TPU the device is the bottleneck; a deep pipeline is
+        # safe) — same throttle as examples/native/dlrm.py
+        throttle = 1 if jax.default_backend() == "cpu" else 32
+        from collections import deque
+        inflight = deque()
         start = time.time()
         mets = None
         with TraceContext(self.config.profile_dir or None):
             for epoch in range(epochs):
                 self.reset_metrics()
                 for b in range(num_batches):
-                    sl = slice(b * bs, (b + 1) * bs)
-                    batch = {k: v[sl] for k, v in inputs.items()}
-                    batch["label"] = labels[sl]
-                    mets = self.train_batch(batch)
+                    if staged is not None:
+                        mets = self.train_batch_device(staged[b])
+                        # bound the pipeline without draining it: block on
+                        # the step issued `throttle` iterations AGO
+                        inflight.append(mets["loss"])
+                        if len(inflight) > throttle:
+                            jax.block_until_ready(inflight.popleft())
+                    else:
+                        sl = slice(b * bs, (b + 1) * bs)
+                        batch = {k: v[sl] for k, v in inputs.items()}
+                        batch["label"] = labels[sl]
+                        mets = self.train_batch(batch)
                 if verbose:
                     # host sync happens here only (metrics are async futures)
                     print(f"epoch {epoch}: loss={float(mets['loss']):.6f} "
@@ -952,7 +981,10 @@ class FFModel:
                 if callbacks:
                     for cb in callbacks:
                         cb(self, epoch, self.perf.report())
-            jax.block_until_ready(self.params)
+            if mets is not None:
+                # dependent readback = true completion (block_until_ready
+                # does not wait on some experimental PJRT backends)
+                float(mets["loss"])
         elapsed = time.time() - start
         num_samples = num_batches * bs * epochs
         throughput = num_samples / elapsed if elapsed > 0 else float("inf")

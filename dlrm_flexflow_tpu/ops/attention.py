@@ -200,6 +200,15 @@ class MultiHeadAttention(Op):
         return {"wq": ((), ch), "wk": ((), ch), "wv": ((), ch),
                 "wo": (ch, ()), "bo": ((),)}
 
+    def param_shard_shapes(self, pc: ParallelConfig, ndev=None):
+        dc = pc.degrees[2] if len(pc.degrees) > 2 else 1
+        shapes = {n_: list(d.shape) for n_, d in self.param_defs().items()}
+        if dc > 1:
+            for n_ in ("wq", "wk", "wv"):
+                shapes[n_][1] = max(shapes[n_][1] // dc, 1)
+            shapes["wo"][0] = max(shapes["wo"][0] // dc, 1)
+        return {n_: tuple(v) for n_, v in shapes.items()}
+
     def flops_per_sample(self) -> float:
         _, s, _ = self.outputs[0].shape
         e = self.embed_dim

@@ -109,6 +109,15 @@ class Conv2D(Op):
             out["bias"] = (ch,)
         return out
 
+    def param_shard_shapes(self, pc: ParallelConfig, ndev=None):
+        dc = pc.degrees[1] if len(pc.degrees) > 1 else 1
+        shapes = {n_: list(d.shape) for n_, d in self.param_defs().items()}
+        if dc > 1:
+            shapes["kernel"][0] = max(shapes["kernel"][0] // dc, 1)
+            if "bias" in shapes:
+                shapes["bias"][0] = max(shapes["bias"][0] // dc, 1)
+        return {n_: tuple(v) for n_, v in shapes.items()}
+
     def flops_per_sample(self) -> float:
         _, co, oh, ow = self.outputs[0].shape
         kh, kw = self.kernel

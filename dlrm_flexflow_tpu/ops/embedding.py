@@ -106,7 +106,7 @@ def _pallas_gate(model, op_name: str, width_ok: bool) -> bool:
     return mesh is None or mesh.size <= 1
 
 
-def _row_shard_axes(op, d: int):
+def _row_shard_axes(op, d: int, packed_rows: int):
     """Mesh axes over which `op`'s packed table rows are block-sharded —
     when the multi-chip Pallas scatter can run (TPU, pallas on, not host-
     offloaded, lane-packable width, table actually sharded on dim 0).
@@ -131,7 +131,12 @@ def _row_shard_axes(op, d: int):
     nsh = 1
     for a in axes:
         nsh *= mesh.shape[a]
-    return axes if nsh > 1 else None
+    if nsh <= 1:
+        return None
+    # the shard_map kernel needs equal row blocks per shard
+    if packed_rows % nsh != 0:
+        return None
+    return axes
 
 
 def _pallas_scatter_ok(model, out_dim: int, op_name: str = "") -> bool:
@@ -410,10 +415,8 @@ class EmbeddingBagStacked(Op):
         r, d = self._pack, self.out_dim
         T, rows = self.num_tables, self.num_entries
 
-        shard_axes = _row_shard_axes(self, d)
-        if shard_axes is not None and (T * rows // r) % (
-                math.prod(self.model.mesh.shape[a]
-                          for a in shard_axes)) == 0:
+        shard_axes = _row_shard_axes(self, d, T * rows // r)
+        if shard_axes is not None:
             # multi-chip: table-dim-sharded packed view; every shard masks
             # the global updates to its row block and runs the local RMW
             # kernel under shard_map
@@ -612,10 +615,8 @@ class EmbeddingBagConcat(Op):
         r, d = self._pack, self.out_dim
         upd = jnp.broadcast_to(ct[..., None, :], g.shape + (d,))
         upd = upd.reshape(-1, d)
-        shard_axes = _row_shard_axes(self, d)
-        if shard_axes is not None and (self.total_rows // r) % (
-                math.prod(self.model.mesh.shape[a]
-                          for a in shard_axes)) == 0:
+        shard_axes = _row_shard_axes(self, d, self.total_rows // r)
+        if shard_axes is not None:
             from .pallas.embedding_kernel import sharded_scatter_add_packed
             new = sharded_scatter_add_packed(
                 self.model.mesh, shard_axes, tbl, g.reshape(-1),

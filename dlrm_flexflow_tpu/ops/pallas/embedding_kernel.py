@@ -347,19 +347,21 @@ def sharded_scatter_add_packed(mesh, row_axes, view, indices, updates,
     indices : (n,) int32 in UNPACKED row space, replicated
     updates : (n, dim), replicated
     """
+    import inspect
+
     from jax.sharding import PartitionSpec as P
     try:
         from jax import shard_map as _shard_map
-
-        def smap(f, in_specs, out_specs):
-            return _shard_map(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_vma=False)
-    except (ImportError, TypeError):
+    except ImportError:
         from jax.experimental.shard_map import shard_map as _shard_map
+    # the replication-check kwarg was renamed check_rep -> check_vma
+    _params = inspect.signature(_shard_map).parameters
+    _ckw = {"check_vma": False} if "check_vma" in _params else \
+        {"check_rep": False}
 
-        def smap(f, in_specs, out_specs):
-            return _shard_map(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_rep=False)
+    def smap(f, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **_ckw)
 
     r_per_tile = _LANES // dim
     vrows = view.shape[0]

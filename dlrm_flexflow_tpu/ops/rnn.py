@@ -98,6 +98,14 @@ class LSTM(Op):
         # split(4) is along the same sharded dim)
         return {"wx": ((), ch), "wh": ((), ch), "bias": (ch,)}
 
+    def param_shard_shapes(self, pc: ParallelConfig, ndev=None):
+        dc = pc.degrees[2] if len(pc.degrees) > 2 else 1
+        shapes = {n_: list(d.shape) for n_, d in self.param_defs().items()}
+        if dc > 1:
+            for n_ in shapes:
+                shapes[n_][-1] = max(shapes[n_][-1] // dc, 1)
+        return {n_: tuple(v) for n_, v in shapes.items()}
+
     def flops_per_sample(self) -> float:
         s = self.inputs[0].shape[1]
         return 2.0 * s * 4 * self.hidden * (self.in_dim + self.hidden)

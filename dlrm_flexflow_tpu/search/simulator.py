@@ -139,21 +139,19 @@ class Simulator:
                 continue
             pc = strategies[op.name]
             replicas = pc.degrees[0] if pc.degrees else 1
-            pbytes = op.param_bytes()
             # per-device bytes: dense params are sharded over the
             # non-sample degrees; sparse-update embeddings stream only
             # their touched rows (min() picks whichever applies)
-            # three upper bounds on per-device parameter traffic: the
-            # op-declared shard shapes (whole-mesh row sharding), the
-            # generic degree-based split (ops that shard via param_axes
-            # but keep the default param_shard_shapes), and touched-rows
-            # sparse updates — take the tightest
-            nonsample = max(pc.num_parts // max(replicas, 1), 1)
+            # per-device parameter traffic: the op-declared shard shapes
+            # (every TP-capable op overrides param_shard_shapes; a config
+            # that replicates params — e.g. conv spatial splits — keeps
+            # full shapes) or touched-rows sparse updates, whichever is
+            # tighter
             shard_bytes = sum(
                 math.prod(shape) * 4.0
                 for shape in op.param_shard_shapes(pc, ndev).values())
             touched = op.param_bytes_touched_per_step(max(pc.num_parts, 1))
-            dev_bytes = min(shard_bytes, pbytes / nonsample, touched)
+            dev_bytes = min(shard_bytes, touched)
             sync_t = self.cost.grad_sync_time(dev_bytes, replicas)
             upd_compute = dev_bytes / self.cost._hbm_rate() * 3.0  # r/w+mom
             if sync_t > 0:
