@@ -46,6 +46,10 @@ class FFConfig:
     # (it is a PROCESS-global switch — enabling it affects every model
     # in the process until another model sets it False)
     debug_nans: Optional[bool] = None
+    # raise instead of warn when a strategy's degrees don't divide the real
+    # tensor shapes (Model._effective_pc would otherwise execute a clamped,
+    # different config)
+    strict_strategies: bool = False
     import_strategy_file: str = ""
     export_strategy_file: str = ""
     profiling: bool = False
@@ -129,6 +133,8 @@ class FFConfig:
                 cfg.search_measure = True
             elif a == "--debug-nans":
                 cfg.debug_nans = True
+            elif a == "--strict-strategies":
+                cfg.strict_strategies = True
             else:
                 cfg.unparsed.append(a)
             i += 1

@@ -41,6 +41,7 @@ from . import metrics as metrics_mod
 from .op import InputOp, Op
 from .optimizers import Optimizer, SGDOptimizer
 from .tensor import Tensor
+from ..utils.logging import log_model
 
 
 class FFModel:
@@ -398,7 +399,12 @@ class FFModel:
 
     # --- sharding plumbing --------------------------------------------
     def _effective_pc(self, op: Op) -> ParallelConfig:
-        """Clamp strategy degrees to divide the actual tensor dims."""
+        """Clamp strategy degrees to divide the actual tensor dims.
+
+        A rewrite is loud: warn by default, raise under
+        FFConfig.strict_strategies — a searched/imported config that does
+        not divide the real shapes would otherwise execute as a silently
+        different strategy."""
         pc = self.strategies[op.name]
         shape = op.outputs[0].shape
         degs = list(pc.degrees)[:len(shape)]
@@ -410,7 +416,17 @@ class FFModel:
             while d > 1 and (shape[i] % d != 0 or d not in feas):
                 d -= 1
             degs[i] = max(d, 1)
-        return ParallelConfig(tuple(degs), pc.device_type, pc.device_ids)
+        eff = ParallelConfig(tuple(degs), pc.device_type, pc.device_ids)
+        requested = tuple(pc.degrees)[:len(shape)]
+        requested += (1,) * (len(shape) - len(requested))
+        if tuple(degs) != requested and not op.raw_degree_semantics:
+            msg = (f"strategy for {op.name!r} requests degrees {requested} "
+                   f"but output shape {shape} / mesh {tuple(self.mesh.shape.values())} "
+                   f"only admits {tuple(degs)}; executing the clamped config")
+            if getattr(self.config, "strict_strategies", False):
+                raise ValueError(msg)
+            log_model.warning(msg)
+        return eff
 
     def _build_shardings(self):
         asn = AxisAssigner(self.mesh)
@@ -437,8 +453,15 @@ class FFModel:
             if pc.device_type == "CPU":
                 self._host_offload_ops.add(op.name)
             try:
-                out_axes = asn.assign(pc.degrees)
+                out_axes = op.output_axes(
+                    pc, asn, raw_pc=self.strategies.get(op.name, pc))
             except ValueError:
+                msg = (f"strategy for {op.name!r} degrees {pc.degrees} are "
+                       f"not jointly assignable on mesh "
+                       f"{dict(self.mesh.shape)}; executing replicated")
+                if getattr(self.config, "strict_strategies", False):
+                    raise ValueError(msg)
+                log_model.warning(msg)
                 pc = ParallelConfig((1,) * op.outputs[0].num_dims)
                 out_axes = asn.assign(pc.degrees)
             self._op_pc = getattr(self, "_op_pc", {})
@@ -448,10 +471,12 @@ class FFModel:
             op._compiled_pc = pc
             op._seq_axes = tuple(out_axes[1]) if len(out_axes) > 1 else ()
             for t in op.outputs:
-                degs = pc.degrees[:t.num_dims]
                 axes = out_axes[:t.num_dims]
-                ok = all(d == 1 or t.shape[i] % d == 0
-                         for i, d in enumerate(degs))
+                # divisibility against the actual axis products (output_axes
+                # overrides may differ from the positional degrees)
+                sizes = [int(np.prod([self.mesh.shape[a] for a in ax]))
+                         if ax else 1 for ax in axes]
+                ok = all(t.shape[i] % s == 0 for i, s in enumerate(sizes))
                 self._out_sharding[t.guid] = (
                     spec_from_axes(axes) if ok else
                     NamedSharding(self.mesh, PartitionSpec()))
@@ -938,9 +963,51 @@ class FFModel:
         # the reference's design (the ENTIRE dataset lives in zero-copy
         # memory and the hot loop scatters device-side, dlrm.cc:384-589);
         # otherwise fall back to per-batch host→device staging
-        dataset_bytes = sum(v.nbytes for v in inputs.values()) + labels.nbytes
+        # staging budget = per-chip HBM capacity minus what already lives
+        # there (params + optimizer state + op state), with 30% headroom
+        # for activations/workspace. Per-chip cost of a staged input is its
+        # full size when its sharding is replicated, size/ndev when the
+        # sample dim is sharded (matches _build_shardings' input specs).
+        # Off-TPU there is no HBM; keep a modest host-RAM cap so fit() on a
+        # virtual CPU mesh never device_puts a huge dataset a second time.
+        from ..search.cost_model import TPUSpec
+        ndev = max(self.mesh.size, 1)
+
+        def _per_chip(arr, sharded: bool) -> float:
+            return arr.nbytes / ndev if sharded else float(arr.nbytes)
+
+        in_sharded = {
+            t.name: bool(self._out_sharding[t.guid].spec)
+            for t in self.input_tensors}
+        if jax.default_backend() == "tpu":
+            staging_cost = sum(
+                _per_chip(v, in_sharded.get(k, False))
+                for k, v in inputs.items())
+            staging_cost += _per_chip(labels,
+                                      bool(self._label_sharding.spec))
+
+            def _resident_per_chip(leaf) -> float:
+                # per-chip bytes of a (possibly sharded) device array —
+                # .nbytes alone is the GLOBAL logical size
+                try:
+                    shard = leaf.sharding.shard_shape(leaf.shape)
+                    import math as _m
+                    return float(_m.prod(shard)) * leaf.dtype.itemsize
+                except Exception:
+                    return float(getattr(leaf, "nbytes", 0))
+
+            resident = sum(_resident_per_chip(v) for v in jax.tree.leaves(
+                (self.params, self.opt_state, self.op_state)))
+            budget = max(0.0, 0.7 * TPUSpec.detect().hbm_capacity_bytes
+                         - resident)
+        else:
+            # all virtual CPU "chips" share one host's RAM: cap the TOTAL
+            # second copy of the dataset, not the per-chip share
+            staging_cost = float(sum(v.nbytes for v in inputs.values())
+                                 + labels.nbytes)
+            budget = 2e9
         staged = None
-        if dataset_bytes <= 2e9:
+        if staging_cost <= budget:
             staged = []
             for b in range(num_batches):
                 sl = slice(b * bs, (b + 1) * bs)

@@ -102,6 +102,60 @@ class Op:
                 out.append(ParallelConfig(tuple(degs)))
         return out
 
+    def feasible_parallel_configs(self, num_devices: int,
+                                  feasible_degrees: List[int]) -> List[ParallelConfig]:
+        """candidate_parallel_configs filtered by real divisibility of the
+        output shape AND joint mesh-axis assignability, so the config the
+        search costs is exactly the config compile() executes
+        (Model._effective_pc never clamps it and _build_shardings never
+        falls back to replication)."""
+        shape = self.outputs[0].shape
+        from ..parallel.sharding import AxisAssigner, assignable
+        assigner = None
+        mesh = getattr(self.model, "mesh", None)
+        if mesh is not None:
+            assigner = AxisAssigner(mesh)
+            axis_sizes = list(assigner.axis_sizes)
+        else:
+            # pre-compile search path: the fallback mesh the search will
+            # use factorizes num_devices largest-prime-first (make_mesh)
+            from ..parallel.mesh import _prime_factors
+            axis_sizes = sorted(_prime_factors(num_devices), reverse=True)
+        out = []
+        for pc in self.candidate_parallel_configs(num_devices,
+                                                  feasible_degrees):
+            degs = pc.degrees[:len(shape)]
+            if not all(d == 1 or shape[i] % d == 0
+                       for i, d in enumerate(degs)):
+                continue
+            # per-dim degrees can each be expressible yet not jointly
+            # assignable (they consume mesh axes in order)
+            if assigner is not None:
+                try:
+                    self.output_axes(pc, assigner)
+                except ValueError:
+                    continue
+            elif not assignable(pc.degrees, axis_sizes):
+                continue
+            out.append(pc)
+        return out
+
+    # True when the op interprets its strategy's RAW degrees itself (e.g.
+    # the concat-embedding row-shards its table on ANY requested table
+    # parallelism even when the output dim can't split evenly) — the
+    # _effective_pc clamp is then expected, not a misconfiguration
+    raw_degree_semantics: bool = False
+
+    def output_axes(self, pc: ParallelConfig, assigner, raw_pc=None):
+        """Mesh axes per output dim for this config (default: positional
+        assignment of the degrees). Ops whose natural SPMD output layout
+        differs from the degree positions override this — e.g. a row-
+        sharded concat-embedding gather emits a batch-sharded output, and
+        constraining its T dim instead would force a full reshard.
+        `raw_pc` is the UNclamped strategy (see param_axes), for ops whose
+        layout intent survives an output-shape clamp."""
+        return assigner.assign(pc.degrees)
+
     def param_axes(self, pc: ParallelConfig, out_axes,
                    raw_pc=None) -> Dict[str, tuple]:
         """Mesh-axis assignment per parameter dim, given the mesh axes

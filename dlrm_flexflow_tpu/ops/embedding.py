@@ -471,6 +471,10 @@ class EmbeddingBagConcat(Op):
 
     type_name = "EmbedConcat"
 
+    # the table-dim degree is intent ("row-shard the concatenated table"),
+    # not an output partitioning — _effective_pc clamping it is expected
+    raw_degree_semantics = True
+
     # row padding so the concatenated row count divides any power-of-two
     # mesh (and most mixed meshes)
     _ROW_PAD = 8192
@@ -565,6 +569,22 @@ class EmbeddingBagConcat(Op):
                 if ds * dt <= num_devices and self.num_tables % max(dt, 1) == 0:
                     out.append(ParallelConfig((ds, dt, 1)))
         return out
+
+    def output_axes(self, pc: ParallelConfig, assigner, raw_pc=None):
+        # Under table parallelism (RAW degrees[1] > 1 — same trigger as
+        # param_axes, surviving the output-shape clamp) the PARAM is
+        # row-block sharded over the whole mesh; the fused gather's
+        # natural output layout is then batch-sharded over the whole
+        # mesh, matching the data-parallel consumers. Constraining the T
+        # dim instead (the positional reading of the degrees) forces
+        # GSPMD into a full rematerialization per step.
+        raw = raw_pc or pc
+        if len(raw.degrees) > 1 and raw.degrees[1] > 1:
+            batch = self.outputs[0].shape[0]
+            full = assigner.mesh.size
+            if batch % full == 0:
+                return [tuple(assigner.axis_names), (), ()]
+        return assigner.assign(pc.degrees)
 
     def param_axes(self, pc: ParallelConfig, out_axes,
                    raw_pc=None):

@@ -18,6 +18,30 @@ from typing import List, Sequence, Tuple
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
+def assignable(degrees: Sequence[int], axis_sizes: Sequence[int]) -> bool:
+    """True when each degree maps to a consecutive run of unused axes in
+    order — the pure-structure form of AxisAssigner.assign, usable before
+    a jax Mesh exists (the search's fallback mesh factorizes num_devices
+    exactly like parallel.mesh.make_mesh)."""
+    cursor = 0
+    for deg in degrees:
+        if deg == 1:
+            continue
+        start = cursor
+        while start < len(axis_sizes):
+            p, j = 1, start
+            while j < len(axis_sizes) and p < deg:
+                p *= axis_sizes[j]
+                j += 1
+            if p == deg:
+                cursor = j
+                break
+            start += 1
+        else:
+            return False
+    return True
+
+
 class AxisAssigner:
     """Maps partition degrees to tuples of mesh axes, consuming axes in mesh
     order so equal degrees on the same dim index always get the same axes."""

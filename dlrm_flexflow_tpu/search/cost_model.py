@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..core.op import InputOp, Op
 from ..parallel.pconfig import ParallelConfig
+from ..utils.logging import log_sim
 
 
 @dataclass
@@ -49,7 +50,28 @@ class TPUSpec:
     def v4() -> "TPUSpec":
         return TPUSpec(name="v4", mxu_flops=275e12, mxu_flops_f32=69e12,
                        hbm_bytes_per_s=1228e9, ici_bytes_per_s=50e9,
-                       ici_links=6)
+                       ici_links=6, hbm_capacity_bytes=32e9)
+
+    @staticmethod
+    def detect() -> "TPUSpec":
+        """Pick the spec matching the attached accelerator (falls back to
+        the v5e defaults off-TPU)."""
+        try:
+            import jax
+            kind = jax.devices()[0].device_kind.lower()
+        except Exception:
+            return TPUSpec()
+        if "v4" in kind:
+            return TPUSpec.v4()
+        if "v5p" in kind or "v5 p" in kind:
+            return TPUSpec(name="v5p", mxu_flops=459e12, mxu_flops_f32=115e12,
+                           hbm_bytes_per_s=2765e9, ici_bytes_per_s=100e9,
+                           ici_links=6, hbm_capacity_bytes=95e9)
+        if "v6" in kind:
+            return TPUSpec(name="v6e", mxu_flops=918e12, mxu_flops_f32=230e12,
+                           hbm_bytes_per_s=1640e9, ici_bytes_per_s=90e9,
+                           ici_links=4, hbm_capacity_bytes=32e9)
+        return TPUSpec()
 
 
 class CostModel:
@@ -173,7 +195,12 @@ class CostModel:
                 out = fn(params, xs)
             jax.block_until_ready(out)
             dt = (time.perf_counter() - t0) / 10
-        except Exception:
+        except Exception as e:
+            # degrade loudly: a silent fallback would let --measure-ops
+            # quietly become the roofline it was meant to replace
             dt = self._roofline_time(op, pc)
+            log_sim.warning(
+                "measure_op(%s, %s) failed (%r); using roofline %.3es",
+                op.name, pc.degrees, e, dt)
         self._cache[key] = dt
         return dt

@@ -35,7 +35,7 @@ def rewrite(model, strategies: StrategyMap, ndev: int,
     model.cc:1082-1091)."""
     ops = [op for op in model.ops if not isinstance(op, InputOp)]
     op = rng.choice(ops)
-    cands = op.candidate_parallel_configs(ndev, feasible)
+    cands = op.feasible_parallel_configs(ndev, feasible)
     if not cands:
         return strategies, op.name
     new = dict(strategies)

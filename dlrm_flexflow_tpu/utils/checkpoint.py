@@ -4,8 +4,10 @@ The reference has NO checkpointing — only Parameter::set_weights/get_weights
 host copies (reference: src/runtime/model.cu:260-334, exposed via
 flexflow_c.h / flexflow_cbinding.py); strategy files are the only persisted
 artifact. Per SURVEY.md §5.4 this module is a strict superset: full params +
-optimizer state + step counter, saved either as a simple .npz (portable,
-single-host) or via orbax (sharded, async, multi-host).
+optimizer state + step counter, saved as a single .npz (portable; arrays are
+gathered to host, so checkpoints are host-memory-bound — for truly sharded
+async multi-host snapshots wire `model.params` into orbax yourself; this
+module deliberately has no orbax dependency).
 """
 
 from __future__ import annotations

@@ -169,5 +169,45 @@ def test_measured_cost_model_search():
 
 
 def test_config_flags():
-    cfg = ff.FFConfig.parse_args(["--measure-ops", "--debug-nans"])
-    assert cfg.search_measure and cfg.debug_nans
+    cfg = ff.FFConfig.parse_args(["--measure-ops", "--debug-nans",
+                                  "--strict-strategies"])
+    assert cfg.search_measure and cfg.debug_nans and cfg.strict_strategies
+
+
+def test_feasible_configs_execute_unclamped():
+    """The config the search costs is the config compile() executes:
+    every feasible_parallel_configs candidate passes Model._effective_pc
+    unchanged, for every op in the DLRM graph."""
+    from dlrm_flexflow_tpu.core.op import InputOp
+    from dlrm_flexflow_tpu.parallel.sharding import AxisAssigner
+
+    model, _ = _bench_model()
+    feas = AxisAssigner(model.mesh).feasible_degrees()
+    checked = 0
+    for op in model.ops:
+        if isinstance(op, InputOp):
+            continue
+        for pc in op.feasible_parallel_configs(8, feas):
+            model.strategies = {op.name: pc}
+            eff = model._effective_pc(op)
+            nd = op.outputs[0].num_dims
+            want = tuple(pc.degrees[:nd]) + (1,) * (nd - len(pc.degrees))
+            assert eff.degrees == want, (op.name, pc.degrees, eff.degrees)
+            checked += 1
+    assert checked > 10
+
+
+def test_strict_strategies_raises_on_clamp():
+    """--strict-strategies turns the silent-clamp warning into an error."""
+    import pytest
+
+    from dlrm_flexflow_tpu.core.op import InputOp
+    from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
+
+    model, _ = _bench_model()
+    model.config.strict_strategies = True
+    op = next(o for o in model.ops if not isinstance(o, InputOp))
+    nd = op.outputs[0].num_dims
+    model.strategies = {op.name: ParallelConfig((3,) + (1,) * (nd - 1))}
+    with pytest.raises(ValueError, match="only admits"):
+        model._effective_pc(op)
