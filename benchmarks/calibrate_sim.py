@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Simulator calibration against real measured step times.
+
+The reference grounds its simulator in real kernel timings by construction
+(reference: src/runtime/simulator.cc:235-273 microbenchmarks every op's
+forward AND backward on the GPU). This harness closes the same loop for the
+TPU cost model: for a set of model/config points it measures the real
+per-step time on the attached chip, the analytical (roofline) simulated
+time, and the measured-mode simulated time (per-op compiled subgraph
+timings), and reports the relative error of each.
+
+Run on a real TPU:  python benchmarks/calibrate_sim.py
+Writes benchmarks/sim_calibration.json and prints a table.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure_step_time(model, batches, steps=200, windows=3) -> float:
+    """Best-window measured seconds per training step (same methodology as
+    bench.py: interference on a shared chip only ever slows a window)."""
+    model.train_batch_device(batches[0])  # warm/compile
+    best = float("inf")
+    n = len(batches)
+    for _ in range(windows):
+        t0 = time.time()
+        mets = None
+        for s in range(steps):
+            mets = model.train_batch_device(batches[s % n])
+        float(mets["loss"])  # dependent readback = true completion
+        best = min(best, (time.time() - t0) / steps)
+    return best
+
+
+def build_point(name, dcfg, batch, dtype, sparse_update=True):
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.models.dlrm import build_dlrm, synthetic_batch
+
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype=dtype,
+                      sparse_embedding_update=sparse_update)
+    model = ff.FFModel(cfg)
+    build_dlrm(model, dcfg)
+    model.compile(ff.SGDOptimizer(lr=0.01), "mean_squared_error", ["mse"])
+    model.init_layers()
+    batches = []
+    for i in range(4):
+        x, y = synthetic_batch(dcfg, batch, seed=i)
+        x["label"] = y
+        batches.append(model._device_batch(x))
+    return name, model, batches
+
+
+def calibration_points():
+    from dlrm_flexflow_tpu.models.dlrm import DLRMConfig
+
+    rnd = DLRMConfig.random_benchmark()          # 8 x 1M x 64-d tables
+    kaggle = DLRMConfig(                          # run_criteo_kaggle.sh shape
+        embedding_size=[1396, 550, 2700000, 2160000, 301, 22, 11878, 619,
+                        3, 64889, 5236, 2567820, 3136, 26, 12607, 471917,
+                        11, 4970, 2159, 4, 2586596, 7043, 61, 4, 930, 14][:26],
+        sparse_feature_size=16,
+        mlp_bot=[13, 512, 256, 64, 16],
+        mlp_top=[432, 512, 256, 1])
+    mlp = DLRMConfig(embedding_size=[64] * 4, sparse_feature_size=8,
+                     mlp_bot=[32, 1024, 1024, 8],
+                     mlp_top=[40, 1024, 1024, 1])
+    yield build_point("dlrm_random_bf16_b256", rnd, 256, "bfloat16")
+    yield build_point("dlrm_random_bf16_b1024", rnd, 1024, "bfloat16")
+    yield build_point("dlrm_random_f32_b256", rnd, 256, "float32")
+    yield build_point("dlrm_kaggle_bf16_b256", kaggle, 256, "bfloat16")
+    yield build_point("dlrm_kaggle_bf16_b1024", kaggle, 1024, "bfloat16")
+    yield build_point("mlp_heavy_bf16_b1024", mlp, 1024, "bfloat16")
+    yield build_point("dlrm_random_dense_upd_b256", rnd, 256, "bfloat16",
+                      sparse_update=False)
+
+
+def main():
+    from dlrm_flexflow_tpu.search.cost_model import CostModel
+    from dlrm_flexflow_tpu.search.mcmc import default_strategy
+    from dlrm_flexflow_tpu.search.simulator import Simulator
+
+    steps = int(os.environ.get("CAL_STEPS", "200"))
+    rows = []
+    for name, model, batches in calibration_points():
+        measured = measure_step_time(model, batches, steps=steps)
+        strat = default_strategy(model, 1)
+        sim_roof = Simulator(model).simulate(strat, 1)
+        cm = CostModel(measure=True,
+                       compute_dtype=model.config.jnp_compute_dtype)
+        sim_meas = Simulator(model, cost_model=cm).simulate(strat, 1)
+        rows.append({
+            "point": name,
+            "measured_ms": measured * 1e3,
+            "sim_roofline_ms": sim_roof * 1e3,
+            "sim_measured_ms": sim_meas * 1e3,
+            "err_roofline": sim_roof / measured - 1.0,
+            "err_measured": sim_meas / measured - 1.0,
+        })
+        r = rows[-1]
+        print(f"{name:32s} real {r['measured_ms']:8.3f} ms | "
+              f"sim(roofline) {r['sim_roofline_ms']:8.3f} "
+              f"({r['err_roofline']:+.0%}) | "
+              f"sim(measured) {r['sim_measured_ms']:8.3f} "
+              f"({r['err_measured']:+.0%})", flush=True)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "sim_calibration.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=1)
+    worst = max(abs(r["err_measured"]) for r in rows)
+    print(f"worst |err| (measured mode): {worst:.0%}")
+    return rows
+
+
+if __name__ == "__main__":
+    main()

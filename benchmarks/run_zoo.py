@@ -77,7 +77,7 @@ def _image_batch(batch, hw, classes=1000, seed=0):
 def bench_alexnet(quick):
     import dlrm_flexflow_tpu as ff
     from dlrm_flexflow_tpu.models.alexnet import build_alexnet
-    batch = 128
+    batch = 256
     model = ff.FFModel(ff.FFConfig(batch_size=batch,
                                    compute_dtype="bfloat16"))
     build_alexnet(model, num_classes=1000, image_hw=224)
@@ -91,7 +91,7 @@ def bench_alexnet(quick):
 def bench_resnet18(quick):
     import dlrm_flexflow_tpu as ff
     from dlrm_flexflow_tpu.models.resnet import build_resnet
-    batch = 64
+    batch = 256
     model = ff.FFModel(ff.FFConfig(batch_size=batch,
                                    compute_dtype="bfloat16"))
     build_resnet(model, depth=18, num_classes=1000, image_hw=224)
@@ -105,7 +105,7 @@ def bench_resnet18(quick):
 def bench_inception(quick):
     import dlrm_flexflow_tpu as ff
     from dlrm_flexflow_tpu.models.inception import build_inception_v3
-    batch = 32
+    batch = 256
     model = ff.FFModel(ff.FFConfig(batch_size=batch,
                                    compute_dtype="bfloat16"))
     build_inception_v3(model, num_classes=1000)

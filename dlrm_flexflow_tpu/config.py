@@ -60,6 +60,16 @@ class FFConfig:
     # use Pallas kernels for supported ops when running single-chip on TPU
     # (embedding-bag row-streaming; falls back to XLA lowering otherwise)
     use_pallas: bool = True
+    # store ALL embedding tables in host RAM (numpy) with host-side
+    # gather + touched-rows SGD scatter around the jitted step — the
+    # reference hetero-strategy semantics (embedding_avx2.cc), letting
+    # tables larger than HBM train on one chip. Per-op form: strategy
+    # memory_types ZCM. Enable with --host-tables.
+    host_resident_tables: bool = False
+    # run the conv stack (Conv2D/Pool2D/BatchNorm) in NHWC internally —
+    # the TPU-native layout (the NCHW API shape is the cuDNN-native
+    # choice, reference conv_2d.cu); disable with --no-nhwc
+    conv_nhwc: bool = True
     # update only the gathered embedding rows under plain SGD instead of
     # materializing table-sized dense gradients (numerically identical;
     # avoids streaming the full tables through HBM every step). Disable
@@ -135,6 +145,10 @@ class FFConfig:
                 cfg.debug_nans = True
             elif a == "--strict-strategies":
                 cfg.strict_strategies = True
+            elif a == "--no-nhwc":
+                cfg.conv_nhwc = False
+            elif a == "--host-tables":
+                cfg.host_resident_tables = True
             else:
                 cfg.unparsed.append(a)
             i += 1

@@ -379,6 +379,23 @@ class FFModel:
                 dtyp = pcs[0].device_type
                 strategies[op.name] = ParallelConfig(
                     (1, degree, 1), device_type=dtyp)
+                # honor the per-table device assignment, not just its
+                # degree: group tables by their strategy device so
+                # block-sharding the stacked dim lands table i exactly on
+                # device_ids[i] (reference round-robin placement,
+                # dlrm_strategy.cc:242-296)
+                dev_of = [pc.device_ids[0] if pc.device_ids else None
+                          for pc in pcs]
+                if (hasattr(op, "set_table_order")
+                        and len(emb_keys) == op.num_tables
+                        and None not in dev_of):
+                    devs = sorted(set(dev_of))
+                    per = op.num_tables // max(len(devs), 1)
+                    if (len(devs) == degree
+                            and all(dev_of.count(g) == per for g in devs)):
+                        op.set_table_order(tuple(
+                            i for g in devs
+                            for i, dg in enumerate(dev_of) if dg == g))
             elif not isinstance(op, fused_types) and i < len(emb_keys):
                 strategies[op.name] = strategies[emb_keys[i]]
         for op in self.ops:
@@ -436,11 +453,30 @@ class FFModel:
         # reference dlrm_strategy_hetero.cc:28-36): their compute runs under
         # compute_on("device_host"), with operands staged HBM→host per step —
         # the analog of the reference's zero-copy-memory staging
-        # (embedding.cu:280-283). Host-RAM *residency* for the params
-        # (pinned_host memory kind) is not enabled: this XLA build crashes
-        # the SPMD partitioner on host-memory-kind shardings and rejects
-        # donation of host buffers, so tables stay HBM-resident.
+        # (embedding.cu:280-283).
         self._host_offload_ops: set = set()
+        # HOST-RESIDENT tables (reference hetero semantics proper: tables
+        # STORED in CPU RAM and looked up there, embedding_avx2.cc +
+        # dlrm_strategy_hetero.cc:28-49 — the capability that lets
+        # DLRM-Terabyte run on few chips). XLA memory-kind shardings crash
+        # this build's partitioner, so residency is explicit instead: the
+        # table lives in model.host_params as numpy, the wrapper gathers
+        # rows on the host before each step, the jitted step consumes them
+        # via the overrides mechanism and returns their cotangents, and
+        # the wrapper applies the touched-rows SGD scatter on the host.
+        # Selected per op by strategy memory_types ZCM (strategy.proto:
+        # 11-14) or globally by FFConfig.host_resident_tables.
+        hres: set = set()
+        force_host = bool(getattr(self.config, "host_resident_tables",
+                                  False))
+        for op in self.ops:
+            if isinstance(op, InputOp) or not hasattr(op, "host_lookup"):
+                continue
+            raw = self.strategies.get(op.name)
+            if force_host or (raw is not None
+                              and "ZCM" in raw.memory_types):
+                hres.add(op.name)
+        self._host_resident_ops = hres
 
         def spec_from_axes(axes_per_dim):
             return NamedSharding(self.mesh,
@@ -450,7 +486,7 @@ class FFModel:
             if isinstance(op, InputOp):
                 continue
             pc = self._effective_pc(op)
-            if pc.device_type == "CPU":
+            if pc.device_type == "CPU" and op.name not in hres:
                 self._host_offload_ops.add(op.name)
             try:
                 out_axes = op.output_axes(
@@ -471,16 +507,23 @@ class FFModel:
             op._compiled_pc = pc
             op._seq_axes = tuple(out_axes[1]) if len(out_axes) > 1 else ()
             for t in op.outputs:
-                axes = out_axes[:t.num_dims]
+                axes = list(out_axes[:t.num_dims])
+                axes += [()] * (t.num_dims - len(axes))
+                shape = t.shape
+                if t.physical == "nhwc" and t.num_dims == 4:
+                    # constraints apply to the CONCRETE (NHWC) array:
+                    # permute the logical NCHW axis assignment to match
+                    axes = [axes[0], axes[2], axes[3], axes[1]]
+                    shape = (shape[0], shape[2], shape[3], shape[1])
                 # divisibility against the actual axis products (output_axes
                 # overrides may differ from the positional degrees)
                 sizes = [int(np.prod([self.mesh.shape[a] for a in ax]))
                          if ax else 1 for ax in axes]
-                ok = all(t.shape[i] % s == 0 for i, s in enumerate(sizes))
+                ok = all(shape[i] % s == 0 for i, s in enumerate(sizes))
                 self._out_sharding[t.guid] = (
                     spec_from_axes(axes) if ok else
                     NamedSharding(self.mesh, PartitionSpec()))
-            if op.param_defs():
+            if op.param_defs() and op.name not in hres:
                 # raw_pc = the UNclamped strategy, for ops whose param
                 # sharding keys off the requested (not shape-clamped)
                 # degrees — e.g. the concatenated-rows embedding row-shards
@@ -576,7 +619,16 @@ class FFModel:
                 sh = self._out_sharding.get(t.guid)
                 env[t.guid] = constrain(v, sh) if sh is not None else v
                 continue
-            xs = [env[t.guid] for t in op.inputs]
+            # physical-layout boundary: ops that didn't opt into NHWC get
+            # their conv-stack inputs transposed back to logical NCHW
+            # (ops/conv.py module docstring)
+            accepts_nhwc = getattr(op, "_accepts_nhwc_inputs", False)
+            xs = []
+            for t in op.inputs:
+                v = env[t.guid]
+                if t.physical == "nhwc" and not accepts_nhwc:
+                    v = jnp.transpose(v, (0, 3, 1, 2))
+                xs.append(v)
             p = params.get(op.name, {})
             host = op.name in host_ops
             if host:
@@ -634,7 +686,8 @@ class FFModel:
         if (not isinstance(opt, SGDOptimizer) or opt.momentum != 0.0
                 or opt.weight_decay != 0.0):
             return []
-        host = getattr(self, "_host_offload_ops", set())
+        host = (getattr(self, "_host_offload_ops", set())
+                | getattr(self, "_host_resident_ops", set()))
         return [op for op in self.ops
                 if isinstance(op, (Embedding, EmbeddingBagStacked,
                                    EmbeddingBagConcat))
@@ -668,12 +721,50 @@ class FFModel:
         sparse_ops = self._select_sparse_update_ops()
         self._sparse_update_ops = [op.name for op in sparse_ops]
         anc_names = self._ancestor_op_names(sparse_ops)
+        # conv-final models: env values are NHWC-physical; loss/metrics
+        # compare against logical-NCHW labels
+        logits_nhwc = self._logits_tensor.physical == "nhwc"
+        preds_is_nhwc = self._preds_tensor.physical == "nhwc"
 
-        def train_step(params, opt_state, op_state, msums, batch, step):
+        def _env_logits(env):
+            v = env[logits_guid]
+            return jnp.transpose(v, (0, 3, 1, 2)) if logits_nhwc else v
+
+        def _env_preds(env):
+            v = env[preds_guid]
+            return jnp.transpose(v, (0, 3, 1, 2)) if preds_is_nhwc else v
+        host_ops = [op for op in self.ops
+                    if op.name in getattr(self, "_host_resident_ops", set())]
+        self._host_resident_list = host_ops
+        for op in host_ops:
+            for t in op.inputs:
+                if t.owner_op is not None and not isinstance(t.owner_op,
+                                                             InputOp):
+                    raise ValueError(
+                        f"host-resident table op {op.name!r} must consume "
+                        f"a model input directly (use the fused DLRM "
+                        f"embedding layout)")
+        if host_ops and (not isinstance(self.optimizer, SGDOptimizer)
+                         or self.optimizer.momentum
+                         or self.optimizer.weight_decay):
+            raise ValueError(
+                "host-resident tables support plain SGD only (momentum/"
+                "weight-decay touch every row — matches the sparse-update "
+                "restriction)")
+        for op in host_ops:
+            if getattr(op, "aggr", None) == "none":
+                raise ValueError(
+                    f"host-resident table op {op.name!r}: aggr='none' "
+                    f"(per-bag-slot outputs) is not implemented on the "
+                    f"host path — use sum/avg or keep the table in HBM")
+
+        def train_step(params, opt_state, op_state, msums, batch, step,
+                       host_emb=None):
             rng = jax.random.fold_in(jax.random.PRNGKey(self.config.seed),
                                      step)
 
-            if sparse_ops:
+            host_cts = None
+            if sparse_ops or host_ops:
                 sparse_names = {op.name for op in sparse_ops}
                 p_dense = {k: v for k, v in params.items()
                            if k not in sparse_names}
@@ -683,6 +774,10 @@ class FFModel:
                                                only_ops=set(anc_names))
                 emb_vals = {op.name: anc_env[op.outputs[0].guid]
                             for op in sparse_ops}
+                if host_ops:
+                    # host-gathered rows enter as plain inputs; their
+                    # cotangents leave for the wrapper's host scatter
+                    emb_vals = {**emb_vals, **(host_emb or {})}
 
                 # phase B: differentiate the rest of the graph w.r.t. the
                 # dense params AND the embedding outputs; the tables never
@@ -691,8 +786,8 @@ class FFModel:
                 def objective(pd, ev, st):
                     env, st2 = self._forward_env(pd, st, batch, True, rng,
                                                  overrides=dict(ev))
-                    loss = loss_f(env[logits_guid], batch["label"])
-                    return loss, (env[preds_guid], st2)
+                    loss = loss_f(_env_logits(env), batch["label"])
+                    return loss, (_env_preds(env), st2)
 
                 (loss, (preds, st2)), (gd, gev) = jax.value_and_grad(
                     objective, argnums=(0, 1), has_aux=True)(
@@ -704,11 +799,13 @@ class FFModel:
                     xs = [anc_env[t.guid] for t in op.inputs]
                     new_params[op.name] = op.sparse_sgd_update(
                         params[op.name], xs, gev[op.name], lr)
+                if host_ops:
+                    host_cts = {op.name: gev[op.name] for op in host_ops}
             else:
                 def objective(p, st):
                     env, st2 = self._forward_env(p, st, batch, True, rng)
-                    loss = loss_f(env[logits_guid], batch["label"])
-                    return loss, (env[preds_guid], st2)
+                    loss = loss_f(_env_logits(env), batch["label"])
+                    return loss, (_env_preds(env), st2)
 
                 (loss, (preds, st2)), grads = jax.value_and_grad(
                     objective, has_aux=True)(params, op_state)
@@ -728,13 +825,21 @@ class FFModel:
             # accumulation would dispatch extra tiny kernels every step)
             new_msums = {k: msums[k] + v for k, v in mets.items()}
             mets["loss"] = loss
+            if host_cts is not None:
+                mets["_host_cts"] = host_cts
             # the step counter stays device-resident across calls (feeding
             # a fresh host int every step would be one H2D transfer/step)
             return new_params, new_opt, st2, new_msums, step + 1, mets
 
-        def eval_step(params, op_state, batch):
-            env, _ = self._forward_env(params, op_state, batch, False, None)
-            return env[preds_guid]
+        preds_nhwc = self._preds_tensor.physical == "nhwc"
+
+        def eval_step(params, op_state, batch, host_emb=None):
+            env, _ = self._forward_env(params, op_state, batch, False, None,
+                                       overrides=host_emb)
+            v = env[preds_guid]
+            if preds_nhwc:      # expose the user-facing logical NCHW form
+                v = jnp.transpose(v, (0, 3, 1, 2))
+            return v
 
         donate = (0, 1, 2, 3)
         self._train_step = jax.jit(train_step, donate_argnums=donate)
@@ -769,9 +874,16 @@ class FFModel:
         key = jax.random.PRNGKey(seed)
         params: Dict[str, Dict[str, jnp.ndarray]] = {}
         op_state: Dict[str, Any] = {}
+        hres = getattr(self, "_host_resident_ops", set())
+        self.host_params: Dict[str, Dict[str, np.ndarray]] = {}
         with jax.default_device(jax.devices()[0]):
-            for op in self.ops:
+            for i, op in enumerate(self.ops):
                 if isinstance(op, InputOp):
+                    continue
+                if op.name in hres:
+                    # table lives in host RAM, filled there (numpy) —
+                    # never device_put (reference embedding_avx2.cc path)
+                    self.host_params[op.name] = op.host_init(seed + i)
                     continue
                 if op.param_defs():
                     key, sub = jax.random.split(key)
@@ -830,8 +942,16 @@ class FFModel:
             self._step_dev = jax.device_put(
                 jnp.asarray(self._step, jnp.int32),
                 NamedSharding(self.mesh, PartitionSpec()))
+        hres = getattr(self, "_host_resident_list", None)
         args = (self.params, self.opt_state, self.op_state, self._msums,
                 device_batch, self._step_dev)
+        host_idx = None
+        if hres:
+            # one D2H index readback per step, shared by gather and scatter
+            host_idx = {op.name: np.asarray(
+                device_batch[op.inputs[0].name])
+                for op in hres}
+            args = args + (self._host_emb_forward(host_idx),)
         # hot loop: call the AOT-compiled executable directly — the pjit
         # python dispatch re-validates the big param pytree every call,
         # which costs more than the step itself on fast models. Keyed by
@@ -879,14 +999,52 @@ class FFModel:
         (self.params, self.opt_state, self.op_state, self._msums,
          self._step_dev, mets) = outs
         self._step += 1
+        if hres:
+            # apply the host-side touched-rows SGD scatter (synchronous:
+            # the cotangent readback is the step's true completion)
+            self._host_emb_update(host_idx, mets.pop("_host_cts"))
         # the running sums live on device; PerfMetrics syncs at report().
         # shallow-copy so perf.reset()/report() mutating perf.sums can
         # never corrupt the jit carry
         self.perf.sums = dict(self._msums)
         return mets
 
+    def _host_emb_forward(self, host_idx):
+        """Host-side gather for host-resident tables: numpy lookup on the
+        already-read-back indices, rows shipped to the device at the op's
+        output sharding."""
+        out = {}
+        for op in self._host_resident_list:
+            val = op.host_lookup(self.host_params[op.name],
+                                 host_idx[op.name])
+            out[op.name] = jax.device_put(
+                val, self._out_sharding[op.outputs[0].guid])
+        return out
+
+    def _host_emb_update(self, host_idx, cts):
+        lr = self.optimizer.lr
+        for op in self._host_resident_list:
+            op.host_sgd_update(self.host_params[op.name],
+                               host_idx[op.name],
+                               np.asarray(cts[op.name], dtype=np.float32),
+                               lr)
+
+    @staticmethod
+    def to_logical(value, tensor):
+        """Bring a raw _forward_env value into the tensor's logical (NCHW)
+        dim order — conv-stack tensors are stored NHWC (Tensor.physical)."""
+        if tensor.physical == "nhwc":
+            return jnp.transpose(value, (0, 3, 1, 2))
+        return value
+
     def forward_batch(self, batch: Dict[str, np.ndarray]):
         db = self._device_batch(batch, with_label=False)
+        hres = getattr(self, "_host_resident_list", None)
+        if hres:
+            host_idx = {op.name: np.asarray(db[op.inputs[0].name])
+                        for op in hres}
+            return self._eval_step(self.params, self.op_state, db,
+                                   self._host_emb_forward(host_idx))
         return self._eval_step(self.params, self.op_state, db)
 
     def reset_metrics(self):

@@ -170,6 +170,18 @@ class Op:
         """Forward FLOPs per sample, for the analytical simulator."""
         return 0.0
 
+    def random_hbm_rows(self, backward: bool = False) -> float:
+        """Number of RANDOM HBM row accesses this op makes per step
+        (embedding gathers/scatters). These are priced at the measured
+        per-row latency (TPUSpec.hbm_random_row_s), not at bandwidth —
+        the dominant cost of sparse lookups on TPU."""
+        return 0.0
+
+    def update_random_hbm_rows(self) -> float:
+        """Random row accesses of this op's PARAMETER update (the sparse
+        touched-rows RMW scatter: one read + one write per unique row)."""
+        return 0.0
+
     def output_bytes(self) -> int:
         t = self.outputs[0]
         return int(math.prod(t.shape)) * jnp.dtype(t.dtype).itemsize

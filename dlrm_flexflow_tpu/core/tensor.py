@@ -42,6 +42,12 @@ class Tensor:
     owner_idx: int = 0
     name: str = ""
     guid: int = field(default_factory=lambda: next(_tensor_guid))
+    # physical in-memory layout of the concrete array, when it differs
+    # from the logical `shape` order: None = logical, "nhwc" = a rank-4
+    # NCHW-logical tensor stored NHWC (the TPU-native conv layout; convs
+    # produce it, consumers either accept it or transpose back — see
+    # FFModel._forward_env)
+    physical: Optional[str] = None
 
     def __post_init__(self):
         self.shape = tuple(int(d) for d in self.shape)

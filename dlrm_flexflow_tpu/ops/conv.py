@@ -1,4 +1,4 @@
-"""Conv2D, Pool2D, BatchNorm operators (NCHW, matching the reference API).
+"""Conv2D, Pool2D, BatchNorm operators (NCHW API, matching the reference).
 
 Parity with the reference ops (reference: src/ops/conv_2d.cu 1046 LoC —
 cuDNN conv with auto-picked algorithm + fused ReLU; src/ops/pool_2d.cu 510 —
@@ -10,6 +10,14 @@ at conv_2d.cu:217 has no TPU analog). BatchNorm is a fused
 normalize-scale-shift in fp32 statistics; running stats are parameters
 updated functionally (the train step threads them through like weights but
 with direct assignment, not gradients).
+
+Layout: the API is NCHW (reference parity) but the conv stack COMPUTES in
+NHWC — the layout the TPU's vector units and XLA's conv emitter want
+(channels on the 128-lane minor dim). Each op consumes its input in
+whatever physical layout the producer declared (Tensor.physical) and
+declares "nhwc" on its own outputs; layout-agnostic consumers ride along
+and everything else transposes back to logical NCHW at the op boundary
+(FFModel._forward_env). Disable with FFConfig.conv_nhwc=False / --no-nhwc.
 """
 
 from __future__ import annotations
@@ -28,6 +36,20 @@ from .common import AC_MODE_NONE, apply_activation
 
 POOL_MAX = "max"
 POOL_AVG = "avg"
+
+
+def _nhwc_enabled(model) -> bool:
+    return bool(getattr(model.config, "conv_nhwc", True))
+
+
+def _to_nhwc(x, t):
+    """Bring a concrete array for logical-NCHW tensor `t` into NHWC."""
+    return x if t.physical == "nhwc" else jnp.transpose(x, (0, 2, 3, 1))
+
+
+def _from_nhwc(x, t):
+    """Bring an NHWC array back to tensor `t`'s declared physical form."""
+    return x if t.physical == "nhwc" else jnp.transpose(x, (0, 3, 1, 2))
 
 
 class Conv2D(Op):
@@ -56,6 +78,9 @@ class Conv2D(Op):
         oh = (h + 2 * self.padding[0] - self.kernel[0]) // self.stride[0] + 1
         ow = (w + 2 * self.padding[1] - self.kernel[1]) // self.stride[1] + 1
         self.outputs = [self._make_output((n, self.out_channels, oh, ow))]
+        if _nhwc_enabled(model):
+            self.outputs[0].physical = "nhwc"
+            self._accepts_nhwc_inputs = True
 
     def param_defs(self) -> Dict[str, ParamDef]:
         # OIHW kernel layout (cuDNN default, conv_2d.cu)
@@ -70,18 +95,28 @@ class Conv2D(Op):
     def apply(self, params, xs, *, training=False, rng=None):
         (x,) = xs
         cdt = self.model.compute_dtype
+        pads = [(self.padding[0], self.padding[0]),
+                (self.padding[1], self.padding[1])]
         # no preferred_element_type upcast: jax's conv transpose rule
         # rejects mixed dtypes (fp32 cotangent vs bf16 operands), so emit a
         # bf16-out conv (MXU still accumulates fp32 internally) and upcast
-        y = lax.conv_general_dilated(
-            x.astype(cdt), params["kernel"].astype(cdt),
-            window_strides=self.stride,
-            padding=[(self.padding[0], self.padding[0]),
-                     (self.padding[1], self.padding[1])],
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            feature_group_count=self.groups).astype(jnp.float32)
-        if self.use_bias:
-            y = y + params["bias"][None, :, None, None]
+        if self.outputs[0].physical == "nhwc":
+            y = lax.conv_general_dilated(
+                _to_nhwc(x, self.inputs[0]).astype(cdt),
+                params["kernel"].astype(cdt),
+                window_strides=self.stride, padding=pads,
+                dimension_numbers=("NHWC", "OIHW", "NHWC"),
+                feature_group_count=self.groups).astype(jnp.float32)
+            if self.use_bias:
+                y = y + params["bias"]
+        else:
+            y = lax.conv_general_dilated(
+                x.astype(cdt), params["kernel"].astype(cdt),
+                window_strides=self.stride, padding=pads,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                feature_group_count=self.groups).astype(jnp.float32)
+            if self.use_bias:
+                y = y + params["bias"][None, :, None, None]
         return [apply_activation(y, self.activation).astype(x.dtype)]
 
     def candidate_parallel_configs(self, num_devices, feasible_degrees):
@@ -140,14 +175,26 @@ class Pool2D(Op):
         oh = (h + 2 * self.padding[0] - self.kernel[0]) // self.stride[0] + 1
         ow = (w + 2 * self.padding[1] - self.kernel[1]) // self.stride[1] + 1
         self.outputs = [self._make_output((n, c, oh, ow))]
+        if _nhwc_enabled(model):
+            self.outputs[0].physical = "nhwc"
+            self._accepts_nhwc_inputs = True
 
     def apply(self, params, xs, *, training=False, rng=None):
         (x,) = xs
-        pads = [(0, 0), (0, 0),
-                (self.padding[0], self.padding[0]),
-                (self.padding[1], self.padding[1])]
-        dims = (1, 1, *self.kernel)
-        strides = (1, 1, *self.stride)
+        nhwc = self.outputs[0].physical == "nhwc"
+        if nhwc:
+            x = _to_nhwc(x, self.inputs[0])
+            pads = [(0, 0),
+                    (self.padding[0], self.padding[0]),
+                    (self.padding[1], self.padding[1]), (0, 0)]
+            dims = (1, *self.kernel, 1)
+            strides = (1, *self.stride, 1)
+        else:
+            pads = [(0, 0), (0, 0),
+                    (self.padding[0], self.padding[0]),
+                    (self.padding[1], self.padding[1])]
+            dims = (1, 1, *self.kernel)
+            strides = (1, 1, *self.stride)
         if self.pool_type == POOL_MAX:
             init = -jnp.inf
             y = lax.reduce_window(x, init, lax.max, dims, strides, pads)
@@ -179,6 +226,9 @@ class BatchNorm(Op):
         self.relu = bool(relu)
         self.channels = input_tensor.shape[1]
         self.outputs = [self._make_output(input_tensor.shape)]
+        if _nhwc_enabled(model):
+            self.outputs[0].physical = "nhwc"
+            self._accepts_nhwc_inputs = True
 
     def param_defs(self):
         c = self.channels
@@ -197,10 +247,20 @@ class BatchNorm(Op):
 
     def apply_with_state(self, params, state, xs, *, training=False, rng=None):
         (x,) = xs
+        nhwc = self.outputs[0].physical == "nhwc"
+        if nhwc:
+            x = _to_nhwc(x, self.inputs[0])
+            reduce_axes = (0, 1, 2)
+        else:
+            reduce_axes = (0, 2, 3)
+
+        def _b(v):  # broadcast a (C,) vector over the channel dim
+            return v[None, :, None, None] if not nhwc else v
+
         x32 = x.astype(jnp.float32)
         if training:
-            mean = jnp.mean(x32, axis=(0, 2, 3))
-            var = jnp.var(x32, axis=(0, 2, 3))
+            mean = jnp.mean(x32, axis=reduce_axes)
+            var = jnp.var(x32, axis=reduce_axes)
             new_state = {
                 "running_mean": self.momentum * state["running_mean"]
                                 + (1 - self.momentum) * mean,
@@ -211,8 +271,8 @@ class BatchNorm(Op):
             mean, var = state["running_mean"], state["running_var"]
             new_state = state
         inv = lax.rsqrt(var + self.eps)
-        y = (x32 - mean[None, :, None, None]) * inv[None, :, None, None]
-        y = y * params["scale"][None, :, None, None] + params["bias"][None, :, None, None]
+        y = (x32 - _b(mean)) * _b(inv)
+        y = y * _b(params["scale"]) + _b(params["bias"])
         if self.relu:
             y = jax.nn.relu(y)
         return [y.astype(x.dtype)], new_state

@@ -49,6 +49,10 @@ class ElementUnary(Op):
         super().__init__(model, [input_tensor], name)
         self.op_type = op_type
         self.outputs = [self._make_output(input_tensor.shape, input_tensor.dtype)]
+        # layout-agnostic: ride along in the producer's physical layout
+        # (keeps ResNet/Inception activation chains in NHWC end to end)
+        self.outputs[0].physical = input_tensor.physical
+        self._accepts_nhwc_inputs = input_tensor.physical == "nhwc"
 
     def apply(self, params, xs, *, training=False, rng=None):
         return [_UNARY[self.op_type](xs[0])]
@@ -66,6 +70,11 @@ class ElementBinary(Op):
             raise ValueError(f"elementwise shape mismatch {a.shape} vs {b.shape}")
         self.op_type = op_type
         self.outputs = [self._make_output(a.shape, a.dtype)]
+        # layout-agnostic only when BOTH operands share a physical layout
+        # (e.g. two NHWC conv branches summed in a residual block)
+        if a.physical == b.physical and a.physical is not None:
+            self.outputs[0].physical = a.physical
+            self._accepts_nhwc_inputs = True
 
     def apply(self, params, xs, *, training=False, rng=None):
         return [_BINARY[self.op_type](xs[0], xs[1])]
@@ -97,6 +106,9 @@ class Dropout(Op):
         self.rate = float(rate)
         self.seed = int(seed)
         self.outputs = [self._make_output(input_tensor.shape, input_tensor.dtype)]
+        # layout-agnostic (elementwise mask)
+        self.outputs[0].physical = input_tensor.physical
+        self._accepts_nhwc_inputs = input_tensor.physical == "nhwc"
 
     def apply(self, params, xs, *, training=False, rng=None):
         (x,) = xs

@@ -62,6 +62,71 @@ def _packed_gather(tbl, ix, r, d):
         t, sub[..., None, None], axis=-2)[..., 0, :]    # (..., d)
 
 
+def _lookup_count(op) -> float:
+    """Rows randomly touched per step by this op's gather: batch × tables
+    × bag."""
+    t = op.inputs[0]
+    batch = t.shape[0]
+    bag = t.shape[-1] if t.num_dims > 1 else 1
+    tables = getattr(op, "num_tables", 1)
+    return float(batch * tables * bag)
+
+
+def _embedding_random_rows(op, backward: bool) -> float:
+    # forward = one random read per lookup; the sparse-path backward never
+    # re-gathers (the train step threads cotangents via overrides)
+    return 0.0 if backward else _lookup_count(op)
+
+
+def _embedding_update_rows(op) -> float:
+    # touched-rows RMW scatter: one random read + one write per lookup
+    # (dedup reduces this; worst case priced). Dense updates stream the
+    # table instead (covered by param_bytes_touched_per_step).
+    return 2.0 * _lookup_count(op) if _sparse_update_active(op) else 0.0
+
+
+def _host_init_table(initializer, shape, seed: int):
+    """Numpy re-implementation of the common initializers for HOST-resident
+    tables (the reference stores hetero tables in CPU RAM and fills them
+    there, embedding_avx2.cc / dlrm_strategy_hetero.cc:28-49; jax init on
+    the accelerator would defeat the point of host residency)."""
+    import numpy as np
+
+    from ..core import initializers as I
+    rng = np.random.RandomState(seed & 0x7FFFFFFF)
+    if isinstance(initializer, I.ZeroInitializer):
+        return np.zeros(shape, np.float32)
+    if isinstance(initializer, I.ConstantInitializer):
+        return np.full(shape, initializer.value, np.float32)
+    if isinstance(initializer, I.UniformInitializer):
+        return rng.uniform(initializer.min_val, initializer.max_val,
+                           shape).astype(np.float32)
+    if isinstance(initializer, I.NormInitializer):
+        return rng.normal(initializer.mean, initializer.stddev,
+                          shape).astype(np.float32)
+    # GlorotUniform over the last two dims (matches initializers.py fans)
+    fan_in, fan_out = shape[-2], shape[-1]
+    lim = float(np.sqrt(6.0 / (fan_in + fan_out)))
+    return rng.uniform(-lim, lim, shape).astype(np.float32)
+
+
+def _host_bag_lookup(table, g, aggr):
+    """table (rows, d) numpy; g (batch, T, bag) global rows -> (batch,T,d)."""
+    import numpy as np
+    rows = table[g.reshape(-1)].reshape(g.shape + (table.shape[-1],))
+    out = rows.mean(axis=2) if aggr == AGGR_MODE_AVG else rows.sum(axis=2)
+    return np.ascontiguousarray(out, np.float32)
+
+
+def _host_bag_update(table, g, ct, lr, aggr):
+    """In-place table[g] -= lr * d(out)/d(rows) · ct (duplicate-safe)."""
+    import numpy as np
+    bag = g.shape[-1]
+    c = ct / bag if aggr == AGGR_MODE_AVG else ct
+    upd = np.broadcast_to(c[..., None, :], g.shape + (table.shape[-1],))
+    np.add.at(table, g.reshape(-1), -lr * upd.reshape(-1, table.shape[-1]))
+
+
 def _sparse_update_active(op) -> bool:
     """Whether the touched-rows-only update will actually run for `op`
     (mirrors FFModel._select_sparse_update_ops; optimizer may be unset
@@ -227,6 +292,13 @@ class Embedding(Op):
         dc = pc.degrees[-1] if len(pc.degrees) > 1 else 1
         return {"kernel": (self.num_entries, max(self.out_dim // dc, 1))}
 
+
+    def random_hbm_rows(self, backward: bool = False) -> float:
+        return _embedding_random_rows(self, backward)
+
+    def update_random_hbm_rows(self) -> float:
+        return _embedding_update_rows(self)
+
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
             return self.param_bytes()   # dense grad+update streams the table
@@ -271,6 +343,30 @@ class Embedding(Op):
         return {"kernel": new}
 
 
+
+    # ---- host-resident table form (reference embedding_avx2.cc) --------
+    def host_init(self, seed: int):
+        return {"kernel": _host_init_table(
+            self.kernel_initializer, (self.num_entries, self.out_dim), seed)}
+
+    def host_lookup(self, host_params, idx_np):
+        import numpy as np
+        g = idx_np.astype(np.int64) % self.num_entries
+        if g.ndim == 1:
+            g = g[:, None]
+        out = _host_bag_lookup(host_params["kernel"], g[:, None, :],
+                               self.aggr)
+        return out[:, 0]                                  # (batch, d)
+
+    def host_sgd_update(self, host_params, idx_np, ct_np, lr):
+        import numpy as np
+        g = idx_np.astype(np.int64) % self.num_entries
+        if g.ndim == 1:
+            g = g[:, None]
+        _host_bag_update(host_params["kernel"], g[:, None, :],
+                         ct_np[:, None, :], lr, self.aggr)
+
+
 class EmbeddingBagStacked(Op):
     """N same-shape embedding bags fused into one (N, rows, dim) parameter.
 
@@ -306,6 +402,27 @@ class EmbeddingBagStacked(Op):
         self._pack = _pack_factor(self.out_dim, self.num_entries)
         batch = input_tensor.shape[0]
         self.outputs = [self._make_output((batch, self.num_tables, self.out_dim))]
+        # storage permutation honoring strategy device_ids: stored slot s
+        # holds LOGICAL table _table_order[s], so block-sharding dim 0
+        # reproduces the reference's per-table device assignment
+        # (dlrm_strategy.cc:242-296 round-robins table i to device i%N;
+        # mapper.cc:33-97 places point tasks there). None = identity.
+        self._table_order = None
+        self._table_inv = None
+
+    def set_table_order(self, order):
+        """Storage order for the stacked tables (see __init__)."""
+        order = tuple(int(t) for t in order)
+        if sorted(order) != list(range(self.num_tables)):
+            raise ValueError(f"not a table permutation: {order}")
+        if order == tuple(range(self.num_tables)):
+            self._table_order = self._table_inv = None
+            return
+        inv = [0] * self.num_tables
+        for s, t in enumerate(order):
+            inv[t] = s
+        self._table_order = jnp.asarray(order, jnp.int32)
+        self._table_inv = jnp.asarray(inv, jnp.int32)
 
     def param_defs(self):
         r = self._pack
@@ -326,11 +443,16 @@ class EmbeddingBagStacked(Op):
 
     def unpack_kernel(self, kernel):
         """(T, rows/r, r*d) stored form -> logical (T, rows, d)."""
-        return kernel.reshape(self.num_tables, self.num_entries,
-                              self.out_dim)
+        logical = kernel.reshape(self.num_tables, self.num_entries,
+                                 self.out_dim)
+        if self._table_order is not None:
+            logical = jnp.take(logical, self._table_inv, axis=0)
+        return logical
 
     def pack_kernel(self, logical):
         r = self._pack
+        if self._table_order is not None:
+            logical = jnp.take(logical, self._table_order, axis=0)
         return logical.reshape(self.num_tables, self.num_entries // r,
                                self.out_dim * r)
 
@@ -338,27 +460,32 @@ class EmbeddingBagStacked(Op):
         (idx,) = xs  # (batch, T, bag)
         table = params["kernel"]  # (T, rows/r, r*d)
         idx = idx.astype(jnp.int32) % self.num_entries
+        if self._table_order is not None:
+            idx = jnp.take(idx, self._table_order, axis=1)
         r, d = self._pack, self.out_dim
 
         if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG) and r == 1
                 and _pallas_ok(self.model, self.out_dim, self.name)):
             from .pallas.embedding_kernel import stacked_embedding_bag
-            return [stacked_embedding_bag(table, idx, self.aggr)]
+            out = stacked_embedding_bag(table, idx, self.aggr)
+        else:
+            # vmap over the table dim: for each table t, gather its own
+            # rows for the full batch. With dim-0 sharded params + matching
+            # sharding constraints this lowers to per-device local gathers
+            # + all-to-all.
+            def one_table(tbl, ix):  # tbl (rows/r, r*d), ix (batch, bag)
+                if r == 1:
+                    rows = jnp.take(tbl, ix, axis=0, mode="wrap")
+                else:
+                    rows = _packed_gather(tbl, ix, r, d)   # (batch, bag, d)
+                if self.aggr == AGGR_MODE_AVG:
+                    return jnp.mean(rows, axis=1)
+                return jnp.sum(rows, axis=1)
 
-        # vmap over the table dim: for each table t, gather its own rows for
-        # the full batch. With dim-0 sharded params + matching sharding
-        # constraints this lowers to per-device local gathers + all-to-all.
-        def one_table(tbl, ix):  # tbl (rows/r, r*d), ix (batch, bag)
-            if r == 1:
-                rows = jnp.take(tbl, ix, axis=0, mode="wrap")
-            else:
-                rows = _packed_gather(tbl, ix, r, d)       # (batch, bag, d)
-            if self.aggr == AGGR_MODE_AVG:
-                return jnp.mean(rows, axis=1)
-            return jnp.sum(rows, axis=1)
-
-        out = jax.vmap(one_table, in_axes=(0, 1), out_axes=1)(table, idx)
-        return [out]  # (batch, T, d)
+            out = jax.vmap(one_table, in_axes=(0, 1), out_axes=1)(table, idx)
+        if self._table_order is not None:
+            out = jnp.take(out, self._table_inv, axis=1)
+        return [out]  # (batch, T, d) in LOGICAL table order
 
     def candidate_parallel_configs(self, num_devices, feasible_degrees):
         # partition the table dim (dim 1 of the output) and/or sample dim
@@ -394,6 +521,13 @@ class EmbeddingBagStacked(Op):
         return {"kernel": (max(self.num_tables // dt, 1),
                            self.num_entries // r, self.out_dim * r)}
 
+
+    def random_hbm_rows(self, backward: bool = False) -> float:
+        return _embedding_random_rows(self, backward)
+
+    def update_random_hbm_rows(self) -> float:
+        return _embedding_update_rows(self)
+
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
             return self.param_bytes()
@@ -410,6 +544,10 @@ class EmbeddingBagStacked(Op):
         tbl = params["kernel"]            # (T, rows/r, r*d)
         idx = idx.astype(jnp.int32) % self.num_entries
         ct = out_ct.astype(tbl.dtype)     # (batch, T, d)
+        if self._table_order is not None:
+            # stored slot s holds logical table _table_order[s]
+            idx = jnp.take(idx, self._table_order, axis=1)
+            ct = jnp.take(ct, self._table_order, axis=1)
         if self.aggr == AGGR_MODE_AVG:
             ct = ct / idx.shape[-1]
         r, d = self._pack, self.out_dim
@@ -454,6 +592,30 @@ class EmbeddingBagStacked(Op):
 
         new = jax.vmap(one_table, in_axes=(0, 1, 1))(tbl, idx, ct)
         return {"kernel": new}
+
+
+
+    # ---- host-resident table form (reference embedding_avx2.cc) --------
+    def host_init(self, seed: int):
+        return {"kernel": _host_init_table(
+            self.kernel_initializer,
+            (self.num_tables, self.num_entries, self.out_dim), seed)}
+
+    def host_lookup(self, host_params, idx_np):
+        import numpy as np
+        T, rows, d = host_params["kernel"].shape
+        offs = (np.arange(T, dtype=np.int64) * rows)[None, :, None]
+        g = idx_np.astype(np.int64) % rows + offs         # (batch, T, bag)
+        return _host_bag_lookup(host_params["kernel"].reshape(T * rows, d),
+                                g, self.aggr)
+
+    def host_sgd_update(self, host_params, idx_np, ct_np, lr):
+        import numpy as np
+        T, rows, d = host_params["kernel"].shape
+        offs = (np.arange(T, dtype=np.int64) * rows)[None, :, None]
+        g = idx_np.astype(np.int64) % rows + offs
+        _host_bag_update(host_params["kernel"].reshape(T * rows, d), g,
+                         ct_np, lr, self.aggr)
 
 
 class EmbeddingBagConcat(Op):
@@ -614,6 +776,13 @@ class EmbeddingBagConcat(Op):
         return {"kernel": (max(self.total_rows // r // max(dt, 1), 1),
                            self.out_dim * r)}
 
+
+    def random_hbm_rows(self, backward: bool = False) -> float:
+        return _embedding_random_rows(self, backward)
+
+    def update_random_hbm_rows(self) -> float:
+        return _embedding_update_rows(self)
+
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
             return self.param_bytes()
@@ -655,3 +824,30 @@ class EmbeddingBagConcat(Op):
             new = self.pack_kernel(
                 self.unpack_kernel(tbl).at[g.reshape(-1)].add(-lr * upd))
         return {"kernel": new}
+
+    # ---- host-resident table form (reference embedding_avx2.cc) --------
+    def host_init(self, seed: int):
+        import numpy as np
+        parts = [_host_init_table(self.kernel_initializer,
+                                  (rows, self.out_dim), seed + i)
+                 for i, rows in enumerate(self.table_sizes)]
+        pad = self.total_rows - sum(self.table_sizes)
+        if pad:
+            parts.append(np.zeros((pad, self.out_dim), np.float32))
+        return {"kernel": np.concatenate(parts)}
+
+    def _host_global_indices(self, idx_np):
+        import numpy as np
+        sizes = np.asarray(self.table_sizes, np.int64)[None, :, None]
+        offs = np.asarray(self._offsets, np.int64)[None, :, None]
+        return idx_np.astype(np.int64) % sizes + offs     # (batch, T, bag)
+
+    def host_lookup(self, host_params, idx_np):
+        return _host_bag_lookup(host_params["kernel"],
+                                self._host_global_indices(idx_np), self.aggr)
+
+    def host_sgd_update(self, host_params, idx_np, ct_np, lr):
+        _host_bag_update(host_params["kernel"],
+                         self._host_global_indices(idx_np), ct_np, lr,
+                         self.aggr)
+

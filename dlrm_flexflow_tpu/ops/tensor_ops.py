@@ -36,9 +36,17 @@ class Concat(Op):
         out_shape = list(inputs[0].shape)
         out_shape[self.axis] = sum(t.shape[self.axis] for t in inputs)
         self.outputs = [self._make_output(out_shape, inputs[0].dtype)]
+        # channel-concat of NHWC branches (Inception towers) stays NHWC:
+        # logical axis 1 (C) is physical axis 3
+        self._phys_axis = self.axis
+        if (nd == 4 and self.axis == 1
+                and all(t.physical == "nhwc" for t in inputs)):
+            self.outputs[0].physical = "nhwc"
+            self._accepts_nhwc_inputs = True
+            self._phys_axis = 3
 
     def apply(self, params, xs, *, training=False, rng=None):
-        return [jnp.concatenate(xs, axis=self.axis)]
+        return [jnp.concatenate(xs, axis=self._phys_axis)]
 
 
 class Split(Op):

@@ -35,6 +35,10 @@ class ParallelConfig:
     degrees: Tuple[int, ...]
     device_type: str = DEVICE_TPU
     device_ids: Tuple[int, ...] = field(default=())
+    # per-part memory placement (reference strategy.proto:11-14: FBM =
+    # framebuffer/HBM, ZCM = zero-copy host memory); round-tripped through
+    # strategy files and consulted by the hetero host-offload path
+    memory_types: Tuple[str, ...] = field(default=())
 
     def __post_init__(self):
         object.__setattr__(self, "degrees", tuple(int(d) for d in self.degrees))

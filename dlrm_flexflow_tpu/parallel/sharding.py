@@ -13,19 +13,24 @@ any op-to-op resharding collectives that Legion's implicit DMA used to do
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
-def assignable(degrees: Sequence[int], axis_sizes: Sequence[int]) -> bool:
-    """True when each degree maps to a consecutive run of unused axes in
-    order — the pure-structure form of AxisAssigner.assign, usable before
-    a jax Mesh exists (the search's fallback mesh factorizes num_devices
-    exactly like parallel.mesh.make_mesh)."""
+def assign_indices(degrees: Sequence[int], axis_sizes: Sequence[int]
+                   ) -> "Optional[List[Tuple[int, ...]]]":
+    """THE axis-consumption algorithm, by index: each degree takes a
+    consecutive run of unused axes (searching forward from the last
+    consumed one) whose sizes multiply to it; None when not jointly
+    assignable. AxisAssigner.assign, the search's structural feasibility
+    check, and the simulator's collective pricing all defer here so they
+    can never disagree about which axes a config's collectives ride."""
+    result: List[Tuple[int, ...]] = []
     cursor = 0
     for deg in degrees:
         if deg == 1:
+            result.append(())
             continue
         start = cursor
         while start < len(axis_sizes):
@@ -34,12 +39,20 @@ def assignable(degrees: Sequence[int], axis_sizes: Sequence[int]) -> bool:
                 p *= axis_sizes[j]
                 j += 1
             if p == deg:
+                result.append(tuple(range(start, j)))
                 cursor = j
                 break
             start += 1
         else:
-            return False
-    return True
+            return None
+    return result
+
+
+def assignable(degrees: Sequence[int], axis_sizes: Sequence[int]) -> bool:
+    """True when assign_indices succeeds — usable before a jax Mesh exists
+    (the search's fallback mesh factorizes num_devices exactly like
+    parallel.mesh.make_mesh)."""
+    return assign_indices(degrees, axis_sizes) is not None
 
 
 class AxisAssigner:
@@ -64,36 +77,18 @@ class AxisAssigner:
         return sorted(out)
 
     def assign(self, degrees: Sequence[int]) -> List[Tuple[str, ...]]:
-        """Assign each dim's degree a tuple of consecutive unused axes.
+        """Assign each dim's degree a tuple of consecutive unused axes
+        (assign_indices, mapped to axis names).
 
         Raises ValueError when a degree cannot be formed from the remaining
         axes (search proposals are filtered through feasible_degrees()).
         """
-        result: List[Tuple[str, ...]] = []
-        cursor = 0
-        for deg in degrees:
-            if deg == 1:
-                result.append(())
-                continue
-            # find a consecutive run starting at or after cursor whose sizes
-            # multiply to deg
-            start = cursor
-            while start < len(self.axis_sizes):
-                p, j = 1, start
-                while j < len(self.axis_sizes) and p < deg:
-                    p *= self.axis_sizes[j]
-                    j += 1
-                if p == deg:
-                    result.append(tuple(self.axis_names[start:j]))
-                    cursor = j
-                    break
-                start += 1
-            else:
-                raise ValueError(
-                    f"degree {deg} not expressible over mesh axes "
-                    f"{list(zip(self.axis_names, self.axis_sizes))} "
-                    f"(remaining from {cursor})")
-        return result
+        idx = assign_indices(degrees, self.axis_sizes)
+        if idx is None:
+            raise ValueError(
+                f"degrees {tuple(degrees)} not jointly expressible over "
+                f"mesh axes {list(zip(self.axis_names, self.axis_sizes))}")
+        return [tuple(self.axis_names[i] for i in t) for t in idx]
 
     @staticmethod
     def axes_to_spec(axes_per_dim) -> PartitionSpec:

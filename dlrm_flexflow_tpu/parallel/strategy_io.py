@@ -65,7 +65,8 @@ def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
 
 
 def _encode_op(name: str, device_type: int, dims: List[int],
-               device_ids: List[int]) -> bytes:
+               device_ids: List[int],
+               memory_types: List[int]) -> bytes:
     msg = bytearray()
     nb = name.encode()
     msg += b"\x0a" + _varint(len(nb)) + nb          # 1: name (len-delim)
@@ -74,6 +75,8 @@ def _encode_op(name: str, device_type: int, dims: List[int],
         msg += b"\x18" + _varint(d)
     for d in device_ids:                            # 4: device_ids
         msg += b"\x20" + _varint(d)
+    for m in memory_types:                          # 5: memory_types
+        msg += b"\x28" + _varint(m)
     return bytes(msg)
 
 
@@ -117,8 +120,9 @@ def save_strategies_pb(path: str, strategies: StrategyMap) -> None:
     body = bytearray()
     for name, pc in sorted(strategies.items()):
         dt = 1 if pc.device_type == "CPU" else 0
+        mts = [1 if m == "ZCM" else 0 for m in pc.memory_types]
         op = _encode_op(name, dt, list(reversed(pc.degrees)),
-                        list(pc.device_ids))
+                        list(pc.device_ids), mts)
         body += b"\x0a" + _varint(len(op)) + op     # Strategy.ops = 1
     with open(path, "wb") as f:
         f.write(bytes(body))
@@ -140,7 +144,7 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
     for field, wt, v in _decode_message(buf):
         if field != 1 or wt != 2:
             continue
-        name, dt, dims, dev_ids = "", 0, [], []
+        name, dt, dims, dev_ids, mts = "", 0, [], [], []
         for f2, wt2, v2 in _decode_message(v):
             if f2 == 1:
                 name = v2.decode()
@@ -150,9 +154,12 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
                 dims += _unpack_varints(v2) if wt2 == 2 else [v2]
             elif f2 == 4:
                 dev_ids += _unpack_varints(v2) if wt2 == 2 else [v2]
+            elif f2 == 5:
+                mts += _unpack_varints(v2) if wt2 == 2 else [v2]
         out[name] = ParallelConfig(
             tuple(reversed(dims)), device_type="CPU" if dt == 1 else "TPU",
-            device_ids=tuple(dev_ids))
+            device_ids=tuple(dev_ids),
+            memory_types=tuple("ZCM" if m == 1 else "FBM" for m in mts))
     return out
 
 
@@ -167,7 +174,8 @@ def save_strategies(path: str, strategies: StrategyMap) -> None:
         {"name": name,
          "device_type": pc.device_type,
          "dims": list(pc.degrees),
-         "device_ids": list(pc.device_ids)}
+         "device_ids": list(pc.device_ids),
+         "memory_types": list(pc.memory_types)}
         for name, pc in sorted(strategies.items())]}
     with open(path, "w") as f:
         json.dump(doc, f, indent=1)
@@ -183,5 +191,6 @@ def load_strategies(path: str) -> StrategyMap:
         out[entry["name"]] = ParallelConfig(
             tuple(entry["dims"]),
             device_type=entry.get("device_type", "TPU"),
-            device_ids=tuple(entry.get("device_ids", ())))
+            device_ids=tuple(entry.get("device_ids", ())),
+            memory_types=tuple(entry.get("memory_types", ())))
     return out

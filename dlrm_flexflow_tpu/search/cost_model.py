@@ -45,6 +45,19 @@ class TPUSpec:
     hbm_utilization: float = 0.75
     kernel_launch_s: float = 2e-6     # per-HLO overhead (XLA fused ≈ small)
     hbm_capacity_bytes: float = 16e9  # v5e HBM per chip
+    # RANDOM HBM row-access model (embedding gather/scatter): fixed setup
+    # plus per-row sustained cost. Measured on v5e (benchmarks/
+    # calibrate_sim.py): 2048 random 512 B reads from an 8M-row table take
+    # ~1.1 ms — identical for XLA gather and a Pallas kernel with an
+    # 8-64-deep DMA pipeline (latency/row-activation bound, not
+    # bandwidth); larger counts amortize to ~0.3 µs/row.
+    hbm_random_fixed_s: float = 4.0e-4
+    hbm_random_row_s: float = 3.0e-7
+    # host-resident tables: PCIe host<->device link and host-DRAM random
+    # row cost (the reference prices GPU<->DRAM at 16 MB/ms,
+    # simulator.cu:27-29; v5e host link ~ PCIe gen3/4)
+    pcie_bytes_per_s: float = 16e9
+    host_random_row_s: float = 1.0e-7
 
     @staticmethod
     def v4() -> "TPUSpec":
@@ -110,22 +123,43 @@ class CostModel:
 
         if self.measure:
             # calibrated mode: time the op's compiled subgraph on the real
-            # device (reference Op::measure_compute_time); backward ≈ 2×
-            # forward, the same ratio the analytical model assumes
-            t = self.measure_op(op, pc) * (2.0 if backward else 1.0)
+            # device (reference measures forward AND backward separately,
+            # linear.cu:973-1049 / simulator.cc:235-273)
+            t = self.measure_op(op, pc, backward=backward)
         else:
             t = self._roofline_time(op, pc, backward)
         self._cache[key] = t
         return t
 
+    @staticmethod
+    def _host_resident(op: Op, pc: ParallelConfig) -> bool:
+        """True only for host-RESIDENT tables (ZCM memory). A bare CPU
+        device_type without ZCM is compute-offload — its tables still
+        live in HBM and MUST count against capacity."""
+        if not hasattr(op, "host_lookup"):
+            return False
+        if op.name in getattr(op.model, "_host_resident_ops", set()):
+            return True
+        return "ZCM" in pc.memory_types
+
     def _roofline_time(self, op: Op, pc: ParallelConfig,
                        backward: bool = False) -> float:
+        if self._host_resident(op, pc):
+            # host gather (DRAM random rows) + rows over PCIe down
+            # (forward) / cotangents up + host scatter RMW (backward)
+            rows = op.random_hbm_rows(False)
+            out_bytes = self.tensor_bytes(op.outputs[0])
+            host_rows = rows * (2.0 if backward else 1.0)
+            return (self.spec.hbm_random_fixed_s
+                    + host_rows * self.spec.host_random_row_s
+                    + out_bytes / self.spec.pcie_bytes_per_s)
         batch = op.outputs[0].shape[0] if op.outputs[0].num_dims > 0 else 1
         flops = op.flops_per_sample() * batch / max(pc.num_parts, 1)
-        # bytes: inputs read + outputs written (+ params read), sharded
-        io_elems = sum(math.prod(t.shape) for t in op.inputs)
-        io_elems += math.prod(op.outputs[0].shape)
-        io_bytes = 4.0 * io_elems / max(pc.num_parts, 1)
+        # bytes: inputs read + outputs written (+ params read), sharded;
+        # dtype-aware (activations stream at compute-dtype width)
+        io_bytes = sum(self.tensor_bytes(t) for t in op.inputs)
+        io_bytes += self.tensor_bytes(op.outputs[0])
+        io_bytes /= max(pc.num_parts, 1)
         # params: bytes this shard actually streams per step (a sparse-
         # update embedding touches only its gathered rows, not the
         # multi-GB table)
@@ -135,45 +169,169 @@ class CostModel:
             flops *= 2.0
             io_bytes *= 2.0
         t = max(flops / self._flops_rate(), io_bytes / self._hbm_rate())
+        # random-row HBM accesses (embedding gathers) are latency-bound,
+        # not bandwidth-bound — the dominant term for sparse ops
+        rand_rows = op.random_hbm_rows(backward) / max(pc.num_parts, 1)
+        t = max(t, self.random_rows_time(rand_rows))
         return t + self.spec.kernel_launch_s
 
+    def random_rows_time(self, rows: float) -> float:
+        if rows <= 0:
+            return 0.0
+        return (self.spec.hbm_random_fixed_s
+                + rows * self.spec.hbm_random_row_s)
+
+    def tensor_bytes(self, t) -> float:
+        """Dtype-aware byte size: float activations flow in the model's
+        compute dtype (bf16 halves comm/IO vs the old flat 4 B/elem);
+        integer tensors (indices) keep their declared dtype."""
+        dt = jnp.dtype(t.dtype)
+        if jnp.issubdtype(dt, jnp.floating):
+            dt = jnp.dtype(self.compute_dtype)
+        return float(math.prod(t.shape)) * dt.itemsize
+
     # ---- comm -----------------------------------------------------------
-    def _ici_allreduce_bw(self) -> float:
+    # The reference prices inter-GPU and inter-node transfers distinctly
+    # (simulator.cu:27-29: 20 MB/ms NVLink, 12/numNodes MB/ms inter-node)
+    # and gives each GPU its own comm devices (simulator.cu:21-76). The
+    # TPU analog: per-MESH-AXIS channels — a collective over an "ici" axis
+    # rides that axis's torus links at ring-allreduce bandwidth, a
+    # collective over the "dcn" (multi-slice) axis rides the data-center
+    # network. Collectives on different axes use disjoint links and run
+    # concurrently; collectives on the same axis contend (the Simulator
+    # serializes them on the axis's channel).
+
+    def axis_bw(self, kind: str) -> float:
+        if kind == "dcn":
+            return self.spec.dcn_bytes_per_s
         # bidirectional ring over ICI: effective algorithm bandwidth
         return self.spec.ici_bytes_per_s * self.spec.ici_links
 
+    def allreduce_time_axes(self, bytes_per_dev: float, axes) -> float:
+        """Hierarchical ring all-reduce over `axes` = [(kind, size), ...]:
+        phase i moves 2·B·(n−1)/n at its axis's bandwidth, with B shrinking
+        by each completed phase's factor (reduce-scatter hierarchy)."""
+        t, b = 0.0, float(bytes_per_dev)
+        for kind, size in axes:
+            if size <= 1:
+                continue
+            t += 2.0 * b * (size - 1) / size / self.axis_bw(kind)
+            b /= size
+        return t
+
+    def _ici_allreduce_bw(self) -> float:
+        return self.axis_bw("ici")
+
     def resharding_time(self, tensor_bytes: float, src_pc: ParallelConfig,
-                        dst_pc: ParallelConfig) -> float:
+                        dst_pc: ParallelConfig,
+                        kind: str = "ici") -> float:
         """Cost of moving a tensor from the producer's sharding to the
         consumer's (the reference gets this implicitly from Legion region
-        intersections, simulator.cc:279-326; GSPMD emits collectives)."""
+        intersections, simulator.cc:279-326; GSPMD emits collectives).
+        `kind` picks the channel the move rides ("dcn" when the redistri-
+        bution crosses the slice axis)."""
         if src_pc.degrees == dst_pc.degrees:
             return 0.0
         # approximate: every device re-reads its destination shard from
-        # peers — an all-to-all of the full tensor over ICI
+        # peers — an all-to-all of the full tensor over the channel
         moved = tensor_bytes * (1.0 - 1.0 / max(src_pc.num_parts,
                                                 dst_pc.num_parts, 1))
-        return moved / self._ici_allreduce_bw()
+        return moved / self.axis_bw(kind)
 
-    def grad_sync_time(self, param_bytes: float, replicas: int) -> float:
+    def grad_sync_time(self, param_bytes: float, replicas: int,
+                       kind: str = "ici") -> float:
         """All-reduce of a parameter's gradient across `replicas`
         data-parallel parts (reference: replica regions gathered into the
         optimizer task, optimizer_kernel.cu:98-104; here a psum ring)."""
         if replicas <= 1:
             return 0.0
         moved = 2.0 * param_bytes * (replicas - 1) / replicas
-        return moved / self._ici_allreduce_bw()
+        return moved / self.axis_bw(kind)
 
     # ---- measured calibration ------------------------------------------
-    def measure_op(self, op: Op, pc: ParallelConfig) -> float:
-        """Time the op's compiled XLA computation for its shard shape on
-        the real device (reference Op::measure_compute_time, e.g.
-        linear.cu:973-1049: warmup 5 / repeat 10). Memoized."""
+    # in-graph repetitions per measurement: on a tunneled PJRT device the
+    # residual dispatch jitter is ~ms, so per-op resolution needs a long
+    # in-graph loop to amortize below the op times being measured
+    _REPEATS = 128
+
+    def _dispatch_overhead(self) -> float:
+        """One-time estimate of per-dispatch wall overhead (a tunneled /
+        remote PJRT device costs milliseconds per execute call — that is
+        harness overhead, not kernel time, and must be subtracted)."""
+        key = ("dispatch_overhead",)
+        if key in self._cache:
+            return self._cache[key]
+        import time
+
+        import jax
+        f = jax.jit(lambda x: x + 1.0)
+        x = jnp.zeros((8,), jnp.float32)
+        float(f(x)[0])
+        t0 = time.perf_counter()
+        out = x
+        for _ in range(10):
+            out = f(out)
+        float(out[0])   # dependent readback = true completion
+        dt = (time.perf_counter() - t0) / 10
+        self._cache[key] = dt
+        return dt
+
+    def _time_fn(self, make_out, params, xs) -> float:
+        """Median-of-3 wall time of ONE application of `make_out`, measured
+        as an in-graph lax.scan of _REPEATS applications inside a single
+        dispatch (the XLA analog of the reference's warmup-5/repeat-10 raw
+        kernel loops, simulator.cu:25). The scan body perturbs a float
+        input with the carry so XLA cannot hoist the op out of the loop."""
         import time
 
         import jax
 
-        key = ("measured", op.name, pc.degrees)
+        n = self._REPEATS
+
+        def loop(p, xs_):
+            def body(acc, _):
+                eps = (acc * 1e-38).astype(jnp.float32)
+                # perturb the first float operand (or param) with the
+                # carry: a data dependence the compiler cannot remove
+                pxs, bumped = [], False
+                for x in xs_:
+                    if not bumped and jnp.issubdtype(x.dtype, jnp.floating):
+                        x = x + eps.astype(x.dtype)
+                        bumped = True
+                    pxs.append(x)
+                pp = p
+                if not bumped and p:
+                    pp = dict(p)
+                    k0 = next(iter(pp))
+                    pp[k0] = pp[k0] + eps.astype(pp[k0].dtype)
+                out = make_out(pp, pxs)
+                leaf = jax.tree.leaves(out)[0]
+                return acc + leaf.reshape(-1)[0].astype(jnp.float32), None
+
+            acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                  None, length=n)
+            return acc
+
+        f = jax.jit(loop)
+        float(f(params, xs))  # compile + warmup
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(f(params, xs))
+            times.append(time.perf_counter() - t0)
+        dt = sorted(times)[1]
+        return max((dt - self._dispatch_overhead()) / n, 1e-9)
+
+    def measure_op(self, op: Op, pc: ParallelConfig,
+                   backward: bool = False) -> float:
+        """Time the op's compiled XLA computation for its shard shape on
+        the real device (reference Op::measure_compute_time, e.g.
+        linear.cu:973-1049: warmup 5 / repeat 10 — forward and backward
+        are measured SEPARATELY there too). Backward is measured as
+        (fwd+vjp) − fwd on the op subgraph. Memoized."""
+        import jax
+
+        key = ("measured", op.name, pc.degrees, backward)
         if key in self._cache:
             return self._cache[key]
         # inputs and params are built at the per-device shapes the op
@@ -183,24 +341,39 @@ class CostModel:
         params = ({n: jnp.zeros(s, jnp.float32)
                    for n, s in op.param_shard_shapes(pc).items()}
                   if op.param_defs() else {})
-        xs = [jnp.zeros(s, t.dtype) for s, t in zip(shard_shapes, op.inputs)]
-        fn = jax.jit(lambda p, xs_: op.apply(p, xs_, training=False))
+        # mirror _forward_env: NHWC-opted-in ops see the producer's NHWC
+        # physical form; everything else gets logical NCHW
+        accepts_nhwc = getattr(op, "_accepts_nhwc_inputs", False)
+
+        def _phys(s, t):
+            if (accepts_nhwc and len(s) == 4
+                    and getattr(t, "physical", None) == "nhwc"):
+                return (s[0], s[2], s[3], s[1])
+            return s
+        xs = [jnp.zeros(_phys(s, t), t.dtype)
+              for s, t in zip(shard_shapes, op.inputs)]
         try:
-            fn(params, xs)  # compile+warmup
-            for _ in range(4):
-                fn(params, xs)
-            jax.block_until_ready(fn(params, xs))
-            t0 = time.perf_counter()
-            for _ in range(10):
-                out = fn(params, xs)
-            jax.block_until_ready(out)
-            dt = (time.perf_counter() - t0) / 10
+            t_fwd = self._time_fn(
+                lambda p, xs_: op.apply(p, xs_, training=False), params, xs)
+            if not backward:
+                dt = t_fwd
+            else:
+                def fwdbwd(p, xs_):
+                    y, vjp = jax.vjp(
+                        lambda p2, x2: op.apply(p2, x2, training=True),
+                        p, xs_)
+                    return vjp(jax.tree.map(jnp.ones_like, y))
+                t_both = self._time_fn(fwdbwd, params, xs)
+                # floor at the analytical fwd/bwd ratio's spirit: vjp can't
+                # be cheaper than re-running forward
+                dt = max(t_both - t_fwd, 0.5 * t_fwd)
         except Exception as e:
             # degrade loudly: a silent fallback would let --measure-ops
             # quietly become the roofline it was meant to replace
-            dt = self._roofline_time(op, pc)
+            dt = self._roofline_time(op, pc, backward)
             log_sim.warning(
-                "measure_op(%s, %s) failed (%r); using roofline %.3es",
-                op.name, pc.degrees, e, dt)
+                "measure_op(%s, %s, backward=%s) failed (%r); "
+                "using roofline %.3es",
+                op.name, pc.degrees, backward, e, dt)
         self._cache[key] = dt
         return dt

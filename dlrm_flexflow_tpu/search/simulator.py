@@ -7,11 +7,22 @@ compute and comm devices; weight sync modeled either overlapped with
 compute or bulk-synchronous behind a barrier, simulator.cc:327-408).
 
 The algorithm is pure logic (no CUDA) and ports directly; what changes is
-the device graph: instead of per-GPU compute devices + DRAM hops, the
-devices are (a) one SPMD compute stream per mesh device and (b) one shared
-ICI collective channel (XLA overlaps async collectives with compute, which
-the event-driven queue models naturally by putting comm tasks on the
-channel device). Costs come from search/cost_model.py.
+the device graph. The reference gives each GPU its own comm devices and
+prices inter-node hops separately (simulator.cu:21-76, 27-29:
+GPU→DRAM→DRAM→GPU at 12/numNodes MB/ms). The TPU analog here:
+
+- one SPMD compute stream per mesh device, and
+- one comm channel PER MESH AXIS: a collective over an "ici" axis rides
+  that torus dimension's links, a collective over the "dcn" (multi-slice)
+  axis rides the data-center network at TPUSpec.dcn_bytes_per_s.
+  Collectives on different axes use disjoint links and run concurrently;
+  collectives contending for the same axis serialize on its channel —
+  replacing round 1's single shared COMM_DEVICE, which serialized
+  everything and priced DCN at ICI rates.
+
+Degrees map to axes exactly as parallel.sharding.AxisAssigner does at
+compile time (consume consecutive axes in order), so the simulator prices
+the same collectives GSPMD will emit. Costs come from search/cost_model.py.
 """
 
 from __future__ import annotations
@@ -19,13 +30,17 @@ from __future__ import annotations
 import heapq
 import math
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..core.op import InputOp, Op
 from ..parallel.pconfig import ParallelConfig, StrategyMap
 from .cost_model import CostModel
 
-COMM_DEVICE = -1  # the ICI channel pseudo-device
+COMM_DEVICE = -1  # flat-topology fallback channel (axis 0)
+
+
+def _axis_kind(name: str) -> str:
+    return "dcn" if str(name).startswith("dcn") else "ici"
 
 
 @dataclass
@@ -46,23 +61,71 @@ class SimTask:
 
 class Simulator:
     """Builds the per-iteration task graph for a model + strategy and
-    simulates its makespan (reference Simulator::simulate_runtime)."""
+    simulates its makespan (reference Simulator::simulate_runtime).
+
+    `topology` describes the simulated machine as [(axis_name, size), ...]
+    in AxisAssigner order; axis names starting with "dcn" are priced at
+    DCN bandwidth. Default: the model's mesh axes when the mesh matches
+    the simulated device count, else one flat ICI axis.
+    """
 
     def __init__(self, model, cost_model: Optional[CostModel] = None,
-                 overlap_weight_sync: bool = True):
+                 overlap_weight_sync: bool = True,
+                 topology: Optional[Sequence[Tuple[str, int]]] = None):
         self.model = model
         self.cost = cost_model or CostModel(
             compute_dtype=model.config.jnp_compute_dtype)
         self.overlap_weight_sync = overlap_weight_sync
+        self.topology = list(topology) if topology is not None else None
 
-    # ------------------------------------------------------------------
-    def _participants(self, pc: ParallelConfig, ndev: int) -> List[int]:
-        """SPMD: every op runs on all devices, but an op whose config uses
-        fewer parts than devices leaves the rest idle for its duration —
-        modeled by placing tasks only on the participating devices."""
-        return list(range(min(pc.num_parts, ndev)))
+    # ---- topology ----------------------------------------------------
+    def _topo(self, ndev: int) -> List[Tuple[str, int]]:
+        if self.topology is not None:
+            return self.topology
+        mesh = self.model.mesh
+        if mesh is not None and mesh.size == ndev:
+            return [(a, int(mesh.shape[a])) for a in mesh.axis_names]
+        return [("ici", ndev)]
+
+    @staticmethod
+    def _assign(degrees: Sequence[int],
+                topo: Sequence[Tuple[str, int]]
+                ) -> Optional[List[Tuple[int, ...]]]:
+        """Per-dim axis-index assignment — the SAME algorithm compile-time
+        sharding uses (parallel.sharding.assign_indices), so the simulator
+        prices exactly the collectives GSPMD will emit."""
+        from ..parallel.sharding import assign_indices
+        return assign_indices(degrees, [s for _, s in topo])
+
+    @staticmethod
+    def _channel(axis_idx: int) -> int:
+        """Comm pseudo-device id for a mesh axis (compute devices are >=0)."""
+        return -(axis_idx + 1)
+
+    def _reshard_spec(self, src_pc: ParallelConfig, dst_pc: ParallelConfig,
+                      topo) -> Optional[Tuple[str, int]]:
+        """(kind, channel) the src→dst redistribution rides: the slowest
+        axis whose per-dim assignment changes. None = layouts agree."""
+        if src_pc.degrees == dst_pc.degrees:
+            return None
+        sa = self._assign(src_pc.degrees, topo)
+        da = self._assign(dst_pc.degrees, topo)
+        if sa is None or da is None:
+            return ("ici", COMM_DEVICE)
+        nd = max(len(sa), len(da))
+        sa += [()] * (nd - len(sa))
+        da += [()] * (nd - len(da))
+        involved = set()
+        for s, d in zip(sa, da):
+            involved |= set(s) ^ set(d)
+        if not involved:
+            return None
+        dcn = [i for i in involved if _axis_kind(topo[i][0]) == "dcn"]
+        idx = dcn[0] if dcn else min(involved)
+        return (_axis_kind(topo[idx][0]), self._channel(idx))
 
     def build_task_graph(self, strategies: StrategyMap, ndev: int):
+        topo = self._topo(ndev)
         ops = [op for op in self.model.ops if not isinstance(op, InputOp)]
         tasks: List[SimTask] = []
         fwd_of: Dict[str, List[SimTask]] = {}
@@ -72,6 +135,18 @@ class Simulator:
             t = SimTask(run_time=rt, device=dev, name=name)
             tasks.append(t)
             return t
+
+        def reshard_task(tensor, src_pc, dst_pc, name):
+            spec = self._reshard_spec(src_pc, dst_pc, topo)
+            if spec is None:
+                return None
+            kind, chan = spec
+            bytes_ = self.cost.tensor_bytes(tensor)
+            comm_t = self.cost.resharding_time(bytes_, src_pc, dst_pc,
+                                               kind=kind)
+            if comm_t <= 0:
+                return None
+            return new_task(comm_t, chan, name)
 
         # forward tasks per op per participating device
         for op in ops:
@@ -84,11 +159,9 @@ class Simulator:
                 if src.owner_op is None or isinstance(src.owner_op, InputOp):
                     continue
                 src_pc = strategies[src.owner_op.name]
-                bytes_ = math.prod(src.shape) * 4.0
-                comm_t = self.cost.resharding_time(bytes_, src_pc, pc)
-                if comm_t > 0:
-                    c = new_task(comm_t, COMM_DEVICE,
+                c = reshard_task(src, src_pc, pc,
                                  f"reshard:{src.owner_op.name}->{op.name}")
+                if c is not None:
                     for ft in fwd_of[src.owner_op.name]:
                         ft.add_next(c)
                     for ft in fwd_of[op.name]:
@@ -116,14 +189,10 @@ class Simulator:
                     consumers.setdefault(src.owner_op.name, []).append(op)
         for op in ops:
             for cons in consumers.get(op.name, []):
-                src_pc = strategies[cons.name]
-                dst_pc = strategies[op.name]
-                bytes_ = math.prod(op.outputs[0].shape) * 4.0
-                comm_t = self.cost.resharding_time(bytes_, src_pc, dst_pc)
-                if comm_t > 0:
-                    c = SimTask(run_time=comm_t, device=COMM_DEVICE,
-                                name=f"reshard_grad:{cons.name}->{op.name}")
-                    tasks.append(c)
+                c = reshard_task(op.outputs[0], strategies[cons.name],
+                                 strategies[op.name],
+                                 f"reshard_grad:{cons.name}->{op.name}")
+                if c is not None:
                     for bt in bwd_of[cons.name]:
                         bt.add_next(c)
                     for bt in bwd_of[op.name]:
@@ -139,48 +208,75 @@ class Simulator:
                 continue
             pc = strategies[op.name]
             replicas = pc.degrees[0] if pc.degrees else 1
-            # per-device bytes: dense params are sharded over the
-            # non-sample degrees; sparse-update embeddings stream only
-            # their touched rows (min() picks whichever applies)
             # per-device parameter traffic: the op-declared shard shapes
             # (every TP-capable op overrides param_shard_shapes; a config
             # that replicates params — e.g. conv spatial splits — keeps
             # full shapes) or touched-rows sparse updates, whichever is
-            # tighter
+            # tighter. Params/grads sync in fp32.
             shard_bytes = sum(
                 math.prod(shape) * 4.0
                 for shape in op.param_shard_shapes(pc, ndev).values())
             touched = op.param_bytes_touched_per_step(max(pc.num_parts, 1))
             dev_bytes = min(shard_bytes, touched)
-            sync_t = self.cost.grad_sync_time(dev_bytes, replicas)
-            upd_compute = dev_bytes / self.cost._hbm_rate() * 3.0  # r/w+mom
-            if sync_t > 0:
-                s = SimTask(run_time=sync_t, device=COMM_DEVICE,
-                            name=f"allreduce:{op.name}")
-                tasks.append(s)
-                for bt in bwd_of[op.name]:
-                    bt.add_next(s)
-                parents = [s]
-            else:
-                parents = bwd_of[op.name]
+            # the DP all-reduce rides the axes assigned to the sample dim —
+            # a hierarchical chain, one task per axis on that axis's
+            # channel (phases over different axes of different ops overlap)
+            asn = self._assign(pc.degrees, topo)
+            parents: List[SimTask] = list(bwd_of[op.name])
+            if replicas > 1:
+                if asn is not None and asn[0]:
+                    b = float(dev_bytes)
+                    for ax in asn[0]:
+                        kind, size = _axis_kind(topo[ax][0]), topo[ax][1]
+                        ph = self.cost.allreduce_time_axes(b, [(kind, size)])
+                        if ph <= 0:
+                            continue
+                        s = new_task(ph, self._channel(ax),
+                                     f"allreduce[{topo[ax][0]}]:{op.name}")
+                        for p in parents:
+                            p.add_next(s)
+                        parents = [s]
+                        b /= size
+                else:
+                    sync_t = self.cost.grad_sync_time(dev_bytes, replicas)
+                    if sync_t > 0:
+                        s = new_task(sync_t, COMM_DEVICE,
+                                     f"allreduce:{op.name}")
+                        for p in parents:
+                            p.add_next(s)
+                        parents = [s]
+            upd_compute = max(
+                dev_bytes / self.cost._hbm_rate() * 3.0,   # r/w+momentum
+                # sparse touched-rows RMW is random-access latency bound
+                self.cost.random_rows_time(
+                    op.update_random_hbm_rows() / max(pc.num_parts, 1)))
             for d in self._participants(pc, ndev):
-                u = SimTask(run_time=upd_compute, device=d,
-                            name=f"update:{op.name}")
-                tasks.append(u)
+                u = new_task(upd_compute, d, f"update:{op.name}")
                 for p in parents:
                     p.add_next(u)
         return tasks
 
     # ------------------------------------------------------------------
+    def _participants(self, pc: ParallelConfig, ndev: int) -> List[int]:
+        """SPMD: every op runs on all devices, but an op whose config uses
+        fewer parts than devices leaves the rest idle for its duration —
+        modeled by placing tasks only on the participating devices."""
+        return list(range(min(pc.num_parts, ndev)))
+
     def fits_memory(self, strategies: StrategyMap, ndev: int) -> bool:
         """Per-device parameter bytes (at each op's sharded shapes) must
-        fit the chip's HBM, with 25% headroom for activations/temps."""
+        fit the chip's HBM, with 25% headroom for activations/temps.
+        Host-resident tables (CPU/ZCM strategies) live in host RAM and
+        don't count — the capability that lets DLRM-Terabyte run on few
+        chips (reference dlrm_strategy_hetero.cc:28-49)."""
         total = 0.0
         for op in self.model.ops:
             if isinstance(op, InputOp) or not op.param_defs():
                 continue
             pc = strategies.get(op.name)
             if pc is None:
+                continue
+            if self.cost._host_resident(op, pc):
                 continue
             for shape in op.param_shard_shapes(pc, ndev).values():
                 total += math.prod(shape) * 4.0
@@ -198,7 +294,6 @@ class Simulator:
         Python loop below is the reference semantics and the fallback.
         """
         if ndev is None:
-            import numpy as np
             ndev = int(math.prod(
                 [self.model.mesh.shape[a] for a in self.model.mesh.axis_names])
             ) if self.model.mesh else 1

@@ -52,6 +52,9 @@ def save_checkpoint(model, path: str):
                  for k, v in _flatten(model.opt_state).items()})
     flat.update({f"state/{k}": v
                  for k, v in _flatten(model.op_state).items()})
+    flat.update({f"hostparams/{k}": v
+                 for k, v in _flatten(
+                     getattr(model, "host_params", {}) or {}).items()})
     flat["meta/step"] = np.asarray(model._step)
     np.savez(path, **flat)
 
@@ -60,7 +63,7 @@ def restore_checkpoint(model, path: str):
     """Restore into a compiled model, re-applying each parameter's GSPMD
     sharding."""
     data = np.load(path if path.endswith(".npz") else path + ".npz")
-    params_flat, opt_flat, state_flat = {}, {}, {}
+    params_flat, opt_flat, state_flat, host_flat = {}, {}, {}, {}
     for k in data.files:
         if k.startswith("params/"):
             params_flat[k[len("params/"):]] = data[k]
@@ -68,6 +71,8 @@ def restore_checkpoint(model, path: str):
             opt_flat[k[len("opt/"):]] = data[k]
         elif k.startswith("state/"):
             state_flat[k[len("state/"):]] = data[k]
+        elif k.startswith("hostparams/"):
+            host_flat[k[len("hostparams/"):]] = data[k]
     params = _unflatten(params_flat)
     # validate against the model's parameter spec before overwriting
     # anything: a mismatch (e.g. a checkpoint from a per-table or
@@ -101,6 +106,9 @@ def restore_checkpoint(model, path: str):
     model.params = params
     model.opt_state = jax.tree.map(jax.device_put, _unflatten(opt_flat))
     model.op_state = jax.tree.map(jax.device_put, _unflatten(state_flat))
+    if host_flat:
+        # host-resident tables stay numpy on the host — no device_put
+        model.host_params = _unflatten(host_flat)
     model._step = int(data["meta/step"])
     return model
 

@@ -20,16 +20,21 @@ from dlrm_flexflow_tpu.parallel.mesh import make_mesh
 from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
 
 
-def _train_dlrm(ndev, strategies=None, steps=3, fuse=True):
-    dcfg = DLRMConfig(embedding_size=[64] * 8, sparse_feature_size=8,
-                      mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1])
+def _build_dlrm_model(dcfg, ndev, strategies=None, fuse=True, momentum=0.9):
     model = ff.FFModel(ff.FFConfig(batch_size=16, seed=7))
     build_dlrm(model, dcfg, fuse_embeddings=fuse)
     strat = strategies(model, dcfg, ndev) if callable(strategies) else strategies
-    model.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
+    model.compile(ff.SGDOptimizer(lr=0.1, momentum=momentum),
                   "mean_squared_error", ["mse"],
                   mesh=make_mesh(num_devices=ndev), strategies=strat)
     model.init_layers()
+    return model
+
+
+def _train_dlrm(ndev, strategies=None, steps=3, fuse=True):
+    dcfg = DLRMConfig(embedding_size=[64] * 8, sparse_feature_size=8,
+                      mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1])
+    model = _build_dlrm_model(dcfg, ndev, strategies, fuse)
     for s in range(steps):
         x, y = synthetic_batch(dcfg, 16, seed=s)
         x["label"] = y
@@ -71,11 +76,91 @@ def test_tensor_parallel_linear_matches():
     _assert_tree_close(single, multi)
 
 
-def test_per_table_embeddings_match_fused():
-    """Unfused per-table path trains equivalently shaped params sanely
-    (different param trees, so compare final loss trajectory instead)."""
-    p1 = _train_dlrm(8, fuse=False, strategies=dlrm_strategy)
-    assert all(np.isfinite(x).all() for x in jax.tree.leaves(p1))
+def _sync_params_unfused_to_fused(unfused, fused):
+    """Re-key the unfused model's initial params onto the fused model's
+    layout: per-table kernels stack/concatenate into the fused op's packed
+    kernel (via its pack_kernel), MLP params copy by name."""
+    import jax.numpy as jnp
+    fop = next(op for op in fused.ops
+               if op.name in ("emb_stack", "emb_concat"))
+    T = fop.num_tables
+    tables = [np.asarray(unfused.params[f"emb_{i}"]["kernel"])
+              for i in range(T)]
+    if fop.type_name == "EmbedStack":
+        logical = jnp.stack([jnp.asarray(t) for t in tables])
+    else:
+        pad = fop.total_rows - sum(t.shape[0] for t in tables)
+        parts = [jnp.asarray(t) for t in tables]
+        if pad:
+            parts.append(jnp.zeros((pad, fop.out_dim), jnp.float32))
+        logical = jnp.concatenate(parts)
+    new = {k: dict(v) for k, v in fused.params.items()}
+    shards = fused._param_sharding
+    new[fop.name] = {"kernel": jax.device_put(
+        fop.pack_kernel(logical), shards.get(fop.name, {}).get("kernel"))}
+    for name, pdict in unfused.params.items():
+        if name.startswith("emb_"):
+            continue
+        new[name] = {k: jax.device_put(jnp.asarray(np.asarray(v)),
+                                       shards.get(name, {}).get(k))
+                     for k, v in pdict.items()}
+    fused.params = new
+    fused.opt_state = fused.optimizer.init_state(new)
+    return fop
+
+
+def _fused_vs_unfused(dcfg, steps=3):
+    """Train the unfused per-table and fused forms from IDENTICAL initial
+    params on the same data (plain SGD → both take the sparse touched-rows
+    path) and assert table-by-table + MLP equality."""
+    unfused = _build_dlrm_model(dcfg, 8, dlrm_strategy, fuse=False,
+                                momentum=0.0)
+    fused = _build_dlrm_model(dcfg, 8, dlrm_strategy, fuse=True,
+                              momentum=0.0)
+    fop = _sync_params_unfused_to_fused(unfused, fused)
+    for s in range(steps):
+        x, y = synthetic_batch(dcfg, 16, seed=s)
+        x["label"] = y
+        unfused.train_batch(dict(x))
+        fused.train_batch(dict(x))
+    T = fop.num_tables
+    logical = np.asarray(fop.unpack_kernel(fused.params[fop.name]["kernel"]))
+    off = 0
+    for i in range(T):
+        rows = dcfg.embedding_size[i]
+        if fop.type_name == "EmbedStack":
+            ftab = logical[i]
+        else:
+            ftab = logical[off:off + rows]
+            off += rows
+        utab = np.asarray(unfused.params[f"emb_{i}"]["kernel"])
+        np.testing.assert_allclose(ftab, utab, rtol=2e-4, atol=2e-5,
+                                   err_msg=f"table {i}")
+    for name, pdict in unfused.params.items():
+        if name.startswith("emb_"):
+            continue
+        for k, v in pdict.items():
+            np.testing.assert_allclose(
+                np.asarray(fused.params[name][k]), np.asarray(v),
+                rtol=2e-4, atol=2e-5, err_msg=f"{name}.{k}")
+
+
+def test_per_table_embeddings_match_fused_stacked():
+    """Unfused per-table ≡ fused stacked embedding, numerically, after
+    re-keying initial params onto the packed layout (catches offset /
+    lane-packing bugs the old finiteness check could not)."""
+    _fused_vs_unfused(DLRMConfig(
+        embedding_size=[64] * 8, sparse_feature_size=8,
+        mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1]))
+
+
+def test_per_table_embeddings_match_fused_concat():
+    """Unfused per-table ≡ fused concatenated-rows embedding (non-uniform
+    table sizes — exercises EmbeddingBagConcat._global_indices offsets)."""
+    _fused_vs_unfused(DLRMConfig(
+        embedding_size=[40, 7, 300, 12, 64, 5, 128, 9],
+        sparse_feature_size=8,
+        mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1]))
 
 
 def test_strategy_search_space_feasibility():

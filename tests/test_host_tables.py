@@ -1,0 +1,175 @@
+"""Host-RESIDENT embedding tables (reference hetero semantics: tables
+stored in CPU RAM and looked up there, embedding_avx2.cc +
+dlrm_strategy_hetero.cc:28-49): numerics must match the all-device path,
+the simulator must exempt host tables from HBM capacity, and ZCM
+memory_types in a strategy file must select the path per-op."""
+
+import numpy as np
+
+import jax
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                           synthetic_batch)
+from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
+
+
+def _dcfg(sizes=(64,) * 8):
+    return DLRMConfig(embedding_size=list(sizes), sparse_feature_size=8,
+                      mlp_bot=[4, 16, 8],
+                      mlp_top=[8 * (len(sizes) + 1), 16, 1])
+
+
+def _build(dcfg, host_tables=False, ndev=1, strategies=None):
+    cfg = ff.FFConfig(batch_size=16, seed=7,
+                      host_resident_tables=host_tables)
+    model = ff.FFModel(cfg)
+    build_dlrm(model, dcfg)
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+                  mesh=make_mesh(num_devices=ndev), strategies=strategies)
+    model.init_layers()
+    return model
+
+
+def _sync_tables(dev_model, host_model):
+    """Copy the device model's initial state into the host-table model."""
+    emb = next(op for op in host_model.ops
+               if op.name in host_model._host_resident_ops)
+    dev_op = next(op for op in dev_model.ops if op.name == emb.name)
+    logical = np.asarray(dev_op.unpack_kernel(
+        dev_model.params[emb.name]["kernel"]))
+    host_model.host_params[emb.name]["kernel"][:] = logical
+    for name, pdict in dev_model.params.items():
+        if name == emb.name:
+            continue
+        host_model.params[name] = {
+            k: jax.device_put(np.asarray(v),
+                              host_model._param_sharding.get(name, {}).get(k))
+            for k, v in pdict.items()}
+    host_model.opt_state = host_model.optimizer.init_state(host_model.params)
+    return emb
+
+
+def _train_steps(model, dcfg, steps=3):
+    for s in range(steps):
+        x, y = synthetic_batch(dcfg, 16, seed=s)
+        x["label"] = y
+        model.train_batch(dict(x))
+
+
+class TestHostResidentTables:
+    def test_numerics_match_device_path(self):
+        """Same data, same init: host-resident training == device training
+        (tables AND dense params), for the stacked uniform form."""
+        dcfg = _dcfg()
+        dev = _build(dcfg, host_tables=False)
+        host = _build(dcfg, host_tables=True)
+        emb = _sync_tables(dev, host)
+        assert emb.name in host._host_resident_ops
+        assert emb.name not in host.params
+        _train_steps(dev, dcfg)
+        _train_steps(host, dcfg)
+        dev_op = next(op for op in dev.ops if op.name == emb.name)
+        want = np.asarray(dev_op.unpack_kernel(
+            dev.params[emb.name]["kernel"]))
+        got = host.host_params[emb.name]["kernel"]
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+        for name, pdict in dev.params.items():
+            if name == emb.name:
+                continue
+            for k, v in pdict.items():
+                np.testing.assert_allclose(
+                    np.asarray(host.params[name][k]), np.asarray(v),
+                    rtol=2e-4, atol=2e-5, err_msg=f"{name}.{k}")
+
+    def test_numerics_match_device_path_concat(self):
+        """Non-uniform (concatenated-rows) form on the host path."""
+        dcfg = _dcfg((40, 7, 300, 12, 64, 5, 128, 9))
+        dev = _build(dcfg, host_tables=False)
+        host = _build(dcfg, host_tables=True)
+        emb = _sync_tables(dev, host)
+        _train_steps(dev, dcfg)
+        _train_steps(host, dcfg)
+        dev_op = next(op for op in dev.ops if op.name == emb.name)
+        want = np.asarray(dev_op.unpack_kernel(
+            dev.params[emb.name]["kernel"]))
+        got = host.host_params[emb.name]["kernel"]
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_zcm_memory_types_select_host_residency(self):
+        """Per-op ZCM memory_types in the strategy (strategy.proto:11-14)
+        put that op's table on the host without the global flag."""
+        dcfg = _dcfg()
+        model = ff.FFModel(ff.FFConfig(batch_size=16, seed=7))
+        build_dlrm(model, dcfg)
+        strat = {"emb_stack": ParallelConfig((1, 1, 1),
+                                             memory_types=("ZCM",))}
+        model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                      ["mse"], mesh=make_mesh(num_devices=1),
+                      strategies=strat)
+        model.init_layers()
+        assert "emb_stack" in model._host_resident_ops
+        assert "emb_stack" in model.host_params
+        _train_steps(model, dcfg, steps=2)
+        assert np.isfinite(
+            model.host_params["emb_stack"]["kernel"]).all()
+
+    def test_eval_works_with_host_tables(self):
+        dcfg = _dcfg()
+        model = _build(dcfg, host_tables=True)
+        x, _ = synthetic_batch(dcfg, 16)
+        out = np.asarray(model.forward_batch(x))
+        assert out.shape == (16, 1) and np.isfinite(out).all()
+
+    def test_checkpoint_roundtrip_host_tables(self, tmp_path):
+        from dlrm_flexflow_tpu.utils.checkpoint import (restore_checkpoint,
+                                                        save_checkpoint)
+        dcfg = _dcfg()
+        model = _build(dcfg, host_tables=True)
+        _train_steps(model, dcfg, steps=1)
+        want = model.host_params["emb_stack"]["kernel"].copy()
+        path = str(tmp_path / "ck.npz")
+        save_checkpoint(model, path)
+        model.host_params["emb_stack"]["kernel"][:] = 0
+        restore_checkpoint(model, path)
+        np.testing.assert_array_equal(
+            model.host_params["emb_stack"]["kernel"], want)
+
+    def test_momentum_rejected(self):
+        import pytest
+        dcfg = _dcfg()
+        cfg = ff.FFConfig(batch_size=16, host_resident_tables=True)
+        model = ff.FFModel(cfg)
+        build_dlrm(model, dcfg)
+        with pytest.raises(ValueError, match="plain SGD"):
+            model.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
+                          "mean_squared_error", ["mse"],
+                          mesh=make_mesh(num_devices=1))
+
+
+def test_simulator_host_tables_unlock_terabyte():
+    """The HBM-capacity model: DP with device tables is infeasible for
+    Terabyte-scale tables on one chip, but a CPU/ZCM strategy (host
+    residency) is feasible and finite — and prices the PCIe hop."""
+    from dlrm_flexflow_tpu.search.mcmc import default_strategy
+    from dlrm_flexflow_tpu.search.simulator import Simulator
+
+    dcfg = DLRMConfig.terabyte()
+    model = ff.FFModel(ff.FFConfig(batch_size=256,
+                                   compute_dtype="bfloat16"))
+    build_dlrm(model, dcfg)
+    model.mesh = make_mesh(num_devices=1)
+    sim = Simulator(model)
+    dp = default_strategy(model, 1)
+    t_dev = sim.simulate(dp, 1)
+    assert t_dev == float("inf"), "device-resident Terabyte must not fit"
+    emb_name = next(op.name for op in model.ops
+                    if hasattr(op, "host_lookup"))
+    host = dict(dp)
+    nd = next(op for op in model.ops
+              if op.name == emb_name).outputs[0].num_dims
+    host[emb_name] = ParallelConfig((1,) * nd, device_type="CPU",
+                                    memory_types=("ZCM",))
+    t_host = sim.simulate(host, 1)
+    assert np.isfinite(t_host) and t_host > 0

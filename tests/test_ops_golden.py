@@ -272,7 +272,8 @@ def test_batchnorm_training_matches_torch():
     tbn = torch.nn.BatchNorm2d(5, eps=1e-5, momentum=0.1)
     tbn.train()
     ty = tbn(torch.tensor(x))
-    assert_close(np.asarray(env[out_t.guid]), ty.detach().numpy(),
+    assert_close(np.asarray(model.to_logical(env[out_t.guid], out_t)),
+                 ty.detach().numpy(),
                  rtol=1e-4, atol=1e-4, label="bn train fwd")
     # running stats: torch uses momentum=0.1 on NEW value (ours: 0.9 on old)
     assert_close(np.asarray(new_state["bn"]["running_mean"]),

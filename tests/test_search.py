@@ -168,6 +168,45 @@ def test_measured_cost_model_search():
     assert any(k[0] == "measured" for k in cm._cache)
 
 
+def test_dcn_crossslice_allreduce_priced_higher():
+    """A 2-slice mesh prices a cross-slice (DCN) all-reduce far above an
+    in-slice (ICI) one of the same bytes (reference prices inter-node at
+    12/numNodes MB/ms vs 20 NVLink, simulator.cu:27-29)."""
+    cm = CostModel()
+    nbytes = 64e6
+    t_dcn = cm.allreduce_time_axes(nbytes, [("dcn", 2)])
+    t_ici = cm.allreduce_time_axes(nbytes, [("ici", 4)])
+    assert t_dcn > 3 * t_ici, (t_dcn, t_ici)
+
+
+def test_hybrid_mesh_prefers_tp_inside_slices():
+    """On a 2-slice × 4-chip topology, channel-TP that spans the DCN axis
+    must simulate slower than the same TP kept inside slices (DP on DCN)."""
+    model, dcfg = _bench_model()
+    topo = [("dcn", 2), ("f0", 2), ("f1", 2)]
+    sim = Simulator(model, topology=topo)
+    base = default_strategy(model, 8)
+    inside = dict(base)
+    inside["top_dense_0"] = ff.ParallelConfig((2, 4))   # DP on dcn, TP ici
+    spanning = dict(base)
+    spanning["top_dense_0"] = ff.ParallelConfig((1, 8))  # TP spans dcn
+    t_in = sim.simulate(inside, 8)
+    t_span = sim.simulate(spanning, 8)
+    assert t_in < t_span, (t_in, t_span)
+
+
+def test_dp_sync_on_hybrid_topology_rides_dcn():
+    """Full-mesh DP gradient sync crosses the slice axis, so the hybrid
+    topology must price it above the same sync on a flat ICI mesh."""
+    model, _ = _bench_model()
+    model.optimizer = ff.SGDOptimizer(lr=0.1, momentum=0.9)  # dense sync
+    dp = default_strategy(model, 8)
+    t_flat = Simulator(model, topology=[("ici", 8)]).simulate(dp, 8)
+    t_hybrid = Simulator(
+        model, topology=[("dcn", 2), ("f0", 2), ("f1", 2)]).simulate(dp, 8)
+    assert t_hybrid > 1.5 * t_flat, (t_hybrid, t_flat)
+
+
 def test_config_flags():
     cfg = ff.FFConfig.parse_args(["--measure-ops", "--debug-nans",
                                   "--strict-strategies"])

@@ -1,0 +1,39 @@
+"""Simulator-vs-hardware calibration gate (VERDICT r1 item 1).
+
+Runs benchmarks/calibrate_sim.py on the REAL TPU and asserts the analytical
+(roofline) simulator matches measured step times within 35% on every point.
+Gated behind FF_TPU_TESTS=1 because the normal suite runs on the virtual
+CPU mesh (conftest.py) where there is no hardware to calibrate against;
+the round's recorded results live in benchmarks/sim_calibration.json and
+BENCHMARKS.md.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(os.environ.get("FF_TPU_TESTS") != "1",
+                    reason="needs the real TPU chip (set FF_TPU_TESTS=1)")
+def test_simulator_matches_hardware():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    out = os.path.join(REPO, "benchmarks", "sim_calibration.json")
+    if os.path.exists(out):
+        os.unlink(out)
+    subprocess.check_call(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "calibrate_sim.py")],
+        env=dict(env, CAL_STEPS="100"), cwd=REPO, timeout=3600)
+    rows = json.load(open(out))
+    assert len(rows) >= 5, "need >=5 calibration points"
+    for r in rows:
+        assert abs(r["err_roofline"]) <= 0.35, (
+            f"{r['point']}: simulated {r['sim_roofline_ms']:.2f} ms vs "
+            f"measured {r['measured_ms']:.2f} ms "
+            f"({r['err_roofline']:+.0%} > 35%)")
