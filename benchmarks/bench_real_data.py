@@ -58,7 +58,7 @@ def main():
     subprocess.check_call([sys.executable,
                            os.path.join(REPO, "examples", "native",
                                         "preprocess_hdf.py"),
-                           raw, "-o", h5])
+                           "-i", raw, "-o", h5])
 
     from dlrm_flexflow_tpu.data.dataloader import (load_dlrm_hdf5,
                                                    write_ffbin)

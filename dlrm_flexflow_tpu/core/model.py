@@ -607,7 +607,8 @@ class FFModel:
         constrain = jax.lax.with_sharding_constraint
         host_ops = getattr(self, "_host_offload_ops", set())
         for t in self.input_tensors:
-            env[t.guid] = batch[t.name]
+            if t.name in batch:   # host-only inputs are popped pre-jit
+                env[t.guid] = batch[t.name]
         for op in self.ops:
             if isinstance(op, InputOp):
                 continue
@@ -757,6 +758,20 @@ class FFModel:
                     f"host-resident table op {op.name!r}: aggr='none' "
                     f"(per-bag-slot outputs) is not implemented on the "
                     f"host path — use sum/avg or keep the table in HBM")
+        # inputs consumed ONLY by host-resident ops never need to touch the
+        # device: the wrapper reads them on the host for the gather/scatter
+        # and the jitted step sees only the override values
+        consumers_of: Dict[str, List[Op]] = {}
+        for op in self.ops:
+            if isinstance(op, InputOp):
+                continue
+            for t in op.inputs:
+                if t.owner_op is not None and isinstance(t.owner_op, InputOp):
+                    consumers_of.setdefault(t.name, []).append(op)
+        hres_names = {op.name for op in host_ops}
+        self._host_only_inputs = {
+            name for name, cons in consumers_of.items()
+            if cons and all(c.name in hres_names for c in cons)}
 
         def train_step(params, opt_state, op_state, msums, batch, step,
                        host_emb=None):
@@ -831,15 +846,11 @@ class FFModel:
             # a fresh host int every step would be one H2D transfer/step)
             return new_params, new_opt, st2, new_msums, step + 1, mets
 
-        preds_nhwc = self._preds_tensor.physical == "nhwc"
-
         def eval_step(params, op_state, batch, host_emb=None):
             env, _ = self._forward_env(params, op_state, batch, False, None,
                                        overrides=host_emb)
-            v = env[preds_guid]
-            if preds_nhwc:      # expose the user-facing logical NCHW form
-                v = jnp.transpose(v, (0, 3, 1, 2))
-            return v
+            # _env_preds exposes the user-facing logical NCHW form
+            return _env_preds(env)
 
         donate = (0, 1, 2, 3)
         self._train_step = jax.jit(train_step, donate_argnums=donate)
@@ -911,10 +922,16 @@ class FFModel:
     def _device_batch(self, batch: Dict[str, np.ndarray],
                       with_label: bool = True) -> Dict[str, Any]:
         out = {}
+        host_only = getattr(self, "_host_only_inputs", set())
         for t in self.input_tensors:
             if t.name in batch:
-                out[t.name] = jax.device_put(
-                    batch[t.name], self._out_sharding[t.guid])
+                if t.name in host_only:
+                    # consumed only by host-resident tables: stays numpy
+                    # (no H2D; the wrapper reads it for the host gather)
+                    out[t.name] = np.asarray(batch[t.name])
+                else:
+                    out[t.name] = jax.device_put(
+                        batch[t.name], self._out_sharding[t.guid])
         if with_label:
             lab = batch["label"]
             sh = self._label_sharding
@@ -943,14 +960,23 @@ class FFModel:
                 jnp.asarray(self._step, jnp.int32),
                 NamedSharding(self.mesh, PartitionSpec()))
         hres = getattr(self, "_host_resident_list", None)
-        args = (self.params, self.opt_state, self.op_state, self._msums,
-                device_batch, self._step_dev)
         host_idx = None
         if hres:
-            # one D2H index readback per step, shared by gather and scatter
-            host_idx = {op.name: np.asarray(
-                device_batch[op.inputs[0].name])
-                for op in hres}
+            # indices for host tables never ride PCIe: host-only inputs are
+            # kept numpy by _device_batch and popped before the jit call
+            # (np.asarray on an already-host array is free; on a staged
+            # device array it is the one unavoidable D2H)
+            device_batch = dict(device_batch)
+            host_idx = {}
+            for op in hres:
+                name = op.inputs[0].name
+                arr = device_batch[name]
+                host_idx[op.name] = np.asarray(arr)
+                if name in getattr(self, "_host_only_inputs", set()):
+                    device_batch.pop(name)
+        args = (self.params, self.opt_state, self.op_state, self._msums,
+                device_batch, self._step_dev)
+        if hres:
             args = args + (self._host_emb_forward(host_idx),)
         # hot loop: call the AOT-compiled executable directly — the pjit
         # python dispatch re-validates the big param pytree every call,
@@ -1041,8 +1067,13 @@ class FFModel:
         db = self._device_batch(batch, with_label=False)
         hres = getattr(self, "_host_resident_list", None)
         if hres:
-            host_idx = {op.name: np.asarray(db[op.inputs[0].name])
-                        for op in hres}
+            db = dict(db)
+            host_idx = {}
+            for op in hres:
+                name = op.inputs[0].name
+                host_idx[op.name] = np.asarray(db[name])
+                if name in getattr(self, "_host_only_inputs", set()):
+                    db.pop(name)
             return self._eval_step(self.params, self.op_state, db,
                                    self._host_emb_forward(host_idx))
         return self._eval_step(self.params, self.op_state, db)
@@ -1107,9 +1138,21 @@ class FFModel:
         first = {k: v[:bs] for k, v in inputs.items()}
         first["label"] = labels[:bs]
         db = self._device_batch(first)
-        self._train_step.lower(self.params, self.opt_state, self.op_state,
-                               self._zero_msums(), db,
-                               jnp.asarray(0, jnp.int32)).compile()
+        wargs = (self.params, self.opt_state, self.op_state,
+                 self._zero_msums(), db, jnp.asarray(0, jnp.int32))
+        hres = getattr(self, "_host_resident_list", None)
+        if hres:
+            db = dict(db)
+            hidx = {}
+            for op in hres:
+                name = op.inputs[0].name
+                hidx[op.name] = np.asarray(db[name])
+                if name in getattr(self, "_host_only_inputs", set()):
+                    db.pop(name)
+            wargs = (self.params, self.opt_state, self.op_state,
+                     self._zero_msums(), db, jnp.asarray(0, jnp.int32),
+                     self._host_emb_forward(hidx))
+        self._train_step.lower(*wargs).compile()
 
         if self.config.profiling:
             # per-op timing report (reference --profiling cudaEvent prints,

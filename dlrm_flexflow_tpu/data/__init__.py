@@ -1,5 +1,7 @@
-from .dataloader import (FFBinDataLoader, SingleDataLoader, load_dlrm_hdf5,
-                         write_ffbin)
+from .dataloader import (FFBinDataLoader, ImgDataLoader2D, ImgDataLoader4D,
+                         SingleDataLoader, load_dlrm_hdf5, write_ffbin,
+                         write_img_ffbin)
 
 __all__ = ["SingleDataLoader", "FFBinDataLoader", "write_ffbin",
+           "ImgDataLoader4D", "ImgDataLoader2D", "write_img_ffbin",
            "load_dlrm_hdf5"]

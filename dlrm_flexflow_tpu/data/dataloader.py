@@ -45,6 +45,8 @@ class SingleDataLoader:
                 f"dataset ({self.num_samples}) smaller than one batch "
                 f"({self.batch_size})")
         self._order = np.arange(self.num_samples)
+        if self.shuffle:
+            self.rng.shuffle(self._order)
         self._idx = 0
         self._prefetch = prefetch
         self._next: Optional[Dict] = None
@@ -72,13 +74,22 @@ class SingleDataLoader:
             self._thread.join()
             self._thread = None
 
-    def next_batch(self) -> Dict:
-        """Device-resident batch dict (reference next_batch(ff):
-        dlrm.cc:486-589). Wraps around at the end of the dataset."""
+    def next_host_batch(self) -> Dict[str, np.ndarray]:
+        """Next host-side (numpy) batch with full shuffle semantics."""
+        b = self._advance()
+        return self._host_batch(b)
+
+    def _advance(self) -> int:
         b = self._idx % self.num_batches
         if b == 0 and self._idx > 0 and self.shuffle:
             self.rng.shuffle(self._order)
         self._idx += 1
+        return b
+
+    def next_batch(self) -> Dict:
+        """Device-resident batch dict (reference next_batch(ff):
+        dlrm.cc:486-589). Wraps around at the end of the dataset."""
+        b = self._advance()
         if not self._prefetch:
             return self._stage(b)
         self._join()
@@ -201,6 +212,109 @@ class FFBinDataLoader:
     def __iter__(self) -> Iterator[Dict]:
         for _ in range(self.num_batches):
             yield self.next_batch()
+
+
+def write_img_ffbin(path: str, images: np.ndarray,
+                    labels: np.ndarray) -> None:
+    """Store an image dataset in the native .ffbin format: images flatten
+    into the dense block (sparse width 0), labels into the label block —
+    the same mmap+prefetch machinery then serves CNNs and DLRM alike
+    (reference ImgDataLoader4D/2D, python/flexflow_dataloader.cc, keeps
+    images resident and scatters batches exactly like SingleDataLoader)."""
+    n = len(labels)
+    imgs = np.ascontiguousarray(images, dtype=np.float32).reshape(n, -1)
+    write_ffbin(path, imgs, np.empty((n, 0), np.int32), labels)
+
+
+class ImgDataLoader4D:
+    """Generic on-disk image loader feeding 4-D (N, C, H, W) inputs
+    (reference ImgDataLoader4D, python/flexflow_dataloader.cc: numpy /
+    legacy-binary image loading into resident memory + per-batch scatter).
+
+    Sources by extension:
+      - `.ffbin`  — native mmap + background prefetch (write with
+        write_img_ffbin); `image_shape` restores (C, H, W)
+      - `.npz`    — arrays `images` (N,C,H,W) and `labels`
+      - `.npy`    — images array; labels from `<stem>_labels.npy`
+
+    next_batch() returns a device-staged dict {input_name: (b,C,H,W),
+    "label": (b,1) int32} ready for train_batch_device.
+    """
+
+    rank = 4
+
+    def __init__(self, model, path: str, image_shape=None,
+                 input_name: str = "image", batch_size: Optional[int] = None,
+                 shuffle: bool = False, seed: int = 0):
+        self.model = model
+        self.input_name = input_name
+        self.batch_size = batch_size or model.config.batch_size
+        self._native = None
+        if path.endswith(".ffbin"):
+            if self.rank == 4 and image_shape is None:
+                raise ValueError(
+                    ".ffbin stores images flattened; pass "
+                    "image_shape=(C, H, W)")
+            self._native = FFBinDataLoader(model, path,
+                                           batch_size=self.batch_size,
+                                           shuffle=shuffle, seed=seed,
+                                           sparse_shape=(0, 1))
+            flat = self._native.dense_dim
+            if self.rank == 4:
+                if int(np.prod(image_shape)) != flat:
+                    raise ValueError(f"image_shape {image_shape} != stored "
+                                     f"width {flat}")
+                self.image_shape = tuple(image_shape)
+            else:
+                self.image_shape = (flat,)
+            self.num_samples = self._native.num_samples
+            self.num_batches = self._native.num_batches
+            return
+        if path.endswith(".npz"):
+            d = np.load(path)
+            images, labels = d["images"], d["labels"]
+        elif path.endswith(".npy"):
+            images = np.load(path)
+            import os
+            stem = path[:-len(".npy")]
+            labels = np.load(stem + "_labels.npy")
+        else:
+            raise ValueError(f"unsupported image dataset {path!r} "
+                             f"(.ffbin/.npz/.npy)")
+        images = np.asarray(images, np.float32)
+        if self.rank == 2:
+            images = images.reshape(len(images), -1)
+        self.image_shape = images.shape[1:]
+        self._fallback = SingleDataLoader(
+            model, {input_name: images},
+            np.asarray(labels).reshape(len(labels), -1),
+            batch_size=self.batch_size, shuffle=shuffle, seed=seed)
+        self.num_samples = self._fallback.num_samples
+        self.num_batches = self._fallback.num_batches
+
+    def next_host_batch(self) -> Dict[str, np.ndarray]:
+        if self._native is not None:
+            raw = self._native.next_host_batch()
+            imgs = raw["dense"].reshape((self.batch_size,)
+                                        + self.image_shape)
+            return {self.input_name: imgs,
+                    "label": raw["label"].astype(np.int32)}
+        hb = self._fallback.next_host_batch()   # keeps shuffle semantics
+        hb["label"] = hb["label"].astype(np.int32)
+        return hb
+
+    def next_batch(self) -> Dict:
+        return self.model._device_batch(self.next_host_batch())
+
+    def __iter__(self) -> Iterator[Dict]:
+        for _ in range(self.num_batches):
+            yield self.next_batch()
+
+
+class ImgDataLoader2D(ImgDataLoader4D):
+    """Flattened (N, D) variant (reference ImgDataLoader2D)."""
+
+    rank = 2
 
 
 def load_dlrm_hdf5(path: str):

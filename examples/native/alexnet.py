@@ -1,8 +1,11 @@
 #!/usr/bin/env python
-"""AlexNet on synthetic images (reference: examples/cpp/AlexNet/alexnet.cc
-and examples/python/native/alexnet.py:7-70).
+"""AlexNet on synthetic or ON-DISK images (reference:
+examples/cpp/AlexNet/alexnet.cc and examples/python/native/alexnet.py:7-70;
+the on-disk path is the ImgDataLoader4D parity,
+python/flexflow_dataloader.cc).
 
   python examples/native/alexnet.py -b 64 -e 1 [--image-hw 224]
+  python examples/native/alexnet.py --data-path imgs.ffbin  # or .npz/.npy
 """
 
 import sys
@@ -17,9 +20,35 @@ def main(argv=None):
     if "--image-hw" in cfg.unparsed:
         hw = int(cfg.unparsed[cfg.unparsed.index("--image-hw") + 1])
     num_classes = 1000 if hw >= 128 else 10
+    data_path = None
+    if "--data-path" in cfg.unparsed:
+        data_path = cfg.unparsed[cfg.unparsed.index("--data-path") + 1]
 
     model = ff.FFModel(cfg)
     inputs, _ = build_alexnet(model, num_classes=num_classes, image_hw=hw)
+    if data_path:
+        import time
+
+        from dlrm_flexflow_tpu.data import ImgDataLoader4D
+        model.compile(ff.SGDOptimizer(lr=cfg.learning_rate),
+                      "sparse_categorical_crossentropy", ["accuracy"],
+                      mesh=mesh)
+        model.init_layers()
+        loader = ImgDataLoader4D(model, data_path,
+                                 image_shape=inputs["image"][1:])
+        model.train_batch_device(loader.next_batch())  # warm/compile
+        t0 = time.time()
+        steps = 0
+        mets = None
+        for _epoch in range(cfg.epochs):
+            for _ in range(loader.num_batches):
+                mets = model.train_batch_device(loader.next_batch())
+                steps += 1
+        loss = float(mets["loss"])
+        dt = time.time() - t0
+        print(f"[on-disk] loss={loss:.4f} "
+              f"THROUGHPUT = {steps * cfg.batch_size / dt:.2f} samples/s")
+        return
     x, y = synthetic_classification(inputs, num_classes,
                                     4 * cfg.batch_size, seed=cfg.seed)
     train(model, x, y, cfg, mesh=mesh)

@@ -136,6 +136,18 @@ class TestHostResidentTables:
         np.testing.assert_array_equal(
             model.host_params["emb_stack"]["kernel"], want)
 
+    def test_fit_works_with_host_tables(self):
+        """fit() (AOT warmup + staged loop) with host-resident tables —
+        regression for the warmup lowering without the host_emb arg."""
+        dcfg = _dcfg()
+        model = _build(dcfg, host_tables=True)
+        x, y = synthetic_batch(dcfg, 64)
+        out = model.fit({k: v for k, v in x.items()}, y, epochs=1,
+                        batch_size=16, verbose=False)
+        assert out["throughput"] > 0
+        assert np.isfinite(
+            model.host_params["emb_stack"]["kernel"]).all()
+
     def test_momentum_rejected(self):
         import pytest
         dcfg = _dcfg()
