@@ -606,9 +606,19 @@ class FFModel:
         new_state: Dict[str, Any] = {}
         constrain = jax.lax.with_sharding_constraint
         host_ops = getattr(self, "_host_offload_ops", set())
+        # under bf16 compute, float inputs enter the graph in bf16 so the
+        # WHOLE activation stream (ops preserve their input dtype) flows at
+        # half the HBM bytes; fp32 stats/accumulations inside ops keep
+        # their precision. No-op under the default f32 compute dtype.
+        cast_bf16 = (jnp.dtype(self.compute_dtype)
+                     == jnp.dtype(jnp.bfloat16))
         for t in self.input_tensors:
             if t.name in batch:   # host-only inputs are popped pre-jit
-                env[t.guid] = batch[t.name]
+                v = batch[t.name]
+                if cast_bf16 and jnp.issubdtype(jnp.dtype(t.dtype),
+                                                jnp.floating):
+                    v = v.astype(self.compute_dtype)
+                env[t.guid] = v
         for op in self.ops:
             if isinstance(op, InputOp):
                 continue
@@ -950,41 +960,29 @@ class FFModel:
         dict of device scalars (async — don't block)."""
         return self.train_batch_device(self._device_batch(batch))
 
-    def train_batch_device(self, device_batch: Dict):
-        """train_batch for a batch already staged on device (skips the
-        host->device put; used by benchmark loops that pre-stage)."""
-        if not getattr(self, "_msums", None):
-            self._msums = self._zero_msums()
-        if getattr(self, "_step_dev", None) is None:
-            self._step_dev = jax.device_put(
-                jnp.asarray(self._step, jnp.int32),
-                NamedSharding(self.mesh, PartitionSpec()))
+    def _split_host_idx(self, device_batch: Dict):
+        """(device_batch_for_jit, host_idx | None): indices for host-
+        resident tables never ride PCIe — host-only inputs are kept numpy
+        by _device_batch and popped before the jit call (np.asarray on an
+        already-host array is free; on a staged device array it is the one
+        unavoidable D2H)."""
         hres = getattr(self, "_host_resident_list", None)
-        host_idx = None
-        if hres:
-            # indices for host tables never ride PCIe: host-only inputs are
-            # kept numpy by _device_batch and popped before the jit call
-            # (np.asarray on an already-host array is free; on a staged
-            # device array it is the one unavoidable D2H)
-            device_batch = dict(device_batch)
-            host_idx = {}
-            for op in hres:
-                name = op.inputs[0].name
-                arr = device_batch[name]
-                host_idx[op.name] = np.asarray(arr)
-                if name in getattr(self, "_host_only_inputs", set()):
-                    device_batch.pop(name)
-        args = (self.params, self.opt_state, self.op_state, self._msums,
-                device_batch, self._step_dev)
-        if hres:
-            args = args + (self._host_emb_forward(host_idx),)
-        # hot loop: call the AOT-compiled executable directly — the pjit
-        # python dispatch re-validates the big param pytree every call,
-        # which costs more than the step itself on fast models. Keyed by
-        # the batch signature so alternating shapes (e.g. a remainder
-        # batch) each compile once; stringifying shardings is the slow
-        # part, so memoize it by sharding-object identity (the model's
-        # sharding objects are long-lived)
+        if not hres:
+            return device_batch, None
+        device_batch = dict(device_batch)
+        host_idx = {}
+        host_only = getattr(self, "_host_only_inputs", set())
+        for op in hres:
+            name = op.inputs[0].name
+            host_idx[op.name] = np.asarray(device_batch[name])
+            if name in host_only:
+                device_batch.pop(name)
+        return device_batch, host_idx
+
+    def _exec_key(self, device_batch: Dict):
+        """Executable-cache key for a staged batch. Stringifying shardings
+        is the slow part, so memoize it by sharding-object identity (the
+        model's sharding objects are long-lived)."""
         smemo = getattr(self, "_sharding_str_memo", None)
         if smemo is None:
             smemo = self._sharding_str_memo = {}
@@ -1002,9 +1000,31 @@ class FFModel:
             smemo[id(sh)] = (sh, s)
             return s
 
-        key = tuple(sorted(
+        return tuple(sorted(
             (k, v.shape, v.dtype.name, _shs(v))
             for k, v in device_batch.items()))
+
+    def train_batch_device(self, device_batch: Dict):
+        """train_batch for a batch already staged on device (skips the
+        host->device put; used by benchmark loops that pre-stage)."""
+        if not getattr(self, "_msums", None):
+            self._msums = self._zero_msums()
+        if getattr(self, "_step_dev", None) is None:
+            self._step_dev = jax.device_put(
+                jnp.asarray(self._step, jnp.int32),
+                NamedSharding(self.mesh, PartitionSpec()))
+        device_batch, host_idx = self._split_host_idx(device_batch)
+        args = (self.params, self.opt_state, self.op_state, self._msums,
+                device_batch, self._step_dev)
+        if host_idx is not None:
+            args = args + (self._host_emb_forward(host_idx),)
+        hres = host_idx is not None
+        # hot loop: call the AOT-compiled executable directly — the pjit
+        # python dispatch re-validates the big param pytree every call,
+        # which costs more than the step itself on fast models. Keyed by
+        # the batch signature so alternating shapes (e.g. a remainder
+        # batch) each compile once.
+        key = self._exec_key(device_batch)
         execs = getattr(self, "_train_step_execs", None)
         if execs is None:
             execs = self._train_step_execs = {}
@@ -1137,22 +1157,25 @@ class FFModel:
         # trace during epoch 0 instead, dlrm.cc:178-185)
         first = {k: v[:bs] for k, v in inputs.items()}
         first["label"] = labels[:bs]
-        db = self._device_batch(first)
-        wargs = (self.params, self.opt_state, self.op_state,
-                 self._zero_msums(), db, jnp.asarray(0, jnp.int32))
-        hres = getattr(self, "_host_resident_list", None)
-        if hres:
-            db = dict(db)
-            hidx = {}
-            for op in hres:
-                name = op.inputs[0].name
-                hidx[op.name] = np.asarray(db[name])
-                if name in getattr(self, "_host_only_inputs", set()):
-                    db.pop(name)
-            wargs = (self.params, self.opt_state, self.op_state,
-                     self._zero_msums(), db, jnp.asarray(0, jnp.int32),
-                     self._host_emb_forward(hidx))
-        self._train_step.lower(*wargs).compile()
+        db, hidx = self._split_host_idx(self._device_batch(first))
+        if getattr(self, "_msums", None) is None:
+            self._msums = self._zero_msums()
+        if getattr(self, "_step_dev", None) is None:
+            self._step_dev = jax.device_put(
+                jnp.asarray(self._step, jnp.int32),
+                NamedSharding(self.mesh, PartitionSpec()))
+        wargs = (self.params, self.opt_state, self.op_state, self._msums,
+                 db, self._step_dev)
+        if hidx is not None:
+            wargs = wargs + (self._host_emb_forward(hidx),)
+        # cache the warmup executable under the SAME key the hot loop
+        # uses, so the first timed step doesn't recompile it
+        execs = getattr(self, "_train_step_execs", None)
+        if execs is None:
+            execs = self._train_step_execs = {}
+        wkey = self._exec_key(db)
+        if wkey not in execs:
+            execs[wkey] = self._train_step.lower(*wargs).compile()
 
         if self.config.profiling:
             # per-op timing report (reference --profiling cudaEvent prints,

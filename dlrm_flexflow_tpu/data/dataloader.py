@@ -75,7 +75,11 @@ class SingleDataLoader:
             self._thread = None
 
     def next_host_batch(self) -> Dict[str, np.ndarray]:
-        """Next host-side (numpy) batch with full shuffle semantics."""
+        """Next host-side (numpy) batch with full shuffle semantics.
+        Safe to interleave with next_batch: the prefetch pipeline is
+        drained first (it staged a batch this call now consumes)."""
+        self._join()
+        self._next = None
         b = self._advance()
         return self._host_batch(b)
 
@@ -285,9 +289,11 @@ class ImgDataLoader4D:
         if self.rank == 2:
             images = images.reshape(len(images), -1)
         self.image_shape = images.shape[1:]
+        # labels cast once here so the fallback's prefetching next_batch
+        # can be used as-is (int labels for sparse-CCE CNN training)
         self._fallback = SingleDataLoader(
             model, {input_name: images},
-            np.asarray(labels).reshape(len(labels), -1),
+            np.asarray(labels, np.int32).reshape(len(labels), -1),
             batch_size=self.batch_size, shuffle=shuffle, seed=seed)
         self.num_samples = self._fallback.num_samples
         self.num_batches = self._fallback.num_batches
@@ -299,11 +305,12 @@ class ImgDataLoader4D:
                                         + self.image_shape)
             return {self.input_name: imgs,
                     "label": raw["label"].astype(np.int32)}
-        hb = self._fallback.next_host_batch()   # keeps shuffle semantics
-        hb["label"] = hb["label"].astype(np.int32)
-        return hb
+        return self._fallback.next_host_batch()  # keeps shuffle semantics
 
     def next_batch(self) -> Dict:
+        if self._native is None:
+            # fallback keeps SingleDataLoader's background H2D prefetch
+            return self._fallback.next_batch()
         return self.model._device_batch(self.next_host_batch())
 
     def __iter__(self) -> Iterator[Dict]:

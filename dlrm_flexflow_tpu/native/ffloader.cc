@@ -87,8 +87,10 @@ struct Loader {
       const int64_t s_idx = shuffle ? perm[idx] : idx;
       std::memcpy(&s.dense[r * dense_dim], &dense[s_idx * dense_dim],
                   sizeof(float) * dense_dim);
-      std::memcpy(&s.sparse[r * n_sparse], &sparse[s_idx * n_sparse],
-                  sizeof(int32_t) * n_sparse);
+      if (n_sparse > 0) {  // image datasets store a zero-width block
+        std::memcpy(&s.sparse[r * n_sparse], &sparse[s_idx * n_sparse],
+                    sizeof(int32_t) * n_sparse);
+      }
       s.label[r] = label[s_idx];
     }
     s.batch_index = global_batch;
@@ -203,7 +205,10 @@ int64_t ffloader_next(void* handle, float* out_dense, int32_t* out_sparse,
   Loader::Slot& s = L->slots[L->consumed % kSlots];
   const int64_t bi = s.batch_index;
   std::memcpy(out_dense, s.dense.data(), sizeof(float) * s.dense.size());
-  std::memcpy(out_sparse, s.sparse.data(), sizeof(int32_t) * s.sparse.size());
+  if (!s.sparse.empty()) {
+    std::memcpy(out_sparse, s.sparse.data(),
+                sizeof(int32_t) * s.sparse.size());
+  }
   std::memcpy(out_label, s.label.data(), sizeof(float) * s.label.size());
   s.full = false;
   ++L->consumed;

@@ -278,49 +278,68 @@ class CostModel:
 
     def _time_fn(self, make_out, params, xs) -> float:
         """Median-of-3 wall time of ONE application of `make_out`, measured
-        as an in-graph lax.scan of _REPEATS applications inside a single
-        dispatch (the XLA analog of the reference's warmup-5/repeat-10 raw
-        kernel loops, simulator.cu:25). The scan body perturbs a float
-        input with the carry so XLA cannot hoist the op out of the loop."""
+        as an in-graph lax.scan of N applications inside a single dispatch
+        (the XLA analog of the reference's warmup-5/repeat-10 raw kernel
+        loops, simulator.cu:25). The scan body perturbs a float input with
+        the carry so XLA cannot hoist the op out of the loop. N adapts so
+        the loop wall time dwarfs the per-dispatch overhead — on a
+        tunneled PJRT device that overhead is milliseconds of RPC jitter,
+        which would otherwise swamp sub-ms ops."""
+        import math as _math
         import time
 
         import jax
 
+        def loop_fn(n):
+            def loop(p, xs_):
+                def body(acc, _):
+                    eps = (acc * 1e-38).astype(jnp.float32)
+                    # perturb the first float operand (or param) with the
+                    # carry: a data dependence the compiler cannot remove
+                    pxs, bumped = [], False
+                    for x in xs_:
+                        if not bumped and jnp.issubdtype(x.dtype,
+                                                         jnp.floating):
+                            x = x + eps.astype(x.dtype)
+                            bumped = True
+                        pxs.append(x)
+                    pp = p
+                    if not bumped and p:
+                        pp = dict(p)
+                        k0 = next(iter(pp))
+                        pp[k0] = pp[k0] + eps.astype(pp[k0].dtype)
+                    out = make_out(pp, pxs)
+                    leaf = jax.tree.leaves(out)[0]
+                    return acc + leaf.reshape(-1)[0].astype(jnp.float32), None
+
+                acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                      None, length=n)
+                return acc
+            return jax.jit(loop)
+
+        def run(f):
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(f(params, xs))
+                times.append(time.perf_counter() - t0)
+            return sorted(times)[1]
+
+        ovh = self._dispatch_overhead()
         n = self._REPEATS
-
-        def loop(p, xs_):
-            def body(acc, _):
-                eps = (acc * 1e-38).astype(jnp.float32)
-                # perturb the first float operand (or param) with the
-                # carry: a data dependence the compiler cannot remove
-                pxs, bumped = [], False
-                for x in xs_:
-                    if not bumped and jnp.issubdtype(x.dtype, jnp.floating):
-                        x = x + eps.astype(x.dtype)
-                        bumped = True
-                    pxs.append(x)
-                pp = p
-                if not bumped and p:
-                    pp = dict(p)
-                    k0 = next(iter(pp))
-                    pp[k0] = pp[k0] + eps.astype(pp[k0].dtype)
-                out = make_out(pp, pxs)
-                leaf = jax.tree.leaves(out)[0]
-                return acc + leaf.reshape(-1)[0].astype(jnp.float32), None
-
-            acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
-                                  None, length=n)
-            return acc
-
-        f = jax.jit(loop)
+        f = loop_fn(n)
         float(f(params, xs))  # compile + warmup
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(f(params, xs))
-            times.append(time.perf_counter() - t0)
-        dt = sorted(times)[1]
-        return max((dt - self._dispatch_overhead()) / n, 1e-9)
+        dt = run(f)
+        # grow the loop until it costs >= 20x the dispatch overhead (one
+        # extra compile at most; scan length doesn't affect compile time)
+        target = max(20.0 * ovh, 0.2)
+        if dt < target:
+            n2 = min(int(n * _math.ceil(target / max(dt, 1e-4))), 8192)
+            if n2 > n:
+                f = loop_fn(n2)
+                float(f(params, xs))
+                dt, n = run(f), n2
+        return max((dt - ovh) / n, 1e-9)
 
     def measure_op(self, op: Op, pc: ParallelConfig,
                    backward: bool = False) -> float:
