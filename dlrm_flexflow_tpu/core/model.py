@@ -793,12 +793,30 @@ class FFModel:
                 sparse_names = {op.name for op in sparse_ops}
                 p_dense = {k: v for k, v in params.items()
                            if k not in sparse_names}
-                # phase A (no grad): index pipelines + embedding lookups
-                anc_env, _ = self._forward_env(params, op_state, batch,
-                                               True, rng,
-                                               only_ops=set(anc_names))
-                emb_vals = {op.name: anc_env[op.outputs[0].guid]
-                            for op in sparse_ops}
+                # phase A (no grad): index pipelines, then the embedding
+                # lookups evaluated DIRECTLY so ops can hand their
+                # forward-gather residuals to the write-only sparse update
+                # (apply_with_fwd)
+                anc_env, _ = self._forward_env(
+                    params, op_state, batch, True, rng,
+                    only_ops=set(anc_names) - sparse_names)
+                emb_vals, emb_fwd = {}, {}
+                for op in sparse_ops:
+                    xs_ = [anc_env[t.guid] for t in op.inputs]
+                    f = getattr(op, "apply_with_fwd", None)
+                    if f is not None:
+                        outs, fwd = f(params[op.name], xs_, rng=rng)
+                    else:
+                        outs, fwd = op.apply(params[op.name], xs_,
+                                             training=True, rng=rng), None
+                    v = outs[0]
+                    sh = self._out_sharding.get(op.outputs[0].guid)
+                    if sh is not None:
+                        v = jax.lax.with_sharding_constraint(v, sh)
+                    emb_vals[op.name] = v
+                    anc_env[op.outputs[0].guid] = v
+                    if fwd is not None:
+                        emb_fwd[op.name] = fwd
                 if host_ops:
                     # host-gathered rows enter as plain inputs; their
                     # cotangents leave for the wrapper's host scatter
@@ -823,7 +841,8 @@ class FFModel:
                 for op in sparse_ops:
                     xs = [anc_env[t.guid] for t in op.inputs]
                     new_params[op.name] = op.sparse_sgd_update(
-                        params[op.name], xs, gev[op.name], lr)
+                        params[op.name], xs, gev[op.name], lr,
+                        fwd=emb_fwd.get(op.name))
                 if host_ops:
                     host_cts = {op.name: gev[op.name] for op in host_ops}
             else:
@@ -960,6 +979,17 @@ class FFModel:
         dict of device scalars (async — don't block)."""
         return self.train_batch_device(self._device_batch(batch))
 
+    def _ensure_step_state(self):
+        """Lazy-init the device-resident step counter and metric sums that
+        the jitted step threads through (single definition — warmup and
+        hot loop must compile against identically-sharded inputs)."""
+        if not getattr(self, "_msums", None):
+            self._msums = self._zero_msums()
+        if getattr(self, "_step_dev", None) is None:
+            self._step_dev = jax.device_put(
+                jnp.asarray(self._step, jnp.int32),
+                NamedSharding(self.mesh, PartitionSpec()))
+
     def _split_host_idx(self, device_batch: Dict):
         """(device_batch_for_jit, host_idx | None): indices for host-
         resident tables never ride PCIe — host-only inputs are kept numpy
@@ -1007,12 +1037,7 @@ class FFModel:
     def train_batch_device(self, device_batch: Dict):
         """train_batch for a batch already staged on device (skips the
         host->device put; used by benchmark loops that pre-stage)."""
-        if not getattr(self, "_msums", None):
-            self._msums = self._zero_msums()
-        if getattr(self, "_step_dev", None) is None:
-            self._step_dev = jax.device_put(
-                jnp.asarray(self._step, jnp.int32),
-                NamedSharding(self.mesh, PartitionSpec()))
+        self._ensure_step_state()
         device_batch, host_idx = self._split_host_idx(device_batch)
         args = (self.params, self.opt_state, self.op_state, self._msums,
                 device_batch, self._step_dev)
@@ -1158,12 +1183,7 @@ class FFModel:
         first = {k: v[:bs] for k, v in inputs.items()}
         first["label"] = labels[:bs]
         db, hidx = self._split_host_idx(self._device_batch(first))
-        if getattr(self, "_msums", None) is None:
-            self._msums = self._zero_msums()
-        if getattr(self, "_step_dev", None) is None:
-            self._step_dev = jax.device_put(
-                jnp.asarray(self._step, jnp.int32),
-                NamedSharding(self.mesh, PartitionSpec()))
+        self._ensure_step_state()
         wargs = (self.params, self.opt_state, self.op_state, self._msums,
                  db, self._step_dev)
         if hidx is not None:

@@ -53,13 +53,23 @@ def _pack_factor(dim: int, rows: int) -> int:
     return 1
 
 
+def _packed_gather_tiles(tbl, ix, r, d):
+    """Gather logical rows `ix` from a packed (rows/r, r*d) table.
+    Returns (rows `ix.shape + (d,)`, flat tile rows (n,), flat tiles
+    (n, r*d)) — THE packed-layout invariant (tile = ix//r, sub-row =
+    ix%r, wrap) in one place; the tiles are the forward residuals the
+    write-only sparse update reuses."""
+    vrow = (ix // r).reshape(-1)
+    tiles = jnp.take(tbl, vrow, axis=0, mode="wrap")    # (n, r*d)
+    sub = (ix % r).reshape(-1)
+    rows = jnp.take_along_axis(
+        tiles.reshape(-1, r, d), sub[:, None, None], axis=1)[:, 0, :]
+    return rows.reshape(ix.shape + (d,)), vrow, tiles
+
+
 def _packed_gather(tbl, ix, r, d):
     """Gather logical rows `ix` from a packed (rows/r, r*d) table."""
-    prow, sub = ix // r, ix % r
-    t128 = jnp.take(tbl, prow, axis=0, mode="wrap")     # (..., r*d)
-    t = t128.reshape(ix.shape + (r, d))
-    return jnp.take_along_axis(
-        t, sub[..., None, None], axis=-2)[..., 0, :]    # (..., d)
+    return _packed_gather_tiles(tbl, ix, r, d)[0]
 
 
 def _lookup_count(op) -> float:
@@ -319,7 +329,8 @@ class Embedding(Op):
     def supports_sparse_update(self) -> bool:
         return self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG, AGGR_MODE_NONE)
 
-    def sparse_sgd_update(self, params, xs, out_ct, lr):
+    def sparse_sgd_update(self, params, xs, out_ct, lr,
+                          fwd=None):
         """params - lr * d(loss)/d(table), given out_ct = d(loss)/d(output).
         Touches only the gathered rows."""
         (idx,) = xs
@@ -539,7 +550,46 @@ class EmbeddingBagStacked(Op):
     def supports_sparse_update(self) -> bool:
         return self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
 
-    def sparse_sgd_update(self, params, xs, out_ct, lr):
+    def _fwd_residual_ok(self) -> bool:
+        """Whether the packed-gather forward can hand its tiles to a
+        write-only sparse update (single chip, lane-packed storage, the
+        Pallas scatter available, XLA gather path in use)."""
+        return (self._pack > 1
+                and self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
+                and not _pallas_ok(self.model, self.out_dim, self.name)
+                and _pallas_scatter_ok(self.model, 128, self.name)
+                and _row_shard_axes(
+                    self, self.out_dim,
+                    self.num_tables * self.num_entries // self._pack)
+                is None)
+
+    def apply_with_fwd(self, params, xs, *, rng=None):
+        """apply() plus forward-gather residuals (global unpacked rows +
+        packed tiles): random HBM rows are latency-bound (~0.3 µs each,
+        BENCHMARKS.md), so keeping the 1 MB of gathered tiles lets the
+        sparse update WRITE new rows without re-reading them — halving
+        the update's random accesses vs the RMW kernel. Returns
+        (outs, fwd|None); None = caller should treat as plain apply."""
+        if not self._fwd_residual_ok():
+            return self.apply(params, xs, training=True, rng=rng), None
+        (idx,) = xs
+        table = params["kernel"]
+        idx = idx.astype(jnp.int32) % self.num_entries
+        if self._table_order is not None:
+            idx = jnp.take(idx, self._table_order, axis=1)
+        r, d = self._pack, self.out_dim
+        T, rows = self.num_tables, self.num_entries
+        view = table.reshape(T * rows // r, r * d)
+        offs = (jnp.arange(T, dtype=jnp.int32) * rows)[None, :, None]
+        g = idx + offs                                 # (batch, T, bag)
+        rows_g, _, tiles = _packed_gather_tiles(view, g, r, d)
+        out = (jnp.mean(rows_g, axis=2) if self.aggr == AGGR_MODE_AVG
+               else jnp.sum(rows_g, axis=2))
+        if self._table_order is not None:
+            out = jnp.take(out, self._table_inv, axis=1)
+        return [out], (g.reshape(-1), tiles)
+
+    def sparse_sgd_update(self, params, xs, out_ct, lr, fwd=None):
         (idx,) = xs                       # (batch, T, bag)
         tbl = params["kernel"]            # (T, rows/r, r*d)
         idx = idx.astype(jnp.int32) % self.num_entries
@@ -552,6 +602,19 @@ class EmbeddingBagStacked(Op):
             ct = ct / idx.shape[-1]
         r, d = self._pack, self.out_dim
         T, rows = self.num_tables, self.num_entries
+
+        if fwd is not None and self._fwd_residual_ok():
+            # write-only path: fwd tiles + summed deltas -> pure scatter
+            # writes (apply_with_fwd produced g in the SAME permuted
+            # (batch, T, bag) order as idx/ct here)
+            from .pallas.embedding_kernel import scatter_write_rows_packed
+            g_flat, tiles = fwd
+            upd = jnp.broadcast_to(
+                ct[..., None, :], idx.shape + (d,)).reshape(-1, d)
+            new = scatter_write_rows_packed(
+                tbl.reshape(T * rows // r, r * d), g_flat, -lr * upd,
+                tiles, d)
+            return {"kernel": new.reshape(tbl.shape)}
 
         shard_axes = _row_shard_axes(self, d, T * rows // r)
         if shard_axes is not None:
@@ -794,7 +857,31 @@ class EmbeddingBagConcat(Op):
     def supports_sparse_update(self) -> bool:
         return self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
 
-    def sparse_sgd_update(self, params, xs, out_ct, lr):
+    def _fwd_residual_ok(self) -> bool:
+        """See EmbeddingBagStacked._fwd_residual_ok."""
+        return (self._pack > 1
+                and self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
+                and not _pallas_ok(self.model, self.out_dim, self.name)
+                and _pallas_scatter_ok(self.model, 128, self.name)
+                and _row_shard_axes(self, self.out_dim,
+                                    self.total_rows // self._pack) is None)
+
+    def apply_with_fwd(self, params, xs, *, rng=None):
+        """apply() plus forward-gather residuals for the write-only sparse
+        update (see EmbeddingBagStacked.apply_with_fwd)."""
+        if not self._fwd_residual_ok():
+            return self.apply(params, xs, training=True, rng=rng), None
+        (idx,) = xs
+        tbl = params["kernel"]             # (total_rows/r, r*d)
+        g = self._global_indices(idx)      # (batch, T, bag) unpacked rows
+        r, d = self._pack, self.out_dim
+        rows, _, tiles = _packed_gather_tiles(tbl, g, r, d)
+        out = (jnp.mean(rows, axis=2) if self.aggr == AGGR_MODE_AVG
+               else jnp.sum(rows, axis=2))
+        return [out], (g.reshape(-1), tiles)
+
+    def sparse_sgd_update(self, params, xs, out_ct, lr,
+                          fwd=None):
         (idx,) = xs                        # (batch, T, bag)
         tbl = params["kernel"]             # (total_rows, d)
         g = self._global_indices(idx)
@@ -804,6 +891,12 @@ class EmbeddingBagConcat(Op):
         r, d = self._pack, self.out_dim
         upd = jnp.broadcast_to(ct[..., None, :], g.shape + (d,))
         upd = upd.reshape(-1, d)
+        if fwd is not None and self._fwd_residual_ok():
+            from .pallas.embedding_kernel import scatter_write_rows_packed
+            g_flat, tiles = fwd
+            new = scatter_write_rows_packed(tbl, g_flat, -lr * upd,
+                                            tiles, d)
+            return {"kernel": new}
         shard_axes = _row_shard_axes(self, d, self.total_rows // r)
         if shard_axes is not None:
             from .pallas.embedding_kernel import sharded_scatter_add_packed

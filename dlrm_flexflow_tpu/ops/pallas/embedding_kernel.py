@@ -287,9 +287,14 @@ def scatter_add_rows_packed(view: jax.Array, indices: jax.Array,
     return _dedup_and_scatter(view, tile_rows, tile_upds, interpret)
 
 
-def _dedup_and_scatter(view, tile_rows, tile_upds, interpret):
+def _dedup_tile_updates(tile_rows, tile_upds):
+    """Combine same-tile updates so a scatter kernel sees DISTINCT rows:
+    sort → segment-sum → per-segment target row (-1 marks invalid/pad
+    slots) → pad to a _TILE_B multiple. Returns
+    (target (m,), summed (m, 128), rep (m,), m) where rep[s] is one
+    original position whose update landed in segment s (for callers that
+    need a representative forward tile)."""
     m = tile_rows.shape[0]
-    # dedup: combine same-tile updates so the kernel sees distinct rows
     order = jnp.argsort(tile_rows)
     srows = tile_rows[order]
     supds = tile_upds[order]
@@ -300,6 +305,8 @@ def _dedup_and_scatter(view, tile_rows, tile_upds, interpret):
                                  indices_are_sorted=True)
     target = jax.ops.segment_max(srows, seg, num_segments=m,
                                  indices_are_sorted=True)
+    rep = jax.ops.segment_max(order, seg, num_segments=m,
+                              indices_are_sorted=True)
     num_unique = seg[-1] + 1
     valid = jnp.arange(m) < num_unique
     target = jnp.where(valid, target, -1).astype(jnp.int32)
@@ -308,7 +315,13 @@ def _dedup_and_scatter(view, tile_rows, tile_upds, interpret):
     if pad_n:
         target = jnp.pad(target, (0, pad_n), constant_values=-1)
         summed = jnp.pad(summed, ((0, pad_n), (0, 0)))
+        rep = jnp.pad(rep, (0, pad_n))
         m += pad_n
+    return target, summed, rep, m
+
+
+def _dedup_and_scatter(view, tile_rows, tile_upds, interpret):
+    target, summed, _, m = _dedup_tile_updates(tile_rows, tile_upds)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -331,6 +344,83 @@ def _dedup_and_scatter(view, tile_rows, tile_upds, interpret):
         input_output_aliases={2: 0},
         interpret=interpret,
     )(target, summed.astype(view.dtype), view)
+
+
+def _scatter_write_kernel(idx_ref, val_ref, tbl_ref, out_ref, wsems):
+    """Write-ONLY scatter: out[row] = val for _TILE_B distinct rows per
+    grid step (row < 0 skipped). No read DMA: callers that kept the
+    forward-gathered tiles compute new = fwd_tile + summed_update in XLA
+    and this kernel just lands the rows — half the random-HBM traffic of
+    the RMW form (the update side of the reference's atomicAdd backward,
+    embedding.cu:173-224, with distinctness + precomputed values replacing
+    atomicity)."""
+    i = pl.program_id(0)
+    for s in range(_TILE_B):            # static unroll: issue all writes
+        row = idx_ref[i * _TILE_B + s]
+
+        @pl.when(row >= 0)
+        def _():
+            pltpu.make_async_copy(
+                val_ref.at[pl.ds(s, 1), :], out_ref.at[pl.ds(row, 1), :],
+                wsems.at[s]).start()
+    for s in range(_TILE_B):            # drain before the next block
+        row = idx_ref[i * _TILE_B + s]
+
+        @pl.when(row >= 0)
+        def _():
+            pltpu.make_async_copy(
+                val_ref.at[pl.ds(s, 1), :], out_ref.at[pl.ds(row, 1), :],
+                wsems.at[s]).wait()
+
+
+def scatter_write_rows_packed(view: jax.Array, indices: jax.Array,
+                              updates: jax.Array, fwd_tiles: jax.Array,
+                              dim: int,
+                              interpret: bool = False) -> jax.Array:
+    """Sparse-SGD update WITHOUT the RMW read: the caller passes the
+    forward-gathered packed tiles (one per lookup, same order as
+    `indices`), so each unique target tile's new value is
+    fwd_tile + sum(updates landing in it), computed in XLA, and the
+    Pallas kernel performs pure writes.
+
+    view      : (vrows, 128) packed table (donated/aliased)
+    indices   : (n,) int in UNPACKED row space — duplicates allowed
+    updates   : (n, dim) pre-scaled deltas (e.g. -lr * row_cotangent)
+    fwd_tiles : (n, 128) the tile each lookup read in the forward pass
+    """
+    r_per_tile = _LANES // dim
+    indices = indices.astype(jnp.int32)
+    tile_rows = indices // r_per_tile
+    offs = (indices % r_per_tile) * dim
+    padded = jnp.pad(updates.astype(view.dtype),
+                     ((0, 0), (0, _LANES - dim)))
+    tile_upds = jax.vmap(jnp.roll)(padded, offs)
+
+    target, summed, rep, m = _dedup_tile_updates(tile_rows, tile_upds)
+    # any duplicate's forward tile is the same pre-update value, so the
+    # representative original position's tile stands in for the segment
+    vals = (jnp.take(fwd_tiles, rep, axis=0).astype(view.dtype)
+            + summed.astype(view.dtype))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m // _TILE_B,),
+        in_specs=[
+            pl.BlockSpec((_TILE_B, _LANES), lambda i, idx: (i, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((_TILE_B,)),
+        ],
+    )
+    return pl.pallas_call(
+        _scatter_write_kernel,
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(target, vals.astype(view.dtype), view)
 
 
 def sharded_scatter_add_packed(mesh, row_axes, view, indices, updates,
