@@ -177,9 +177,10 @@ class Op:
         the dominant cost of sparse lookups on TPU."""
         return 0.0
 
-    def update_random_hbm_rows(self) -> float:
+    def update_random_hbm_rows(self, pc=None) -> float:
         """Random row accesses of this op's PARAMETER update (the sparse
-        touched-rows RMW scatter: one read + one write per unique row)."""
+        touched-rows scatter; `pc` is the candidate config being priced —
+        sharded tables take the costlier RMW path)."""
         return 0.0
 
     def output_bytes(self) -> int:

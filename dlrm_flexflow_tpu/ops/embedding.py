@@ -88,11 +88,26 @@ def _embedding_random_rows(op, backward: bool) -> float:
     return 0.0 if backward else _lookup_count(op)
 
 
-def _embedding_update_rows(op) -> float:
-    # touched-rows RMW scatter: one random read + one write per lookup
-    # (dedup reduces this; worst case priced). Dense updates stream the
-    # table instead (covered by param_bytes_touched_per_step).
-    return 2.0 * _lookup_count(op) if _sparse_update_active(op) else 0.0
+def _embedding_update_rows(op, pc=None) -> float:
+    # touched-rows scatter: the RMW fallback reads AND writes each row
+    # (2.0 accesses/lookup); the write-only path
+    # (scatter_write_rows_packed) skips the read but measured step times
+    # show random writes amortize only slightly better than reads —
+    # 1.6 effective accesses/lookup fits every calibration point within
+    # ~16% (benchmarks/calibrate_sim.py). Dense updates stream the table
+    # instead (param_bytes_touched_per_step).
+    #
+    # The choice is STRUCTURAL (op attributes + the CANDIDATE config,
+    # never the live process's backend/mesh): write-only needs
+    # lane-packed storage and an unsharded table (row-sharded tables take
+    # the shard_map RMW path) — the simulator models the target TPU even
+    # when the search runs on a CPU host.
+    if not _sparse_update_active(op):
+        return 0.0
+    write_only = (getattr(op, "_pack", 1) > 1
+                  and op.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
+                  and (pc is None or pc.num_parts == 1))
+    return (1.6 if write_only else 2.0) * _lookup_count(op)
 
 
 def _host_init_table(initializer, shape, seed: int):
@@ -306,8 +321,8 @@ class Embedding(Op):
     def random_hbm_rows(self, backward: bool = False) -> float:
         return _embedding_random_rows(self, backward)
 
-    def update_random_hbm_rows(self) -> float:
-        return _embedding_update_rows(self)
+    def update_random_hbm_rows(self, pc=None) -> float:
+        return _embedding_update_rows(self, pc)
 
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
@@ -536,8 +551,8 @@ class EmbeddingBagStacked(Op):
     def random_hbm_rows(self, backward: bool = False) -> float:
         return _embedding_random_rows(self, backward)
 
-    def update_random_hbm_rows(self) -> float:
-        return _embedding_update_rows(self)
+    def update_random_hbm_rows(self, pc=None) -> float:
+        return _embedding_update_rows(self, pc)
 
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
@@ -843,8 +858,8 @@ class EmbeddingBagConcat(Op):
     def random_hbm_rows(self, backward: bool = False) -> float:
         return _embedding_random_rows(self, backward)
 
-    def update_random_hbm_rows(self) -> float:
-        return _embedding_update_rows(self)
+    def update_random_hbm_rows(self, pc=None) -> float:
+        return _embedding_update_rows(self, pc)
 
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):

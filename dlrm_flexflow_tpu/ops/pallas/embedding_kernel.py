@@ -277,14 +277,21 @@ def scatter_add_rows_packed(view: jax.Array, indices: jax.Array,
     indices : (n,) int in UNPACKED row space — duplicates allowed
     updates : (n, dim)
     """
+    tile_rows, tile_upds = _pack_tile_updates(indices, updates, dim,
+                                              view.dtype)
+    return _dedup_and_scatter(view, tile_rows, tile_upds, interpret)
+
+
+def _pack_tile_updates(indices, updates, dim, dtype):
+    """(n,) unpacked-row indices + (n, dim) updates -> (tile_rows,
+    tile_upds (n, 128)): the packed-layout roll math shared by the RMW
+    and write-only scatters (tile = idx // r, lane offset = (idx % r)·d)."""
     r_per_tile = _LANES // dim
     indices = indices.astype(jnp.int32)
     tile_rows = indices // r_per_tile
     offs = (indices % r_per_tile) * dim
-    padded = jnp.pad(updates.astype(view.dtype),
-                     ((0, 0), (0, _LANES - dim)))
-    tile_upds = jax.vmap(jnp.roll)(padded, offs)
-    return _dedup_and_scatter(view, tile_rows, tile_upds, interpret)
+    padded = jnp.pad(updates.astype(dtype), ((0, 0), (0, _LANES - dim)))
+    return tile_rows, jax.vmap(jnp.roll)(padded, offs)
 
 
 def _dedup_tile_updates(tile_rows, tile_upds):
@@ -310,6 +317,10 @@ def _dedup_tile_updates(tile_rows, tile_upds):
     num_unique = seg[-1] + 1
     valid = jnp.arange(m) < num_unique
     target = jnp.where(valid, target, -1).astype(jnp.int32)
+    # empty segments get INT_MIN from segment_max; mask to a safe index so
+    # downstream takes never depend on fill behavior (their rows carry
+    # target=-1 and are skipped by the kernels regardless)
+    rep = jnp.where(valid, rep, 0)
 
     pad_n = (-m) % _TILE_B
     if pad_n:
@@ -388,14 +399,8 @@ def scatter_write_rows_packed(view: jax.Array, indices: jax.Array,
     updates   : (n, dim) pre-scaled deltas (e.g. -lr * row_cotangent)
     fwd_tiles : (n, 128) the tile each lookup read in the forward pass
     """
-    r_per_tile = _LANES // dim
-    indices = indices.astype(jnp.int32)
-    tile_rows = indices // r_per_tile
-    offs = (indices % r_per_tile) * dim
-    padded = jnp.pad(updates.astype(view.dtype),
-                     ((0, 0), (0, _LANES - dim)))
-    tile_upds = jax.vmap(jnp.roll)(padded, offs)
-
+    tile_rows, tile_upds = _pack_tile_updates(indices, updates, dim,
+                                              view.dtype)
     target, summed, rep, m = _dedup_tile_updates(tile_rows, tile_upds)
     # any duplicate's forward tile is the same pre-update value, so the
     # representative original position's tile stands in for the segment

@@ -267,12 +267,16 @@ class CostModel:
         f = jax.jit(lambda x: x + 1.0)
         x = jnp.zeros((8,), jnp.float32)
         float(f(x)[0])
-        t0 = time.perf_counter()
-        out = x
-        for _ in range(10):
-            out = f(out)
-        float(out[0])   # dependent readback = true completion
-        dt = (time.perf_counter() - t0) / 10
+        # SAME pattern as _time_fn's timed runs — one dispatch + dependent
+        # readback per sample — so the full round-trip latency (which on a
+        # tunneled device is ~ms of RPC, not just enqueue cost) is what
+        # gets subtracted
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(f(x)[0])
+            times.append(time.perf_counter() - t0)
+        dt = sorted(times)[2]
         self._cache[key] = dt
         return dt
 
@@ -293,14 +297,23 @@ class CostModel:
         def loop_fn(n):
             def loop(p, xs_):
                 def body(acc, _):
+                    # a data dependence the compiler cannot remove, at
+                    # negligible cost: float operands get +tiny·acc; int
+                    # operands (embedding indices) get a data-dependent
+                    # zero — NEVER perturb params (adding eps to a
+                    # multi-GB table would stream it every iteration and
+                    # swamp the op being measured)
                     eps = (acc * 1e-38).astype(jnp.float32)
-                    # perturb the first float operand (or param) with the
-                    # carry: a data dependence the compiler cannot remove
+                    izero = jnp.where(acc > 3e38, 1, 0).astype(jnp.int32)
                     pxs, bumped = [], False
                     for x in xs_:
                         if not bumped and jnp.issubdtype(x.dtype,
                                                          jnp.floating):
                             x = x + eps.astype(x.dtype)
+                            bumped = True
+                        elif not bumped and jnp.issubdtype(x.dtype,
+                                                           jnp.integer):
+                            x = x + izero.astype(x.dtype)
                             bumped = True
                         pxs.append(x)
                     pp = p
