@@ -113,14 +113,15 @@ class Op:
         from ..parallel.sharding import AxisAssigner, assignable
         assigner = None
         mesh = getattr(self.model, "mesh", None)
-        if mesh is not None:
+        if mesh is not None and mesh.size == num_devices:
             assigner = AxisAssigner(mesh)
             axis_sizes = list(assigner.axis_sizes)
         else:
-            # pre-compile search path: the fallback mesh the search will
-            # use factorizes num_devices largest-prime-first (make_mesh)
-            from ..parallel.mesh import _prime_factors
-            axis_sizes = sorted(_prime_factors(num_devices), reverse=True)
+            # no live mesh, or searching for a DIFFERENT target device
+            # count than the attached mesh (offline planning): use the
+            # factorization make_mesh would build for the target
+            from ..parallel.mesh import structural_axis_sizes
+            axis_sizes = structural_axis_sizes(num_devices)
         out = []
         for pc in self.candidate_parallel_configs(num_devices,
                                                   feasible_degrees):

@@ -34,6 +34,14 @@ def _prime_factors(n: int) -> List[int]:
     return fs
 
 
+def structural_axis_sizes(n: int) -> List[int]:
+    """THE axis factorization make_mesh builds for n devices (largest
+    prime factor first). Search feasibility, offline-target simulation,
+    and mesh construction all defer here so a strategy planned for an
+    n-device target matches the mesh compile() will build."""
+    return sorted(_prime_factors(n), reverse=True) or [1]
+
+
 def make_mesh(devices: Optional[Sequence] = None,
               num_devices: Optional[int] = None) -> Mesh:
     """Build a factorized mesh over `devices` (default: all jax devices).
@@ -54,7 +62,7 @@ def make_mesh(devices: Optional[Sequence] = None,
             devices = devices[:num_devices]
     devices = list(devices)
     n = len(devices)
-    factors = sorted(_prime_factors(n), reverse=True) or [1]
+    factors = structural_axis_sizes(n)
     names = tuple(f"f{i}" for i in range(len(factors)))
     arr = np.array(devices).reshape(tuple(factors))
     return Mesh(arr, names)

@@ -18,6 +18,21 @@ from typing import List, Optional, Sequence, Tuple
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
+def feasible_degrees_for(axis_sizes: Sequence[int]) -> List[int]:
+    """All degrees expressible as a product of a contiguous run of axes
+    (what assign_indices accepts), plus 1 — the pure-structure form of
+    AxisAssigner.feasible_degrees, usable for a TARGET device count with
+    no jax Mesh (offline strategy search from a smaller host)."""
+    out = {1}
+    n = len(axis_sizes)
+    for i in range(n):
+        p = 1
+        for j in range(i, n):
+            p *= axis_sizes[j]
+            out.add(p)
+    return sorted(out)
+
+
 def assign_indices(degrees: Sequence[int], axis_sizes: Sequence[int]
                    ) -> "Optional[List[Tuple[int, ...]]]":
     """THE axis-consumption algorithm, by index: each degree takes a
@@ -67,14 +82,7 @@ class AxisAssigner:
     def feasible_degrees(self) -> List[int]:
         """All degrees expressible as a product of a prefix-contiguous run of
         axes starting anywhere (what assign() below accepts), plus 1."""
-        out = {1}
-        n = len(self.axis_sizes)
-        for i in range(n):
-            p = 1
-            for j in range(i, n):
-                p *= self.axis_sizes[j]
-                out.add(p)
-        return sorted(out)
+        return feasible_degrees_for(self.axis_sizes)
 
     def assign(self, degrees: Sequence[int]) -> List[Tuple[str, ...]]:
         """Assign each dim's degree a tuple of consecutive unused axes

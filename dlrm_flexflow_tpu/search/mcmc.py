@@ -53,12 +53,20 @@ def optimize(model, budget: int = 1000, alpha: float = 1.2,
     """
     import math
 
-    from ..parallel.mesh import make_mesh
 
     if ndev is None:
         ndev = model.config.num_devices
-    mesh = model.mesh or make_mesh(num_devices=ndev)
-    feasible = AxisAssigner(mesh).feasible_degrees()
+    if model.mesh is not None and model.mesh.size == ndev:
+        feasible = AxisAssigner(model.mesh).feasible_degrees()
+    else:
+        # OFFLINE search for an ndev-device target from a smaller host
+        # (e.g. planning a v5e-64 strategy on one chip — the reference
+        # must run its search ON the target cluster, simulator.cu:79-109;
+        # the analytical/measured cost model frees us from that): use the
+        # structural factorization make_mesh would produce
+        from ..parallel.mesh import structural_axis_sizes
+        from ..parallel.sharding import feasible_degrees_for
+        feasible = feasible_degrees_for(structural_axis_sizes(ndev))
     rng = random.Random(seed)
     sim = Simulator(model, cost_model)
 

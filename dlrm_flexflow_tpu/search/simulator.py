@@ -85,7 +85,12 @@ class Simulator:
         mesh = self.model.mesh
         if mesh is not None and mesh.size == ndev:
             return [(a, int(mesh.shape[a])) for a in mesh.axis_names]
-        return [("ici", ndev)]
+        # offline target: the factorization make_mesh would build for
+        # ndev, so per-dim axis assignment (and thus collective pricing)
+        # matches what compile() on the target will do
+        from ..parallel.mesh import structural_axis_sizes
+        return [(f"f{i}", s)
+                for i, s in enumerate(structural_axis_sizes(ndev))]
 
     @staticmethod
     def _assign(degrees: Sequence[int],

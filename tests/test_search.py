@@ -207,6 +207,39 @@ def test_dp_sync_on_hybrid_topology_rides_dcn():
     assert t_hybrid > 1.5 * t_flat, (t_hybrid, t_flat)
 
 
+def test_offline_target_search_not_clamped_by_live_mesh():
+    """Planning for a 64-chip target from an 8-device host must explore
+    degrees beyond the live mesh (candidates, simulator topology, and
+    optimize() all use the target's structural factorization)."""
+    from dlrm_flexflow_tpu.parallel.mesh import structural_axis_sizes
+    from dlrm_flexflow_tpu.parallel.sharding import feasible_degrees_for
+
+    model, _ = _bench_model()               # live mesh has 8 devices
+    feas = feasible_degrees_for(structural_axis_sizes(64))
+    assert max(feas) == 64
+    op = next(o for o in model.ops if o.name == "top_dense_0")
+    cands = op.feasible_parallel_configs(64, feas)
+    assert any(max(pc.degrees) > 8 for pc in cands), \
+        "64-target candidates stuck at live-mesh degrees"
+    # simulator prices the target topology, not a flat axis
+    topo = Simulator(model)._topo(64)
+    assert [s for _, s in topo] == structural_axis_sizes(64)
+
+
+def test_write_only_update_pricing_is_structural():
+    """The sparse-update cost depends on the CANDIDATE config, not live
+    process state: an unsharded lane-packed table prices the write-only
+    scatter (1.6 accesses/lookup), a row-sharded one the shard_map RMW
+    (2.0) — deterministic on any host."""
+    model, dcfg = _bench_model()
+    op = next(o for o in model.ops if "emb" in o.name)
+    lookups = 2048 * op.num_tables          # batch x T x bag(=1)
+    single = op.update_random_hbm_rows(ff.ParallelConfig((1, 1, 1)))
+    sharded = op.update_random_hbm_rows(ff.ParallelConfig((1, 8, 1)))
+    assert single == 1.6 * lookups, single
+    assert sharded == 2.0 * lookups, sharded
+
+
 def test_config_flags():
     cfg = ff.FFConfig.parse_args(["--measure-ops", "--debug-nans",
                                   "--strict-strategies"])
