@@ -21,9 +21,15 @@ TPU-native redesign:
   same-shape tables into one (N, rows, dim) parameter sharded on dim 0 —
   the GSPMD expression of "each table whole on one device" with the
   all-to-all the reference got from Legion DMA.
-- `device_type == CPU` configs are honored by pinning the table to host
-  memory (jax memories API) in a later milestone; currently they fall back
-  to TPU HBM.
+- hetero strategies: `device_type == CPU` host-offloads the COMPUTE
+  (compute_on); ZCM memory_types / FFConfig.host_resident_tables store the
+  table itself in host RAM with numpy gather + touched-rows scatter around
+  the jitted step (host_init/host_lookup/host_sgd_update below) — the
+  embedding_avx2.cc capability that lets tables larger than HBM train.
+- the sparse-SGD update keeps the forward-gathered tiles as residuals
+  (apply_with_fwd) so the scatter WRITES new rows without re-reading them
+  (ops/pallas scatter_write_rows_packed) — random HBM rows are the
+  latency floor on TPU.
 """
 
 from __future__ import annotations
