@@ -242,8 +242,10 @@ def test_write_only_update_pricing_is_structural():
 
 def test_config_flags():
     cfg = ff.FFConfig.parse_args(["--measure-ops", "--debug-nans",
-                                  "--strict-strategies"])
+                                  "--strict-strategies", "--host-tables",
+                                  "--no-nhwc"])
     assert cfg.search_measure and cfg.debug_nans and cfg.strict_strategies
+    assert cfg.host_resident_tables and not cfg.conv_nhwc
 
 
 def test_feasible_configs_execute_unclamped():
