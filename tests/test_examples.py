@@ -52,6 +52,18 @@ def test_dlrm_example_search_export(tmp_path, capsys):
     assert "THROUGHPUT" in capsys.readouterr().out
 
 
+def test_dlrm_example_host_tables(capsys):
+    """--host-tables through the example CLI (reference hetero run mode):
+    tables live in host RAM, training completes."""
+    mod = _load("native/dlrm.py")
+    mod.main(["-b", "32", "-e", "1", "--host-tables",
+              "--arch-embedding-size", "32-32-32-32",
+              "--arch-sparse-feature-size", "4",
+              "--arch-mlp-bot", "4-8-4",
+              "--arch-mlp-top", "20-8-1"])
+    assert "THROUGHPUT" in capsys.readouterr().out
+
+
 def test_alexnet_example_tiny(capsys):
     mod = _load("native/alexnet.py")
     mod.main(["-b", "8", "-e", "1", "--image-hw", "32"])
