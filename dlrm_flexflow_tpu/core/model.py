@@ -377,8 +377,27 @@ class FFModel:
                 distinct = {pc.device_ids[:1] for pc in pcs if pc.device_ids}
                 degree = max(1, min(len(distinct), op.num_tables, ndev))
                 dtyp = pcs[0].device_type
+                if any(pc.device_type != dtyp for pc in pcs):
+                    log_model.warning(
+                        "per-table strategies mix device types %s; the "
+                        "fused embedding %r uses %r for all tables",
+                        sorted({pc.device_type for pc in pcs}), op.name,
+                        dtyp)
+                # per-table ZCM marks host-RESIDENT storage
+                # (strategy.proto:11-14); any table marked ZCM makes the
+                # fused op host-resident — dropping it here would silently
+                # fall back to HBM tables and OOM the >HBM configs this
+                # path exists for
+                zcm = ["ZCM" in pc.memory_types for pc in pcs]
+                mem = ("ZCM",) if any(zcm) else ()
+                if any(zcm) and not all(zcm):
+                    log_model.warning(
+                        "per-table strategies mark only %d/%d tables ZCM; "
+                        "the fused embedding %r stores ALL tables "
+                        "host-resident (fusion constraint)",
+                        sum(zcm), len(zcm), op.name)
                 strategies[op.name] = ParallelConfig(
-                    (1, degree, 1), device_type=dtyp)
+                    (1, degree, 1), device_type=dtyp, memory_types=mem)
                 # honor the per-table device assignment, not just its
                 # degree: group tables by their strategy device so
                 # block-sharding the stacked dim lands table i exactly on

@@ -57,7 +57,12 @@ class TPUSpec:
     # row cost (the reference prices GPU<->DRAM at 16 MB/ms,
     # simulator.cu:27-29; v5e host link ~ PCIe gen3/4)
     pcie_bytes_per_s: float = 16e9
-    host_random_row_s: float = 1.0e-7
+    # host DDR random row access is SLOWER than HBM random access (~60-100
+    # ns DRAM latency, no HBM bank parallelism); pricing it cheaper would
+    # make the simulator prefer host tables over HBM tables, inverting the
+    # measured reality (benchmarks/bench_host_tables.py)
+    host_random_row_s: float = 6.0e-7
+    host_bytes_per_s: float = 50e9    # host DDR sequential stream
 
     @staticmethod
     def v4() -> "TPUSpec":
@@ -117,7 +122,11 @@ class CostModel:
     def op_compute_time(self, op: Op, pc: ParallelConfig,
                         backward: bool = False) -> float:
         """Roofline time for one device's shard of `op` (seconds)."""
-        key = (op.name, pc.degrees, backward)
+        # residency/device-type must key the cache: a ZCM config and an
+        # HBM config with equal degrees have sharply different costs, and
+        # MCMC rewrite proposals compare exactly such pairs
+        key = (op.name, pc.degrees, pc.device_type, pc.memory_types,
+               backward)
         if key in self._cache:
             return self._cache[key]
 
@@ -145,14 +154,16 @@ class CostModel:
     def _roofline_time(self, op: Op, pc: ParallelConfig,
                        backward: bool = False) -> float:
         if self._host_resident(op, pc):
-            # host gather (DRAM random rows) + rows over PCIe down
-            # (forward) / cotangents up + host scatter RMW (backward)
-            rows = op.random_hbm_rows(False)
+            # forward: host gather (DRAM random rows) + rows over PCIe
+            # down; backward: cotangents staged host-ward over PCIe — the
+            # touched-rows scatter itself is priced on the UPDATE task
+            # (simulator._build_tasks), not here, so it isn't charged twice
             out_bytes = self.tensor_bytes(op.outputs[0])
-            host_rows = rows * (2.0 if backward else 1.0)
-            return (self.spec.hbm_random_fixed_s
-                    + host_rows * self.spec.host_random_row_s
-                    + out_bytes / self.spec.pcie_bytes_per_s)
+            t = (self.spec.hbm_random_fixed_s
+                 + out_bytes / self.spec.pcie_bytes_per_s)
+            if not backward:
+                t += op.random_hbm_rows(False) * self.spec.host_random_row_s
+            return t
         batch = op.outputs[0].shape[0] if op.outputs[0].num_dims > 0 else 1
         flops = op.flops_per_sample() * batch / max(pc.num_parts, 1)
         # bytes: inputs read + outputs written (+ params read), sharded;
@@ -174,6 +185,26 @@ class CostModel:
         rand_rows = op.random_hbm_rows(backward) / max(pc.num_parts, 1)
         t = max(t, self.random_rows_time(rand_rows))
         return t + self.spec.kernel_launch_s
+
+    def host_update_time(self, op: Op, pc: ParallelConfig) -> float:
+        """Update cost for a host-RESIDENT (ZCM) table. Pairs with the
+        host branch of _roofline_time: the touched-rows scatter is priced
+        HERE (on the update task) and nowhere else, so forward/backward
+        must not charge it. Host DRAM is one shared resource — rows are
+        not divided by num_parts."""
+        if op.update_random_hbm_rows(pc) > 0:
+            # sparse path: host RMW scatter = 2 accesses per looked-up
+            # row (read + write; the 1.6x write-only discount is
+            # structural to the Pallas lane-packed TPU path and does not
+            # exist on the host)
+            rows = 2.0 * op.random_hbm_rows(False)
+            return (self.spec.hbm_random_fixed_s
+                    + rows * self.spec.host_random_row_s)
+        # dense fallback (momentum/Adam without sparse state): stream the
+        # FULL table read+write+state through host DDR
+        full_bytes = sum(math.prod(d.shape) * 4.0
+                         for d in op.param_defs().values())
+        return full_bytes * 3.0 / self.spec.host_bytes_per_s
 
     def random_rows_time(self, rows: float) -> float:
         if rows <= 0:
@@ -363,7 +394,8 @@ class CostModel:
         (fwd+vjp) − fwd on the op subgraph. Memoized."""
         import jax
 
-        key = ("measured", op.name, pc.degrees, backward)
+        key = ("measured", op.name, pc.degrees, pc.device_type,
+               pc.memory_types, backward)
         if key in self._cache:
             return self._cache[key]
         # inputs and params are built at the per-device shapes the op
@@ -382,8 +414,20 @@ class CostModel:
                     and getattr(t, "physical", None) == "nhwc"):
                 return (s[0], s[2], s[3], s[1])
             return s
-        xs = [jnp.zeros(_phys(s, t), t.dtype)
-              for s, t in zip(shard_shapes, op.inputs)]
+        # integer inputs are lookup indices: zeros would hit row 0 every
+        # iteration and hide the random-HBM-row latency that dominates
+        # sparse ops — fill them with seeded uniform rows over the table
+        # range instead (reference measures with the app's real batches)
+        import numpy as _np
+        rows = int(getattr(op, "num_entries", 0))
+        rng = _np.random.RandomState(0)
+
+        def _fill(s, t):
+            if rows > 0 and jnp.issubdtype(jnp.dtype(t.dtype), jnp.integer):
+                return jnp.asarray(rng.randint(0, rows, size=s),
+                                   dtype=t.dtype)
+            return jnp.zeros(_phys(s, t), t.dtype)
+        xs = [_fill(s, t) for s, t in zip(shard_shapes, op.inputs)]
         try:
             t_fwd = self._time_fn(
                 lambda p, xs_: op.apply(p, xs_, training=False), params, xs)

@@ -250,11 +250,16 @@ class Simulator:
                         for p in parents:
                             p.add_next(s)
                         parents = [s]
-            upd_compute = max(
-                dev_bytes / self.cost._hbm_rate() * 3.0,   # r/w+momentum
-                # sparse touched-rows scatter is random-access latency bound
-                self.cost.random_rows_time(
-                    op.update_random_hbm_rows(pc) / max(pc.num_parts, 1)))
+            if self.cost._host_resident(op, pc):
+                upd_compute = self.cost.host_update_time(op, pc)
+            else:
+                upd_compute = max(
+                    dev_bytes / self.cost._hbm_rate() * 3.0,  # r/w+momentum
+                    # sparse touched-rows scatter is random-access
+                    # latency bound
+                    self.cost.random_rows_time(
+                        op.update_random_hbm_rows(pc)
+                        / max(pc.num_parts, 1)))
             for d in self._participants(pc, ndev):
                 u = new_task(upd_compute, d, f"update:{op.name}")
                 for p in parents:

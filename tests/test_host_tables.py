@@ -115,6 +115,24 @@ class TestHostResidentTables:
         assert np.isfinite(
             model.host_params["emb_stack"]["kernel"]).all()
 
+    def test_per_table_zcm_keys_select_host_residency(self):
+        """Reference-format hetero strategies mark ZCM on per-table
+        `embeddingN` entries (dlrm_strategy_hetero.cc:28-49); the derived
+        fused-op config must carry memory_types through, or the path this
+        feature exists for (tables > HBM) silently falls back to
+        HBM-resident tables."""
+        dcfg = _dcfg()
+        strat = {f"embedding{i}": ParallelConfig(
+                     (1, 1), device_type="CPU", device_ids=(0,),
+                     memory_types=("ZCM",))
+                 for i in range(len(dcfg.embedding_size))}
+        model = _build(dcfg, strategies=strat)
+        assert "emb_stack" in model._host_resident_ops
+        assert "emb_stack" in model.host_params
+        _train_steps(model, dcfg, steps=2)
+        assert np.isfinite(
+            model.host_params["emb_stack"]["kernel"]).all()
+
     def test_eval_works_with_host_tables(self):
         dcfg = _dcfg()
         model = _build(dcfg, host_tables=True)
