@@ -55,6 +55,72 @@ def build_point(name, dcfg, batch, dtype, sparse_update=True):
     return name, model, batches
 
 
+def build_image_point(name, build_fn, batch, hw, steps_scale=1.0,
+                      **build_kw):
+    import numpy as np
+
+    import dlrm_flexflow_tpu as ff
+
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
+    model = ff.FFModel(cfg)
+    build_fn(model, num_classes=1000, image_hw=hw, **build_kw)
+    model.compile(ff.SGDOptimizer(lr=0.01),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+    model.init_layers()
+    rng = np.random.RandomState(0)
+    batches = [model._device_batch({
+        "image": rng.rand(batch, 3, hw, hw).astype(np.float32),
+        "label": rng.randint(0, 1000, (batch, 1)).astype(np.int32)})
+        for _ in range(2)]
+    return name, model, batches
+
+
+def build_attention_point(name, batch, seq, d, heads):
+    import numpy as np
+
+    import dlrm_flexflow_tpu as ff
+
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
+    model = ff.FFModel(cfg)
+    x = model.create_tensor((batch, seq, d), name="x")
+    t = model.multihead_attention(x, num_heads=heads, causal=True,
+                                  name="attn")
+    t = model.dense(model.reshape(t, (batch * seq, d), name="fold"),
+                    d, activation="relu", name="ff1")
+    t = model.dense(t, 1, name="head")
+    model.compile(ff.SGDOptimizer(lr=0.01), "mean_squared_error",
+                  ["mse"], final_tensor=t)
+    model.init_layers()
+    rng = np.random.RandomState(0)
+    batches = [model._device_batch({
+        "x": rng.rand(batch, seq, d).astype(np.float32),
+        "label": rng.rand(batch * seq, 1).astype(np.float32)})
+        for _ in range(2)]
+    return name, model, batches
+
+
+def build_lstm_point(name, batch, seq, vocab, hidden):
+    import numpy as np
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.models.nmt import build_nmt
+
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
+    model = ff.FFModel(cfg)
+    build_nmt(model, src_vocab=vocab, tgt_vocab=vocab, embed_dim=hidden,
+              hidden=hidden, num_layers=2, src_len=seq, tgt_len=seq)
+    model.compile(ff.SGDOptimizer(lr=0.1),
+                  "sparse_categorical_crossentropy", ["accuracy"])
+    model.init_layers()
+    rng = np.random.RandomState(0)
+    batches = [model._device_batch({
+        "src": rng.randint(0, vocab, (batch, seq)).astype(np.int32),
+        "tgt": rng.randint(0, vocab, (batch, seq)).astype(np.int32),
+        "label": rng.randint(0, vocab, (batch, seq)).astype(np.int32)})
+        for _ in range(2)]
+    return name, model, batches
+
+
 def calibration_points():
     from dlrm_flexflow_tpu.models.dlrm import DLRMConfig
 
@@ -77,6 +143,21 @@ def calibration_points():
     yield build_point("mlp_heavy_bf16_b1024", mlp, 1024, "bfloat16")
     yield build_point("dlrm_random_dense_upd_b256", rnd, 256, "bfloat16",
                       sparse_update=False)
+    # conv / attention / LSTM families: the shapes the InceptionV3
+    # searched strategy and the NMT/attention configs are optimized
+    # against must be checked against the chip too (round-2 calibrated
+    # only DLRM/MLP shapes)
+    from dlrm_flexflow_tpu.models.alexnet import build_alexnet
+    from dlrm_flexflow_tpu.models.resnet import build_resnet
+    yield build_image_point("alexnet_bf16_b256", build_alexnet, 256, 224)
+    yield build_image_point("resnet18_bf16_b128", build_resnet, 128, 224,
+                            depth=18)
+    yield build_image_point("resnet18_bf16_b64_hw112", build_resnet, 64,
+                            112, depth=18)
+    yield build_attention_point("attention_bf16_b8_s2048_d1024",
+                                8, 2048, 1024, 16)
+    yield build_lstm_point("nmt_lstm_bf16_b64_s40", 64, 40, 32 * 1024,
+                           1024)
 
 
 def main():

@@ -66,6 +66,14 @@ class FFConfig:
     # tables larger than HBM train on one chip. Per-op form: strategy
     # memory_types ZCM. Enable with --host-tables.
     host_resident_tables: bool = False
+    # pipeline the host-table work: the previous step's cotangent
+    # readback + host scatter run on a worker thread, overlapping the
+    # next step's host gather + H2D + device dispatch. The racing gather
+    # sees the table atomically before or after the in-flight scatter
+    # (never torn — a model-level lock serializes table access on every
+    # path), i.e. bounded one-step staleness instead of exact ordering.
+    # Enable with --host-tables-async.
+    host_tables_async: bool = False
     # run the conv stack (Conv2D/Pool2D/BatchNorm) in NHWC internally —
     # the TPU-native layout (the NCHW API shape is the cuDNN-native
     # choice, reference conv_2d.cu); disable with --no-nhwc
@@ -149,6 +157,8 @@ class FFConfig:
                 cfg.conv_nhwc = False
             elif a == "--host-tables":
                 cfg.host_resident_tables = True
+            elif a == "--host-tables-async":
+                cfg.host_tables_async = True
             else:
                 cfg.unparsed.append(a)
             i += 1

@@ -405,16 +405,47 @@ class FFModel:
                 # dlrm_strategy.cc:242-296)
                 dev_of = [pc.device_ids[0] if pc.device_ids else None
                           for pc in pcs]
-                if (hasattr(op, "set_table_order")
-                        and len(emb_keys) == op.num_tables
-                        and None not in dev_of):
+                if len(emb_keys) == op.num_tables and None not in dev_of:
                     devs = sorted(set(dev_of))
-                    per = op.num_tables // max(len(devs), 1)
-                    if (len(devs) == degree
-                            and all(dev_of.count(g) == per for g in devs)):
-                        op.set_table_order(tuple(
-                            i for g in devs
-                            for i, dg in enumerate(dev_of) if dg == g))
+                    if hasattr(op, "set_device_groups") and len(devs) > 1:
+                        # concatenated-rows form: UNEVEN per-table
+                        # placement is honored exactly by grouping the
+                        # rows by device with per-group padding
+                        before = op.total_rows
+                        op.set_device_groups(dev_of)
+                        if op.total_rows > 1.25 * before:
+                            log_model.warning(
+                                "honoring per-table device placement "
+                                "pads %r from %d to %d rows (+%d%%): "
+                                "groups pad to the LARGEST device's row "
+                                "count — skewed placements cost memory",
+                                op.name, before, op.total_rows,
+                                round(100 * (op.total_rows / before - 1)))
+                        if len(devs) != ndev:
+                            log_model.warning(
+                                "strategy places tables on %d devices "
+                                "but the mesh has %d; row blocks land "
+                                "in device order, placement is "
+                                "approximate", len(devs), ndev)
+                    elif hasattr(op, "set_table_order"):
+                        per = op.num_tables // max(len(devs), 1)
+                        if (len(devs) == degree
+                                and all(dev_of.count(g) == per
+                                        for g in devs)):
+                            op.set_table_order(tuple(
+                                i for g in devs
+                                for i, dg in enumerate(dev_of)
+                                if dg == g))
+                        elif len(devs) > 1:
+                            log_model.warning(
+                                "per-table device_ids place %d tables "
+                                "unevenly across %d devices (counts %s); "
+                                "the stacked uniform embedding can only "
+                                "block-shard equal groups — PLACEMENT "
+                                "INTENT DROPPED, executing degree-%d "
+                                "table sharding in declaration order",
+                                op.num_tables, len(devs),
+                                [dev_of.count(g) for g in devs], degree)
             elif not isinstance(op, fused_types) and i < len(emb_keys):
                 strategies[op.name] = strategies[emb_keys[i]]
         for op in self.ops:
@@ -704,24 +735,34 @@ class FFModel:
 
     # --- jitted steps --------------------------------------------------
     def _select_sparse_update_ops(self):
-        """Embedding-type ops whose tables can take the touched-rows-only
-        SGD update: plain SGD (no momentum/weight-decay — both terms touch
-        every row), op supports it, not host-offloaded. Disabled by
+        """Embedding-type ops whose tables take a touched-rows-only
+        update: plain SGD goes through the state-free sparse_sgd_update;
+        momentum/weight-decay SGD and Adam go through the STATEFUL lazy
+        sparse_opt_update (touched-rows state, lazily-applied decay) —
+        the reference's Adam world pays a full dense table stream
+        otherwise (optimizer_kernel.cu:110+). Disabled by
         config.sparse_embedding_update=False (--dense-embedding-update)."""
+        from ..core.optimizers import AdamOptimizer
         from ..ops.embedding import (Embedding, EmbeddingBagConcat,
                                      EmbeddingBagStacked)
         if not getattr(self.config, "sparse_embedding_update", True):
             return []
         opt = self.optimizer
-        if (not isinstance(opt, SGDOptimizer) or opt.momentum != 0.0
-                or opt.weight_decay != 0.0):
+        plain = (isinstance(opt, SGDOptimizer) and opt.momentum == 0.0
+                 and opt.weight_decay == 0.0)
+        stateful = ((isinstance(opt, SGDOptimizer) and not plain)
+                    or isinstance(opt, AdamOptimizer))
+        if not (plain or stateful):
             return []
         host = (getattr(self, "_host_offload_ops", set())
                 | getattr(self, "_host_resident_ops", set()))
-        return [op for op in self.ops
-                if isinstance(op, (Embedding, EmbeddingBagStacked,
-                                   EmbeddingBagConcat))
-                and op.supports_sparse_update() and op.name not in host]
+        ops = [op for op in self.ops
+               if isinstance(op, (Embedding, EmbeddingBagStacked,
+                                  EmbeddingBagConcat))
+               and op.supports_sparse_update() and op.name not in host]
+        if stateful:
+            ops = [op for op in ops if hasattr(op, "sparse_opt_update")]
+        return ops
 
     def _ancestor_op_names(self, targets) -> set:
         out: set = set()
@@ -774,19 +815,19 @@ class FFModel:
                         f"host-resident table op {op.name!r} must consume "
                         f"a model input directly (use the fused DLRM "
                         f"embedding layout)")
-        if host_ops and (not isinstance(self.optimizer, SGDOptimizer)
-                         or self.optimizer.momentum
-                         or self.optimizer.weight_decay):
+        from ..core.optimizers import AdamOptimizer
+        if host_ops and not isinstance(self.optimizer,
+                                       (SGDOptimizer, AdamOptimizer)):
             raise ValueError(
-                "host-resident tables support plain SGD only (momentum/"
-                "weight-decay touch every row — matches the sparse-update "
-                "restriction)")
+                "host-resident tables support SGD (plain/momentum/"
+                "weight-decay) and Adam — stateful optimizers take the "
+                "lazy touched-rows host update")
         for op in host_ops:
-            if getattr(op, "aggr", None) == "none":
+            if (getattr(op, "aggr", None) == "none"
+                    and not getattr(op, "host_aggr_none_ok", False)):
                 raise ValueError(
                     f"host-resident table op {op.name!r}: aggr='none' "
-                    f"(per-bag-slot outputs) is not implemented on the "
-                    f"host path — use sum/avg or keep the table in HBM")
+                    f"is not implemented on the host path for this op")
         # inputs consumed ONLY by host-resident ops never need to touch the
         # device: the wrapper reads them on the host for the gather/scatter
         # and the jitted step sees only the override values
@@ -854,14 +895,44 @@ class FFModel:
                 (loss, (preds, st2)), (gd, gev) = jax.value_and_grad(
                     objective, argnums=(0, 1), has_aux=True)(
                         p_dense, emb_vals, op_state)
+                # the optimizer state for sparse tables is NOT part of the
+                # dense update: split it out, update it touched-rows-only
+                # below, and merge back (keeps one opt_state pytree for
+                # checkpoints/sharding)
+                slab_names = self.optimizer.sparse_slab_names()
+                dense_state = {}
+                sparse_state = {}
+                for k, sub in opt_state.items():
+                    if k in slab_names and isinstance(sub, dict):
+                        dense_state[k] = {pk: pv for pk, pv in sub.items()
+                                          if pk not in sparse_names}
+                        sparse_state[k] = {pk: pv for pk, pv in sub.items()
+                                           if pk in sparse_names}
+                    else:
+                        dense_state[k] = sub
                 new_params, new_opt = self.optimizer.update(p_dense, gd,
-                                                            opt_state)
-                lr = self.optimizer.lr
+                                                            dense_state)
+                stateful = bool(slab_names) or (
+                    isinstance(self.optimizer, SGDOptimizer)
+                    and self.optimizer.weight_decay != 0.0)
+                pre_step = opt_state.get("step",
+                                         jnp.zeros((), jnp.int32))
                 for op in sparse_ops:
                     xs = [anc_env[t.guid] for t in op.inputs]
-                    new_params[op.name] = op.sparse_sgd_update(
-                        params[op.name], xs, gev[op.name], lr,
-                        fwd=emb_fwd.get(op.name))
+                    if stateful:
+                        slabs = {k: sparse_state[k][op.name]["kernel"]
+                                 for k in slab_names}
+                        new_k, new_slabs = op.sparse_opt_update(
+                            params[op.name], xs, gev[op.name],
+                            self.optimizer, slabs, pre_step,
+                            fwd=emb_fwd.get(op.name))
+                        new_params[op.name] = new_k
+                        for k in slab_names:
+                            new_opt[k][op.name] = {"kernel": new_slabs[k]}
+                    else:
+                        new_params[op.name] = op.sparse_sgd_update(
+                            params[op.name], xs, gev[op.name],
+                            self.optimizer.lr, fwd=emb_fwd.get(op.name))
                 if host_ops:
                     host_cts = {op.name: gev[op.name] for op in host_ops}
             else:
@@ -935,6 +1006,7 @@ class FFModel:
         op_state: Dict[str, Any] = {}
         hres = getattr(self, "_host_resident_ops", set())
         self.host_params: Dict[str, Dict[str, np.ndarray]] = {}
+        self.host_opt_state: Dict[str, Dict[str, np.ndarray]] = {}
         with jax.default_device(jax.devices()[0]):
             for i, op in enumerate(self.ops):
                 if isinstance(op, InputOp):
@@ -943,6 +1015,12 @@ class FFModel:
                     # table lives in host RAM, filled there (numpy) —
                     # never device_put (reference embedding_avx2.cc path)
                     self.host_params[op.name] = op.host_init(seed + i)
+                    # stateful optimizers keep their table-shaped state
+                    # slabs on the host too (lazy touched-rows update)
+                    for slab in self.optimizer.sparse_slab_names():
+                        self.host_opt_state.setdefault(op.name, {})[
+                            slab] = np.zeros_like(
+                                self.host_params[op.name]["kernel"])
                     continue
                 if op.param_defs():
                     key, sub = jax.random.split(key)
@@ -1090,34 +1168,100 @@ class FFModel:
          self._step_dev, mets) = outs
         self._step += 1
         if hres:
-            # apply the host-side touched-rows SGD scatter (synchronous:
-            # the cotangent readback is the step's true completion)
-            self._host_emb_update(host_idx, mets.pop("_host_cts"))
+            if getattr(self.config, "host_tables_async", False):
+                # pipelined: the cotangent readback + host scatter run on
+                # a worker thread, overlapping the NEXT step's host
+                # gather/H2D/dispatch (double-buffering; table reads and
+                # writes serialize on _host_table_lock, so the racing
+                # gather sees the table atomically before or after the
+                # scatter — bounded one-step staleness, never torn rows).
+                # Only one scatter in flight: join the previous first.
+                self._host_drain()
+                import threading
+                cts = mets.pop("_host_cts")
+                step = self._step - 1   # capture NOW: the thread may run
+                # after the next call's increment
+
+                def scatter():
+                    try:
+                        self._host_emb_update(host_idx, cts, step)
+                    except BaseException as e:   # re-raised at drain
+                        self._host_scatter_exc = e
+                t = threading.Thread(target=scatter, daemon=True)
+                self._host_scatter_thread = t
+                t.start()
+            else:
+                # exact ordering: the cotangent readback is the step's
+                # true completion
+                self._host_emb_update(host_idx, mets.pop("_host_cts"),
+                                      self._step - 1)
         # the running sums live on device; PerfMetrics syncs at report().
         # shallow-copy so perf.reset()/report() mutating perf.sums can
         # never corrupt the jit carry
         self.perf.sums = dict(self._msums)
         return mets
 
+    @property
+    def _host_lock(self):
+        """Serializes host-table reads (gather) against the async scatter
+        thread's writes — atomic either-order visibility on EVERY path
+        (native, numpy fallback, stateful updates), not just the native
+        pool's internal serialization."""
+        lk = getattr(self, "_host_table_lock", None)
+        if lk is None:
+            import threading
+            lk = self._host_table_lock = threading.Lock()
+        return lk
+
+    def _host_drain(self):
+        """Join the in-flight async host scatter (no-op when none) and
+        surface any exception it hit — a silently dropped scatter would
+        corrupt training. Call before any read of host_params that needs
+        the latest update (eval, checkpoint, end of fit)."""
+        t = getattr(self, "_host_scatter_thread", None)
+        if t is not None and t.is_alive():
+            t.join()
+        self._host_scatter_thread = None
+        exc = getattr(self, "_host_scatter_exc", None)
+        if exc is not None:
+            self._host_scatter_exc = None
+            raise exc
+
     def _host_emb_forward(self, host_idx):
         """Host-side gather for host-resident tables: numpy lookup on the
         already-read-back indices, rows shipped to the device at the op's
         output sharding."""
         out = {}
-        for op in self._host_resident_list:
-            val = op.host_lookup(self.host_params[op.name],
-                                 host_idx[op.name])
-            out[op.name] = jax.device_put(
-                val, self._out_sharding[op.outputs[0].guid])
+        with self._host_lock:
+            for op in self._host_resident_list:
+                val = op.host_lookup(self.host_params[op.name],
+                                     host_idx[op.name])
+                out[op.name] = jax.device_put(
+                    val, self._out_sharding[op.outputs[0].guid])
         return out
 
-    def _host_emb_update(self, host_idx, cts):
-        lr = self.optimizer.lr
-        for op in self._host_resident_list:
-            op.host_sgd_update(self.host_params[op.name],
-                               host_idx[op.name],
-                               np.asarray(cts[op.name], dtype=np.float32),
-                               lr)
+    def _host_emb_update(self, host_idx, cts, step):
+        opt = self.optimizer
+        stateful = bool(opt.sparse_slab_names()) or (
+            isinstance(opt, SGDOptimizer) and opt.weight_decay != 0.0)
+        # the device readback happens OUTSIDE the table lock (it is the
+        # slow part the async mode overlaps); only the table mutation
+        # serializes against concurrent gathers
+        cts_np = {op.name: np.asarray(cts[op.name], dtype=np.float32)
+                  for op in self._host_resident_list}
+        with self._host_lock:
+            for op in self._host_resident_list:
+                if stateful:
+                    # lazy momentum/Adam on the host (same semantics as
+                    # the device tile path)
+                    op.host_opt_update(
+                        self.host_params[op.name], host_idx[op.name],
+                        cts_np[op.name], opt,
+                        self.host_opt_state.get(op.name, {}), step)
+                else:
+                    op.host_sgd_update(self.host_params[op.name],
+                                       host_idx[op.name],
+                                       cts_np[op.name], opt.lr)
 
     @staticmethod
     def to_logical(value, tensor):
@@ -1131,6 +1275,7 @@ class FFModel:
         db = self._device_batch(batch, with_label=False)
         hres = getattr(self, "_host_resident_list", None)
         if hres:
+            self._host_drain()   # eval must see the last step's scatter
             db = dict(db)
             host_idx = {}
             for op in hres:
@@ -1315,6 +1460,7 @@ class FFModel:
                 # dependent readback = true completion (block_until_ready
                 # does not wait on some experimental PJRT backends)
                 float(mets["loss"])
+        self._host_drain()   # land the last async host scatter, if any
         elapsed = time.time() - start
         num_samples = num_batches * bs * epochs
         throughput = num_samples / elapsed if elapsed > 0 else float("inf")

@@ -35,6 +35,35 @@ class Optimizer:
     def hyperparams(self) -> Dict[str, float]:
         raise NotImplementedError
 
+    # ---- sparse (touched-rows-only) embedding support ----------------
+    # The reference updates embedding tables densely (one update task
+    # streaming the whole table + its table-sized gradient region,
+    # optimizer_kernel.cu:22-236). Here eligible embeddings take a
+    # touched-rows-only update (ops/embedding.py); stateful optimizers
+    # participate via the two hooks below with LAZY semantics (torch
+    # SparseAdam-style): state rows update only when their row is
+    # touched, and weight decay applies lazily on touch. Within a step
+    # the result on touched rows is EXACTLY the dense update (duplicate
+    # lookups are pre-summed into one gradient row by the caller).
+
+    def sparse_slab_names(self) -> tuple:
+        """State slabs (table-shaped arrays) the sparse path must carry."""
+        return ()
+
+    def sparse_row_update(self, w, g, slabs, touched, step):
+        """Update gathered rows: w, g (m, k) float32; slabs {name: (m, k)};
+        touched (m, k) bool — lanes of w belonging to rows that received
+        gradient (lane-packed tiles hold several logical rows; untouched
+        lanes must pass through unchanged). step = pre-increment scalar.
+        Returns (new_w, new_slabs)."""
+        raise NotImplementedError
+
+    def sparse_row_update_np(self, w, g, slabs, step):
+        """Numpy twin of sparse_row_update for HOST-resident tables (all
+        rows pre-deduped/touched; pure host math, never touches the
+        accelerator). Returns (new_w, new_slabs)."""
+        raise NotImplementedError
+
 
 class SGDOptimizer(Optimizer):
     """SGD with momentum / nesterov / weight decay.
@@ -83,6 +112,28 @@ class SGDOptimizer(Optimizer):
             return (w - lr * gt).astype(w.dtype)
 
         return jax.tree.map(upd_plain, params, grads), state
+
+    def sparse_slab_names(self):
+        return ("v",) if self.momentum > 0.0 else ()
+
+    def sparse_row_update(self, w, g, slabs, touched, step):
+        lr, m, wd = self.lr, self.momentum, self.weight_decay
+        gt = g + wd * w * touched if wd > 0.0 else g
+        if m > 0.0:
+            v = slabs["v"]
+            vn = jnp.where(touched, m * v + gt, v)
+            d = gt + m * vn if self.nesterov else vn
+            return jnp.where(touched, w - lr * d, w), {"v": vn}
+        return jnp.where(touched, w - lr * gt, w), {}
+
+    def sparse_row_update_np(self, w, g, slabs, step):
+        lr, m, wd = self.lr, self.momentum, self.weight_decay
+        gt = g + wd * w if wd > 0.0 else g
+        if m > 0.0:
+            vn = m * slabs["v"] + gt
+            d = gt + m * vn if self.nesterov else vn
+            return w - lr * d, {"v": vn}
+        return w - lr * gt, {}
 
 
 class AdamOptimizer(Optimizer):
@@ -133,3 +184,31 @@ class AdamOptimizer(Optimizer):
         new_m = jax.tree.map(lambda t_: t_[1], flat, is_leaf=is_triple)
         new_v = jax.tree.map(lambda t_: t_[2], flat, is_leaf=is_triple)
         return new_params, {"m": new_m, "v": new_v, "step": step}
+
+    def sparse_slab_names(self):
+        return ("m", "v")
+
+    def sparse_row_update_np(self, w, g, slabs, step):
+        import numpy as np
+        t = float(step) + 1.0
+        alpha_t = (self.alpha * np.sqrt(1.0 - self.beta2 ** t)
+                   / (1.0 - self.beta1 ** t))
+        wd, b1, b2, eps = (self.weight_decay, self.beta1, self.beta2,
+                           self.epsilon)
+        gt = g + wd * w if wd > 0.0 else g
+        mn = b1 * slabs["m"] + (1.0 - b1) * gt
+        vn = b2 * slabs["v"] + (1.0 - b2) * gt * gt
+        return w - alpha_t * mn / (np.sqrt(vn) + eps), {"m": mn, "v": vn}
+
+    def sparse_row_update(self, w, g, slabs, touched, step):
+        t = (step + 1).astype(jnp.float32)
+        alpha_t = (self.alpha * jnp.sqrt(1.0 - self.beta2 ** t)
+                   / (1.0 - self.beta1 ** t))
+        wd, b1, b2, eps = (self.weight_decay, self.beta1, self.beta2,
+                           self.epsilon)
+        gt = g + wd * w * touched if wd > 0.0 else g
+        m_, v_ = slabs["m"], slabs["v"]
+        mn = jnp.where(touched, b1 * m_ + (1.0 - b1) * gt, m_)
+        vn = jnp.where(touched, b2 * v_ + (1.0 - b2) * gt * gt, v_)
+        wn = jnp.where(touched, w - alpha_t * mn / (jnp.sqrt(vn) + eps), w)
+        return wn, {"m": mn, "v": vn}

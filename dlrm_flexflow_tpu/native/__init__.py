@@ -19,7 +19,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["ffsim.cc", "ffloader.cc"]
+_SOURCES = ["ffsim.cc", "ffloader.cc", "ffemb.cc"]
 _LIB_PATH = os.path.join(_DIR, "_ffnative.so")
 
 _lock = threading.Lock()
@@ -65,6 +65,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                   c.POINTER(c.c_int32), c.POINTER(c.c_float)]
     lib.ffloader_close.restype = None
     lib.ffloader_close.argtypes = [c.c_void_p]
+    lib.ffemb_bag_gather.restype = None
+    lib.ffemb_bag_gather.argtypes = [
+        c.POINTER(c.c_float), c.c_int64, c.c_int64,
+        c.POINTER(c.c_int64), c.c_int64, c.c_int64, c.c_int32,
+        c.POINTER(c.c_float)]
+    lib.ffemb_bag_scatter.restype = None
+    lib.ffemb_bag_scatter.argtypes = [
+        c.POINTER(c.c_float), c.c_int64, c.c_int64,
+        c.POINTER(c.c_int64), c.c_int64, c.c_int64, c.c_int32,
+        c.POINTER(c.c_float), c.c_float]
     return lib
 
 

@@ -101,7 +101,9 @@ def _embedding_update_rows(op, pc=None) -> float:
     # show random writes amortize only slightly better than reads —
     # 1.6 effective accesses/lookup fits every calibration point within
     # ~16% (benchmarks/calibrate_sim.py). Dense updates stream the table
-    # instead (param_bytes_touched_per_step).
+    # instead (param_bytes_touched_per_step). Stateful sparse updates
+    # (lazy momentum/Adam) add one read + one write per state slab per
+    # touched row on top of the weight traffic.
     #
     # The choice is STRUCTURAL (op attributes + the CANDIDATE config,
     # never the live process's backend/mesh): write-only needs
@@ -113,7 +115,11 @@ def _embedding_update_rows(op, pc=None) -> float:
     write_only = (getattr(op, "_pack", 1) > 1
                   and op.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
                   and (pc is None or pc.num_parts == 1))
-    return (1.6 if write_only else 2.0) * _lookup_count(op)
+    accesses = 1.6 if write_only else 2.0
+    opt = getattr(op.model, "optimizer", None)
+    if opt is not None:
+        accesses += 2.0 * len(opt.sparse_slab_names())
+    return accesses * _lookup_count(op)
 
 
 def _host_init_table(initializer, shape, seed: int):
@@ -141,28 +147,151 @@ def _host_init_table(initializer, shape, seed: int):
     return rng.uniform(-lim, lim, shape).astype(np.float32)
 
 
-def _host_bag_lookup(table, g, aggr):
-    """table (rows, d) numpy; g (batch, T, bag) global rows -> (batch,T,d)."""
+def _native_emb():
+    """The native threaded gather/scatter library, or None (numpy
+    fallback). The reference's hetero path is blocked AVX2/FMA C++
+    (embedding_avx2.cc); native/ffemb.cc is this build's equivalent."""
+    from .. import native
+    return native.get_lib()
+
+
+# gather path chosen by MEASUREMENT per shape (the reference's own trick
+# for cuDNN conv algos, conv_2d.cu:217,873): the threaded native gather
+# wins on many-core hosts, numpy's fancy-index loop wins on small CPU
+# quotas — time both once and keep the faster
+_GATHER_CHOICE: Dict[tuple, str] = {}
+
+
+def _native_gather(lib, table, g, aggr, d):
+    import ctypes
+
     import numpy as np
-    rows = table[g.reshape(-1)].reshape(g.shape + (table.shape[-1],))
+    batch, T, bag = g.shape
+    gf = np.ascontiguousarray(g.reshape(batch * T, bag), np.int64)
+    out = np.empty((batch * T, d), np.float32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    ip = ctypes.POINTER(ctypes.c_int64)
+    lib.ffemb_bag_gather(
+        table.ctypes.data_as(fp), table.shape[0], d,
+        gf.ctypes.data_as(ip), batch * T, bag,
+        1 if aggr == AGGR_MODE_AVG else 0, out.ctypes.data_as(fp))
+    return out.reshape(batch, T, d)
+
+
+def _numpy_gather(table, g, aggr, d):
+    import numpy as np
+    rows = table[g.reshape(-1)].reshape(g.shape + (d,))
     out = rows.mean(axis=2) if aggr == AGGR_MODE_AVG else rows.sum(axis=2)
     return np.ascontiguousarray(out, np.float32)
 
 
+def _host_bag_lookup(table, g, aggr):
+    """table (rows, d) numpy; g (batch, T, bag) global rows -> (batch,T,d)."""
+    import time
+
+    import numpy as np
+    d = table.shape[-1]
+    lib = _native_emb()
+    native_ok = (lib is not None and table.dtype == np.float32
+                 and table.flags["C_CONTIGUOUS"])
+    if not native_ok:
+        return _numpy_gather(table, g, aggr, d)
+    key = (table.shape, g.shape, aggr)
+    choice = _GATHER_CHOICE.get(key)
+    if choice is None:
+        # warm both paths first (the native side pays one-time pool
+        # construction and cold caches; timing it cold would cache the
+        # wrong verdict forever), then time each once
+        _native_gather(lib, table, g, aggr, d)
+        _numpy_gather(table, g, aggr, d)
+        t0 = time.perf_counter()
+        out_n = _native_gather(lib, table, g, aggr, d)
+        t_native = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out_p = _numpy_gather(table, g, aggr, d)
+        t_numpy = time.perf_counter() - t0
+        choice = "native" if t_native <= t_numpy else "numpy"
+        _GATHER_CHOICE[key] = choice
+        return out_n if choice == "native" else out_p
+    if choice == "native":
+        return _native_gather(lib, table, g, aggr, d)
+    return _numpy_gather(table, g, aggr, d)
+
+
 def _host_bag_update(table, g, ct, lr, aggr):
     """In-place table[g] -= lr * d(out)/d(rows) · ct (duplicate-safe)."""
+    import ctypes
+
+    import numpy as np
+    d = table.shape[-1]
+    lib = _native_emb()
+    if (lib is not None and table.dtype == np.float32
+            and table.flags["C_CONTIGUOUS"]):
+        batch, T, bag = g.shape
+        gf = np.ascontiguousarray(g.reshape(batch * T, bag), np.int64)
+        cf = np.ascontiguousarray(ct.reshape(batch * T, d), np.float32)
+        fp = ctypes.POINTER(ctypes.c_float)
+        ip = ctypes.POINTER(ctypes.c_int64)
+        lib.ffemb_bag_scatter(
+            table.ctypes.data_as(fp), table.shape[0], d,
+            gf.ctypes.data_as(ip), batch * T, bag,
+            1 if aggr == AGGR_MODE_AVG else 0,
+            cf.ctypes.data_as(fp), float(lr))
+        return
+    bag = g.shape[-1]
+    c = ct / bag if aggr == AGGR_MODE_AVG else ct
+    upd = np.broadcast_to(c[..., None, :], g.shape + (d,))
+    np.add.at(table, g.reshape(-1), -lr * upd.reshape(-1, d))
+
+
+def _host_dedup_rows(flat, upd):
+    """Numpy duplicate combination for the host stateful update: stateful
+    optimizers are nonlinear in the gradient, so duplicate lookups must
+    pre-sum into one gradient row (same reason as _dedup_rows)."""
+    import numpy as np
+    uniq, inv = np.unique(flat, return_inverse=True)
+    summed = np.zeros((uniq.shape[0], upd.shape[-1]), np.float32)
+    np.add.at(summed, inv, upd)
+    return uniq, summed
+
+
+def _host_stateful_update(table, g, ct, opt, slabs, step, aggr):
+    """Lazy stateful touched-rows update on a HOST (numpy) table — the
+    host twin of _sparse_opt_update (same semantics as the device tile
+    path: state rows update only on touch, decay applies lazily).
+
+    table (rows, d) numpy, mutated in place; g (batch, T, bag) global
+    rows; ct (batch, T, d); slabs {name: (rows, d)} mutated in place."""
+    d = table.shape[-1]
     import numpy as np
     bag = g.shape[-1]
     c = ct / bag if aggr == AGGR_MODE_AVG else ct
-    upd = np.broadcast_to(c[..., None, :], g.shape + (table.shape[-1],))
-    np.add.at(table, g.reshape(-1), -lr * upd.reshape(-1, table.shape[-1]))
+    upd = np.broadcast_to(c[..., None, :],
+                          g.shape + (d,)).reshape(-1, d)
+    uniq, summed = _host_dedup_rows(g.reshape(-1), upd)
+    slab_rows = {k: v[uniq] for k, v in slabs.items()}
+    wn, sn = opt.sparse_row_update_np(table[uniq], summed, slab_rows,
+                                      step)
+    table[uniq] = wn
+    for k in slabs:
+        slabs[k][uniq] = sn[k]
+
+
+def _touched_bytes_factor(op) -> float:
+    """Bytes-per-touched-element multiplier: gather read + update
+    read/write of the weights (3), plus read+write per optimizer state
+    slab on the stateful sparse path."""
+    opt = getattr(op.model, "optimizer", None)
+    nslabs = len(opt.sparse_slab_names()) if opt is not None else 0
+    return 3.0 + 2.0 * nslabs
 
 
 def _sparse_update_active(op) -> bool:
-    """Whether the touched-rows-only update will actually run for `op`
-    (mirrors FFModel._select_sparse_update_ops; optimizer may be unset
-    when the search costs ops pre-compile — assume the common plain-SGD
-    case then)."""
+    """Whether a touched-rows-only update will actually run for `op` —
+    the state-free plain-SGD path or the stateful lazy momentum/Adam
+    path (mirrors FFModel._select_sparse_update_ops; optimizer may be
+    unset when the search costs ops pre-compile — assume the common
+    plain-SGD case then)."""
     if not getattr(op.model.config, "sparse_embedding_update", True):
         return False
     if not op.supports_sparse_update():
@@ -172,9 +301,135 @@ def _sparse_update_active(op) -> bool:
     opt = getattr(op.model, "optimizer", None)
     if opt is None:
         return True
-    from ..core.optimizers import SGDOptimizer
-    return (isinstance(opt, SGDOptimizer) and opt.momentum == 0.0
-            and opt.weight_decay == 0.0)
+    from ..core.optimizers import AdamOptimizer, SGDOptimizer
+    if isinstance(opt, SGDOptimizer):
+        return (opt.momentum == 0.0 and opt.weight_decay == 0.0) \
+            or hasattr(op, "sparse_opt_update")
+    return isinstance(opt, AdamOptimizer) and hasattr(op,
+                                                      "sparse_opt_update")
+
+
+def _dedup_rows(gidx, upd, num_rows: int):
+    """Row-granularity duplicate combination: sort + segment-sum, exactly
+    the sorted-segment trick of the Pallas scatters but in UNPACKED row
+    space (stateful optimizers are nonlinear in the gradient, so duplicate
+    lookups MUST be pre-summed into one gradient row — dense semantics).
+
+    gidx (n,) int row ids (duplicates allowed); upd (n, d).
+    Returns (target (n,), summed (n, d)): distinct target rows with their
+    combined updates; pad slots carry target == num_rows (out of bounds,
+    dropped by mode='drop' scatters)."""
+    n = gidx.shape[0]
+    order = jnp.argsort(gidx)
+    si = jnp.take(gidx, order)
+    sg = jnp.take(upd, order, axis=0)
+    first = jnp.concatenate([jnp.ones((1,), jnp.bool_), si[1:] != si[:-1]])
+    seg = jnp.cumsum(first) - 1
+    summed = jax.ops.segment_sum(sg, seg, num_segments=n,
+                                 indices_are_sorted=True)
+    target = jax.ops.segment_max(si, seg, num_segments=n,
+                                 indices_are_sorted=True)
+    valid = jnp.arange(n) < seg[-1] + 1
+    target = jnp.where(valid, target, num_rows).astype(jnp.int32)
+    return target, summed
+
+
+def _stateful_update_rows_xla(logical, gidx, upd, opt, slabs, step):
+    """Generic stateful touched-rows update on the LOGICAL (rows, d) view:
+    dedup -> gather w/state rows -> optimizer row math -> scatter-set.
+    Runs on any backend (the CPU-mesh test oracle and the fallback for
+    layouts the Pallas tile path doesn't cover).
+
+    logical (rows, d); slabs {name: (rows, d)} in the same layout.
+    Returns (new_logical, new_slabs)."""
+    rows = logical.shape[0]
+    target, summed = _dedup_rows(gidx, upd, rows)
+    safe = jnp.minimum(target, rows - 1)
+    w = jnp.take(logical, safe, axis=0).astype(jnp.float32)
+    slab_rows = {k: jnp.take(v, safe, axis=0).astype(jnp.float32)
+                 for k, v in slabs.items()}
+    touched = jnp.ones_like(w, dtype=jnp.bool_)
+    wn, sn = opt.sparse_row_update(w, summed.astype(jnp.float32),
+                                   slab_rows, touched, step)
+    new_logical = logical.at[target].set(wn.astype(logical.dtype),
+                                         mode="drop")
+    new_slabs = {k: slabs[k].at[target].set(sn[k].astype(slabs[k].dtype),
+                                            mode="drop")
+                 for k in slabs}
+    return new_logical, new_slabs
+
+
+def _stateful_update_tiles_packed(view, gidx, upd, d, opt, slab_views,
+                                  step, fwd_tiles=None, interpret=False):
+    """TPU tile path of the stateful touched-rows update, on the lane-
+    packed (vrows, 128) view (128 // d logical rows per tile).
+
+    Same structure as the write-only sparse-SGD scatter: dedup at TILE
+    granularity, then pure Pallas writes of distinct tiles — but each
+    tile's new value comes from the optimizer's row math applied to the
+    whole 128-lane tile with a per-lane `touched` mask (a tile holds
+    several logical rows; only looked-up rows' lanes may change, or lazy
+    momentum/Adam would decay their tile-neighbours). Weight tiles come
+    from the forward-gather residuals when available (no re-read); state
+    tiles are gathered here (their only read).
+    """
+    from .pallas.embedding_kernel import (_dedup_tile_updates,
+                                          _pack_tile_updates,
+                                          scatter_write_tiles)
+    tile_rows, tile_upds = _pack_tile_updates(gidx, upd, d, jnp.float32)
+    _, tile_ones = _pack_tile_updates(gidx, jnp.ones_like(upd), d,
+                                      jnp.float32)
+    # one sort/segment pass for both the gradient and the touch counts
+    both = jnp.concatenate([tile_upds, tile_ones], axis=1)
+    target, summed, rep, _ = _dedup_tile_updates(tile_rows, both)
+    g_tiles, counts = summed[:, :128], summed[:, 128:]
+    touched = counts > 0
+    safe = jnp.minimum(jnp.maximum(target, 0), view.shape[0] - 1)
+    if fwd_tiles is not None:
+        # any duplicate's forward tile is the same pre-update value; rep
+        # holds one original lookup position per segment (pad slots 0,
+        # dropped by target < 0 at the write)
+        w = jnp.take(fwd_tiles, rep, axis=0).astype(jnp.float32)
+    else:
+        w = jnp.take(view, safe, axis=0).astype(jnp.float32)
+    slab_tiles = {k: jnp.take(v, safe, axis=0).astype(jnp.float32)
+                  for k, v in slab_views.items()}
+    wn, sn = opt.sparse_row_update(w, g_tiles, slab_tiles, touched, step)
+    new_view = scatter_write_tiles(view, target, wn, interpret=interpret)
+    new_slabs = {k: scatter_write_tiles(slab_views[k], target, sn[k],
+                                        interpret=interpret)
+                 for k in slab_views}
+    return new_view, new_slabs
+
+
+def _sparse_opt_update(op, tbl, gidx, upd, opt, slabs, step, total_rows,
+                       fwd_tiles=None):
+    """Shared stateful-update router for the embedding ops: lane-packed
+    Pallas tile path on TPU, logical-row XLA path elsewhere.
+
+    tbl: stored kernel (any layout reshapeable to (total_rows, d));
+    slabs {name: same-layout state}; gidx (n,) UNPACKED global rows;
+    upd (n, d) RAW gradient rows (not pre-scaled by -lr — stateful
+    optimizers are nonlinear in the gradient).
+    Returns (new_kernel, new_slabs) in the stored layout."""
+    d = op.out_dim
+    r = getattr(op, "_pack", 1)
+    use_tiles = (r * d == 128
+                 and _pallas_scatter_ok(op.model, 128, op.name)
+                 and _row_shard_axes(op, d, total_rows // r) is None)
+    if use_tiles:
+        view = tbl.reshape(total_rows // r, r * d)
+        slab_views = {k: v.reshape(total_rows // r, r * d)
+                      for k, v in slabs.items()}
+        nv, ns = _stateful_update_tiles_packed(view, gidx, upd, d, opt,
+                                               slab_views, step, fwd_tiles)
+    else:
+        view = tbl.reshape(total_rows, d)
+        slab_views = {k: v.reshape(total_rows, d) for k, v in slabs.items()}
+        nv, ns = _stateful_update_rows_xla(view, gidx, upd, opt,
+                                           slab_views, step)
+    return (nv.reshape(tbl.shape),
+            {k: ns[k].reshape(slabs[k].shape) for k in slabs})
 
 
 def _pallas_common(model, op_name: str, width_ok: bool) -> bool:
@@ -253,6 +508,8 @@ class Embedding(Op):
     SUM/AVG aggregation, or (batch, bag, out_dim) with AGGR_MODE_NONE."""
 
     type_name = "Embed"
+    # per-bag-slot (aggr="none") outputs work on the host-resident path
+    host_aggr_none_ok = True
 
     def __init__(self, model, input_tensor, num_entries: int, out_dim: int,
                  aggr: str = AGGR_MODE_SUM, kernel_initializer=None,
@@ -336,7 +593,8 @@ class Embedding(Op):
         # gather read + sparse-update read/write of this shard's rows only
         batch = self.inputs[0].shape[0]
         bag = self.inputs[0].shape[-1] if self.inputs[0].num_dims > 1 else 1
-        return int(3 * batch * bag * self.out_dim * 4 // max(num_parts, 1))
+        return int(_touched_bytes_factor(self) * batch * bag
+                   * self.out_dim * 4 // max(num_parts, 1))
 
     # ---- sparse (touched-rows-only) SGD update -------------------------
     # The dense path materializes a gradient the size of the whole table
@@ -374,6 +632,29 @@ class Embedding(Op):
             new = tbl.at[idx.reshape(-1)].add(-lr * upd)
         return {"kernel": new}
 
+    def sparse_opt_update(self, params, xs, out_ct, opt, slabs, step,
+                          fwd=None):
+        """Stateful touched-rows update (lazy momentum / Adam): the dense
+        update streams the whole table + state slabs (reference
+        optimizer_kernel.cu adam_update world); this touches only the
+        gathered rows' weights AND state."""
+        (idx,) = xs
+        tbl = params["kernel"]
+        idx = idx.astype(jnp.int32) % self.num_entries
+        d = self.out_dim
+        ct = out_ct.astype(jnp.float32)
+        if self.aggr == AGGR_MODE_AVG:
+            ct = ct / idx.shape[-1]
+        if self.aggr == AGGR_MODE_NONE:
+            upd = ct.reshape(-1, d)
+        else:
+            upd = jnp.broadcast_to(ct[..., None, :],
+                                   idx.shape + (d,)).reshape(-1, d)
+        new_k, new_s = _sparse_opt_update(self, tbl, idx.reshape(-1), upd,
+                                          opt, slabs, step,
+                                          self.num_entries)
+        return {"kernel": new_k}, new_s
+
 
 
     # ---- host-resident table form (reference embedding_avx2.cc) --------
@@ -386,6 +667,10 @@ class Embedding(Op):
         g = idx_np.astype(np.int64) % self.num_entries
         if g.ndim == 1:
             g = g[:, None]
+        if self.aggr == AGGR_MODE_NONE:
+            # per-bag-slot outputs: no reduction, (batch, bag, d)
+            return np.ascontiguousarray(
+                host_params["kernel"][g], np.float32)
         out = _host_bag_lookup(host_params["kernel"], g[:, None, :],
                                self.aggr)
         return out[:, 0]                                  # (batch, d)
@@ -395,8 +680,37 @@ class Embedding(Op):
         g = idx_np.astype(np.int64) % self.num_entries
         if g.ndim == 1:
             g = g[:, None]
+        if self.aggr == AGGR_MODE_NONE:
+            # ct (batch, bag, d): each slot's cotangent lands on its row
+            d = self.out_dim
+            np.add.at(host_params["kernel"], g.reshape(-1),
+                      -lr * ct_np.reshape(-1, d))
+            return
         _host_bag_update(host_params["kernel"], g[:, None, :],
                          ct_np[:, None, :], lr, self.aggr)
+
+    def host_opt_update(self, host_params, idx_np, ct_np, opt, slabs,
+                        step):
+        """Lazy stateful (momentum/Adam) host update; see
+        _host_stateful_update."""
+        import numpy as np
+        g = idx_np.astype(np.int64) % self.num_entries
+        if g.ndim == 1:
+            g = g[:, None]
+        if self.aggr == AGGR_MODE_NONE:
+            uniq, summed = _host_dedup_rows(
+                g.reshape(-1), ct_np.reshape(-1, self.out_dim))
+            tbl = host_params["kernel"]
+            slab_rows = {k: v[uniq] for k, v in slabs.items()}
+            wn, sn = opt.sparse_row_update_np(tbl[uniq], summed,
+                                              slab_rows, step)
+            tbl[uniq] = wn
+            for k in slabs:
+                slabs[k][uniq] = sn[k]
+            return
+        _host_stateful_update(host_params["kernel"], g[:, None, :],
+                              ct_np[:, None, :], opt, slabs, step,
+                              self.aggr)
 
 
 class EmbeddingBagStacked(Op):
@@ -564,8 +878,8 @@ class EmbeddingBagStacked(Op):
         if not _sparse_update_active(self):
             return self.param_bytes()
         batch, _, bag = self.inputs[0].shape
-        return int(3 * batch * self.num_tables * bag * self.out_dim * 4
-                   // max(num_parts, 1))
+        return int(_touched_bytes_factor(self) * batch * self.num_tables
+                   * bag * self.out_dim * 4 // max(num_parts, 1))
 
     # ---- sparse (touched-rows-only) SGD update (see Embedding) ---------
     def supports_sparse_update(self) -> bool:
@@ -677,6 +991,30 @@ class EmbeddingBagStacked(Op):
         new = jax.vmap(one_table, in_axes=(0, 1, 1))(tbl, idx, ct)
         return {"kernel": new}
 
+    def sparse_opt_update(self, params, xs, out_ct, opt, slabs, step,
+                          fwd=None):
+        """Stateful touched-rows update (lazy momentum / Adam) on the
+        fused stacked tables; see Embedding.sparse_opt_update."""
+        (idx,) = xs                       # (batch, T, bag)
+        tbl = params["kernel"]            # (T, rows/r, r*d)
+        idx = idx.astype(jnp.int32) % self.num_entries
+        ct = out_ct.astype(jnp.float32)   # (batch, T, d)
+        if self._table_order is not None:
+            idx = jnp.take(idx, self._table_order, axis=1)
+            ct = jnp.take(ct, self._table_order, axis=1)
+        if self.aggr == AGGR_MODE_AVG:
+            ct = ct / idx.shape[-1]
+        d = self.out_dim
+        T, rows = self.num_tables, self.num_entries
+        offs = (jnp.arange(T, dtype=jnp.int32) * rows)[None, :, None]
+        g = (idx + offs).reshape(-1)
+        upd = jnp.broadcast_to(ct[..., None, :],
+                               idx.shape + (d,)).reshape(-1, d)
+        fwd_tiles = fwd[1] if fwd is not None else None
+        new_k, new_s = _sparse_opt_update(self, tbl, g, upd, opt, slabs,
+                                          step, T * rows, fwd_tiles)
+        return {"kernel": new_k}, new_s
+
 
 
     # ---- host-resident table form (reference embedding_avx2.cc) --------
@@ -700,6 +1038,17 @@ class EmbeddingBagStacked(Op):
         g = idx_np.astype(np.int64) % rows + offs
         _host_bag_update(host_params["kernel"].reshape(T * rows, d), g,
                          ct_np, lr, self.aggr)
+
+    def host_opt_update(self, host_params, idx_np, ct_np, opt, slabs,
+                        step):
+        import numpy as np
+        T, rows, d = host_params["kernel"].shape
+        offs = (np.arange(T, dtype=np.int64) * rows)[None, :, None]
+        g = idx_np.astype(np.int64) % rows + offs
+        _host_stateful_update(
+            host_params["kernel"].reshape(T * rows, d), g, ct_np, opt,
+            {k: v.reshape(T * rows, d) for k, v in slabs.items()},
+            step, self.aggr)
 
 
 class EmbeddingBagConcat(Op):
@@ -749,6 +1098,33 @@ class EmbeddingBagConcat(Op):
         self.outputs = [self._make_output(
             (batch, self.num_tables, self.out_dim))]
 
+    def set_device_groups(self, dev_of):
+        """Group the concatenated tables by their strategy device: row
+        block k holds exactly the tables the strategy places on the k-th
+        named device, each block padded to one common size, so GSPMD's
+        equal-block row sharding lands every table WHOLE on its intended
+        device — the reference's per-table round-robin placement
+        (dlrm_strategy.cc:242-296, mapper.cc:33-97) with UNEVEN table
+        counts per device. Must be called before init_params (compile-time
+        strategy resolution does)."""
+        assert len(dev_of) == self.num_tables
+        devs = sorted(set(dev_of))
+        groups = [[i for i, dg in enumerate(dev_of) if dg == g]
+                  for g in devs]
+        block = max(sum(self.table_sizes[i] for i in grp)
+                    for grp in groups)
+        block = -(-block // self._ROW_PAD) * self._ROW_PAD
+        offs = [0] * self.num_tables
+        for k, grp in enumerate(groups):
+            off = k * block
+            for i in grp:
+                offs[i] = off
+                off += self.table_sizes[i]
+        self._offsets = tuple(offs)
+        self.total_rows = block * len(groups)
+        self._pack = _pack_factor(self.out_dim, self.total_rows)
+        self._device_groups = tuple(devs)
+
     def param_defs(self):
         r = self._pack
         return {"kernel": ParamDef(
@@ -758,15 +1134,17 @@ class EmbeddingBagConcat(Op):
     def init_params(self, key):
         # per-table init at each table's LOGICAL (rows_t, d) shape:
         # one Glorot over the fused multi-million-row shape would collapse
-        # small tables' scale to ~0 versus the unfused per-table ops
+        # small tables' scale to ~0 versus the unfused per-table ops.
+        # Tables land at their _offsets (sequential by default; grouped by
+        # device under set_device_groups), pad rows stay zero.
         keys = jax.random.split(key, self.num_tables)
-        parts = [self.kernel_initializer(
-            keys[i], (rows, self.out_dim), jnp.float32)
-            for i, rows in enumerate(self.table_sizes)]
-        pad = self.total_rows - sum(self.table_sizes)
-        if pad:
-            parts.append(jnp.zeros((pad, self.out_dim), jnp.float32))
-        return {"kernel": self.pack_kernel(jnp.concatenate(parts))}
+        logical = jnp.zeros((self.total_rows, self.out_dim), jnp.float32)
+        for i, rows in enumerate(self.table_sizes):
+            part = self.kernel_initializer(
+                keys[i], (rows, self.out_dim), jnp.float32)
+            logical = jax.lax.dynamic_update_slice(
+                logical, part, (self._offsets[i], 0))
+        return {"kernel": self.pack_kernel(logical)}
 
     def unpack_kernel(self, kernel):
         """(total_rows/r, r*d) stored form -> logical (total_rows, d)."""
@@ -871,8 +1249,8 @@ class EmbeddingBagConcat(Op):
         if not _sparse_update_active(self):
             return self.param_bytes()
         batch, _, bag = self.inputs[0].shape
-        return int(3 * batch * self.num_tables * bag * self.out_dim * 4
-                   // max(num_parts, 1))
+        return int(_touched_bytes_factor(self) * batch * self.num_tables
+                   * bag * self.out_dim * 4 // max(num_parts, 1))
 
     # ---- sparse (touched-rows-only) SGD update (see Embedding) ---------
     def supports_sparse_update(self) -> bool:
@@ -939,16 +1317,34 @@ class EmbeddingBagConcat(Op):
                 self.unpack_kernel(tbl).at[g.reshape(-1)].add(-lr * upd))
         return {"kernel": new}
 
+    def sparse_opt_update(self, params, xs, out_ct, opt, slabs, step,
+                          fwd=None):
+        """Stateful touched-rows update (lazy momentum / Adam) on the
+        concatenated non-uniform tables; see Embedding.sparse_opt_update."""
+        (idx,) = xs                        # (batch, T, bag)
+        tbl = params["kernel"]             # (total_rows/r, r*d)
+        g = self._global_indices(idx)
+        ct = out_ct.astype(jnp.float32)    # (batch, T, d)
+        if self.aggr == AGGR_MODE_AVG:
+            ct = ct / g.shape[-1]
+        d = self.out_dim
+        upd = jnp.broadcast_to(ct[..., None, :],
+                               g.shape + (d,)).reshape(-1, d)
+        fwd_tiles = fwd[1] if fwd is not None else None
+        new_k, new_s = _sparse_opt_update(self, tbl, g.reshape(-1), upd,
+                                          opt, slabs, step,
+                                          self.total_rows, fwd_tiles)
+        return {"kernel": new_k}, new_s
+
     # ---- host-resident table form (reference embedding_avx2.cc) --------
     def host_init(self, seed: int):
         import numpy as np
-        parts = [_host_init_table(self.kernel_initializer,
-                                  (rows, self.out_dim), seed + i)
-                 for i, rows in enumerate(self.table_sizes)]
-        pad = self.total_rows - sum(self.table_sizes)
-        if pad:
-            parts.append(np.zeros((pad, self.out_dim), np.float32))
-        return {"kernel": np.concatenate(parts)}
+        logical = np.zeros((self.total_rows, self.out_dim), np.float32)
+        for i, rows in enumerate(self.table_sizes):
+            logical[self._offsets[i]:self._offsets[i] + rows] = \
+                _host_init_table(self.kernel_initializer,
+                                 (rows, self.out_dim), seed + i)
+        return {"kernel": logical}
 
     def _host_global_indices(self, idx_np):
         import numpy as np
@@ -964,4 +1360,10 @@ class EmbeddingBagConcat(Op):
         _host_bag_update(host_params["kernel"],
                          self._host_global_indices(idx_np), ct_np, lr,
                          self.aggr)
+
+    def host_opt_update(self, host_params, idx_np, ct_np, opt, slabs,
+                        step):
+        _host_stateful_update(host_params["kernel"],
+                              self._host_global_indices(idx_np), ct_np,
+                              opt, slabs, step, self.aggr)
 

@@ -406,7 +406,25 @@ def scatter_write_rows_packed(view: jax.Array, indices: jax.Array,
     # representative original position's tile stands in for the segment
     vals = (jnp.take(fwd_tiles, rep, axis=0).astype(view.dtype)
             + summed.astype(view.dtype))
+    return scatter_write_tiles(view, target, vals, interpret=interpret)
 
+
+def scatter_write_tiles(view: jax.Array, target: jax.Array,
+                        vals: jax.Array,
+                        interpret: bool = False) -> jax.Array:
+    """Pure-write scatter of whole (1, 128) tiles at DISTINCT view rows.
+
+    PRECONDITIONS (the caller establishes them, e.g. via
+    _dedup_tile_updates): targets are distinct; target < 0 marks a pad
+    slot to skip; len(target) is a _TILE_B multiple. Used by the write-
+    only sparse-SGD update and by the stateful (momentum/Adam) sparse
+    update, which writes the new weight AND state tiles this way.
+
+    view   : (vrows, 128) (donated/aliased)
+    target : (m,) int32, m % _TILE_B == 0
+    vals   : (m, 128) new tile values
+    """
+    m = target.shape[0]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(m // _TILE_B,),

@@ -44,6 +44,8 @@ def _unflatten(flat):
 
 def save_checkpoint(model, path: str):
     """Save params + optimizer state + step to `path` (.npz)."""
+    if hasattr(model, "_host_drain"):
+        model._host_drain()   # land any in-flight async host scatter
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     flat = {}
     flat.update({f"params/{k}": v
@@ -55,6 +57,9 @@ def save_checkpoint(model, path: str):
     flat.update({f"hostparams/{k}": v
                  for k, v in _flatten(
                      getattr(model, "host_params", {}) or {}).items()})
+    flat.update({f"hostopt/{k}": v
+                 for k, v in _flatten(
+                     getattr(model, "host_opt_state", {}) or {}).items()})
     flat["meta/step"] = np.asarray(model._step)
     np.savez(path, **flat)
 
@@ -63,7 +68,8 @@ def restore_checkpoint(model, path: str):
     """Restore into a compiled model, re-applying each parameter's GSPMD
     sharding."""
     data = np.load(path if path.endswith(".npz") else path + ".npz")
-    params_flat, opt_flat, state_flat, host_flat = {}, {}, {}, {}
+    params_flat, opt_flat, state_flat = {}, {}, {}
+    host_flat, hostopt_flat = {}, {}
     for k in data.files:
         if k.startswith("params/"):
             params_flat[k[len("params/"):]] = data[k]
@@ -73,6 +79,8 @@ def restore_checkpoint(model, path: str):
             state_flat[k[len("state/"):]] = data[k]
         elif k.startswith("hostparams/"):
             host_flat[k[len("hostparams/"):]] = data[k]
+        elif k.startswith("hostopt/"):
+            hostopt_flat[k[len("hostopt/"):]] = data[k]
     params = _unflatten(params_flat)
     # validate against the model's parameter spec before overwriting
     # anything: a mismatch (e.g. a checkpoint from a per-table or
@@ -109,6 +117,8 @@ def restore_checkpoint(model, path: str):
     if host_flat:
         # host-resident tables stay numpy on the host — no device_put
         model.host_params = _unflatten(host_flat)
+    if hostopt_flat:
+        model.host_opt_state = _unflatten(hostopt_flat)
     model._step = int(data["meta/step"])
     return model
 

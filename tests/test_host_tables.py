@@ -21,12 +21,14 @@ def _dcfg(sizes=(64,) * 8):
                       mlp_top=[8 * (len(sizes) + 1), 16, 1])
 
 
-def _build(dcfg, host_tables=False, ndev=1, strategies=None):
+def _build(dcfg, host_tables=False, ndev=1, strategies=None,
+           optimizer=None):
     cfg = ff.FFConfig(batch_size=16, seed=7,
                       host_resident_tables=host_tables)
     model = ff.FFModel(cfg)
     build_dlrm(model, dcfg)
-    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+    model.compile(optimizer or ff.SGDOptimizer(lr=0.1),
+                  "mean_squared_error", ["mse"],
                   mesh=make_mesh(num_devices=ndev), strategies=strategies)
     model.init_layers()
     return model
@@ -133,6 +135,88 @@ class TestHostResidentTables:
         assert np.isfinite(
             model.host_params["emb_stack"]["kernel"]).all()
 
+    def test_stateful_host_matches_device_sparse_path(self):
+        """Host-resident tables under momentum SGD and Adam: the lazy
+        numpy update must match the device's lazy tile update exactly
+        (same semantics, both touched-rows-only) — tables AND state."""
+        for label, opt_f in (
+                ("momentum", lambda: ff.SGDOptimizer(lr=0.1,
+                                                     momentum=0.9)),
+                ("adam", lambda: ff.AdamOptimizer(alpha=0.01))):
+            dcfg = _dcfg()
+            dev = _build(dcfg, host_tables=False, optimizer=opt_f())
+            host = _build(dcfg, host_tables=True, optimizer=opt_f())
+            emb = _sync_tables(dev, host)
+            _train_steps(dev, dcfg)
+            _train_steps(host, dcfg)
+            dev_op = next(op for op in dev.ops if op.name == emb.name)
+            want = np.asarray(dev_op.unpack_kernel(
+                dev.params[emb.name]["kernel"]))
+            got = host.host_params[emb.name]["kernel"]
+            np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5,
+                                       err_msg=label)
+            for slab in dev.optimizer.sparse_slab_names():
+                want_s = np.asarray(dev_op.unpack_kernel(
+                    dev.opt_state[slab][emb.name]["kernel"]))
+                got_s = host.host_opt_state[emb.name][slab]
+                np.testing.assert_allclose(
+                    got_s, want_s, rtol=2e-4, atol=2e-5,
+                    err_msg=f"{label}:{slab}")
+
+    def test_aggr_none_host_matches_device(self):
+        """Per-bag-slot (aggr='none') embedding on the host path."""
+        def build(host):
+            cfg = ff.FFConfig(batch_size=8, seed=3,
+                              host_resident_tables=host)
+            model = ff.FFModel(cfg)
+            sl = model.create_tensor((8, 3), dtype="int32", name="slots")
+            emb = model.embedding(sl, 32, 4, aggr="none", name="emb")
+            flat = model.reshape(emb, (8, 12), name="flat")
+            out = model.dense(flat, 1, name="head")
+            model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                          ["mse"], mesh=make_mesh(num_devices=1),
+                          final_tensor=out)
+            model.init_layers()
+            return model
+
+        dev, host = build(False), build(True)
+        # align inits: jax and numpy initializers draw differently
+        host.host_params["emb"]["kernel"][:] = np.asarray(
+            dev.params["emb"]["kernel"])
+        for name, pdict in dev.params.items():
+            if name == "emb":
+                continue
+            host.params[name] = {k: jax.device_put(np.asarray(v))
+                                 for k, v in pdict.items()}
+        host.opt_state = host.optimizer.init_state(host.params)
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            batch = {
+                "slots": rng.randint(0, 32, (8, 3)).astype(np.int32),
+                "label": rng.rand(8, 1).astype(np.float32)}
+            dev.train_batch(dict(batch))
+            host.train_batch(dict(batch))
+        np.testing.assert_allclose(
+            host.host_params["emb"]["kernel"],
+            np.asarray(dev.params["emb"]["kernel"]),
+            rtol=2e-4, atol=2e-5)
+
+    def test_async_pipeline_trains_and_drains(self):
+        """--host-tables-async: the scatter thread pipeline trains, the
+        drain lands the last scatter, eval sees updated tables."""
+        dcfg = _dcfg()
+        model = _build(dcfg, host_tables=True)
+        model.config.host_tables_async = True
+        before = model.host_params["emb_stack"]["kernel"].copy()
+        _train_steps(model, dcfg, steps=4)
+        x, _ = synthetic_batch(dcfg, 16)
+        out = np.asarray(model.forward_batch(x))   # drains implicitly
+        assert model._host_scatter_thread is None
+        assert np.isfinite(out).all()
+        k = model.host_params["emb_stack"]["kernel"]
+        assert np.isfinite(k).all()
+        assert not np.array_equal(k, before), "tables must have trained"
+
     def test_eval_works_with_host_tables(self):
         dcfg = _dcfg()
         model = _build(dcfg, host_tables=True)
@@ -166,15 +250,31 @@ class TestHostResidentTables:
         assert np.isfinite(
             model.host_params["emb_stack"]["kernel"]).all()
 
-    def test_momentum_rejected(self):
+    def test_unknown_optimizer_rejected(self):
+        """SGD/Adam host tables are supported (lazy updates); anything
+        else must fail loudly at compile, not corrupt tables later."""
         import pytest
+
+        from dlrm_flexflow_tpu.core.optimizers import Optimizer
+
+        class Exotic(Optimizer):
+            lr = 0.1
+
+            def init_state(self, params):
+                return {}
+
+            def update(self, params, grads, state):
+                return params, state
+
+            def hyperparams(self):
+                return {}
+
         dcfg = _dcfg()
         cfg = ff.FFConfig(batch_size=16, host_resident_tables=True)
         model = ff.FFModel(cfg)
         build_dlrm(model, dcfg)
-        with pytest.raises(ValueError, match="plain SGD"):
-            model.compile(ff.SGDOptimizer(lr=0.1, momentum=0.9),
-                          "mean_squared_error", ["mse"],
+        with pytest.raises(ValueError, match="host-resident"):
+            model.compile(Exotic(), "mean_squared_error", ["mse"],
                           mesh=make_mesh(num_devices=1))
 
 

@@ -187,3 +187,38 @@ class TestNativeLoader:
                 losses.append(float(mets["loss"]))
         dl.close()
         assert losses[-1] < losses[0]
+
+
+class TestNativeHostEmbedding:
+    """native/ffemb.cc threaded gather/scatter vs the numpy oracle (the
+    reference's hetero path is blocked AVX2 C++, embedding_avx2.cc; the
+    numpy expressions are the semantics both must match)."""
+
+    def test_gather_scatter_match_numpy(self):
+        import numpy as np
+
+        from dlrm_flexflow_tpu import native
+        from dlrm_flexflow_tpu.ops.embedding import (_host_bag_lookup,
+                                                     _host_bag_update)
+        if native.get_lib() is None:
+            import pytest
+            pytest.skip("native toolchain unavailable")
+        rng = np.random.RandomState(0)
+        rows, d, batch, T, bag = 997, 48, 32, 8, 3
+        table = rng.randn(rows, d).astype(np.float32)
+        # duplicates guaranteed: small row space
+        g = rng.randint(0, rows, (batch, T, bag)).astype(np.int64)
+        for aggr in ("sum", "avg"):
+            out = _host_bag_lookup(table, g, aggr)
+            ref = table[g.reshape(-1)].reshape(g.shape + (d,))
+            ref = ref.mean(2) if aggr == "avg" else ref.sum(2)
+            np.testing.assert_allclose(out, ref.astype(np.float32),
+                                       rtol=1e-6, atol=1e-6)
+            t_nat, t_np = table.copy(), table.copy()
+            ct = rng.randn(batch, T, d).astype(np.float32)
+            _host_bag_update(t_nat, g, ct, 0.1, aggr)
+            c = ct / bag if aggr == "avg" else ct
+            upd = np.broadcast_to(c[..., None, :], g.shape + (d,))
+            np.add.at(t_np, g.reshape(-1), -0.1 * upd.reshape(-1, d))
+            np.testing.assert_allclose(t_nat, t_np, rtol=1e-5, atol=1e-6,
+                                       err_msg=aggr)

@@ -262,3 +262,72 @@ class TestScatterWritePacked:
                 jnp.asarray(fwd_tiles))
         np.testing.assert_allclose(np.asarray(got).reshape(rows, d), want,
                                    rtol=0, atol=0)
+
+
+class TestStatefulTilesPacked:
+    """The lane-packed tile path of the stateful sparse update must agree
+    with the logical-row XLA path (its oracle) — including the per-lane
+    touched masks that keep a tile's OTHER logical rows' state undecayed."""
+
+    def _run(self, opt, rows=256, d=16, n=96, fwd=False, seed=0):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from dlrm_flexflow_tpu.ops.embedding import (
+            _stateful_update_rows_xla, _stateful_update_tiles_packed)
+        rng = np.random.RandomState(seed)
+        logical = rng.randn(rows, d).astype(np.float32)
+        gidx = rng.randint(0, rows, size=(n,)).astype(np.int32)
+        upd = rng.randn(n, d).astype(np.float32)
+        slabs = {k: rng.rand(rows, d).astype(np.float32)
+                 for k in opt.sparse_slab_names()}
+        step = jnp.asarray(3, jnp.int32)
+
+        want_w, want_s = jax.jit(
+            lambda l, g, u, s: _stateful_update_rows_xla(
+                l, g, u, opt, s, step))(
+                    jnp.asarray(logical), jnp.asarray(gidx),
+                    jnp.asarray(upd), {k: jnp.asarray(v)
+                                       for k, v in slabs.items()})
+
+        r = 128 // d
+        view = logical.reshape(rows // r, r * d)
+        slab_views = {k: v.reshape(rows // r, r * d)
+                      for k, v in slabs.items()}
+        fwd_tiles = (jnp.asarray(view[gidx // r]) if fwd else None)
+        got_w, got_s = jax.jit(
+            lambda v, g, u, s: _stateful_update_tiles_packed(
+                v, g, u, d, opt, s, step, fwd_tiles=fwd_tiles,
+                interpret=True))(
+                    jnp.asarray(view), jnp.asarray(gidx),
+                    jnp.asarray(upd), {k: jnp.asarray(v)
+                                       for k, v in slab_views.items()})
+        np.testing.assert_allclose(
+            np.asarray(got_w).reshape(rows, d), np.asarray(want_w),
+            rtol=1e-5, atol=1e-6)
+        for k in slabs:
+            np.testing.assert_allclose(
+                np.asarray(got_s[k]).reshape(rows, d),
+                np.asarray(want_s[k]), rtol=1e-5, atol=1e-6, err_msg=k)
+
+    def test_momentum(self):
+        import dlrm_flexflow_tpu as ff
+        self._run(ff.SGDOptimizer(lr=0.1, momentum=0.9))
+
+    def test_momentum_wd_nesterov(self):
+        import dlrm_flexflow_tpu as ff
+        self._run(ff.SGDOptimizer(lr=0.1, momentum=0.9, nesterov=True,
+                                  weight_decay=1e-3))
+
+    def test_adam(self):
+        import dlrm_flexflow_tpu as ff
+        self._run(ff.AdamOptimizer(alpha=0.01))
+
+    def test_adam_with_fwd_residuals(self):
+        import dlrm_flexflow_tpu as ff
+        self._run(ff.AdamOptimizer(alpha=0.01), fwd=True)
+
+    def test_adam_full_tile_rows(self):
+        import dlrm_flexflow_tpu as ff
+        self._run(ff.AdamOptimizer(alpha=0.01), rows=128, d=128, n=64)

@@ -34,14 +34,15 @@ def test_simulator_deterministic_and_sane():
 
 def test_table_parallel_beats_dp_in_simulation():
     """The core SOAP claim on DLRM under DENSE embedding updates (the
-    reference's world — momentum/Adam, or --dense-embedding-update):
-    table-parallel embeddings beat pure DP, which all-reduces the full
-    2 GB of tables every step. (With the sparse touched-rows update this
-    framework adds, plain-SGD DP becomes comm-cheap — see
+    reference's world, reachable via --dense-embedding-update now that
+    momentum/Adam take the stateful sparse path too): table-parallel
+    embeddings beat pure DP, which all-reduces the full 2 GB of tables
+    every step. (With touched-rows updates DP becomes comm-cheap — see
     test_sparse_updates_make_dp_cheap — and the table-parallel advantage
     shifts to HBM capacity, see the terabyte test.)"""
     model, dcfg = _bench_model()
-    model.optimizer = ff.SGDOptimizer(lr=0.1, momentum=0.9)  # dense world
+    model.optimizer = ff.SGDOptimizer(lr=0.1, momentum=0.9)
+    model.config.sparse_embedding_update = False   # dense world
     sim = Simulator(model)
     dp = default_strategy(model, 8)
     hand = dlrm_strategy(model, dcfg, 8)
@@ -66,7 +67,8 @@ def test_sparse_updates_make_dp_cheap():
 
 def test_mcmc_rediscovers_table_parallelism():
     model, dcfg = _bench_model()
-    model.optimizer = ff.SGDOptimizer(lr=0.1, momentum=0.9)  # dense world
+    model.optimizer = ff.SGDOptimizer(lr=0.1, momentum=0.9)
+    model.config.sparse_embedding_update = False   # dense world
     sim = Simulator(model)
     dp = default_strategy(model, 8)
     found = optimize(model, budget=300, alpha=1.2, ndev=8, seed=0)
@@ -199,7 +201,8 @@ def test_dp_sync_on_hybrid_topology_rides_dcn():
     """Full-mesh DP gradient sync crosses the slice axis, so the hybrid
     topology must price it above the same sync on a flat ICI mesh."""
     model, _ = _bench_model()
-    model.optimizer = ff.SGDOptimizer(lr=0.1, momentum=0.9)  # dense sync
+    model.optimizer = ff.SGDOptimizer(lr=0.1, momentum=0.9)
+    model.config.sparse_embedding_update = False   # dense sync
     dp = default_strategy(model, 8)
     t_flat = Simulator(model, topology=[("ici", 8)]).simulate(dp, 8)
     t_hybrid = Simulator(

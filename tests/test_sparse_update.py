@@ -56,13 +56,14 @@ class TestSparseUpdate:
         model, _ = _train(sparse=True, steps=1)
         assert model._sparse_update_ops == ["emb_stack"]
 
-    def test_disabled_for_momentum_and_wd(self):
-        m1, _ = _train(sparse=True, steps=1,
-                       optimizer=ff.SGDOptimizer(lr=0.1, momentum=0.9))
-        assert m1._sparse_update_ops == []
-        m2, _ = _train(sparse=True, steps=1,
-                       optimizer=ff.SGDOptimizer(lr=0.1, weight_decay=1e-4))
-        assert m2._sparse_update_ops == []
+    def test_enabled_for_momentum_wd_adam(self):
+        """Momentum/weight-decay SGD and Adam now take the STATEFUL
+        touched-rows path instead of falling back to dense updates."""
+        for opt in (ff.SGDOptimizer(lr=0.1, momentum=0.9),
+                    ff.SGDOptimizer(lr=0.1, weight_decay=1e-4),
+                    ff.AdamOptimizer(alpha=0.01)):
+            m, _ = _train(sparse=True, steps=1, optimizer=opt)
+            assert m._sparse_update_ops == ["emb_stack"], type(opt).__name__
 
     @pytest.mark.parametrize("fuse", [True, False])
     def test_matches_dense_path(self, fuse):
@@ -116,6 +117,193 @@ class TestSparseUpdate:
             return jax.tree.map(np.asarray, model.params)
 
         _assert_equal_trees(run(True), run(False))
+
+
+def _stateful_optimizers():
+    return [
+        ("momentum", lambda: ff.SGDOptimizer(lr=0.1, momentum=0.9)),
+        ("nesterov_wd", lambda: ff.SGDOptimizer(lr=0.1, momentum=0.9,
+                                                nesterov=True,
+                                                weight_decay=1e-3)),
+        ("wd_only", lambda: ff.SGDOptimizer(lr=0.1, weight_decay=1e-3)),
+        ("adam", lambda: ff.AdamOptimizer(alpha=0.01)),
+        ("adam_wd", lambda: ff.AdamOptimizer(alpha=0.01,
+                                             weight_decay=1e-3)),
+    ]
+
+
+class TestStatefulSparseUpdate:
+    """Lazy (touched-rows-only) momentum/Adam vs the dense reference
+    update (optimizer_kernel.cu sgd_update/adam_update semantics).
+
+    Within a step, touched rows must match the dense update exactly
+    (duplicates pre-summed). Across steps the LAZY semantics differ on
+    untouched rows by design (their state does not decay — torch
+    SparseAdam behavior), so multi-step comparisons either restrict to
+    runs where every row is touched every step or pin the lazy behavior
+    explicitly."""
+
+    DCFG = dict(embedding_size=[64] * 8, sparse_feature_size=8,
+                embedding_bag_size=2, mlp_bot=[4, 16, 8],
+                mlp_top=[72, 16, 1])
+
+    def _logical(self, model, name="emb_stack"):
+        op = model.get_layer_by_name(name)
+        k = np.asarray(model.params[name]["kernel"])
+        return np.asarray(op.unpack_kernel(k)).reshape(
+            op.num_tables, op.num_entries, op.out_dim)
+
+    def _slab(self, model, slab, name="emb_stack"):
+        op = model.get_layer_by_name(name)
+        arr = model.opt_state[slab][name]["kernel"]
+        return np.asarray(op.unpack_kernel(np.asarray(arr))).reshape(
+            op.num_tables, op.num_entries, op.out_dim)
+
+    @pytest.mark.parametrize("label,opt_f", _stateful_optimizers())
+    def test_single_step_matches_dense_on_touched_rows(self, label, opt_f):
+        dcfg = DLRMConfig(**self.DCFG)
+        m_s, _ = _train(sparse=True, steps=1, bag=2, optimizer=opt_f())
+        m_d, _ = _train(sparse=False, steps=1, bag=2, optimizer=opt_f())
+        ls, ld = self._logical(m_s), self._logical(m_d)
+        x, _ = synthetic_batch(dcfg, 16, seed=0)
+        idx = np.asarray(x["sparse"])              # (16, 8, 2)
+        for t in range(8):
+            rows = np.unique(idx[:, t, :].astype(np.int64) % 64)
+            np.testing.assert_allclose(
+                ls[t][rows], ld[t][rows], rtol=1e-5, atol=1e-6,
+                err_msg=f"{label}: table {t} touched rows")
+            # state slabs on touched rows match the dense state
+            opt = m_s.optimizer
+            for slab in opt.sparse_slab_names():
+                ss = self._slab(m_s, slab)
+                sd = self._slab(m_d, slab)
+                np.testing.assert_allclose(
+                    ss[t][rows], sd[t][rows], rtol=1e-5, atol=1e-6,
+                    err_msg=f"{label}: table {t} slab {slab}")
+
+    def test_untouched_rows_and_state_are_lazy(self):
+        """Untouched rows keep their initial value AND zero state (the
+        dense momentum update would keep moving them once v != 0)."""
+        dcfg = DLRMConfig(**self.DCFG)
+        m_s, _ = _train(sparse=True, steps=3, bag=2,
+                        optimizer=ff.SGDOptimizer(lr=0.1, momentum=0.9))
+        touched = [set() for _ in range(8)]
+        for s in range(3):
+            x, _ = synthetic_batch(dcfg, 16, seed=s)
+            idx = np.asarray(x["sparse"])
+            for t in range(8):
+                touched[t] |= set((idx[:, t, :].astype(np.int64)
+                                   % 64).ravel())
+        m_init, _ = _train(sparse=True, steps=0, bag=2,
+                           optimizer=ff.SGDOptimizer(lr=0.1, momentum=0.9))
+        ls, li = self._logical(m_s), self._logical(m_init)
+        v = self._slab(m_s, "v")
+        for t in range(8):
+            untouched = sorted(set(range(64)) - touched[t])
+            if not untouched:
+                continue
+            np.testing.assert_array_equal(ls[t][untouched],
+                                          li[t][untouched])
+            np.testing.assert_array_equal(v[t][untouched], 0.0)
+
+    @pytest.mark.parametrize("label,opt_f",
+                             [("momentum",
+                               lambda: ff.SGDOptimizer(lr=0.1,
+                                                       momentum=0.9)),
+                              ("adam",
+                               lambda: ff.AdamOptimizer(alpha=0.01))])
+    def test_all_rows_touched_matches_dense_multi_step(self, label, opt_f):
+        """When every row is touched every step, lazy == dense for the
+        whole run (weights AND state)."""
+        rows, T, d, batch, bag = 32, 4, 8, 16, 2
+
+        def run(sparse):
+            cfg = ff.FFConfig(batch_size=batch, seed=11)
+            cfg.sparse_embedding_update = sparse
+            model = ff.FFModel(cfg)
+            dense_in = model.create_tensor((batch, 4), name="dense")
+            sparse_in = model.create_tensor((batch, T, bag), dtype="int32",
+                                            name="sparse")
+            bot = model.dense(dense_in, 8, activation="relu", name="bot")
+            emb = model.embedding_stacked(sparse_in, T, rows, d, name="emb")
+            flat = model.reshape(emb, (batch, T * d), name="flat")
+            cat = model.concat([bot, flat], axis=1, name="cat")
+            out = model.dense(cat, 1, name="head")
+            model.compile(opt_f(), "mean_squared_error", ["mse"],
+                          mesh=make_mesh(num_devices=1), final_tensor=out)
+            model.init_layers()
+            rng = np.random.RandomState(7)
+            for s in range(4):
+                # full coverage: batch*bag == rows, a permutation per table
+                idx = np.stack([rng.permutation(rows).reshape(batch, bag)
+                                for _ in range(T)], axis=1)
+                batch_d = {
+                    "dense": rng.rand(batch, 4).astype(np.float32),
+                    "sparse": idx.astype(np.int32),
+                    "label": rng.rand(batch, 1).astype(np.float32),
+                }
+                model.train_batch(batch_d)
+            out = {"params": jax.tree.map(np.asarray, model.params)}
+            for slab in model.optimizer.sparse_slab_names():
+                out[slab] = np.asarray(
+                    model.opt_state[slab]["emb"]["kernel"])
+            return out
+
+        a, b = run(True), run(False)
+        _assert_equal_trees(a["params"], b["params"], rtol=2e-5,
+                            atol=2e-6)
+        for slab in (set(a) - {"params"}):
+            np.testing.assert_allclose(a[slab], b[slab], rtol=2e-5,
+                                       atol=2e-6, err_msg=slab)
+
+    def test_adam_concat_single_step_touched_rows(self):
+        """The non-uniform concatenated-rows op under Adam."""
+        sizes = [40, 7, 300, 12, 64, 5, 128, 9]
+        dcfg = DLRMConfig(embedding_size=sizes, sparse_feature_size=8,
+                          embedding_bag_size=1,
+                          mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1])
+
+        def run(sparse):
+            cfg = ff.FFConfig(batch_size=16, seed=5)
+            cfg.sparse_embedding_update = sparse
+            model = ff.FFModel(cfg)
+            build_dlrm(model, dcfg)
+            model.compile(ff.AdamOptimizer(alpha=0.01),
+                          "mean_squared_error", ["mse"],
+                          mesh=make_mesh(num_devices=1))
+            model.init_layers()
+            x, y = synthetic_batch(dcfg, 16, seed=0)
+            x["label"] = y
+            model.train_batch(dict(x))
+            return model, x
+
+        m_s, x = run(True)
+        m_d, _ = run(False)
+        assert m_s._sparse_update_ops == ["emb_concat"]
+        op = m_s.get_layer_by_name("emb_concat")
+        ks = np.asarray(op.unpack_kernel(
+            np.asarray(m_s.params["emb_concat"]["kernel"])))
+        kd = np.asarray(op.unpack_kernel(
+            np.asarray(m_d.params["emb_concat"]["kernel"])))
+        g = np.asarray(op._host_global_indices(np.asarray(x["sparse"])))
+        rows = np.unique(g)
+        np.testing.assert_allclose(ks[rows], kd[rows], rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_momentum_8dev_matches_1dev_on_touched_rows(self):
+        dcfg = DLRMConfig(**self.DCFG)
+        m8, _ = _train(sparse=True, steps=1, bag=2, ndev=8,
+                       strategies=dlrm_strategy,
+                       optimizer=ff.SGDOptimizer(lr=0.1, momentum=0.9))
+        m1, _ = _train(sparse=False, steps=1, bag=2,
+                       optimizer=ff.SGDOptimizer(lr=0.1, momentum=0.9))
+        l8, l1 = self._logical(m8), self._logical(m1)
+        x, _ = synthetic_batch(dcfg, 16, seed=0)
+        idx = np.asarray(x["sparse"])
+        for t in range(8):
+            rows = np.unique(idx[:, t, :].astype(np.int64) % 64)
+            np.testing.assert_allclose(l8[t][rows], l1[t][rows],
+                                       rtol=2e-4, atol=2e-5)
 
 
 class TestEmbeddingBagConcat:

@@ -193,6 +193,105 @@ class TestGenStrategyAndGenericKeys:
         mets = model.train_batch(x)
         assert np.isfinite(float(mets["loss"]))
 
+    def test_uneven_device_ids_pb_places_tables_exactly(self, tmp_path):
+        """A .pb placing 7 NON-UNIFORM tables round-robin on 3 devices
+        (counts 3/2/2 — reference dlrm_strategy.cc round-robin with
+        tables % devices != 0): the concatenated-rows embedding groups
+        its rows by device with per-group padding, so every table lands
+        WHOLE on exactly the device the file names, and the model still
+        computes the identity-layout math."""
+        import numpy as np
+
+        import dlrm_flexflow_tpu as ff
+        from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                                   synthetic_batch)
+        from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+
+        sizes = [40, 7, 300, 12, 64, 5, 128]          # 7 non-uniform
+        dev_of = [i % 3 for i in range(7)]            # 0,1,2,0,1,2,0
+        strategies = {f"embedding{i}": ParallelConfig(
+                          (1, 1), device_ids=(dev_of[i],))
+                      for i in range(7)}
+        strategies["linear"] = ParallelConfig((3, 1),
+                                              device_ids=(0, 1, 2))
+        path = str(tmp_path / "uneven.pb")
+        save_strategies_pb(path, strategies)           # full round-trip
+
+        mesh = make_mesh(num_devices=3)
+        dcfg = DLRMConfig(embedding_size=sizes, sparse_feature_size=8,
+                          mlp_bot=[4, 16, 8], mlp_top=[64, 16, 1])
+        cfg = ff.FFConfig(batch_size=18, seed=4)
+        cfg.import_strategy_file = path
+        model = ff.FFModel(cfg)
+        build_dlrm(model, dcfg, fuse_embeddings=True)
+        model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                      ["mse"], mesh=mesh)
+        model.init_layers()
+        op = next(o for o in model.ops if o.name == "emb_concat")
+
+        # rows are grouped: block k holds exactly device k's tables
+        assert getattr(op, "_device_groups", None) == (0, 1, 2)
+        block = op.total_rows // 3
+        for i, dev in enumerate(dev_of):
+            off = op._offsets[i]
+            assert off // block == dev, (i, off, block)
+            assert (off + sizes[i] - 1) // block == dev, \
+                f"table {i} straddles blocks"
+
+        # the sharded kernel puts block k on mesh device k
+        kernel = model.params["emb_concat"]["kernel"]
+        vrows = kernel.shape[0]
+        devs = list(mesh.devices.flat)
+        for sh in kernel.addressable_shards:
+            sl = sh.index[0]
+            start = sl.start or 0
+            k = start // (vrows // 3)
+            assert sh.device == devs[k], (start, sh.device, devs[k])
+
+        # identity-layout math: same seed without the strategy
+        m_ref = ff.FFModel(ff.FFConfig(batch_size=18, seed=4))
+        build_dlrm(m_ref, dcfg, fuse_embeddings=True)
+        m_ref.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                      ["mse"], mesh=make_mesh(num_devices=1))
+        m_ref.init_layers()
+        x, y = synthetic_batch(dcfg, 18, seed=0)
+        got = np.asarray(model.forward_batch(dict(x)))
+        want = np.asarray(m_ref.forward_batch(dict(x)))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        x["label"] = y
+        mets = model.train_batch(x)
+        assert np.isfinite(float(mets["loss"]))
+
+    def test_uneven_device_ids_on_stacked_warns_loudly(self):
+        """The stacked UNIFORM embedding cannot block-shard unequal
+        groups; a .pb with uneven placement must warn that placement
+        intent is dropped (not silently degrade)."""
+        import logging
+
+        import dlrm_flexflow_tpu as ff
+        from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+        from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+
+        dcfg = DLRMConfig(embedding_size=[64] * 7, sparse_feature_size=8,
+                          mlp_bot=[4, 16, 8], mlp_top=[64, 16, 1])
+        strategies = {f"embedding{i}": ParallelConfig(
+                          (1, 1), device_ids=(i % 3,))
+                      for i in range(7)}
+        model = ff.FFModel(ff.FFConfig(batch_size=18, seed=4))
+        build_dlrm(model, dcfg, fuse_embeddings=True)
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        logging.getLogger("ff.model").addHandler(handler)
+        try:
+            model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                          ["mse"], mesh=make_mesh(num_devices=3),
+                          strategies=strategies)
+        finally:
+            logging.getLogger("ff.model").removeHandler(handler)
+        assert any("PLACEMENT INTENT DROPPED" in r.getMessage()
+                   for r in records)
+
     def test_hetero_pb_marks_cpu(self):
         s = load_strategies(os.path.join(_REPO, "strategies", "dlrm_strategy_8nEmb_1cpu_1gpu.pb"))
         for i in range(8):
