@@ -45,16 +45,39 @@ class EarlyStopping(Callback):
 
 
 class VerifyMetrics(Callback):
-    """reference base_model.py:416-421: stop (successfully) once accuracy
-    reaches a threshold; raise if training finished below it (the examples'
-    accuracy assertion, examples/python/keras/accuracy.py)."""
+    """reference base_model.py:416-421: stop (successfully) once the
+    metric reaches a threshold; RAISE if training finished without
+    reaching it (the examples' accuracy assertion,
+    examples/python/keras/accuracy.py). mode="min" verifies
+    loss-like metrics (mse under the threshold)."""
 
-    def __init__(self, metric="accuracy", threshold=0.9):
+    def __init__(self, metric="accuracy", threshold=0.9, mode="max"):
         self.metric = metric
         self.threshold = float(threshold)
+        self.mode = mode
         self.reached = False
+        self.last = None
+
+    def on_train_begin(self, model):
+        # a reused callback must re-verify, not pass on stale state
+        self.reached = False
+        self.stop_training = False
+        self.last = None
+
+    def _ok(self, value):
+        if self.mode == "min":
+            return value <= self.threshold
+        return value >= self.threshold
 
     def on_epoch_end(self, model, epoch, metrics):
-        if metrics.get(self.metric, 0.0) >= self.threshold:
+        self.last = metrics.get(self.metric)
+        if self.last is not None and self._ok(self.last):
             self.reached = True
             self.stop_training = True
+
+    def on_train_end(self, model):
+        if not self.reached:
+            op = "<=" if self.mode == "min" else ">="
+            raise AssertionError(
+                f"VerifyMetrics: {self.metric} never reached {op} "
+                f"{self.threshold} (last: {self.last})")

@@ -85,6 +85,9 @@ class Model:
 
         stop = {"flag": False}
         cbs = list(callbacks or [])
+        for cb in cbs:
+            if hasattr(cb, "on_train_begin"):
+                cb.on_train_begin(self)
 
         def on_epoch(model, epoch, report):
             for cb in cbs:
@@ -101,6 +104,9 @@ class Model:
                             callbacks=[on_epoch])
         except _StopFit:
             result = {"metrics": ff.perf.report()}
+        for cb in cbs:
+            if hasattr(cb, "on_train_end"):
+                cb.on_train_end(self)   # VerifyMetrics asserts here
         return result
 
     def evaluate(self, x, y, batch_size: int = 64):

@@ -369,8 +369,15 @@ class CostModel:
                         k0 = next(iter(pp))
                         pp[k0] = pp[k0] + eps.astype(pp[k0].dtype)
                     out = make_out(pp, pxs)
-                    leaf = jax.tree.leaves(out)[0]
-                    return acc + leaf.reshape(-1)[0].astype(jnp.float32), None
+                    # consume EVERY output leaf FULLY: reading one element
+                    # would let XLA slice the computation down to just
+                    # that element (conv/dot shrink to a sliver) and, for
+                    # vjp outputs, drop whole cotangents — the op being
+                    # measured must fully materialize
+                    tot = jnp.zeros((), jnp.float32)
+                    for leaf in jax.tree.leaves(out):
+                        tot = tot + jnp.sum(leaf).astype(jnp.float32)
+                    return acc + tot, None
 
                 acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
                                       None, length=n)

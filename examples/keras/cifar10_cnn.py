@@ -33,10 +33,11 @@ def main():
         K.Dropout(0.5),
         K.Dense(10, activation="softmax"),
     ])
-    model.compile(optimizer=K.SGD(learning_rate=0.05),
+    model.compile(optimizer=K.SGD(learning_rate=0.05, momentum=0.9),
                   loss="sparse_categorical_crossentropy",
                   metrics=["accuracy"])
-    model.fit(x_train, y_train, batch_size=64, epochs=3)
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.4)
+    model.fit(x_train, y_train, batch_size=64, epochs=6, callbacks=[cb])
 
 
 if __name__ == "__main__":

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Functional CIFAR-10 CNN with concatenated conv towers (reference:
+examples/python/keras/func_cifar10_cnn_concat.py — two conv branches
+merged on the channel axis, the InceptionV3-style merge in miniature)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import cifar10
+
+
+def main():
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    inp = K.Input((3, 32, 32))
+    a = K.Conv2D(32, (3, 3), padding="same", activation="relu")(inp)
+    b = K.Conv2D(32, (5, 5), padding="same", activation="relu")(inp)
+    t = K.Concatenate(axis=1)([a, b])          # channel axis (NCHW)
+    t = K.MaxPooling2D((2, 2))(t)
+    t = K.Conv2D(64, (3, 3), padding="same", activation="relu")(t)
+    t = K.MaxPooling2D((2, 2))(t)
+    t = K.Flatten()(t)
+    t = K.Dense(256, activation="relu")(t)
+    out = K.Dense(10, activation="softmax")(t)
+    model = K.Model(inp, out)
+    model.compile(optimizer=K.SGD(learning_rate=0.03),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.45)
+    model.fit(x_train, y_train, batch_size=64, epochs=4, callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

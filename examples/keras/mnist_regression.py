@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""MNIST regression: predict the digit value as a scalar with MSE loss
+(exercises the mse/rmse/mae metric path end-to-end the way the
+reference's python/test.sh covers its loss variants)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import mnist
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(len(x_train), 784).astype(np.float32) / 255.0
+    # regress the normalized digit value
+    y = (y_train.reshape(-1, 1).astype(np.float32)) / 10.0
+
+    model = K.Sequential([
+        K.Input((784,)),
+        K.Dense(256, activation="relu"),
+        K.Dense(64, activation="relu"),
+        K.Dense(1, activation="sigmoid"),
+    ])
+    model.compile(optimizer=K.SGD(learning_rate=0.1),
+                  loss="mean_squared_error",
+                  metrics=["mse", "rmse", "mae"])
+    # templates are learnable: final mse must drop well under the
+    # ~0.082 variance of uniform digits/10
+    cb = K.VerifyMetrics(metric="mse", threshold=0.04, mode="min")
+    model.fit(x_train, y, batch_size=64, epochs=5, callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

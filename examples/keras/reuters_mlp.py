@@ -30,7 +30,8 @@ def main():
     model.compile(optimizer=K.SGD(learning_rate=0.1),
                   loss="sparse_categorical_crossentropy",
                   metrics=["accuracy"])
-    model.fit(x_train, y_train, batch_size=32, epochs=5)
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.5)
+    model.fit(x_train, y_train, batch_size=32, epochs=5, callbacks=[cb])
 
 
 if __name__ == "__main__":
