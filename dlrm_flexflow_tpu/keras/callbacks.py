@@ -7,7 +7,15 @@ from __future__ import annotations
 class Callback:
     stop_training = False
 
+    def on_train_begin(self, model):
+        """Reset per-run state: a callback reused across fit() calls must
+        not carry a stale stop/verdict into the next run."""
+        self.stop_training = False
+
     def on_epoch_end(self, model, epoch: int, metrics: dict):
+        pass
+
+    def on_train_end(self, model):
         pass
 
 
@@ -24,6 +32,11 @@ class EarlyStopping(Callback):
         self.best = None
         self.wait = 0
         self.mode = mode
+
+    def on_train_begin(self, model):
+        super().on_train_begin(model)
+        self.best = None
+        self.wait = 0
 
     def _better(self, cur, best):
         if self.mode == "min" or (self.mode == "auto"
@@ -60,8 +73,8 @@ class VerifyMetrics(Callback):
 
     def on_train_begin(self, model):
         # a reused callback must re-verify, not pass on stale state
+        super().on_train_begin(model)
         self.reached = False
-        self.stop_training = False
         self.last = None
 
     def _ok(self, value):

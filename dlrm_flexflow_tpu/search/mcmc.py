@@ -47,9 +47,13 @@ def optimize(model, budget: int = 1000, alpha: float = 1.2,
              ndev: Optional[int] = None,
              cost_model: Optional[CostModel] = None,
              seed: int = 0, verbose: bool = False,
-             start: Optional[StrategyMap] = None) -> StrategyMap:
+             start: Optional[StrategyMap] = None,
+             topology=None) -> StrategyMap:
     """Simulated-annealing search over per-op parallel configs (reference
     FFModel::optimize, model.cc:1093-1144). Returns the best strategy map.
+    `topology` targets a specific device topology (e.g.
+    [("dcn", 2), ("ici", 4)] for a 2-host slice pair) — comm-heavy
+    configs price differently than on the default flat ICI mesh.
     """
     import math
 
@@ -68,7 +72,7 @@ def optimize(model, budget: int = 1000, alpha: float = 1.2,
         from ..parallel.sharding import feasible_degrees_for
         feasible = feasible_degrees_for(structural_axis_sizes(ndev))
     rng = random.Random(seed)
-    sim = Simulator(model, cost_model)
+    sim = Simulator(model, cost_model, topology=topology)
 
     current = dict(start or default_strategy(model, ndev))
     current_t = sim.simulate(current, ndev)

@@ -273,6 +273,48 @@ class Simulator:
         modeled by placing tasks only on the participating devices."""
         return list(range(min(pc.num_parts, ndev)))
 
+    def _clamp_strategies(self, strategies: StrategyMap,
+                          ndev: int) -> StrategyMap:
+        """Price what would actually EXECUTE: clamp each op's degrees to
+        divide its output dims AND to the target mesh's factorizable
+        degrees (the simulator twin of FFModel._effective_pc — both
+        checks, or the search selects wins from degrees that silently
+        execute as different ones). Without this, 8-way data parallelism
+        over a batch of 4 simulates as an impossible 8x speedup. Ops with
+        raw_degree_semantics (concatenated-rows embeddings) keep their
+        raw degrees — their table dim is intent, not an output
+        partitioning."""
+        from ..parallel.mesh import structural_axis_sizes
+        from ..parallel.sharding import feasible_degrees_for
+        if self.model.mesh is not None and self.model.mesh.size == ndev:
+            from ..parallel.sharding import AxisAssigner
+            feas = AxisAssigner(self.model.mesh).feasible_degrees()
+        else:
+            feas = feasible_degrees_for(structural_axis_sizes(ndev))
+        out = {}
+        by_name = {op.name: op for op in self.model.ops}
+        for name, pc in strategies.items():
+            op = by_name.get(name)
+            if (op is None or not op.outputs
+                    or getattr(op, "raw_degree_semantics", False)):
+                out[name] = pc
+                continue
+            shape = op.outputs[0].shape
+            degs = list(pc.degrees)[:len(shape)]
+            degs += [1] * (len(shape) - len(degs))
+            changed = False
+            for i, d in enumerate(degs):
+                d = min(d, shape[i])
+                while d > 1 and (shape[i] % d != 0 or d not in feas):
+                    d -= 1
+                if d != degs[i]:
+                    changed = True
+                degs[i] = max(d, 1)
+            out[name] = (ParallelConfig(tuple(degs), pc.device_type,
+                                        pc.device_ids, pc.memory_types)
+                         if changed else pc)
+        return out
+
     def fits_memory(self, strategies: StrategyMap, ndev: int) -> bool:
         """Per-device parameter bytes (at each op's sharded shapes) must
         fit the chip's HBM, with 25% headroom for activations/temps.
@@ -307,6 +349,7 @@ class Simulator:
             ndev = int(math.prod(
                 [self.model.mesh.shape[a] for a in self.model.mesh.axis_names])
             ) if self.model.mesh else 1
+        strategies = self._clamp_strategies(strategies, ndev)
         if not self.fits_memory(strategies, ndev):
             # infeasible placement: params exceed per-chip HBM (pure DP on
             # DLRM-Terabyte replicates ~96 GB of tables, ~6x its HBM); an

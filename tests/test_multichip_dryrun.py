@@ -17,9 +17,14 @@ def test_dryrun_8dev_no_spmd_rematerialization():
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "__graft_entry__.py"), "8"],
-        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-4000:]
     assert "ok, loss=" in out
+    # the SOAP-searched InceptionV3 strategy (.pb) loaded and trained
+    pb = os.path.join(REPO, "strategies", "inception_v3_8dev_ici_flat.pb")
+    assert os.path.exists(pb), (
+        f"missing {pb}: regenerate with benchmarks/search_inception.py")
+    assert "searched ok" in out
     assert "rematerialization" not in out, "\n".join(
         l[:200] for l in out.splitlines() if "rematerial" in l)
