@@ -184,6 +184,14 @@ class Op:
         sharded tables take the costlier RMW path)."""
         return 0.0
 
+    def sequential_steps(self) -> int:
+        """Number of inherently serial inner iterations (a lax.scan's
+        length — the recurrent time loop of an LSTM). Each costs a fixed
+        per-iteration latency (TPUSpec.scan_iter_s) no matter how little
+        work the body holds: a scanned op's wall time floors at
+        steps x iter latency, which dominates small-batch RNNs."""
+        return 0
+
     def output_bytes(self) -> int:
         t = self.outputs[0]
         return int(math.prod(t.shape)) * jnp.dtype(t.dtype).itemsize

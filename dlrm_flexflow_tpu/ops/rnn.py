@@ -65,10 +65,14 @@ class LSTM(Op):
         b = x.shape[0]
         h0 = jnp.zeros((b, h), jnp.float32)
         c0 = jnp.zeros((b, h), jnp.float32)
+        # cast the recurrent weights ONCE outside the loop: a cast inside
+        # the body would re-stream the (h, 4h) matrix every timestep if
+        # XLA declines to hoist it (16 MB/step at reference scale)
+        whc = wh.astype(cdt)
 
         def cell(carry, xp):
             hprev, cprev = carry
-            gates = xp + jnp.dot(hprev.astype(cdt), wh.astype(cdt),
+            gates = xp + jnp.dot(hprev.astype(cdt), whc,
                                  preferred_element_type=jnp.float32)
             i, f, g, o = jnp.split(gates, 4, axis=-1)
             i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
@@ -109,3 +113,7 @@ class LSTM(Op):
     def flops_per_sample(self) -> float:
         s = self.inputs[0].shape[1]
         return 2.0 * s * 4 * self.hidden * (self.in_dim + self.hidden)
+
+    def sequential_steps(self) -> int:
+        # the recurrent scan: one serial iteration per sequence position
+        return int(self.inputs[0].shape[1])

@@ -63,6 +63,14 @@ class TPUSpec:
     # measured reality (benchmarks/bench_host_tables.py)
     host_random_row_s: float = 6.0e-7
     host_bytes_per_s: float = 50e9    # host DDR sequential stream
+    # fixed latency per serial scan iteration (lax.scan step): loop
+    # bookkeeping + carry round-trip; floors small-batch RNN cells far
+    # above their FLOP/bandwidth cost. PROVISIONAL estimate from the
+    # measured NMT step (~306 us/iteration incl. gemm at b64, split
+    # between iteration overhead and the cell) — to be pinned by the
+    # nmt_lstm point the next time benchmarks/calibrate_sim.py runs on
+    # the chip (sim_calibration.json does not yet contain that row)
+    scan_iter_s: float = 1.5e-4
 
     @staticmethod
     def v4() -> "TPUSpec":
@@ -200,6 +208,12 @@ class CostModel:
         # not bandwidth-bound — the dominant term for sparse ops
         rand_rows = op.random_hbm_rows(backward) / max(pc.num_parts, 1)
         t = max(t, self.random_rows_time(rand_rows))
+        # serial scan iterations (RNN time loops) floor the op at a fixed
+        # per-iteration latency regardless of per-step work; the vjp of a
+        # scan runs its own reverse-order scan of the same length
+        steps = op.sequential_steps()
+        if steps:
+            t = max(t, steps * self.spec.scan_iter_s)
         return t + self.spec.kernel_launch_s
 
     def host_update_time(self, op: Op, pc: ParallelConfig) -> float:
