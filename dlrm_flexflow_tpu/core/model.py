@@ -202,6 +202,14 @@ class FFModel:
         return MultiHeadAttention(self, q, k, v, embed_dim, num_heads,
                                   causal, name).outputs[0]
 
+    def lstm_stack(self, input_tensor, hidden, num_layers, name=None):
+        """N stacked LSTM layers in ONE scan (see ops/rnn.LSTMStack:
+        pays the serial per-iteration latency once per timestep instead
+        of once per layer per timestep)."""
+        from ..ops.rnn import LSTMStack
+        return LSTMStack(self, input_tensor, hidden, num_layers,
+                         name).outputs[0]
+
     def lstm(self, input_tensor, hidden, name=None):
         from ..ops.rnn import LSTM
         return LSTM(self, input_tensor, hidden, name).outputs[0]

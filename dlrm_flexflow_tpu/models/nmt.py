@@ -33,10 +33,11 @@ def build_nmt(model: FFModel, src_vocab: int = 32 * 1024,
     rsrc = model.reverse(src, axis=1, name="src_rev")
     senc = model.embedding(rsrc, src_vocab, embed_dim, aggr="none",
                            name="src_embed")  # (b, s, e)
-    t = senc
-    for i in range(num_layers):
-        t = model.lstm(t, hidden, name=f"enc_lstm{i}")
-    enc_out = t  # (b, s, h)
+    # all encoder layers in ONE fused scan: seq serial iterations total
+    # instead of num_layers x seq (the per-iteration latency dominates
+    # at reference batch sizes — ops/rnn.LSTMStack)
+    enc_out = model.lstm_stack(senc, hidden, num_layers,
+                               name="enc_lstm")  # (b, s, h)
 
     demb = model.embedding(tgt, tgt_vocab, embed_dim, aggr="none",
                            name="tgt_embed")
@@ -45,8 +46,7 @@ def build_nmt(model: FFModel, src_vocab: int = 32 * 1024,
     if src_len != tgt_len:
         raise ValueError("this NMT build uses src_len == tgt_len")
     d = model.concat([demb, enc_out], axis=2, name="dec_in")
-    for i in range(num_layers):
-        d = model.lstm(d, hidden, name=f"dec_lstm{i}")
+    d = model.lstm_stack(d, hidden, num_layers, name="dec_lstm")
     # per-position logits: fold seq into batch for the big projection
     d2 = model.reshape(d, (batch * tgt_len, hidden), name="dec_fold")
     logits = model.dense(d2, tgt_vocab, name="proj")

@@ -152,7 +152,12 @@ class CostModel:
             # was WORSE than the roofline it was meant to refine).
             t_raw = self.measure_op(op, pc, backward=backward)
             t_roof = self._roofline_time(op, pc, backward)
-            t = min(max(t_raw, 0.5 * t_roof), 2.0 * t_roof)
+            # scanned ops' roofline rests on the PROVISIONAL scan_iter_s
+            # constant — give the real measurement a much wider band there
+            # (clamping an RNN measurement toward an unpinned guess would
+            # defeat the calibration that is supposed to pin it)
+            band = 8.0 if op.sequential_steps() else 2.0
+            t = min(max(t_raw, t_roof / band), band * t_roof)
             if t != t_raw:
                 log_sim.debug(
                     "measured %s %s bwd=%s: %.3es outside the roofline "

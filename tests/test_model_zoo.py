@@ -147,3 +147,99 @@ def test_lstm_hidden_tp_matches_single():
     single = run(1)
     tp = run(8, {"lstm": ParallelConfig((2, 1, 4))})
     np.testing.assert_allclose(single, tp, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_stack_matches_torch_two_layer():
+    """The fused 2-layer scan (LSTMStack) against torch's num_layers=2
+    LSTM — exact same math as stacking two LSTM ops, one scan."""
+    r = np.random.RandomState(6)
+    b, s, d, h = 4, 5, 6, 7
+    x = r.randn(b, s, d).astype(np.float32)
+
+    model = ff.FFModel(ff.FFConfig(batch_size=b))
+    t = model.create_tensor((b, s, d), name="x")
+    model.lstm_stack(t, h, 2, name="stack")
+    model.compile(ff.SGDOptimizer(0.0), "mean_squared_error", ["mse"])
+    model.init_layers()
+
+    tl = torch.nn.LSTM(d, h, num_layers=2, batch_first=True)
+    p = model.params["stack"]
+    with torch.no_grad():
+        for layer in range(2):
+            getattr(tl, f"weight_ih_l{layer}").copy_(
+                torch.tensor(np.asarray(p[f"wx{layer}"]).T))
+            getattr(tl, f"weight_hh_l{layer}").copy_(
+                torch.tensor(np.asarray(p[f"wh{layer}"]).T))
+            getattr(tl, f"bias_ih_l{layer}").copy_(
+                torch.tensor(np.asarray(p[f"bias{layer}"])))
+            getattr(tl, f"bias_hh_l{layer}").zero_()
+    ty, _ = tl(torch.tensor(x))
+    ours = np.asarray(model.forward_batch({"x": x}))
+    np.testing.assert_allclose(ours, ty.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_stack_matches_unfused_ops():
+    """LSTMStack == two chained LSTM ops with the same weights (training
+    one step each to cover backward too)."""
+    r = np.random.RandomState(7)
+    b, s, d, h = 4, 5, 6, 8
+    x = r.randn(b, s, d).astype(np.float32)
+    y = r.randn(b * s, 1).astype(np.float32)
+
+    def build(fused):
+        model = ff.FFModel(ff.FFConfig(batch_size=b, seed=9))
+        t = model.create_tensor((b, s, d), name="x")
+        if fused:
+            t2 = model.lstm_stack(t, h, 2, name="stack")
+        else:
+            t2 = model.lstm(model.lstm(t, h, name="l0"), h, name="l1")
+        f = model.reshape(t2, (b * s, h), name="fold")
+        out = model.dense(f, 1, name="head")
+        model.compile(ff.SGDOptimizer(0.1), "mean_squared_error",
+                      ["mse"], final_tensor=out)
+        model.init_layers()
+        return model
+
+    mf, mu = build(True), build(False)
+    # align weights: fused slot l <- unfused op l (fresh COPIES — the
+    # train step donates param buffers, so sharing arrays between the
+    # two models would delete them under the other's feet)
+    import jax
+    for layer, opn in ((0, "l0"), (1, "l1")):
+        for a, bname in (("wx", "wx"), ("wh", "wh"), ("bias", "bias")):
+            mf.params["stack"][f"{a}{layer}"] = jax.device_put(
+                np.asarray(mu.params[opn][bname]))
+    mf.params["head"] = {k: jax.device_put(np.asarray(v))
+                         for k, v in mu.params["head"].items()}
+    mf.opt_state = mf.optimizer.init_state(mf.params)
+    for _ in range(2):
+        mf.train_batch({"x": x, "label": y})
+        mu.train_batch({"x": x, "label": y})
+    np.testing.assert_allclose(
+        np.asarray(mf.params["stack"]["wh1"]),
+        np.asarray(mu.params["l1"]["wh"]), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(mf.forward_batch({"x": x})),
+        np.asarray(mu.forward_batch({"x": x})), rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_stack_hidden_tp_matches_single():
+    from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
+
+    r = np.random.RandomState(8)
+    b, s, d, h = 8, 5, 6, 8
+    x = r.randn(b, s, d).astype(np.float32)
+
+    def run(ndev, strat=None):
+        model = ff.FFModel(ff.FFConfig(batch_size=b, seed=5))
+        t = model.create_tensor((b, s, d), name="x")
+        model.lstm_stack(t, h, 2, name="stack")
+        model.compile(ff.SGDOptimizer(0.0), "mean_squared_error", ["mse"],
+                      mesh=make_mesh(num_devices=ndev), strategies=strat)
+        model.init_layers()
+        return np.asarray(model.forward_batch({"x": x}))
+
+    single = run(1)
+    tp = run(8, {"stack": ParallelConfig((2, 1, 4))})
+    np.testing.assert_allclose(single, tp, rtol=1e-4, atol=1e-5)
