@@ -135,29 +135,36 @@ def calibration_points():
     mlp = DLRMConfig(embedding_size=[64] * 4, sparse_feature_size=8,
                      mlp_bot=[32, 1024, 1024, 8],
                      mlp_top=[40, 1024, 1024, 1])
-    yield build_point("dlrm_random_bf16_b256", rnd, 256, "bfloat16")
-    yield build_point("dlrm_random_bf16_b1024", rnd, 1024, "bfloat16")
-    yield build_point("dlrm_random_f32_b256", rnd, 256, "float32")
-    yield build_point("dlrm_kaggle_bf16_b256", kaggle, 256, "bfloat16")
-    yield build_point("dlrm_kaggle_bf16_b1024", kaggle, 1024, "bfloat16")
-    yield build_point("mlp_heavy_bf16_b1024", mlp, 1024, "bfloat16")
-    yield build_point("dlrm_random_dense_upd_b256", rnd, 256, "bfloat16",
-                      sparse_update=False)
+    def point(name, fn, *a, **kw):
+        return name, lambda: fn(name, *a, **kw)
+
+    yield point("dlrm_random_bf16_b256", build_point, rnd, 256, "bfloat16")
+    yield point("dlrm_random_bf16_b1024", build_point, rnd, 1024,
+                "bfloat16")
+    yield point("dlrm_random_f32_b256", build_point, rnd, 256, "float32")
+    yield point("dlrm_kaggle_bf16_b256", build_point, kaggle, 256,
+                "bfloat16")
+    yield point("dlrm_kaggle_bf16_b1024", build_point, kaggle, 1024,
+                "bfloat16")
+    yield point("mlp_heavy_bf16_b1024", build_point, mlp, 1024, "bfloat16")
+    yield point("dlrm_random_dense_upd_b256", build_point, rnd, 256,
+                "bfloat16", sparse_update=False)
     # conv / attention / LSTM families: the shapes the InceptionV3
     # searched strategy and the NMT/attention configs are optimized
     # against must be checked against the chip too (round-2 calibrated
     # only DLRM/MLP shapes)
     from dlrm_flexflow_tpu.models.alexnet import build_alexnet
     from dlrm_flexflow_tpu.models.resnet import build_resnet
-    yield build_image_point("alexnet_bf16_b256", build_alexnet, 256, 224)
-    yield build_image_point("resnet18_bf16_b128", build_resnet, 128, 224,
-                            depth=18)
-    yield build_image_point("resnet18_bf16_b64_hw112", build_resnet, 64,
-                            112, depth=18)
-    yield build_attention_point("attention_bf16_b8_s2048_d1024",
-                                8, 2048, 1024, 16)
-    yield build_lstm_point("nmt_lstm_bf16_b64_s40", 64, 40, 32 * 1024,
-                           1024)
+    yield point("alexnet_bf16_b256", build_image_point, build_alexnet,
+                256, 224)
+    yield point("resnet18_bf16_b128", build_image_point, build_resnet,
+                128, 224, depth=18)
+    yield point("resnet18_bf16_b64_hw112", build_image_point,
+                build_resnet, 64, 112, depth=18)
+    yield point("attention_bf16_b8_s2048_d1024", build_attention_point,
+                8, 2048, 1024, 16)
+    yield point("nmt_lstm_bf16_b64_s40", build_lstm_point, 64, 40,
+                32 * 1024, 1024)
 
 
 def main():
@@ -166,8 +173,22 @@ def main():
     from dlrm_flexflow_tpu.search.simulator import Simulator
 
     steps = int(os.environ.get("CAL_STEPS", "200"))
+    only = os.environ.get("CAL_ONLY")           # substring filter
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "sim_calibration.json")
+    # resumable: each finished point lands on disk immediately, and an
+    # interrupted run (the tunneled chip can die mid-sweep) picks up
+    # where it left off with CAL_RESUME=1
     rows = []
-    for name, model, batches in calibration_points():
+    done = set()
+    if os.environ.get("CAL_RESUME") and os.path.exists(out):
+        with open(out) as f:
+            rows = json.load(f)
+        done = {r["point"] for r in rows}
+    for name, make in calibration_points():
+        if name in done or (only and only not in name):
+            continue
+        _, model, batches = make()
         measured = measure_step_time(model, batches, steps=steps)
         strat = default_strategy(model, 1)
         sim_roof = Simulator(model).simulate(strat, 1)
@@ -188,11 +209,15 @@ def main():
               f"({r['err_roofline']:+.0%}) | "
               f"sim(measured) {r['sim_measured_ms']:8.3f} "
               f"({r['err_measured']:+.0%})", flush=True)
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rows, f, indent=1)
+        os.replace(tmp, out)   # atomic: a mid-write kill can't corrupt
+        # the only copy of completed rows
 
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "sim_calibration.json")
-    with open(out, "w") as f:
-        json.dump(rows, f, indent=1)
+    if not rows:
+        print("no calibration points matched (CAL_ONLY filter?)")
+        return rows
     worst = max(abs(r["err_measured"]) for r in rows)
     print(f"worst |err| (measured mode): {worst:.0%}")
     return rows

@@ -15,6 +15,13 @@ from dlrm_flexflow_tpu.parallel.mesh import make_mesh  # noqa: E402
 
 def setup(argv, default_batch=64):
     """Parse reference-style flags; returns (FFConfig, mesh)."""
+    # `JAX_PLATFORMS=cpu` alone is ignored where a sitecustomize pins an
+    # accelerator plugin (the axon tunnel does); tests and CPU-only runs
+    # set FF_FORCE_CPU=<ndev> to virtualize host devices explicitly
+    force_cpu = int(os.environ.get("FF_FORCE_CPU") or 0)
+    if force_cpu > 0:
+        from dlrm_flexflow_tpu.utils.testing import ensure_cpu_devices
+        ensure_cpu_devices(force_cpu)
     import jax
     cfg = ff.FFConfig.parse_args(argv)
     if cfg.batch_size <= 0:

@@ -98,6 +98,9 @@ def test_alexnet_example_trains_from_disk(tmp_path):
     write_img_ffbin(path, images, labels)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # JAX_PLATFORMS alone is ignored under an accelerator-pinning
+    # sitecustomize (axon); the example honors FF_FORCE_CPU explicitly
+    env["FF_FORCE_CPU"] = "1"
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "examples", "native",
                                       "alexnet.py"),
