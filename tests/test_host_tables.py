@@ -217,6 +217,32 @@ class TestHostResidentTables:
         assert np.isfinite(k).all()
         assert not np.array_equal(k, before), "tables must have trained"
 
+    def test_async_scatter_exception_surfaces_at_drain(self):
+        """A failed async scatter must not silently drop a step's update:
+        the exception re-raises at the next drain point."""
+        import pytest
+        dcfg = _dcfg()
+        model = _build(dcfg, host_tables=True)
+        model.config.host_tables_async = True
+        _train_steps(model, dcfg, steps=1)
+        model._host_drain()
+
+        emb = next(iter(model._host_resident_ops))
+        op = next(o for o in model.ops if o.name == emb)
+        orig = op.host_sgd_update
+
+        def boom(*a, **k):
+            raise RuntimeError("scatter exploded")
+        op.host_sgd_update = boom
+        try:
+            _train_steps(model, dcfg, steps=1)   # spawns failing thread
+            with pytest.raises(RuntimeError, match="scatter exploded"):
+                model._host_drain()
+            # the exception is consumed: the next drain is clean
+            model._host_drain()
+        finally:
+            op.host_sgd_update = orig
+
     def test_eval_works_with_host_tables(self):
         dcfg = _dcfg()
         model = _build(dcfg, host_tables=True)
