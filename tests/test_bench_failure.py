@@ -1,0 +1,34 @@
+"""bench.py must stay machine-readable when the TPU backend is down.
+
+Round 3's BENCH_r03.json captured a raw traceback (tunnel outage) with
+parsed=null; the driver could not tell infra failure from regression.
+bench.py now catches backend-init failure and emits one JSON error line
+(nonzero exit code preserved).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_json_error_line_when_backend_unavailable():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "nonexistent_backend"
+    env.pop("XLA_FLAGS", None)
+    # the axon site hook (loaded via PYTHONPATH) registers its own backend
+    # regardless of JAX_PLATFORMS; drop it so the bogus platform truly fails
+    env.pop("PYTHONPATH", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert proc.returncode != 0
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout + proc.stderr
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "dlrm_random_train_throughput_per_chip"
+    assert rec["value"] is None
+    assert "error" in rec and "unavailable" in rec["error"]
