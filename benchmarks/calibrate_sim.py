@@ -178,13 +178,15 @@ def main():
                        "sim_calibration.json")
     # resumable: each finished point lands on disk immediately, and an
     # interrupted run (the tunneled chip can die mid-sweep) picks up
-    # where it left off with CAL_RESUME=1
+    # where it left off with CAL_RESUME=1. Existing rows are ALWAYS
+    # loaded and merged by point name — a CAL_ONLY-filtered run must
+    # never discard the other points' committed rows
     rows = []
-    done = set()
-    if os.environ.get("CAL_RESUME") and os.path.exists(out):
+    if os.path.exists(out):
         with open(out) as f:
             rows = json.load(f)
-        done = {r["point"] for r in rows}
+    done = ({r["point"] for r in rows}
+            if os.environ.get("CAL_RESUME") else set())
     for name, make in calibration_points():
         if name in done or (only and only not in name):
             continue
@@ -195,15 +197,16 @@ def main():
         cm = CostModel(measure=True,
                        compute_dtype=model.config.jnp_compute_dtype)
         sim_meas = Simulator(model, cost_model=cm).simulate(strat, 1)
-        rows.append({
+        row = {
             "point": name,
             "measured_ms": measured * 1e3,
             "sim_roofline_ms": sim_roof * 1e3,
             "sim_measured_ms": sim_meas * 1e3,
             "err_roofline": sim_roof / measured - 1.0,
             "err_measured": sim_meas / measured - 1.0,
-        })
-        r = rows[-1]
+        }
+        rows = [r for r in rows if r["point"] != name] + [row]
+        r = row
         print(f"{name:32s} real {r['measured_ms']:8.3f} ms | "
               f"sim(roofline) {r['sim_roofline_ms']:8.3f} "
               f"({r['err_roofline']:+.0%}) | "

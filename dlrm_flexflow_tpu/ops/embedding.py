@@ -608,6 +608,35 @@ class Embedding(Op):
     def supports_sparse_update(self) -> bool:
         return self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG, AGGR_MODE_NONE)
 
+    def _fwd_residual_ok(self) -> bool:
+        """Forward-gather residuals are usable only when a logical row IS
+        one 128-lane tile (out_dim == 128, unpacked storage): then the
+        rows the XLA-gather forward materializes anyway double as the
+        update's weight tiles, sparing the update's random re-read. (The
+        lane-packed variants cover narrower widths; see
+        EmbeddingBagStacked._fwd_residual_ok.)"""
+        return (self.out_dim == 128
+                and getattr(self, "_pack", 1) == 1
+                and self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
+                and self.inputs[0].num_dims == 2
+                and not _pallas_ok(self.model, self.out_dim, self.name)
+                and _pallas_scatter_ok(self.model, 128, self.name)
+                and _row_shard_axes(self, self.out_dim, self.num_entries)
+                is None)
+
+    def apply_with_fwd(self, params, xs, *, rng=None):
+        """apply() plus forward-gather residuals (global rows + tiles);
+        None residuals = caller should treat as plain apply."""
+        if not self._fwd_residual_ok():
+            return self.apply(params, xs, training=True, rng=rng), None
+        (idx,) = xs
+        table = params["kernel"]
+        g = idx.astype(jnp.int32) % self.num_entries   # (batch, bag)
+        rows = jnp.take(table, g, axis=0)              # (batch, bag, 128)
+        out = (jnp.mean(rows, axis=-2) if self.aggr == AGGR_MODE_AVG
+               else jnp.sum(rows, axis=-2))
+        return [out], (g.reshape(-1), rows.reshape(-1, 128))
+
     def sparse_sgd_update(self, params, xs, out_ct, lr,
                           fwd=None):
         """params - lr * d(loss)/d(table), given out_ct = d(loss)/d(output).
@@ -625,6 +654,14 @@ class Embedding(Op):
             # each row of the bag receives the bag-sum's cotangent
             upd = jnp.broadcast_to(ct[..., None, :],
                                    idx.shape + (d,)).reshape(-1, d)
+        if fwd is not None and self._fwd_residual_ok():
+            # write-only path: the forward's gathered rows are the tiles,
+            # so new rows land without the RMW read
+            from .pallas.embedding_kernel import scatter_write_rows_packed
+            g_flat, tiles = fwd
+            new = scatter_write_rows_packed(tbl, g_flat, -lr * upd,
+                                            tiles, d)
+            return {"kernel": new}
         if _pallas_scatter_ok(self.model, d, self.name):
             from .pallas.embedding_kernel import scatter_add_rows
             new = scatter_add_rows(tbl, idx.reshape(-1), -lr * upd)
@@ -650,9 +687,11 @@ class Embedding(Op):
         else:
             upd = jnp.broadcast_to(ct[..., None, :],
                                    idx.shape + (d,)).reshape(-1, d)
+        fwd_tiles = (fwd[1] if fwd is not None and self._fwd_residual_ok()
+                     else None)
         new_k, new_s = _sparse_opt_update(self, tbl, idx.reshape(-1), upd,
                                           opt, slabs, step,
-                                          self.num_entries)
+                                          self.num_entries, fwd_tiles)
         return {"kernel": new_k}, new_s
 
 

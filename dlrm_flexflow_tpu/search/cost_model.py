@@ -163,7 +163,7 @@ class CostModel:
                     "measured %s %s bwd=%s: %.3es outside the roofline "
                     "band [%.3es, %.3es]; clamped",
                     op.name, pc.degrees, backward, t_raw,
-                    0.5 * t_roof, 2.0 * t_roof)
+                    t_roof / band, band * t_roof)
         else:
             t = self._roofline_time(op, pc, backward)
         self._cache[key] = t
@@ -231,8 +231,11 @@ class CostModel:
             # sparse path: host RMW scatter = 2 accesses per looked-up
             # row (read + write; the 1.6x write-only discount is
             # structural to the Pallas lane-packed TPU path and does not
-            # exist on the host)
-            rows = 2.0 * op.random_hbm_rows(False)
+            # exist on the host), plus read+write per optimizer state
+            # slab — mirrors the device path's _embedding_update_rows
+            opt = getattr(op.model, "optimizer", None)
+            nslabs = len(opt.sparse_slab_names()) if opt is not None else 0
+            rows = (2.0 + 2.0 * nslabs) * op.random_hbm_rows(False)
             return (self.spec.hbm_random_fixed_s
                     + rows * self.spec.host_random_row_s)
         # dense fallback (momentum/Adam without sparse state): stream the
