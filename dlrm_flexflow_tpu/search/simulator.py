@@ -37,6 +37,7 @@ from ..parallel.pconfig import ParallelConfig, StrategyMap
 from .cost_model import CostModel
 
 COMM_DEVICE = -1  # flat-topology fallback channel (axis 0)
+HOST_DEVICE = -1000  # host CPU/DRAM: ONE shared resource for all ZCM ops
 
 
 def _axis_kind(name: str) -> str:
@@ -158,7 +159,7 @@ class Simulator:
             pc = strategies[op.name]
             ct = self.cost.op_compute_time(op, pc, backward=False)
             fwd_of[op.name] = [new_task(ct, d, f"fwd:{op.name}")
-                               for d in self._participants(pc, ndev)]
+                               for d in self._participants(pc, ndev, op)]
             # dependency + resharding comm from producers
             for src in op.inputs:
                 if src.owner_op is None or isinstance(src.owner_op, InputOp):
@@ -181,7 +182,7 @@ class Simulator:
             pc = strategies[op.name]
             ct = self.cost.op_compute_time(op, pc, backward=True)
             bwd_of[op.name] = [new_task(ct, d, f"bwd:{op.name}")
-                               for d in self._participants(pc, ndev)]
+                               for d in self._participants(pc, ndev, op)]
             # bwd of op depends on bwd of its consumers (grad flow) and on
             # its own fwd
             for ft in fwd_of[op.name]:
@@ -260,18 +261,30 @@ class Simulator:
                     self.cost.random_rows_time(
                         op.update_random_hbm_rows(pc)
                         / max(pc.num_parts, 1)))
-            for d in self._participants(pc, ndev):
+            for d in self._participants(pc, ndev, op):
                 u = new_task(upd_compute, d, f"update:{op.name}")
                 for p in parents:
                     p.add_next(u)
         return tasks
 
     # ------------------------------------------------------------------
-    def _participants(self, pc: ParallelConfig, ndev: int) -> List[int]:
-        """SPMD: every op runs on all devices, but an op whose config uses
-        fewer parts than devices leaves the rest idle for its duration —
-        modeled by placing tasks only on the participating devices."""
-        return list(range(min(pc.num_parts, ndev)))
+    def _participants(self, pc: ParallelConfig, ndev: int,
+                      op: Optional[Op] = None) -> List[int]:
+        """Devices an op's point tasks run on. The strategy's explicit
+        `device_ids` are honored when present (reference builds each op's
+        SimTasks on the devices its strategy names,
+        simulator.cc:279-326 — what lets operator-placement strategies
+        price correctly: ops on disjoint devices overlap). Fallback:
+        devices 0..k-1. Host-RESIDENT ops run on the single shared host
+        channel instead — host DRAM does not parallelize across tables
+        (see CostModel.host_update_time)."""
+        if op is not None and self.cost._host_resident(op, pc):
+            return [HOST_DEVICE]
+        k = min(pc.num_parts, ndev)
+        ids = pc.device_ids
+        if ids and len(ids) >= k:
+            return [int(i) % ndev for i in ids[:k]]
+        return list(range(k))
 
     def _clamp_strategies(self, strategies: StrategyMap,
                           ndev: int) -> StrategyMap:
