@@ -42,11 +42,22 @@ import jax.numpy as jnp
 
 from ..core.initializers import GlorotUniform
 from ..core.op import Op, ParamDef
-from ..parallel.pconfig import ParallelConfig
+from ..parallel.pconfig import DEVICE_CPU, ParallelConfig
 
 AGGR_MODE_NONE = "none"
 AGGR_MODE_SUM = "sum"
 AGGR_MODE_AVG = "avg"
+
+
+def _zcm_candidate(ndims: int) -> ParallelConfig:
+    """Host-resident (ZCM) candidate for the strategy search: the table
+    stored in CPU RAM, looked up and scatter-updated there (reference
+    hetero strategies, dlrm_strategy_hetero.cc:28-49). Offering it as a
+    search candidate lets optimize() discover Terabyte-style placements
+    (huge tables to host, the rest row-sharded in HBM) instead of only
+    executing hand-written hetero .pb files."""
+    return ParallelConfig((1,) * ndims, device_type=DEVICE_CPU,
+                          memory_types=("ZCM",))
 
 
 def _pack_factor(dim: int, rows: int) -> int:
@@ -563,6 +574,7 @@ class Embedding(Op):
                     degs[0] = ds
                     degs[-1] = dc
                     out.append(ParallelConfig(tuple(degs)))
+        out.append(_zcm_candidate(nd))
         return out
 
     def param_axes(self, pc: ParallelConfig, out_axes,
@@ -879,6 +891,7 @@ class EmbeddingBagStacked(Op):
             for dt in feasible_degrees:
                 if ds * dt <= num_devices and self.num_tables % max(dt, 1) == 0:
                     out.append(ParallelConfig((ds, dt, 1)))
+        out.append(_zcm_candidate(3))
         return out
 
     def param_axes(self, pc: ParallelConfig, out_axes,
@@ -1231,6 +1244,7 @@ class EmbeddingBagConcat(Op):
             for dt in feasible_degrees:
                 if ds * dt <= num_devices and self.num_tables % max(dt, 1) == 0:
                     out.append(ParallelConfig((ds, dt, 1)))
+        out.append(_zcm_candidate(3))
         return out
 
     def output_axes(self, pc: ParallelConfig, assigner, raw_pc=None):

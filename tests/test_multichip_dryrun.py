@@ -26,5 +26,9 @@ def test_dryrun_8dev_no_spmd_rematerialization():
     assert os.path.exists(pb), (
         f"missing {pb}: regenerate with benchmarks/search_inception.py")
     assert "searched ok" in out
+    # the Terabyte-shape config: optimize() under the capacity model must
+    # host-offload the huge table and row-shard the concat tables, then
+    # train a real step on the hybrid DCN+ICI mesh
+    assert "terabyte ok" in out
     assert "rematerialization" not in out, "\n".join(
         l[:200] for l in out.splitlines() if "rematerial" in l)

@@ -348,3 +348,31 @@ def test_disjoint_device_ids_simulate_concurrently():
         same["e1"], backward=False)
     assert t_disj < t_same - 0.5 * t_emb
     assert t_disj < t_same
+
+
+def test_fits_memory_counts_activations():
+    """Activation-aware feasibility (reference simulator.cu:84-90
+    allocates real FB scratch and fails oversized configs): a conv
+    stack whose FORWARD RESIDUALS alone exceed 16 GB HBM at b256 must
+    be rejected, while the identical model at b32 fits. Parameter bytes
+    alone (~2 MB here) would pass both."""
+    from dlrm_flexflow_tpu.search.mcmc import default_strategy
+
+    def build(batch):
+        model = ff.FFModel(ff.FFConfig(batch_size=batch,
+                                       compute_dtype="bfloat16"))
+        x = model.create_tensor((batch, 3, 224, 224), name="image")
+        t = model.conv2d(x, 128, 3, 3, 1, 1, 1, 1, activation="relu")
+        t = model.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation="relu")
+        t = model.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation="relu")
+        return model
+
+    big = build(256)
+    small = build(32)
+    sim_big = Simulator(big)
+    sim_small = Simulator(small)
+    assert not sim_big.fits_memory(default_strategy(big, 1), 1)
+    assert sim_small.fits_memory(default_strategy(small, 1), 1)
+    # and the simulator front door turns the rejection into an infinite
+    # makespan the MCMC will never accept
+    assert sim_big.simulate(default_strategy(big, 1), 1) == float("inf")
