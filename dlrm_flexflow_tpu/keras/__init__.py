@@ -15,7 +15,8 @@ reference's deferred translation.
 
 from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
                      Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,
-                     Input, MaxPooling2D, Multiply, Subtract)
+                     Input, MaxPooling2D, Multiply, Reshape, Subtract,
+                     add, concatenate, multiply, subtract)
 from .models import Model, Sequential
 from .callbacks import Callback, EarlyStopping, VerifyMetrics
 from .optimizers import SGD, Adam
@@ -23,6 +24,7 @@ from . import initializers, losses, metrics, preprocessing, utils
 
 __all__ = ["Input", "Dense", "Conv2D", "MaxPooling2D", "AveragePooling2D",
            "Flatten", "Embedding", "Concatenate", "Add", "Subtract",
-           "Multiply", "Activation", "Dropout", "BatchNormalization",
-           "Model", "Sequential", "Callback", "EarlyStopping",
+           "Multiply", "Reshape", "Activation", "Dropout",
+           "BatchNormalization", "concatenate", "add", "subtract",
+           "multiply", "Model", "Sequential", "Callback", "EarlyStopping",
            "VerifyMetrics", "SGD", "Adam"]

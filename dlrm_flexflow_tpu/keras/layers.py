@@ -40,6 +40,7 @@ class Layer:
         self.name = name
         self.input_tensors: List[KTensor] = []
         self.output: Optional[KTensor] = None
+        self._pending_weights = None
 
     def __call__(self, inputs):
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
@@ -56,6 +57,58 @@ class Layer:
         Tensors."""
         raise NotImplementedError
 
+    # -- weight surgery (reference layer.get_weights/set_weights, used by
+    # the net2net examples to move a trained teacher's weights into a
+    # wider/deeper student across two separately compiled models,
+    # examples/python/keras/func_cifar10_cnn_net2net.py) ---------------
+    def get_weights(self, ffmodel):
+        """Trained (kernel, bias) as numpy arrays, in the reference's
+        layouts (Dense (in, out); Conv2D OIHW)."""
+        import numpy as np
+        if ffmodel is None or not getattr(ffmodel, "params", None) or \
+                self.name not in ffmodel.params:
+            raise ValueError(
+                f"layer {self.name!r}: no trained weights available — "
+                "fit() (or init_layers) the model first")
+        p = ffmodel.params[self.name]
+        out = [np.asarray(p["kernel"], dtype=np.float32)]
+        if "bias" in p:
+            out.append(np.asarray(p["bias"], dtype=np.float32))
+        return tuple(out) if len(out) > 1 else (out[0], None)
+
+    def set_weights(self, ffmodel, kernel, bias=None):
+        """Overwrite this layer's parameters. Before the owning model is
+        materialized (the student in the net2net flow calls this right
+        after compile()), the arrays are stashed and applied by fit()
+        after init_layers."""
+        import numpy as np
+        kernel = np.asarray(kernel, dtype=np.float32)
+        bias = None if bias is None else np.asarray(bias, np.float32)
+        if ffmodel is None or not getattr(ffmodel, "params", None) or \
+                self.name not in ffmodel.params:
+            self._pending_weights = (kernel, bias)
+            return
+        self.apply_weights(ffmodel, kernel, bias)
+
+    def apply_weights(self, ffmodel, kernel, bias):
+        import jax
+
+        import jax.numpy as jnp
+        p = ffmodel.params[self.name]
+        new = {"kernel": kernel} if bias is None else {"kernel": kernel,
+                                                       "bias": bias}
+        for k, v in new.items():
+            if k not in p:
+                raise ValueError(f"layer {self.name!r} has no param {k!r}")
+            if tuple(p[k].shape) != tuple(v.shape):
+                raise ValueError(
+                    f"layer {self.name!r} param {k!r}: shape "
+                    f"{v.shape} != expected {tuple(p[k].shape)}")
+            arr = jnp.asarray(v, dtype=p[k].dtype)
+            sh = getattr(ffmodel, "_param_sharding", {}).get(
+                self.name, {}).get(k)
+            p[k] = jax.device_put(arr, sh) if sh is not None else arr
+
 
 def _norm_pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
@@ -63,13 +116,18 @@ def _norm_pair(v):
 
 class Dense(Layer):
     def __init__(self, units, activation=None, use_bias=True, name=None,
-                 kernel_initializer=None, bias_initializer=None):
+                 kernel_initializer=None, bias_initializer=None,
+                 input_shape=None):
         super().__init__(name)
         self.units = int(units)
         self.activation = activation
         self.use_bias = use_bias
         self.kernel_initializer = kernel_initializer
         self.bias_initializer = bias_initializer
+        # keras-style: a first layer can carry the model's input shape
+        # (Sequential([Dense(512, input_shape=(784,)), ...]))
+        self.input_shape_arg = (tuple(input_shape)
+                                if input_shape is not None else None)
 
     def compute_output(self, ins):
         return ins[0].shape[:-1] + (self.units,), ins[0].dtype
@@ -87,7 +145,8 @@ class Conv2D(Layer):
     """NCHW like the reference keras layer (channels_first)."""
 
     def __init__(self, filters, kernel_size, strides=(1, 1),
-                 padding="valid", activation=None, use_bias=True, name=None):
+                 padding="valid", activation=None, use_bias=True, name=None,
+                 input_shape=None):
         super().__init__(name)
         self.filters = int(filters)
         self.kernel = _norm_pair(kernel_size)
@@ -95,6 +154,8 @@ class Conv2D(Layer):
         self.padding = padding
         self.activation = activation
         self.use_bias = use_bias
+        self.input_shape_arg = (tuple(input_shape)
+                                if input_shape is not None else None)
 
     def _pads(self):
         if self.padding == "same":
@@ -231,6 +292,32 @@ class Activation(Layer):
         return model._unary(self.activation, ff_inputs[0], name=self.name)
 
 
+class Reshape(Layer):
+    """keras Reshape: batch-less target_shape (reference
+    examples/python/keras/reshape.py drives FFModel.reshape through it)."""
+
+    def __init__(self, target_shape, name=None):
+        super().__init__(name)
+        self.target_shape = tuple(int(s) for s in target_shape)
+
+    def compute_output(self, ins):
+        n = 1
+        for s in ins[0].shape:
+            n *= s
+        m = 1
+        for s in self.target_shape:
+            m *= s
+        if n != m:
+            raise ValueError(f"Reshape: {ins[0].shape} has {n} elements, "
+                             f"target {self.target_shape} has {m}")
+        return self.target_shape, ins[0].dtype
+
+    def materialize(self, model, ff_inputs):
+        batch = ff_inputs[0].shape[0]
+        return model.reshape(ff_inputs[0], (batch,) + self.target_shape,
+                             name=self.name)
+
+
 class Dropout(Layer):
     def __init__(self, rate, seed=0, name=None):
         super().__init__(name)
@@ -255,3 +342,21 @@ class BatchNormalization(Layer):
 
     def materialize(self, model, ff_inputs):
         return model.batch_norm(ff_inputs[0], relu=self.relu, name=self.name)
+
+
+# functional merge forms (reference keras.layers.merge: concatenate/add/
+# subtract/multiply as free functions over tensors)
+def concatenate(tensors, axis=1, name=None):
+    return Concatenate(axis=axis, name=name)(tensors)
+
+
+def add(tensors, name=None):
+    return Add(name=name)(tensors)
+
+
+def subtract(tensors, name=None):
+    return Subtract(name=name)(tensors)
+
+
+def multiply(tensors, name=None):
+    return Multiply(name=name)(tensors)

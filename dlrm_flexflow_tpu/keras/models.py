@@ -30,7 +30,32 @@ class Model:
         self.metrics: List[str] = []
         self.ffmodel: Optional[FFModel] = None
 
+    @property
+    def input(self):
+        """Reference alias: model.input[0] is the first symbolic input."""
+        return self.inputs
+
     # -- keras API ------------------------------------------------------
+    def __call__(self, tensor):
+        """Call a Model as a layer (reference nested-model examples,
+        func_cifar10_cnn_nested.py: output = model2(model1(input))): the
+        model's layer graph is replayed onto the new input tensor(s) and
+        becomes part of the caller's graph. The SAME layer objects are
+        reused, so surgery via set_weights on them still applies."""
+        ts = tensor if isinstance(tensor, (list, tuple)) else [tensor]
+        if len(ts) != len(self.inputs):
+            raise ValueError(f"model {self.name!r} has {len(self.inputs)} "
+                             f"inputs, got {len(ts)}")
+        # snapshot the original wiring BEFORE re-calling mutates it
+        plan = [(layer, [t.tid for t in layer.input_tensors],
+                 layer.output.tid) for layer in self._topo_layers()]
+        mapping = {inp.tid: t for inp, t in zip(self.inputs, ts)}
+        out_tid = self.output.tid
+        for layer, in_tids, o_tid in plan:
+            ins = [mapping[t] for t in in_tids]
+            mapping[o_tid] = layer(ins if len(ins) > 1 else ins[0])
+        return mapping[out_tid]
+
     def compile(self, optimizer="sgd", loss="mean_squared_error",
                 metrics=None):
         self.optimizer = optimizer
@@ -81,6 +106,13 @@ class Model:
         ff.compile(_resolve_optimizer(self.optimizer), self.loss,
                    self.metrics, final_tensor=self._ff_out)
         ff.init_layers()
+        # weights stashed by Layer.set_weights before materialization
+        # (the net2net student flow) land now, over the fresh init
+        for layer in self._topo_layers():
+            if layer._pending_weights is not None:
+                k, b = layer._pending_weights
+                layer.apply_weights(ff, k, b)
+                layer._pending_weights = None
         inputs = {f"input_{i}": np.asarray(a) for i, a in enumerate(xs)}
 
         stop = {"flag": False}
@@ -164,13 +196,20 @@ class Sequential(Model):
                 self._input = layer
                 self._out = layer
                 return
-            if not hasattr(layer, "input_shape_arg") and \
-               not getattr(layer, "_first_input_shape", None):
-                pass
+            # reference seeding forms: the first layer carries
+            # input_shape=(...), or the first element is itself a Model
+            # (seq_mnist_cnn_nested.py stacks whole sub-models)
+            shape = getattr(layer, "input_shape_arg", None)
+            if shape is None and isinstance(layer, Model):
+                shape = layer.inputs[0].shape
+            if shape is not None:
+                self._input = Input(shape)
+                self._out = self._input
         if self._input is None:
             raise ValueError(
                 "Sequential needs an Input first: Sequential([Input(...), "
-                "Dense(...), ...]) or model.add(Input(shape))")
+                "Dense(...), ...]), or give the first layer an "
+                "input_shape=")
         self._out = layer(self._out)
         self._layers.append(layer)
 

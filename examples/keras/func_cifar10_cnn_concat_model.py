@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Concatenating two Models' outputs (reference:
+examples/python/keras/func_cifar10_cnn_concat_model.py): two functional
+sub-models over separate inputs, their symbolic outputs concatenated on
+the channel axis into one trainable graph fed [x, x]."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import cifar10
+
+
+def _branch(inp):
+    t = K.Conv2D(16, (3, 3), padding=(1, 1), activation="relu")(inp)
+    return K.Conv2D(16, (3, 3), padding=(1, 1), activation="relu")(t)
+
+
+def main():
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    in1 = K.Input((3, 32, 32))
+    in2 = K.Input((3, 32, 32))
+    model1 = K.Model(in1, _branch(in1))
+    model2 = K.Model(in2, _branch(in2))
+    print(model1.summary())
+    print(model2.summary())
+
+    t = K.Concatenate(axis=1)([model1.output, model2.output])
+    t = K.MaxPooling2D((2, 2))(t)
+    t = K.Conv2D(32, (3, 3), padding=(1, 1), activation="relu")(t)
+    t = K.MaxPooling2D((2, 2))(t)
+    t = K.Flatten()(t)
+    t = K.Dense(128, activation="relu")(t)
+    t = K.Dense(10)(t)
+    out = K.Activation("softmax")(t)
+
+    model = K.Model([in1, in2], out)
+    model.compile(optimizer=K.SGD(learning_rate=0.03, momentum=0.9),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.4)
+    model.fit([x_train, x_train], y_train, batch_size=64, epochs=4,
+              callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Nested models (reference:
+examples/python/keras/func_cifar10_cnn_nested.py): two Models composed by
+CALLING them on tensors — output = model2(model1(input)) — and compiled
+as one trainable graph."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import cifar10
+
+
+def main():
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    # sub-model 1: conv feature extractor
+    in1 = K.Input((3, 32, 32))
+    t = K.Conv2D(16, (3, 3), padding=(1, 1), activation="relu")(in1)
+    t = K.Conv2D(16, (3, 3), padding=(1, 1), activation="relu")(t)
+    t = K.MaxPooling2D((2, 2))(t)
+    model1 = K.Model(in1, t)
+
+    # sub-model 2: classifier head over the extractor's output shape
+    in2 = K.Input((16, 16, 16))
+    t = K.Conv2D(32, (3, 3), padding=(1, 1), activation="relu")(in2)
+    t = K.MaxPooling2D((2, 2))(t)
+    t = K.Flatten()(t)
+    t = K.Dense(128, activation="relu")(t)
+    t = K.Dense(10)(t)
+    t = K.Activation("softmax")(t)
+    model2 = K.Model(in2, t)
+
+    # composition: models called as layers
+    in3 = K.Input((3, 32, 32))
+    out = model2(model1(in3))
+    model = K.Model(in3, out)
+    model.compile(optimizer=K.SGD(learning_rate=0.03, momentum=0.9),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    print(model.summary())
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.4)
+    model.fit(x_train, y_train, batch_size=64, epochs=4, callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Net2Net CNN teacher→student (reference:
+examples/python/keras/func_cifar10_cnn_net2net.py): the student WIDENS
+the stem — two copies of the teacher's first conv feed a Concatenate, so
+the second conv's kernel is the teacher's kernel duplicated along its
+INPUT-channel axis (OIHW axis 1) — real weight surgery, not a copy."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import cifar10
+
+
+def main():
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    # teacher
+    inp1 = K.Input((3, 32, 32))
+    c1 = K.Conv2D(16, (3, 3), padding="same", activation="relu")
+    c2 = K.Conv2D(16, (3, 3), padding=(1, 1), activation="relu")
+    d1 = K.Dense(128, activation="relu")
+    d2 = K.Dense(10)
+    t = c1(inp1)
+    t = c2(t)
+    t = K.MaxPooling2D((2, 2))(t)
+    t = K.Flatten()(t)
+    t = d1(t)
+    out = K.Activation("softmax")(d2(t))
+    teacher = K.Model(inp1, out)
+    teacher.compile(optimizer=K.SGD(learning_rate=0.03, momentum=0.9),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    teacher.fit(x_train, y_train, batch_size=64, epochs=2)
+
+    c1_k, c1_b = c1.get_weights(teacher.ffmodel)
+    c2_k, c2_b = c2.get_weights(teacher.ffmodel)
+    d1_k, d1_b = d1.get_weights(teacher.ffmodel)
+    d2_k, d2_b = d2.get_weights(teacher.ffmodel)
+
+    # widen: the student's stem is TWO copies of c1 concatenated, so c2's
+    # input channels double — duplicate its kernel along OIHW axis 1
+    c2_k_wide = np.concatenate((c2_k, c2_k), axis=1)
+
+    # student
+    inp2 = K.Input((3, 32, 32))
+    sc1_1 = K.Conv2D(16, (3, 3), padding="same", activation="relu")
+    sc1_2 = K.Conv2D(16, (3, 3), padding="same", activation="relu")
+    sc2 = K.Conv2D(16, (3, 3), padding=(1, 1), activation="relu")
+    sd1 = K.Dense(128, activation="relu")
+    sd2 = K.Dense(10)
+    t = K.Concatenate(axis=1)([sc1_1(inp2), sc1_2(inp2)])
+    t = sc2(t)
+    t = K.MaxPooling2D((2, 2))(t)
+    t = K.Flatten()(t)
+    t = sd1(t)
+    out = K.Activation("softmax")(sd2(t))
+    student = K.Model(inp2, out)
+    student.compile(optimizer=K.SGD(learning_rate=0.03, momentum=0.9),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    sc1_1.set_weights(student.ffmodel, c1_k, c1_b)
+    sc1_2.set_weights(student.ffmodel, c1_k, c1_b)
+    sc2.set_weights(student.ffmodel, c2_k_wide, c2_b)
+    sd1.set_weights(student.ffmodel, d1_k, d1_b)
+    sd2.set_weights(student.ffmodel, d2_k, d2_b)
+
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.4)
+    student.fit(x_train, y_train, batch_size=64, epochs=4, callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Multi-input MLP with nested-model reuse (reference:
+examples/python/keras/func_mnist_mlp_concat2.py: a Model is CALLED on a
+fresh input — t12 = model11(input12) — then several branch models'
+outputs concatenate into one classifier)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import mnist
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(len(x_train), 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+    half1, half2 = x_train[:, :392], x_train[:, 392:]
+
+    # a sub-model built on one input, then REPLAYED onto another tensor
+    in11 = K.Input((392,))
+    t11 = K.Dense(128, activation="relu")(in11)
+    model11 = K.Model(in11, t11)
+
+    in12 = K.Input((392,))
+    t12 = model11(in12)                  # nested-model call
+    t1 = K.Dense(128, activation="relu")(t12)
+
+    in2 = K.Input((392,))
+    t2 = K.Dense(128, activation="relu")(in2)
+    t2 = K.Dense(128, activation="relu")(t2)
+
+    merged = K.Concatenate(axis=1)([t1, t2])
+    t = K.Dense(128, activation="relu")(merged)
+    t = K.Dense(10)(t)
+    out = K.Activation("softmax")(t)
+
+    model = K.Model([in12, in2], out)
+    model.compile(optimizer=K.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.6)
+    model.fit([half1, half2], y_train, batch_size=64, epochs=5,
+              callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

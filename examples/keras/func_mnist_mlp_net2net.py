@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Net2Net MLP teacher→student (reference:
+examples/python/keras/func_mnist_mlp_net2net.py — train a teacher, read
+each layer's trained weights with layer.get_weights(ffmodel), seed a
+SECOND compiled model's layers with layer.set_weights, keep training).
+The weight transfer is asserted at student train-begin, and the student
+must reach the accuracy bar."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import mnist
+
+
+class VerifyWeightsTransferred(K.Callback):
+    """Asserts the student's materialized params START at the teacher's
+    trained values (net2net's point: not a fresh init)."""
+
+    def __init__(self, expected):   # {layer_name: (kernel, bias)}
+        self.expected = expected
+
+    def on_train_begin(self, model):
+        super().on_train_begin(model)
+        for name, (kern, bias) in self.expected.items():
+            got = np.asarray(model.ffmodel.params[name]["kernel"])
+            np.testing.assert_allclose(got, kern, rtol=1e-6, atol=1e-6,
+                                       err_msg=f"{name} kernel not "
+                                       "transferred")
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(len(x_train), 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    # teacher
+    inp1 = K.Input((784,))
+    d1 = K.Dense(256, activation="relu")
+    d2 = K.Dense(256, activation="relu")
+    d3 = K.Dense(10)
+    out = K.Activation("softmax")(d3(d2(d1(inp1))))
+    teacher = K.Model(inp1, out)
+    teacher.compile(optimizer=K.SGD(learning_rate=0.05),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    teacher.fit(x_train, y_train, batch_size=64, epochs=2)
+
+    d1_k, d1_b = d1.get_weights(teacher.ffmodel)
+    d2_k, d2_b = d2.get_weights(teacher.ffmodel)
+    d3_k, d3_b = d3.get_weights(teacher.ffmodel)
+
+    # student: same topology, seeded with the teacher's trained weights
+    inp2 = K.Input((784,))
+    sd1 = K.Dense(256, activation="relu")
+    sd2 = K.Dense(256, activation="relu")
+    sd3 = K.Dense(10)
+    out = K.Activation("softmax")(sd3(sd2(sd1(inp2))))
+    student = K.Model(inp2, out)
+    student.compile(optimizer=K.SGD(learning_rate=0.05),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    sd1.set_weights(student.ffmodel, d1_k, d1_b)
+    sd2.set_weights(student.ffmodel, d2_k, d2_b)
+    sd3.set_weights(student.ffmodel, d3_k, d3_b)
+
+    cbs = [VerifyWeightsTransferred({sd1.name: (d1_k, d1_b),
+                                     sd2.name: (d2_k, d2_b),
+                                     sd3.name: (d3_k, d3_b)}),
+           K.VerifyMetrics(metric="accuracy", threshold=0.6)]
+    student.fit(x_train, y_train, batch_size=64, epochs=4, callbacks=cbs)
+
+
+if __name__ == "__main__":
+    main()

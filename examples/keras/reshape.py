@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Reshape layer round-trip inside a training graph (reference:
+examples/python/keras/reshape.py: 784 → (28, 28) → 784 → MLP — the
+reshapes must be numerically transparent and differentiable)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import mnist
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(len(x_train), 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    inp = K.Input((784,))
+    t = K.Reshape((28, 28))(inp)
+    t = K.Reshape((784,))(t)
+    t = K.Dense(256, activation="relu")(t)
+    t = K.Dense(256, activation="relu")(t)
+    t = K.Dense(10)(t)
+    out = K.Activation("softmax")(t)
+
+    model = K.Model(inp, out)
+    model.compile(optimizer=K.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    print(model.summary())
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.6)
+    model.fit(x_train, y_train, batch_size=64, epochs=5, callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Net2Net CNN teacher→student with the Sequential API (reference:
+examples/python/keras/seq_mnist_cnn_net2net.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import mnist
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = (x_train.reshape(len(x_train), 1, 28, 28)
+               .astype(np.float32) / 255.0)
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    c1 = K.Conv2D(16, (3, 3), padding=(1, 1), activation="relu",
+                  input_shape=(1, 28, 28))
+    c2 = K.Conv2D(16, (3, 3), padding=(1, 1), activation="relu")
+    d1 = K.Dense(10)
+    teacher = K.Sequential([c1, c2, K.MaxPooling2D((2, 2)), K.Flatten(),
+                            d1, K.Activation("softmax")])
+    teacher.compile(optimizer=K.SGD(learning_rate=0.03, momentum=0.9),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    teacher.fit(x_train, y_train, batch_size=64, epochs=2)
+
+    weights = [l.get_weights(teacher.ffmodel) for l in (c1, c2, d1)]
+
+    sc1 = K.Conv2D(16, (3, 3), padding=(1, 1), activation="relu",
+                   input_shape=(1, 28, 28))
+    sc2 = K.Conv2D(16, (3, 3), padding=(1, 1), activation="relu")
+    sd1 = K.Dense(10)
+    student = K.Sequential([sc1, sc2, K.MaxPooling2D((2, 2)), K.Flatten(),
+                            sd1, K.Activation("softmax")])
+    student.compile(optimizer=K.SGD(learning_rate=0.03, momentum=0.9),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    for layer, (k, b) in zip((sc1, sc2, sd1), weights):
+        layer.set_weights(student.ffmodel, k, b)
+
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.6)
+    student.fit(x_train, y_train, batch_size=64, epochs=4, callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Net2Net MLP teacher→student with the Sequential API (reference:
+examples/python/keras/seq_mnist_mlp_net2net.py): Sequential teacher
+trains, its Dense layers hand their trained weights to a Sequential
+student via get_weights/set_weights across two compiled models."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import mnist
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(len(x_train), 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    d1 = K.Dense(256, activation="relu", input_shape=(784,))
+    d2 = K.Dense(10)
+    teacher = K.Sequential([d1, d2, K.Activation("softmax")])
+    teacher.compile(optimizer=K.SGD(learning_rate=0.05),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    teacher.fit(x_train, y_train, batch_size=64, epochs=2)
+
+    d1_k, d1_b = d1.get_weights(teacher.ffmodel)
+    d2_k, d2_b = d2.get_weights(teacher.ffmodel)
+
+    sd1 = K.Dense(256, activation="relu", input_shape=(784,))
+    sd2 = K.Dense(10)
+    student = K.Sequential([sd1, sd2, K.Activation("softmax")])
+    student.compile(optimizer=K.SGD(learning_rate=0.05),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+    sd1.set_weights(student.ffmodel, d1_k, d1_b)
+    sd2.set_weights(student.ffmodel, d2_k, d2_b)
+
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.6)
+    student.fit(x_train, y_train, batch_size=64, epochs=4, callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

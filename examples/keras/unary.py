@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Element-unary activations and functional merges (reference:
+examples/python/keras/unary.py builds Add/subtract merge graphs and
+initializes them): a two-input graph using the free-function merge forms
+(add/subtract) plus a chain of unary Activations, trained on a learnable
+regression target so the assertion is enforcing."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n = 512
+    x1 = rng.rand(n, 16).astype(np.float32)
+    x2 = rng.rand(n, 16).astype(np.float32)
+    # target depends on both branches: learnable by the merged graph
+    y = (np.tanh(x1.sum(axis=1, keepdims=True))
+         - 0.5 * x2.sum(axis=1, keepdims=True)).astype(np.float32)
+
+    in1 = K.Input((16,))
+    in2 = K.Input((16,))
+    t1 = K.Dense(32)(in1)
+    t1 = K.Activation("tanh")(t1)
+    t2 = K.Dense(32)(in2)
+    t2 = K.Activation("sigmoid")(t2)
+    added = K.add([t1, t2])
+    diff = K.subtract([added, K.Activation("relu")(t2)])
+    out = K.Dense(1)(diff)
+
+    model = K.Model([in1, in2], out)
+    model.compile(optimizer=K.SGD(learning_rate=0.05),
+                  loss="mean_squared_error",
+                  metrics=["mean_squared_error"])
+    print(model.summary())
+    cb = K.VerifyMetrics(metric="mse", threshold=0.5, mode="min")
+    model.fit([x1, x2], y, batch_size=64, epochs=8, callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

@@ -114,6 +114,18 @@ def test_graphfile_example(capsys):
     "keras/func_cifar10_cnn_concat.py",
     "keras/func_cifar10_alexnet.py",
     "keras/reuters_mlp.py",
+    "keras/func_mnist_cnn.py",
+    "keras/func_mnist_mlp_concat2.py",
+    "keras/func_mnist_mlp_net2net.py",
+    "keras/seq_mnist_mlp_net2net.py",
+    "keras/func_cifar10_cnn_net2net.py",
+    "keras/seq_mnist_cnn_net2net.py",
+    "keras/func_cifar10_cnn_nested.py",
+    "keras/seq_mnist_cnn_nested.py",
+    "keras/func_cifar10_cnn_concat_model.py",
+    "keras/func_cifar10_cnn_concat_seq_model.py",
+    "keras/reshape.py",
+    "keras/unary.py",
 ])
 def test_keras_example(script, monkeypatch):
     """Each keras example carries a VerifyMetrics callback that RAISES
