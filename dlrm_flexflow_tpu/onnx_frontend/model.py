@@ -160,6 +160,12 @@ class ONNXModel:
                               int(at.get("axis", 1)), name=name)
             elif op == "Transpose":
                 t = ff.transpose(env[ins[0]], name=name)
+            elif op == "Pad":
+                # reference handlePad is an explicit pass-through
+                # (python/flexflow/onnx/model.py:107-111: "pass-through
+                # pad") — exporters emit standalone Pads whose padding the
+                # following Conv/Pool already carries
+                t = env[ins[0]]
             elif op == "Identity":
                 t = env[ins[0]]
             else:
