@@ -83,6 +83,13 @@ class FFConfig:
     # avoids streaming the full tables through HBM every step). Disable
     # with --dense-embedding-update.
     sparse_embedding_update: bool = True
+    # space-to-depth lowering for strided low-channel convs (the MLPerf
+    # ResNet-stem reformulation; a 3-channel stem fills 3/128 MXU lanes).
+    # "off" | "on" (every eligible conv) | "auto" (measure both lowerings
+    # per eligible conv at init and keep the faster — the TPU analog of
+    # the reference's cuDNN find-algorithm pick, conv_2d.cu:217).
+    # Set with --conv-s2d {on,off,auto}.
+    conv_s2d: str = "off"
     unparsed: List[str] = field(default_factory=list)
 
     @property
@@ -155,6 +162,12 @@ class FFConfig:
                 cfg.strict_strategies = True
             elif a == "--no-nhwc":
                 cfg.conv_nhwc = False
+            elif a == "--conv-s2d":
+                v = take()
+                if v not in ("on", "off", "auto"):
+                    raise ValueError(f"--conv-s2d expects on|off|auto, "
+                                     f"got {v!r}")
+                cfg.conv_s2d = v
             elif a == "--host-tables":
                 cfg.host_resident_tables = True
             elif a == "--host-tables-async":

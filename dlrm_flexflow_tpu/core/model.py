@@ -1008,6 +1008,7 @@ class FFModel:
         """Initialize parameters/optimizer/op state, sharded per strategy
         (reference init_layers launches per-op init tasks; initializer GPU
         tasks run at compile, model.cc:1028-1045)."""
+        self._pick_conv_s2d()
         seed = self.config.seed if seed is None else seed
         key = jax.random.PRNGKey(seed)
         params: Dict[str, Dict[str, jnp.ndarray]] = {}
@@ -1052,6 +1053,27 @@ class FFModel:
         self._step_dev = None
         self._msums = None
         return self
+
+    def _pick_conv_s2d(self):
+        """Choose the conv stem lowering per FFConfig.conv_s2d: "on"
+        forces space-to-depth on every eligible conv; "auto" measures
+        both lowerings per eligible conv on the attached device and keeps
+        the faster (the reference picks its conv algorithm the same way —
+        by running candidates, conv_2d.cu:217)."""
+        mode = getattr(self.config, "conv_s2d", "off")
+        if mode == "off":
+            return
+        from ..ops.conv import Conv2D, measure_s2d_wins
+        for op in self.ops:
+            if not isinstance(op, Conv2D) or not op.s2d_eligible():
+                continue
+            if getattr(op, "_s2d_decided", False):
+                continue
+            op._use_s2d = (True if mode == "on"
+                           else measure_s2d_wins(op))
+            op._s2d_decided = True
+            log_model.info("conv %s: space-to-depth lowering %s (%s)",
+                           op.name, "ON" if op._use_s2d else "off", mode)
 
     def _device_batch(self, batch: Dict[str, np.ndarray],
                       with_label: bool = True) -> Dict[str, Any]:

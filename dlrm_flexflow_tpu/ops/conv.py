@@ -47,6 +47,51 @@ def _to_nhwc(x, t):
     return x if t.physical == "nhwc" else jnp.transpose(x, (0, 2, 3, 1))
 
 
+def _s2d_conv_nhwc(x, kernel, stride, padding, out_hw):
+    """Space-to-depth lowering of a strided conv (the MLPerf ResNet stem
+    reformulation): a k x k stride-s conv over C channels becomes a
+    ceil(k/s) x ceil(k/s) stride-1 conv over C*s*s channels. A 3-channel
+    224x224 stem fills 3/128 MXU lanes (~7% stem MFU measured,
+    benchmarks/CONV_MFU_ANALYSIS.md); after the transform the stem
+    carries C*s*s lanes and the conv's inner dim grows s*s-fold.
+
+    Exact algebra: with explicit input padding, output pixel i reads
+    input rows s*i+p (p < k). Writing p = p'*s + u, rows s*(i+p') + u
+    are exactly space-to-depth block row i+p', sub-row u — so the
+    original conv equals a stride-1 VALID conv over the s2d input with
+    the kernel regrouped as [o, (u, v, c), p', q'] (kernel padded with
+    zero taps to a multiple of s first).
+
+    x: NHWC; kernel: OIHW; returns NHWC conv output of spatial out_hw.
+    """
+    n, h, w, c = x.shape
+    o, _, kh, kw = kernel.shape
+    sh, sw = stride
+    ph, pw = padding
+    oh, ow = out_hw
+    kh_p = -(-kh // sh) * sh
+    kw_p = -(-kw // sw) * sw
+    # exact padded extent each spatial dim must provide: the last output
+    # window starts at (o-1)*s and spans the zero-padded kernel
+    h_need = (oh - 1) * sh + kh_p
+    w_need = (ow - 1) * sw + kw_p
+    x = jnp.pad(x, ((0, 0), (ph, max(h_need - h - ph, 0)),
+                    (pw, max(w_need - w - pw, 0)), (0, 0)))
+    x = x[:, :h_need, :w_need]         # crop rows no window reads
+    # space-to-depth: channel index becomes (u*sw + v)*C + c
+    x = x.reshape(n, h_need // sh, sh, w_need // sw, sw, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
+        n, h_need // sh, w_need // sw, sh * sw * c)
+    # kernel: zero-pad taps to (kh_p, kw_p), regroup to match
+    k = jnp.pad(kernel, ((0, 0), (0, 0), (0, kh_p - kh), (0, kw_p - kw)))
+    k = k.reshape(o, c, kh_p // sh, sh, kw_p // sw, sw)
+    k = jnp.transpose(k, (0, 3, 5, 1, 2, 4)).reshape(
+        o, sh * sw * c, kh_p // sh, kw_p // sw)
+    return lax.conv_general_dilated(
+        x, k, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "OIHW", "NHWC"))
+
+
 def _from_nhwc(x, t):
     """Bring an NHWC array back to tensor `t`'s declared physical form."""
     return x if t.physical == "nhwc" else jnp.transpose(x, (0, 3, 1, 2))
@@ -101,12 +146,19 @@ class Conv2D(Op):
         # rejects mixed dtypes (fp32 cotangent vs bf16 operands), so emit a
         # bf16-out conv (MXU still accumulates fp32 internally) and upcast
         if self.outputs[0].physical == "nhwc":
-            y = lax.conv_general_dilated(
-                _to_nhwc(x, self.inputs[0]).astype(cdt),
-                params["kernel"].astype(cdt),
-                window_strides=self.stride, padding=pads,
-                dimension_numbers=("NHWC", "OIHW", "NHWC"),
-                feature_group_count=self.groups).astype(jnp.float32)
+            if getattr(self, "_use_s2d", False):
+                y = _s2d_conv_nhwc(
+                    _to_nhwc(x, self.inputs[0]).astype(cdt),
+                    params["kernel"].astype(cdt), self.stride,
+                    self.padding,
+                    self.outputs[0].shape[2:]).astype(jnp.float32)
+            else:
+                y = lax.conv_general_dilated(
+                    _to_nhwc(x, self.inputs[0]).astype(cdt),
+                    params["kernel"].astype(cdt),
+                    window_strides=self.stride, padding=pads,
+                    dimension_numbers=("NHWC", "OIHW", "NHWC"),
+                    feature_group_count=self.groups).astype(jnp.float32)
             if self.use_bias:
                 y = y + params["bias"]
         else:
@@ -118,6 +170,18 @@ class Conv2D(Op):
             if self.use_bias:
                 y = y + params["bias"][None, :, None, None]
         return [apply_activation(y, self.activation).astype(x.dtype)]
+
+    def s2d_eligible(self) -> bool:
+        """Space-to-depth pays when the conv is strided and its input
+        channels underfill the 128 MXU lanes (stems: 3 channels). The
+        transformed channel count must still be lane-friendly."""
+        sh, sw = self.stride
+        return (self.groups == 1
+                and self.outputs[0].physical == "nhwc"
+                and (sh > 1 or sw > 1)
+                and self.in_channels <= 8
+                and self.in_channels * sh * sw <= 128
+                and self.kernel[0] >= sh and self.kernel[1] >= sw)
 
     def candidate_parallel_configs(self, num_devices, feasible_degrees):
         """Sample DP plus attribute (h/w) splits — SOAP "A" parallelism
@@ -157,6 +221,63 @@ class Conv2D(Op):
         _, co, oh, ow = self.outputs[0].shape
         kh, kw = self.kernel
         return 2.0 * co * oh * ow * (self.in_channels // self.groups) * kh * kw
+
+
+def measure_s2d_wins(op, iters: int = 8) -> bool:
+    """Time one fwd+bwd of `op` under both lowerings on the attached
+    device and return True when space-to-depth is faster — the TPU analog
+    of the reference's cudnnFindConvolutionForwardAlgorithm pick
+    (conv_2d.cu:217): decided by measurement on the real machine, once,
+    at init. The timed graph scans `iters` applications with a data
+    dependence (XLA cannot hoist the conv) and consumes the gradients."""
+    import time
+
+    import numpy as np
+
+    t_in = op.inputs[0]
+    n, c, h, w = t_in.shape
+    shape = (n, h, w, c) if t_in.physical == "nhwc" else (n, c, h, w)
+    rng = np.random.RandomState(0)
+    cdt = op.model.compute_dtype
+    x = jnp.asarray(rng.rand(*shape).astype(np.float32)).astype(cdt)
+    params = {k: jnp.asarray(rng.rand(*d.shape).astype(np.float32))
+              for k, d in op.param_defs().items()}
+
+    def timed(use_s2d: bool) -> float:
+        old = getattr(op, "_use_s2d", False)
+        op._use_s2d = use_s2d
+        try:
+            @jax.jit
+            def f(p, xx):
+                def body(acc, _):
+                    xb = xx + (acc * 1e-38).astype(xx.dtype)
+
+                    def loss(pp, xi):
+                        out = op.apply(pp, [xi], training=True)[0]
+                        return jnp.sum(out.astype(jnp.float32))
+
+                    l, (gp, gx) = jax.value_and_grad(
+                        loss, argnums=(0, 1))(p, xb)
+                    consume = sum(jnp.sum(g).astype(jnp.float32) * 1e-30
+                                  for g in jax.tree.leaves(gp))
+                    consume += jnp.sum(gx).astype(jnp.float32) * 1e-30
+                    return acc + l + consume, None
+
+                acc, _ = lax.scan(body, jnp.float32(0.0), None,
+                                  length=iters)
+                return acc
+
+            float(f(params, x))            # compile + true wait
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(f(params, x))        # dependent readback
+                ts.append(time.perf_counter() - t0)
+            return sorted(ts)[1]
+        finally:
+            op._use_s2d = old
+
+    return timed(True) < timed(False)
 
 
 class Pool2D(Op):
