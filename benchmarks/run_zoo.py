@@ -78,8 +78,9 @@ def bench_alexnet(quick):
     import dlrm_flexflow_tpu as ff
     from dlrm_flexflow_tpu.models.alexnet import build_alexnet
     batch = 256
-    model = ff.FFModel(ff.FFConfig(batch_size=batch,
-                                   compute_dtype="bfloat16"))
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
+    cfg.conv_s2d = os.environ.get("FF_CONV_S2D", "off")
+    model = ff.FFModel(cfg)
     build_alexnet(model, num_classes=1000, image_hw=224)
     model.compile(ff.SGDOptimizer(lr=0.01),
                   "sparse_categorical_crossentropy", ["accuracy"])
@@ -92,8 +93,9 @@ def bench_resnet18(quick):
     import dlrm_flexflow_tpu as ff
     from dlrm_flexflow_tpu.models.resnet import build_resnet
     batch = 256
-    model = ff.FFModel(ff.FFConfig(batch_size=batch,
-                                   compute_dtype="bfloat16"))
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
+    cfg.conv_s2d = os.environ.get("FF_CONV_S2D", "off")
+    model = ff.FFModel(cfg)
     build_resnet(model, depth=18, num_classes=1000, image_hw=224)
     model.compile(ff.SGDOptimizer(lr=0.01),
                   "sparse_categorical_crossentropy", ["accuracy"])
@@ -106,8 +108,9 @@ def bench_inception(quick):
     import dlrm_flexflow_tpu as ff
     from dlrm_flexflow_tpu.models.inception import build_inception_v3
     batch = 256
-    model = ff.FFModel(ff.FFConfig(batch_size=batch,
-                                   compute_dtype="bfloat16"))
+    cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
+    cfg.conv_s2d = os.environ.get("FF_CONV_S2D", "off")
+    model = ff.FFModel(cfg)
     build_inception_v3(model, num_classes=1000)
     model.compile(ff.SGDOptimizer(lr=0.01),
                   "sparse_categorical_crossentropy", ["accuracy"])

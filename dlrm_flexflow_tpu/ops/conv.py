@@ -380,8 +380,15 @@ class BatchNorm(Op):
 
         x32 = x.astype(jnp.float32)
         if training:
+            # single-pass statistics: E[x] and E[x^2] reduce together in
+            # one traversal of the activation stream (jnp.var alone would
+            # re-read x after computing the mean — one extra full pass
+            # over every conv output per step, benchmarks/
+            # CONV_MFU_ANALYSIS.md names BN stat passes as a top cost).
+            # XLA fuses the two accumulations into one loop.
             mean = jnp.mean(x32, axis=reduce_axes)
-            var = jnp.var(x32, axis=reduce_axes)
+            mean_sq = jnp.mean(x32 * x32, axis=reduce_axes)
+            var = jnp.maximum(mean_sq - mean * mean, 0.0)
             new_state = {
                 "running_mean": self.momentum * state["running_mean"]
                                 + (1 - self.momentum) * mean,
