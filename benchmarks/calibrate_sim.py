@@ -174,8 +174,12 @@ def main():
 
     steps = int(os.environ.get("CAL_STEPS", "200"))
     only = os.environ.get("CAL_ONLY")           # substring filter
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "sim_calibration.json")
+    # CAL_OUT: write elsewhere (the hardware-gated test measures into a
+    # temp file and only replaces the committed artifact on success —
+    # a failed sweep must not destroy the record the always-on gate
+    # validates)
+    out = os.environ.get("CAL_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "sim_calibration.json")
     # resumable: each finished point lands on disk immediately, and an
     # interrupted run (the tunneled chip can die mid-sweep) picks up
     # where it left off with CAL_RESUME=1. Existing rows are ALWAYS

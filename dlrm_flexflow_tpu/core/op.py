@@ -184,6 +184,24 @@ class Op:
         sharded tables take the costlier RMW path)."""
         return 0.0
 
+    def hbm_io_factor(self) -> float:
+        """Multiplier on this op's modeled HBM activation traffic.
+        Elementwise-class ops (BatchNorm, unary/binary elementwise)
+        override with 0.5: XLA fuses them into their producer's epilogue
+        (the input read happens in registers/VMEM, not HBM). Measured
+        r4: pricing them standalone overcharges ResNet-18 by ~50%."""
+        return 1.0
+
+    def mxu_utilization_factor(self) -> float:
+        """Multiplier on TPUSpec.mxu_utilization for this op class. The
+        global 0.55 is calibrated on gemm-shaped work (DLRM/MLP, round-2
+        sweep); round-4 calibration shows large convs sustain ~25% MORE
+        of peak (XLA's spatial conv emitter tiles the MXU better) while
+        flash attention sustains far LESS (block-wise softmax
+        recomputation, causal masking, small batch*heads grids). Override
+        per op class; calibrated against benchmarks/sim_calibration.json."""
+        return 1.0
+
     def sequential_steps(self) -> int:
         """Number of inherently serial inner iterations (a lax.scan's
         length — the recurrent time loop of an LSTM). Each costs a fixed

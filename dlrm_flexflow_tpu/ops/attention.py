@@ -245,3 +245,11 @@ class MultiHeadAttention(Op):
         e = self.embed_dim
         # per sample: 4 projections (2*s*e*e each) + QK^T and PV (2*s^2*e each)
         return 8.0 * s * e * e + 4.0 * s * s * e
+
+    def mxu_utilization_factor(self) -> float:
+        # measured (r4 sweep, b8 s2048 d1024 causal training): ~13% of
+        # bf16 peak vs the gemm-calibrated 55% — flash attention pays
+        # block-wise softmax rescaling/recomputation, the causal mask
+        # discards half the score tiles' work, and small batch*heads
+        # grids underfill the chip
+        return 0.25

@@ -40,6 +40,10 @@ _BINARY = {
 class ElementUnary(Op):
     type_name = "ElementUnary"
 
+    def hbm_io_factor(self) -> float:
+        # fused into the producer's epilogue by XLA (see Op.hbm_io_factor)
+        return 0.5
+
     def __init__(self, model, input_tensor, op_type: str,
                  name: Optional[str] = None):
         if op_type not in _UNARY:
@@ -60,6 +64,10 @@ class ElementUnary(Op):
 
 class ElementBinary(Op):
     type_name = "ElementBinary"
+
+    def hbm_io_factor(self) -> float:
+        # fused into the producer's epilogue by XLA (see Op.hbm_io_factor)
+        return 0.5
 
     def __init__(self, model, a, b, op_type: str, name: Optional[str] = None):
         if op_type not in _BINARY:

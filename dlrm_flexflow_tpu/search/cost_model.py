@@ -63,14 +63,14 @@ class TPUSpec:
     # measured reality (benchmarks/bench_host_tables.py)
     host_random_row_s: float = 6.0e-7
     host_bytes_per_s: float = 50e9    # host DDR sequential stream
-    # fixed latency per serial scan iteration (lax.scan step): loop
-    # bookkeeping + carry round-trip; floors small-batch RNN cells far
-    # above their FLOP/bandwidth cost. PROVISIONAL estimate from the
-    # measured NMT step (~306 us/iteration incl. gemm at b64, split
-    # between iteration overhead and the cell) — to be pinned by the
-    # nmt_lstm point the next time benchmarks/calibrate_sim.py runs on
-    # the chip (sim_calibration.json does not yet contain that row)
-    scan_iter_s: float = 1.5e-4
+    # fixed OVERHEAD per serial scan iteration (lax.scan bookkeeping +
+    # carry round-trip), on top of the cell's own FLOP/bandwidth cost.
+    # PINNED by direct measurement (round 4): an NMT-sized cell (b64,
+    # h1024, bf16) costs ~32 us/iteration marginal, of which ~27 us is
+    # the cell's HBM weight re-stream (priced separately in
+    # _roofline_time's scan term) — the residual loop overhead is ~5 us;
+    # 10 us keeps a margin for smaller cells where bookkeeping dominates
+    scan_iter_s: float = 1.0e-5
 
     @staticmethod
     def v4() -> "TPUSpec":
@@ -152,11 +152,11 @@ class CostModel:
             # was WORSE than the roofline it was meant to refine).
             t_raw = self.measure_op(op, pc, backward=backward)
             t_roof = self._roofline_time(op, pc, backward)
-            # scanned ops' roofline rests on the PROVISIONAL scan_iter_s
-            # constant — give the real measurement a much wider band there
-            # (clamping an RNN measurement toward an unpinned guess would
-            # defeat the calibration that is supposed to pin it)
-            band = 8.0 if op.sequential_steps() else 2.0
+            # scanned ops keep a somewhat wider band: their roofline is
+            # calibrated (r4: scan weight re-stream priced, scan_iter_s
+            # pinned by measurement) but serial scans still measure
+            # noisier than single kernels on a shared chip
+            band = 3.0 if op.sequential_steps() else 2.0
             t = min(max(t_raw, t_roof / band), band * t_roof)
             if t != t_raw:
                 log_sim.debug(
@@ -203,20 +203,35 @@ class CostModel:
         # params: bytes this shard actually streams per step (a sparse-
         # update embedding touches only its gathered rows, not the
         # multi-GB table)
-        io_bytes += op.param_bytes_touched_per_step(max(pc.num_parts, 1))
+        p_touch = op.param_bytes_touched_per_step(max(pc.num_parts, 1))
+        io_bytes += p_touch
+        steps = op.sequential_steps()
+        if steps > 1 and p_touch:
+            # a serial scan re-streams its weights from HBM on EVERY
+            # iteration (measured round 4: the NMT LSTM cell's marginal
+            # per-iteration wall time ≈ its bf16 weight-stream time —
+            # XLA does not pin scan weights in VMEM at these sizes).
+            # (steps - 1) extra passes at compute-dtype width (the 4 B
+            # fp32 master read is already counted once above)
+            itemsize = jnp.dtype(self.compute_dtype).itemsize
+            io_bytes += (steps - 1) * p_touch * (itemsize / 4.0)
+        io_bytes *= op.hbm_io_factor()
         if backward:
-            # bwd ≈ 2x fwd flops (dX and dW gemms), grads written
+            # bwd ≈ 2x fwd flops (dX and dW gemms), grads written.
+            # For scanned ops the dX chain re-streams weights like the
+            # forward scan, but dW is ONE stacked gemm over all
+            # timesteps (XLA's scan vjp stacks the residuals), so bwd
+            # io ≈ 1.25x fwd, not 2x (measured r4: NMT bwd ≈ 1.15x fwd)
             flops *= 2.0
-            io_bytes *= 2.0
-        t = max(flops / self._flops_rate(), io_bytes / self._hbm_rate())
+            io_bytes *= 1.25 if steps > 1 else 2.0
+        rate = self._flops_rate() * op.mxu_utilization_factor()
+        t = max(flops / rate, io_bytes / self._hbm_rate())
         # random-row HBM accesses (embedding gathers) are latency-bound,
         # not bandwidth-bound — the dominant term for sparse ops
         rand_rows = op.random_hbm_rows(backward) / max(pc.num_parts, 1)
         t = max(t, self.random_rows_time(rand_rows))
-        # serial scan iterations (RNN time loops) floor the op at a fixed
-        # per-iteration latency regardless of per-step work; the vjp of a
-        # scan runs its own reverse-order scan of the same length
-        steps = op.sequential_steps()
+        # serial scan iterations floor at the per-iteration loop
+        # overhead; the vjp of a scan runs its own reverse-order scan
         if steps:
             t = max(t, steps * self.spec.scan_iter_s)
         return t + self.spec.kernel_launch_s

@@ -291,9 +291,11 @@ def test_strict_strategies_raises_on_clamp():
 
 
 def test_scan_iteration_latency_floors_lstm():
-    """RNN time loops are floored at steps x scan_iter_s — the serial
-    iteration latency the FLOP/bandwidth roofline cannot see (measured
-    ~300 us/iter at NMT scale vs ~15 us of gemm)."""
+    """Serial scans cost per-ITERATION: weights re-stream from HBM every
+    scan step (measured r4: the NMT cell's marginal per-iteration time ≈
+    its bf16 weight-stream time), floored by the per-iteration loop
+    overhead scan_iter_s. A scanned LSTM must therefore cost at least
+    steps x (overhead + its per-iteration weight stream)."""
     model = ff.FFModel(ff.FFConfig(batch_size=4, compute_dtype="bfloat16"))
     t = model.create_tensor((4, 32, 8), name="x")
     model.lstm(t, 8, name="lstm")
@@ -302,6 +304,20 @@ def test_scan_iteration_latency_floors_lstm():
     op = model.get_layer_by_name("lstm")
     t_fwd = cm.op_compute_time(op, ff.ParallelConfig((1, 1, 1)))
     assert t_fwd >= 32 * cm.spec.scan_iter_s
+    # the per-iteration weight restream must be priced: at NMT scale
+    # (h=1024, seq=40) the restream bytes dwarf the scan_iter floor, so
+    # dropping the (steps-1) weight-stream term from _roofline_time
+    # fails HERE even though the tiny-LSTM floor above still passes
+    big = ff.FFModel(ff.FFConfig(batch_size=64, compute_dtype="bfloat16"))
+    tb = big.create_tensor((64, 40, 1024), name="x")
+    big.lstm(tb, 1024, name="lstm")
+    big.mesh = make_mesh(num_devices=1)
+    opb = big.get_layer_by_name("lstm")
+    t_big = CostModel().op_compute_time(opb, ff.ParallelConfig((1, 1, 1)))
+    restream = (39 * opb.param_bytes() * 0.5      # bf16 width
+                / (cm.spec.hbm_bytes_per_s * cm.spec.hbm_utilization))
+    assert restream > 40 * cm.spec.scan_iter_s    # term actually dominates
+    assert t_big >= restream
     # a non-scanned op of the same tiny size is NOT floored: it must
     # cost less than even ONE scan iteration, so any spurious floor
     # (an op wrongly reporting sequential_steps) fails loudly

@@ -1,11 +1,15 @@
 """Simulator-vs-hardware calibration gate (VERDICT r1 item 1).
 
-Runs benchmarks/calibrate_sim.py on the REAL TPU and asserts the analytical
-(roofline) simulator matches measured step times within 35% on every point.
-Gated behind FF_TPU_TESTS=1 because the normal suite runs on the virtual
-CPU mesh (conftest.py) where there is no hardware to calibrate against;
-the round's recorded results live in benchmarks/sim_calibration.json and
-BENCHMARKS.md.
+Two tiers, so the gate actually gates in every environment:
+
+1. `test_committed_calibration_is_valid` runs EVERYWHERE: it validates the
+   COMMITTED benchmarks/sim_calibration.json — the round's on-chip
+   record — for coverage (>= 12 points spanning DLRM/MLP/conv/attention/
+   LSTM families) and accuracy (worst roofline |err| <= 35%; measured
+   mode no worse than 45%). A round that regresses the simulator or
+   commits a truncated sweep fails the normal suite, chip or no chip.
+2. `test_simulator_matches_hardware` (FF_TPU_TESTS=1) RE-MEASURES on the
+   real chip and applies the same bars to fresh numbers.
 """
 
 import json
@@ -16,24 +20,54 @@ import sys
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "benchmarks", "sim_calibration.json")
+
+FAMILIES = {
+    "dlrm": ["dlrm_random", "dlrm_kaggle"],
+    "mlp": ["mlp_heavy"],
+    "conv": ["alexnet", "resnet"],
+    "attention": ["attention"],
+    "lstm": ["nmt_lstm"],
+}
+
+
+def _check_rows(rows, roofline_bar=0.35, measured_bar=0.45):
+    assert len(rows) >= 12, f"need >=12 calibration points, got {len(rows)}"
+    points = [r["point"] for r in rows]
+    for family, prefixes in FAMILIES.items():
+        assert any(p.startswith(pre) for p in points for pre in prefixes), (
+            f"no calibration point for the {family} family in {points}")
+    for r in rows:
+        assert abs(r["err_roofline"]) <= roofline_bar, (
+            f"{r['point']}: simulated {r['sim_roofline_ms']:.2f} ms vs "
+            f"measured {r['measured_ms']:.2f} ms "
+            f"({r['err_roofline']:+.0%} > {roofline_bar:.0%})")
+        assert abs(r["err_measured"]) <= measured_bar, (
+            f"{r['point']}: measured-mode sim {r['sim_measured_ms']:.2f} "
+            f"ms vs measured {r['measured_ms']:.2f} ms "
+            f"({r['err_measured']:+.0%} > {measured_bar:.0%})")
+
+
+def test_committed_calibration_is_valid():
+    rows = json.load(open(OUT))
+    _check_rows(rows)
 
 
 @pytest.mark.skipif(os.environ.get("FF_TPU_TESTS") != "1",
                     reason="needs the real TPU chip (set FF_TPU_TESTS=1)")
-def test_simulator_matches_hardware():
+def test_simulator_matches_hardware(tmp_path):
+    """Fresh on-chip sweep into a TEMP file; the committed artifact is
+    replaced only after the fresh rows pass the bars (a failed/partial
+    sweep must not delete the record test_committed_calibration_is_valid
+    depends on — round 3's outage would have done exactly that)."""
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    out = os.path.join(REPO, "benchmarks", "sim_calibration.json")
-    if os.path.exists(out):
-        os.unlink(out)
+    fresh = str(tmp_path / "sim_calibration.json")
     subprocess.check_call(
         [sys.executable, os.path.join(REPO, "benchmarks",
                                       "calibrate_sim.py")],
-        env=dict(env, CAL_STEPS="100"), cwd=REPO, timeout=3600)
-    rows = json.load(open(out))
-    assert len(rows) >= 5, "need >=5 calibration points"
-    for r in rows:
-        assert abs(r["err_roofline"]) <= 0.35, (
-            f"{r['point']}: simulated {r['sim_roofline_ms']:.2f} ms vs "
-            f"measured {r['measured_ms']:.2f} ms "
-            f"({r['err_roofline']:+.0%} > 35%)")
+        env=dict(env, CAL_STEPS="100", CAL_OUT=fresh), cwd=REPO,
+        timeout=7200)
+    rows = json.load(open(fresh))
+    _check_rows(rows)
+    os.replace(fresh, OUT)
