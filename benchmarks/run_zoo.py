@@ -172,7 +172,7 @@ def main():
     ap.add_argument("--only", default=None)
     args = ap.parse_args()
     for name, fn in BENCHES.items():
-        if args.only and args.only != name:
+        if args.only and args.only not in name:
             continue
         try:
             sps = fn(args.quick)

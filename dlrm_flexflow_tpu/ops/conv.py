@@ -230,13 +230,16 @@ class Conv2D(Op):
         return 1.25
 
 
-def measure_s2d_wins(op, iters: int = 8) -> bool:
+def measure_s2d_wins(op, iters: int = 24) -> bool:
     """Time one fwd+bwd of `op` under both lowerings on the attached
     device and return True when space-to-depth is faster — the TPU analog
     of the reference's cudnnFindConvolutionForwardAlgorithm pick
     (conv_2d.cu:217): decided by measurement on the real machine, once,
-    at init. The timed graph scans `iters` applications with a data
-    dependence (XLA cannot hoist the conv) and consumes the gradients."""
+    at init. The timed graph scans applications with a data dependence
+    (XLA cannot hoist the conv) and consumes the gradients; the cost is
+    the MARGINAL time between a long and a short scan, which cancels
+    the dispatch roundtrip (~100 ms on a tunneled chip — larger than
+    the op being measured)."""
     import time
 
     import numpy as np
@@ -254,33 +257,41 @@ def measure_s2d_wins(op, iters: int = 8) -> bool:
         old = getattr(op, "_use_s2d", False)
         op._use_s2d = use_s2d
         try:
-            @jax.jit
-            def f(p, xx):
-                def body(acc, _):
-                    xb = xx + (acc * 1e-38).astype(xx.dtype)
+            def make(length):
+                @jax.jit
+                def f(p, xx):
+                    def body(acc, _):
+                        xb = xx + (acc * 1e-38).astype(xx.dtype)
 
-                    def loss(pp, xi):
-                        out = op.apply(pp, [xi], training=True)[0]
-                        return jnp.sum(out.astype(jnp.float32))
+                        def loss(pp, xi):
+                            out = op.apply(pp, [xi], training=True)[0]
+                            return jnp.sum(out.astype(jnp.float32))
 
-                    l, (gp, gx) = jax.value_and_grad(
-                        loss, argnums=(0, 1))(p, xb)
-                    consume = sum(jnp.sum(g).astype(jnp.float32) * 1e-30
-                                  for g in jax.tree.leaves(gp))
-                    consume += jnp.sum(gx).astype(jnp.float32) * 1e-30
-                    return acc + l + consume, None
+                        l, (gp, gx) = jax.value_and_grad(
+                            loss, argnums=(0, 1))(p, xb)
+                        consume = sum(
+                            jnp.sum(g).astype(jnp.float32) * 1e-30
+                            for g in jax.tree.leaves(gp))
+                        consume += jnp.sum(gx).astype(jnp.float32) * 1e-30
+                        return acc + l + consume, None
 
-                acc, _ = lax.scan(body, jnp.float32(0.0), None,
-                                  length=iters)
-                return acc
+                    acc, _ = lax.scan(body, jnp.float32(0.0), None,
+                                      length=length)
+                    return acc
+                return f
 
-            float(f(params, x))            # compile + true wait
-            ts = []
-            for _ in range(3):
-                t0 = time.perf_counter()
-                float(f(params, x))        # dependent readback
-                ts.append(time.perf_counter() - t0)
-            return sorted(ts)[1]
+            short, long_ = make(2), make(2 + iters)
+
+            def best(f):
+                float(f(params, x))        # compile + true wait
+                ts = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    float(f(params, x))    # dependent readback
+                    ts.append(time.perf_counter() - t0)
+                return min(ts)
+
+            return (best(long_) - best(short)) / iters
         finally:
             op._use_s2d = old
 
