@@ -83,6 +83,10 @@ class FFConfig:
     # avoids streaming the full tables through HBM every step). Disable
     # with --dense-embedding-update.
     sparse_embedding_update: bool = True
+    # VMEM-resident pallas LSTM scan kernel (weights pinned in VMEM
+    # across the time loop — the lax.scan cell is weight-stream-bound,
+    # BENCHMARKS.md r4). Disable with --no-pallas-lstm.
+    pallas_lstm: bool = True
     # space-to-depth lowering for strided low-channel convs (the MLPerf
     # ResNet-stem reformulation; a 3-channel stem fills 3/128 MXU lanes).
     # "off" | "on" (every eligible conv) | "auto" (measure both lowerings
@@ -162,6 +166,8 @@ class FFConfig:
                 cfg.strict_strategies = True
             elif a == "--no-nhwc":
                 cfg.conv_nhwc = False
+            elif a == "--no-pallas-lstm":
+                cfg.pallas_lstm = False
             elif a == "--conv-s2d":
                 v = take()
                 if v not in ("on", "off", "auto"):

@@ -210,6 +210,12 @@ class Op:
         steps x iter latency, which dominates small-batch RNNs."""
         return 0
 
+    def scan_weights_resident(self) -> bool:
+        """True when this op's serial scan keeps its weights resident in
+        VMEM (the pallas LSTM kernel) — the cost model then skips the
+        per-iteration weight re-stream term it charges lax.scan ops."""
+        return False
+
     def output_bytes(self) -> int:
         t = self.outputs[0]
         return int(math.prod(t.shape)) * jnp.dtype(t.dtype).itemsize

@@ -28,6 +28,40 @@ from ..core.op import Op, ParamDef
 from ..parallel.pconfig import ParallelConfig
 
 
+def _recurrent_scan(model, xproj, whc, cdt):
+    """The serial part of an LSTM layer: scan gate pre-activations
+    `xproj` (b, s, 4h) with recurrent weights `whc`. Routes to the
+    VMEM-resident pallas kernel when eligible — round-4 measurement
+    found the lax.scan cell WEIGHT-STREAM-BOUND (~27 of ~32 us/iter is
+    re-streaming wh from HBM; XLA does not pin scan weights), which the
+    kernel removes. Fallback: plain lax.scan (same math, same i,f,g,o
+    order)."""
+    b, s, h4 = xproj.shape
+    h = h4 // 4
+    from .pallas.lstm_kernel import lstm_scan, resident_scan_ok
+    if resident_scan_ok(model, b, h, s):
+        # the kernel is time-major (grid dim 0 = time; TPU block
+        # alignment wants (b, 4h) as the trailing dims)
+        ys = lstm_scan(jnp.swapaxes(xproj, 0, 1), whc)
+        return jnp.swapaxes(ys, 0, 1)
+
+    def cell(carry, xp):
+        hprev, cprev = carry
+        gates = xp + jnp.dot(hprev.astype(cdt), whc,
+                             preferred_element_type=jnp.float32)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * cprev + i * g
+        hcur = o * jnp.tanh(c)
+        return (hcur, c), hcur
+
+    zeros = jnp.zeros((b, h), jnp.float32)
+    (_, _), hs = lax.scan(cell, (zeros, zeros),
+                          jnp.swapaxes(xproj, 0, 1))  # (s, b, h)
+    return jnp.swapaxes(hs, 0, 1)
+
+
 def _lstm_candidate_configs(hidden, num_devices, feasible_degrees):
     """batch DP x hidden TP; the seq dim must stay whole for the scan
     (shared by LSTM and LSTMStack so the enumerations cannot drift)."""
@@ -67,34 +101,16 @@ class LSTM(Op):
     def apply(self, params, xs, *, training=False, rng=None):
         (x,) = xs  # (b, s, d)
         cdt = self.model.compute_dtype
-        h = self.hidden
         wx, wh, bias = params["wx"], params["wh"], params["bias"]
         # precompute input projections for the whole sequence in one big
         # MXU matmul, then scan only the recurrent part
         xproj = jnp.einsum("bsd,dk->bsk", x.astype(cdt), wx.astype(cdt),
                            preferred_element_type=jnp.float32) + bias
-        b = x.shape[0]
-        h0 = jnp.zeros((b, h), jnp.float32)
-        c0 = jnp.zeros((b, h), jnp.float32)
         # cast the recurrent weights ONCE outside the loop: a cast inside
         # the body would re-stream the (h, 4h) matrix every timestep if
         # XLA declines to hoist it (16 MB/step at reference scale)
-        whc = wh.astype(cdt)
-
-        def cell(carry, xp):
-            hprev, cprev = carry
-            gates = xp + jnp.dot(hprev.astype(cdt), whc,
-                                 preferred_element_type=jnp.float32)
-            i, f, g, o = jnp.split(gates, 4, axis=-1)
-            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
-            g = jnp.tanh(g)
-            c = f * cprev + i * g
-            hcur = o * jnp.tanh(c)
-            return (hcur, c), hcur
-
-        (_, _), hs = lax.scan(cell, (h0, c0),
-                              jnp.swapaxes(xproj, 0, 1))  # (s, b, h)
-        return [jnp.swapaxes(hs, 0, 1).astype(x.dtype)]
+        hs = _recurrent_scan(self.model, xproj, wh.astype(cdt), cdt)
+        return [hs.astype(x.dtype)]
 
     def candidate_parallel_configs(self, num_devices, feasible_degrees):
         return _lstm_candidate_configs(self.hidden, num_devices,
@@ -123,6 +139,11 @@ class LSTM(Op):
     def sequential_steps(self) -> int:
         # the recurrent scan: one serial iteration per sequence position
         return int(self.inputs[0].shape[1])
+
+    def scan_weights_resident(self) -> bool:
+        from .pallas.lstm_kernel import resident_scan_ok
+        b, s, _ = self.inputs[0].shape
+        return resident_scan_ok(self.model, b, self.hidden, s)
 
 
 class LSTMStack(Op):
@@ -175,6 +196,25 @@ class LSTMStack(Op):
         (x,) = xs  # (b, s, d)
         cdt = self.model.compute_dtype
         h, L = self.hidden, self.num_layers
+        b, s, _ = x.shape
+        from .pallas.lstm_kernel import resident_scan_ok
+        if resident_scan_ok(self.model, b, h, s):
+            # layer-by-layer with the VMEM-resident kernel: EVERY
+            # layer's input projection hoists to one big sequence-wide
+            # MXU matmul (the fused single-scan form must project deep
+            # layers inside the loop, re-streaming their wx every
+            # iteration — r4 measurement showed that stream, not the
+            # iteration count, is what the scan pays for)
+            cur = x
+            for l in range(L):
+                xp = jnp.einsum(
+                    "bsd,dk->bsk", cur.astype(cdt),
+                    params[f"wx{l}"].astype(cdt),
+                    preferred_element_type=jnp.float32) \
+                    + params[f"bias{l}"]
+                cur = _recurrent_scan(self.model, xp,
+                                      params[f"wh{l}"].astype(cdt), cdt)
+            return [cur.astype(x.dtype)]
         # layer 0's input projection still happens as ONE big MXU matmul
         # outside the loop; deeper layers' inputs are produced inside the
         # iteration and project there
@@ -250,5 +290,15 @@ class LSTMStack(Op):
         return 2.0 * s * total
 
     def sequential_steps(self) -> int:
-        # ONE scan for all layers — the fusion's whole point
-        return int(self.inputs[0].shape[1])
+        # one fused scan of seq iterations — or, on the resident-kernel
+        # path, num_layers scans of seq iterations each (the overhead
+        # floor is ~10 us/iteration either way; weight traffic decides)
+        s = int(self.inputs[0].shape[1])
+        if self.scan_weights_resident():
+            return s * self.num_layers
+        return s
+
+    def scan_weights_resident(self) -> bool:
+        from .pallas.lstm_kernel import resident_scan_ok
+        b, s, _ = self.inputs[0].shape
+        return resident_scan_ok(self.model, b, self.hidden, s)

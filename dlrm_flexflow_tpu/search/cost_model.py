@@ -206,11 +206,12 @@ class CostModel:
         p_touch = op.param_bytes_touched_per_step(max(pc.num_parts, 1))
         io_bytes += p_touch
         steps = op.sequential_steps()
-        if steps > 1 and p_touch:
+        if steps > 1 and p_touch and not op.scan_weights_resident():
             # a serial scan re-streams its weights from HBM on EVERY
             # iteration (measured round 4: the NMT LSTM cell's marginal
             # per-iteration wall time ≈ its bf16 weight-stream time —
-            # XLA does not pin scan weights in VMEM at these sizes).
+            # XLA does not pin scan weights in VMEM at these sizes;
+            # the pallas resident kernel does, and then skips this).
             # (steps - 1) extra passes at compute-dtype width (the 4 B
             # fp32 master read is already counted once above)
             itemsize = jnp.dtype(self.compute_dtype).itemsize

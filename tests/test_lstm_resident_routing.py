@@ -1,0 +1,86 @@
+"""LSTM/LSTMStack routed through the VMEM-resident kernel must match the
+lax.scan fallback exactly (same math, same gate order) — forward AND a
+training step. Gates monkeypatched so the TPU-only path runs in Pallas
+interpret mode on the CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.ops.pallas import lstm_kernel as lk
+from dlrm_flexflow_tpu.ops import rnn as rnn_mod
+
+
+@pytest.fixture
+def force_resident(monkeypatch):
+    # eligibility reduced to the config flag (backend/mesh checks off),
+    # so pallas_lstm=True routes to the kernel (interpret mode on CPU)
+    # and pallas_lstm=False exercises the lax.scan fallback
+    monkeypatch.setattr(
+        lk, "resident_scan_ok",
+        lambda model, *a, **k: bool(getattr(model.config, "pallas_lstm",
+                                            True)))
+    orig = lk.lstm_scan
+    monkeypatch.setattr(
+        lk, "lstm_scan", lambda xp, wh, interpret=False: orig(xp, wh, True))
+
+
+def _run(stack, steps=2, seed=3):
+    b, s, d, h = 8, 6, 128, 128
+    model = ff.FFModel(ff.FFConfig(batch_size=b, seed=seed))
+    x = model.create_tensor((b, s, d), name="x")
+    if stack:
+        t = model.lstm_stack(x, h, num_layers=2, name="rnn")
+    else:
+        t = model.lstm(x, h, name="rnn")
+    t = model.reshape(t, (b * s, h), name="fold")
+    t = model.dense(t, 1, name="head")
+    model.compile(ff.SGDOptimizer(lr=0.05), "mean_squared_error", ["mse"],
+                  final_tensor=t)
+    model.init_layers(seed=seed)
+    rng = np.random.RandomState(0)
+    xb = rng.randn(b, s, d).astype(np.float32)
+    out = np.asarray(model.forward_batch({"x": xb}))
+    for i in range(steps):
+        model.train_batch({"x": xb,
+                           "label": rng.randn(b * s, 1).astype(np.float32)})
+    import jax
+    return out, jax.tree.map(np.asarray, model.params)
+
+
+@pytest.mark.parametrize("stack", [False, True])
+def test_resident_path_matches_fallback(stack, force_resident):
+    out_k, params_k = _run(stack)
+    # re-run with the kernel path off (fresh fixture state not needed:
+    # monkeypatch only redirects lk; disable via config flag instead)
+    b, s, d, h = 8, 6, 128, 128
+    import dlrm_flexflow_tpu as ff2
+    model = ff2.FFModel(ff2.FFConfig(batch_size=b, seed=3))
+    model.config.pallas_lstm = False
+    x = model.create_tensor((b, s, d), name="x")
+    if stack:
+        t = model.lstm_stack(x, h, num_layers=2, name="rnn")
+    else:
+        t = model.lstm(x, h, name="rnn")
+    t = model.reshape(t, (b * s, h), name="fold")
+    t = model.dense(t, 1, name="head")
+    model.compile(ff2.SGDOptimizer(lr=0.05), "mean_squared_error", ["mse"],
+                  final_tensor=t)
+    model.init_layers(seed=3)
+    rng = np.random.RandomState(0)
+    xb = rng.randn(b, s, d).astype(np.float32)
+    out_f = np.asarray(model.forward_batch({"x": xb}))
+    for i in range(2):
+        model.train_batch({"x": xb,
+                           "label": rng.randn(b * s, 1).astype(np.float32)})
+    import jax
+    params_f = jax.tree.map(np.asarray, model.params)
+
+    np.testing.assert_allclose(out_k, out_f, rtol=1e-4, atol=1e-5)
+    for op_name in params_k:
+        for k in params_k[op_name]:
+            np.testing.assert_allclose(
+                params_k[op_name][k], params_f[op_name][k],
+                rtol=2e-3, atol=2e-4, err_msg=f"{op_name}.{k}")
