@@ -88,7 +88,7 @@ def bench_alexnet(quick):
                   "sparse_categorical_crossentropy", ["accuracy"])
     model.init_layers()
     return _measure(model, _image_batch(batch, 224), batch,
-                    steps=5 if quick else 20)
+                    steps=5 if quick else 60)
 
 
 def bench_resnet18(quick):
@@ -103,7 +103,7 @@ def bench_resnet18(quick):
                   "sparse_categorical_crossentropy", ["accuracy"])
     model.init_layers()
     return _measure(model, _image_batch(batch, 224), batch,
-                    steps=5 if quick else 20)
+                    steps=5 if quick else 60)
 
 
 def bench_inception(quick):
@@ -136,7 +136,7 @@ def bench_nmt(quick):
     x = {"src": rng.randint(0, vocab, (batch, seq)).astype(np.int32),
          "tgt": rng.randint(0, vocab, (batch, seq)).astype(np.int32),
          "label": rng.randint(0, vocab, (batch, seq)).astype(np.int32)}
-    return _measure(model, x, batch, steps=5 if quick else 20, windows=2)
+    return _measure(model, x, batch, steps=5 if quick else 100)
 
 
 def bench_candle_uno(quick):
