@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Functional MNIST CNN with concatenated conv towers (reference:
+examples/python/keras/func_mnist_cnn_concat.py — two conv branches over
+the same input merged on the channel axis)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import mnist
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = (x_train.reshape(len(x_train), 1, 28, 28)
+               .astype(np.float32) / 255.0)
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    inp = K.Input((1, 28, 28))
+    t1 = K.Conv2D(16, (3, 3), padding=(1, 1), activation="relu")(inp)
+    t2 = K.Conv2D(16, (5, 5), padding=(2, 2), activation="relu")(inp)
+    t = K.Concatenate(axis=1)([t1, t2])
+    t = K.MaxPooling2D((2, 2))(t)
+    t = K.Flatten()(t)
+    t = K.Dense(128, activation="relu")(t)
+    t = K.Dense(10)(t)
+    out = K.Activation("softmax")(t)
+
+    model = K.Model(inp, out)
+    model.compile(optimizer=K.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.6)
+    model.fit(x_train, y_train, batch_size=64, epochs=4, callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

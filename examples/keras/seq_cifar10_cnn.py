@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Sequential CIFAR-10 CNN (reference:
+examples/python/keras/seq_cifar10_cnn.py — conv blocks seeded by
+input_shape on the first Conv2D, no explicit Input tensor)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import cifar10
+
+
+def main():
+    (x_train, y_train), _ = cifar10.load_data()
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    model = K.Sequential([
+        K.Conv2D(32, (3, 3), padding=(1, 1), activation="relu",
+                 input_shape=(3, 32, 32)),
+        K.Conv2D(32, (3, 3), padding=(1, 1), activation="relu"),
+        K.MaxPooling2D((2, 2)),
+        K.Flatten(),
+        K.Dense(256, activation="relu"),
+        K.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer=K.SGD(learning_rate=0.03, momentum=0.9),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.4)
+    model.fit(x_train, y_train, batch_size=64, epochs=5, callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Sequential MNIST CNN (reference:
+examples/python/keras/seq_mnist_cnn.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import mnist
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = (x_train.reshape(len(x_train), 1, 28, 28)
+               .astype(np.float32) / 255.0)
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    model = K.Sequential([
+        K.Conv2D(32, (3, 3), padding=(1, 1), activation="relu",
+                 input_shape=(1, 28, 28)),
+        K.Conv2D(32, (3, 3), padding=(1, 1), activation="relu"),
+        K.MaxPooling2D((2, 2)),
+        K.Flatten(),
+        K.Dense(128, activation="relu"),
+        K.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer=K.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.6)
+    model.fit(x_train, y_train, batch_size=64, epochs=4, callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Sequential MNIST MLP (reference:
+examples/python/keras/seq_mnist_mlp.py — Dense stack with the first
+layer carrying input_shape, dropout regularization, softmax head)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from dlrm_flexflow_tpu import keras as K
+from dlrm_flexflow_tpu.keras.datasets import mnist
+
+
+def main():
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train.reshape(len(x_train), 784).astype(np.float32) / 255.0
+    y_train = y_train.reshape(-1, 1).astype(np.int32)
+
+    model = K.Sequential([
+        K.Dense(512, activation="relu", input_shape=(784,)),
+        K.Dropout(0.2),
+        K.Dense(512, activation="relu"),
+        K.Dropout(0.2),
+        K.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer=K.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    cb = K.VerifyMetrics(metric="accuracy", threshold=0.6)
+    model.fit(x_train, y_train, batch_size=64, epochs=5, callbacks=[cb])
+
+
+if __name__ == "__main__":
+    main()

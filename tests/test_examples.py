@@ -126,6 +126,10 @@ def test_graphfile_example(capsys):
     "keras/func_cifar10_cnn_concat_seq_model.py",
     "keras/reshape.py",
     "keras/unary.py",
+    "keras/seq_mnist_mlp.py",
+    "keras/seq_mnist_cnn.py",
+    "keras/seq_cifar10_cnn.py",
+    "keras/func_mnist_cnn_concat.py",
 ])
 def test_keras_example(script, monkeypatch):
     """Each keras example carries a VerifyMetrics callback that RAISES
