@@ -98,6 +98,8 @@ def from_torch_module(ff: FFModel, module, input_shapes: Dict[str, tuple],
                 t = ff.sigmoid(x, name=opname)
             elif isinstance(mod, torch.nn.Tanh):
                 t = ff.tanh(x, name=opname)
+            elif isinstance(mod, torch.nn.ELU):
+                t = ff.elu(x, name=opname)
             elif isinstance(mod, torch.nn.Softmax):
                 t = ff.softmax(x, name=opname)
             elif isinstance(mod, torch.nn.Dropout):
@@ -150,6 +152,9 @@ def from_torch_module(ff: FFModel, module, input_shapes: Dict[str, tuple],
             elif fn is torch.tanh:
                 env[node.name] = ff.tanh(env[node.args[0].name],
                                          name=node.name)
+            elif fn is torch.nn.functional.elu:
+                env[node.name] = ff.elu(env[node.args[0].name],
+                                        name=node.name)
             elif fn is torch.nn.functional.softmax or fn is torch.softmax:
                 x = env[node.args[0].name]
                 dim = node.kwargs.get("dim")
