@@ -118,7 +118,7 @@ def bench_inception(quick):
                   "sparse_categorical_crossentropy", ["accuracy"])
     model.init_layers()
     return _measure(model, _image_batch(batch, 299), batch,
-                    steps=3 if quick else 10, windows=2)
+                    steps=3 if quick else 30, windows=2)
 
 
 def bench_nmt(quick):
