@@ -57,7 +57,7 @@ def _bench_dlrm(cfg_factory, quick):
     x["label"] = y
     # short-step configs need DEEP windows: ~100 ms of tunnel dispatch
     # fill amortized over N steps adds 100/N ms to every apparent step
-    return _measure(model, x, batch, steps=10 if quick else 200)
+    return _measure(model, x, batch, steps=10 if quick else 500)
 
 
 def bench_dlrm_random(quick):
@@ -154,7 +154,7 @@ def bench_candle_uno(quick):
     x = {name: rng.rand(*shape).astype(np.float32)
          for name, shape in inputs.items()}
     x["label"] = rng.rand(batch, 1).astype(np.float32)
-    return _measure(model, x, batch, steps=10 if quick else 200)
+    return _measure(model, x, batch, steps=10 if quick else 500)
 
 
 BENCHES = {
