@@ -179,20 +179,26 @@ def _vjp_bwd(interpret, res, dys):
 lstm_scan.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def resident_scan_ok(model, batch: int, hidden: int, seq: int) -> bool:
-    """Whether the VMEM-resident kernel path applies: TPU, single-device
-    (under a >1 mesh the op runs inside GSPMD where a direct pallas call
-    cannot), lane-aligned hidden, sublane-aligned batch, and recurrent
-    weights that fit VMEM residency comfortably. The budget is sized for
-    the BACKWARD kernel, which pins wh AND whT simultaneously, at the
-    model's actual compute-dtype width (fp32 doubles it)."""
+def resident_scan_ok(model, batch: int, hidden: int, seq: int,
+                     local: bool = False) -> bool:
+    """Whether the VMEM-resident kernel path applies: TPU, lane-aligned
+    hidden, sublane-aligned batch, and recurrent weights that fit VMEM
+    residency comfortably. The budget is sized for the BACKWARD kernel,
+    which pins wh AND whT simultaneously, at the model's actual
+    compute-dtype width (fp32 doubles it).
+
+    `local=False` additionally requires a single-device mesh (a direct
+    pallas call cannot run inside GSPMD); `local=True` checks per-SHARD
+    eligibility for the shard_map DP route (ops/rnn.py:_dp_shard_axes),
+    where `batch` is the per-shard batch."""
     if not getattr(model.config, "pallas_lstm", True):
         return False
     if jax.default_backend() != "tpu":
         return False
-    mesh = getattr(model, "mesh", None)
-    if mesh is not None and mesh.size > 1:
-        return False
+    if not local:
+        mesh = getattr(model, "mesh", None)
+        if mesh is not None and mesh.size > 1:
+            return False
     itemsize = jnp.dtype(getattr(model.config, "jnp_compute_dtype",
                                  jnp.bfloat16)).itemsize
     resident = 2 * hidden * 4 * hidden * itemsize   # bwd: wh + whT

@@ -28,14 +28,57 @@ from ..core.op import Op, ParamDef
 from ..parallel.pconfig import ParallelConfig
 
 
-def _recurrent_scan(model, xproj, whc, cdt):
+def _dp_route(model, op, b, hidden, seq):
+    """(batch_axes, nsh) when the resident kernel can run PER-SHARD
+    under shard_map: pure data parallelism (seq and hidden unsharded,
+    recurrent weights replicated) AND per-shard kernel eligibility
+    (resident_scan_ok with the local batch — pallas flag, backend,
+    alignment, VMEM budget). None otherwise. Same pattern as the
+    sharded embedding scatter
+    (ops/embedding.py:_row_shard_axes → sharded_scatter_add_packed)."""
+    mesh = getattr(model, "mesh", None)
+    if mesh is None or mesh.size <= 1 or op is None:
+        return None
+    sh = getattr(model, "_out_sharding", {}).get(op.outputs[0].guid)
+    if sh is None:
+        return None
+    # PartitionSpec omits trailing unsharded dims: P(('f0','f1'),) means
+    # seq/hidden replicated
+    spec = tuple(sh.spec) + (None,) * (3 - len(sh.spec))
+    if spec[1] is not None or spec[2] is not None:
+        return None
+    spec0 = spec[0]
+    if not spec0:
+        return None
+    axes = (spec0,) if isinstance(spec0, str) else tuple(spec0)
+    # recurrent weights must be replicated (hidden-TP shards the 4h dim)
+    wsh = getattr(model, "_param_sharding", {}).get(op.name, {})
+    for k, s_ in wsh.items():
+        if k.startswith("wh") and any(a is not None for a in s_.spec):
+            return None
+    nsh = 1
+    for a in axes:
+        nsh *= mesh.shape[a]
+    # global-trace check: under the cost model's standalone measurement
+    # the array is already LOCAL-shaped and must not be re-sharded
+    if b != op.inputs[0].shape[0] or b % nsh != 0:
+        return None
+    from .pallas.lstm_kernel import resident_scan_ok
+    if not resident_scan_ok(model, b // nsh, hidden, seq, local=True):
+        return None
+    return axes, nsh
+
+
+def _recurrent_scan(model, xproj, whc, cdt, op=None):
     """The serial part of an LSTM layer: scan gate pre-activations
     `xproj` (b, s, 4h) with recurrent weights `whc`. Routes to the
     VMEM-resident pallas kernel when eligible — round-4 measurement
     found the lax.scan cell WEIGHT-STREAM-BOUND (~27 of ~32 us/iter is
     re-streaming wh from HBM; XLA does not pin scan weights), which the
-    kernel removes. Fallback: plain lax.scan (same math, same i,f,g,o
-    order)."""
+    kernel removes. Under a >1-device mesh with pure batch DP the
+    kernel runs per-shard inside shard_map (each shard's rows are
+    independent — exact). Fallback: plain lax.scan (same math, same
+    i,f,g,o order)."""
     b, s, h4 = xproj.shape
     h = h4 // 4
     from .pallas.lstm_kernel import lstm_scan, resident_scan_ok
@@ -44,6 +87,29 @@ def _recurrent_scan(model, xproj, whc, cdt):
         # alignment wants (b, 4h) as the trailing dims)
         ys = lstm_scan(jnp.swapaxes(xproj, 0, 1), whc)
         return jnp.swapaxes(ys, 0, 1)
+    route = _dp_route(model, op, b, h, s)
+    if route is not None:
+        axes, _ = route
+        import inspect
+
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map as _shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map as _shard_map
+        # the replication-check kwarg was renamed check_rep -> check_vma
+        _ckw = ({"check_vma": False}
+                if "check_vma" in inspect.signature(_shard_map).parameters
+                else {"check_rep": False})
+
+        def local(xp, w):
+            ys = lstm_scan(jnp.swapaxes(xp, 0, 1), w)
+            return jnp.swapaxes(ys, 0, 1)
+
+        return _shard_map(
+            local, mesh=model.mesh,
+            in_specs=(P(axes, None, None), P(None, None)),
+            out_specs=P(axes, None, None), **_ckw)(xproj, whc)
 
     def cell(carry, xp):
         hprev, cprev = carry
@@ -109,7 +175,8 @@ class LSTM(Op):
         # cast the recurrent weights ONCE outside the loop: a cast inside
         # the body would re-stream the (h, 4h) matrix every timestep if
         # XLA declines to hoist it (16 MB/step at reference scale)
-        hs = _recurrent_scan(self.model, xproj, wh.astype(cdt), cdt)
+        hs = _recurrent_scan(self.model, xproj, wh.astype(cdt), cdt,
+                             op=self)
         return [hs.astype(x.dtype)]
 
     def candidate_parallel_configs(self, num_devices, feasible_degrees):
@@ -143,7 +210,9 @@ class LSTM(Op):
     def scan_weights_resident(self) -> bool:
         from .pallas.lstm_kernel import resident_scan_ok
         b, s, _ = self.inputs[0].shape
-        return resident_scan_ok(self.model, b, self.hidden, s)
+        return (resident_scan_ok(self.model, b, self.hidden, s)
+                or _dp_route(self.model, self, b, self.hidden, s)
+                is not None)
 
 
 class LSTMStack(Op):
@@ -198,7 +267,8 @@ class LSTMStack(Op):
         h, L = self.hidden, self.num_layers
         b, s, _ = x.shape
         from .pallas.lstm_kernel import resident_scan_ok
-        if resident_scan_ok(self.model, b, h, s):
+        if (resident_scan_ok(self.model, b, h, s)
+                or _dp_route(self.model, self, b, h, s) is not None):
             # layer-by-layer with the VMEM-resident kernel: EVERY
             # layer's input projection hoists to one big sequence-wide
             # MXU matmul (the fused single-scan form must project deep
@@ -213,7 +283,8 @@ class LSTMStack(Op):
                     preferred_element_type=jnp.float32) \
                     + params[f"bias{l}"]
                 cur = _recurrent_scan(self.model, xp,
-                                      params[f"wh{l}"].astype(cdt), cdt)
+                                      params[f"wh{l}"].astype(cdt), cdt,
+                                      op=self)
             return [cur.astype(x.dtype)]
         # layer 0's input projection still happens as ONE big MXU matmul
         # outside the loop; deeper layers' inputs are produced inside the
@@ -301,4 +372,6 @@ class LSTMStack(Op):
     def scan_weights_resident(self) -> bool:
         from .pallas.lstm_kernel import resident_scan_ok
         b, s, _ = self.inputs[0].shape
-        return resident_scan_ok(self.model, b, self.hidden, s)
+        return (resident_scan_ok(self.model, b, self.hidden, s)
+                or _dp_route(self.model, self, b, self.hidden, s)
+                is not None)

@@ -84,3 +84,60 @@ def test_resident_path_matches_fallback(stack, force_resident):
             np.testing.assert_allclose(
                 params_k[op_name][k], params_f[op_name][k],
                 rtol=2e-3, atol=2e-4, err_msg=f"{op_name}.{k}")
+
+
+def test_dp_shard_map_route_matches_fallback(monkeypatch):
+    """Multi-chip pure-DP: the resident kernel runs PER-SHARD inside
+    shard_map (each shard's batch rows are independent — exact). Forced
+    on the 8-device CPU mesh: global eligibility off, per-shard on,
+    kernel in interpret mode; numerics must equal the lax.scan fallback
+    after compile + train."""
+    from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setattr(
+        lk, "resident_scan_ok",
+        lambda model, b, h, s, local=False: bool(local) and bool(
+            getattr(model.config, "pallas_lstm", True)))
+    orig = lk.lstm_scan
+    calls = {"n": 0, "local_b": None}
+
+    def spy(xp, wh, interpret=False):
+        calls["n"] += 1
+        calls["local_b"] = xp.shape[1]      # time-major: (T, b_local, 4h)
+        return orig(xp, wh, True)
+
+    monkeypatch.setattr(lk, "lstm_scan", spy)
+
+    def run(pallas_on):
+        # per-shard batch must satisfy the sublane-8 constraint: 64/8 = 8
+        b, s, d, h = 64, 5, 128, 128
+        model = ff.FFModel(ff.FFConfig(batch_size=b, seed=11))
+        model.config.pallas_lstm = pallas_on
+        x = model.create_tensor((b, s, d), name="x")
+        t = model.lstm(x, h, name="rnn")
+        t = model.reshape(t, (b * s, h), name="fold")
+        t = model.dense(t, 1, name="head")
+        model.compile(ff.SGDOptimizer(lr=0.05), "mean_squared_error",
+                      ["mse"], mesh=make_mesh(num_devices=8),
+                      final_tensor=t)
+        model.init_layers(seed=11)
+        rng = np.random.RandomState(1)
+        xb = rng.randn(b, s, d).astype(np.float32)
+        out = np.asarray(model.forward_batch({"x": xb}))
+        model.train_batch({"x": xb,
+                           "label": rng.randn(b * s, 1).astype(np.float32)})
+        import jax
+        return out, jax.tree.map(np.asarray, model.params)
+
+    out_k, params_k = run(True)
+    assert calls["n"] > 0, "shard_map kernel route never engaged"
+    assert calls["local_b"] == 64 // 8, "kernel must see the PER-SHARD batch"
+    n_after_on = calls["n"]
+    out_f, params_f = run(False)
+    assert calls["n"] == n_after_on, "fallback run must not hit the kernel"
+    np.testing.assert_allclose(out_k, out_f, rtol=1e-4, atol=1e-5)
+    for opn in params_k:
+        for k in params_k[opn]:
+            np.testing.assert_allclose(params_k[opn][k], params_f[opn][k],
+                                       rtol=2e-3, atol=2e-4,
+                                       err_msg=f"{opn}.{k}")
