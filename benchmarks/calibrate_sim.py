@@ -189,6 +189,11 @@ def main():
     if os.path.exists(out):
         with open(out) as f:
             rows = json.load(f)
+    # prune rows whose point no longer exists: a renamed/removed point
+    # must not keep a stale row alive forever (it would keep counting
+    # toward the gate's coverage bar while no sweep can refresh it)
+    live = {name for name, _ in calibration_points()}
+    rows = [r for r in rows if r["point"] in live]
     done = ({r["point"] for r in rows}
             if os.environ.get("CAL_RESUME") else set())
     for name, make in calibration_points():

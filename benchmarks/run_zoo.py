@@ -82,6 +82,9 @@ def bench_alexnet(quick):
     batch = 256
     cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
     cfg.conv_s2d = os.environ.get("FF_CONV_S2D", "off")
+    if cfg.conv_s2d not in ("on", "off", "auto"):
+        raise ValueError(f"FF_CONV_S2D expects on|off|auto, "
+                         f"got {cfg.conv_s2d!r}")
     model = ff.FFModel(cfg)
     build_alexnet(model, num_classes=1000, image_hw=224)
     model.compile(ff.SGDOptimizer(lr=0.01),
@@ -97,6 +100,9 @@ def bench_resnet18(quick):
     batch = 256
     cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
     cfg.conv_s2d = os.environ.get("FF_CONV_S2D", "off")
+    if cfg.conv_s2d not in ("on", "off", "auto"):
+        raise ValueError(f"FF_CONV_S2D expects on|off|auto, "
+                         f"got {cfg.conv_s2d!r}")
     model = ff.FFModel(cfg)
     build_resnet(model, depth=18, num_classes=1000, image_hw=224)
     model.compile(ff.SGDOptimizer(lr=0.01),
@@ -112,6 +118,9 @@ def bench_inception(quick):
     batch = 256
     cfg = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16")
     cfg.conv_s2d = os.environ.get("FF_CONV_S2D", "off")
+    if cfg.conv_s2d not in ("on", "off", "auto"):
+        raise ValueError(f"FF_CONV_S2D expects on|off|auto, "
+                         f"got {cfg.conv_s2d!r}")
     model = ff.FFModel(cfg)
     build_inception_v3(model, num_classes=1000)
     model.compile(ff.SGDOptimizer(lr=0.01),

@@ -1061,19 +1061,23 @@ class FFModel:
         the faster (the reference picks its conv algorithm the same way —
         by running candidates, conv_2d.cu:217)."""
         mode = getattr(self.config, "conv_s2d", "off")
-        if mode == "off":
-            return
         from ..ops.conv import Conv2D, measure_s2d_wins
         for op in self.ops:
             if not isinstance(op, Conv2D) or not op.s2d_eligible():
                 continue
-            if getattr(op, "_s2d_decided", False):
+            # decisions are cached PER MODE: a re-init after the config
+            # changed must not keep the previous mode's lowering
+            if getattr(op, "_s2d_mode", None) == mode:
                 continue
-            op._use_s2d = (True if mode == "on"
+            op._use_s2d = (False if mode == "off"
+                           else True if mode == "on"
                            else measure_s2d_wins(op))
+            op._s2d_mode = mode
             op._s2d_decided = True
-            log_model.info("conv %s: space-to-depth lowering %s (%s)",
-                           op.name, "ON" if op._use_s2d else "off", mode)
+            if mode != "off":
+                log_model.info("conv %s: space-to-depth lowering %s (%s)",
+                               op.name, "ON" if op._use_s2d else "off",
+                               mode)
 
     def _device_batch(self, batch: Dict[str, np.ndarray],
                       with_label: bool = True) -> Dict[str, Any]:

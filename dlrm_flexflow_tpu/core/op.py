@@ -216,6 +216,13 @@ class Op:
         per-iteration weight re-stream term it charges lax.scan ops."""
         return False
 
+    def scan_param_stream_bytes(self) -> int:
+        """fp32 bytes of the params the serial scan re-streams EVERY
+        iteration — only the weights consumed INSIDE the loop body (an
+        LSTM's recurrent wh; hoisted input projections stream once).
+        Default: all params (ops that hoist override)."""
+        return self.param_bytes()
+
     def output_bytes(self) -> int:
         t = self.outputs[0]
         return int(math.prod(t.shape)) * jnp.dtype(t.dtype).itemsize

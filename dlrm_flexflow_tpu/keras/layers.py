@@ -47,6 +47,11 @@ class Layer:
         self.input_tensors = list(ins)
         out_shape, dtype = self.compute_output(ins)
         self.output = KTensor(out_shape, self, dtype)
+        # stamp the production step on the TENSOR: a layer may be called
+        # at several graph positions (nested-model replays), so the
+        # layer's own wiring fields above only reflect the LATEST call —
+        # graph capture must read the per-tensor record
+        self.output._in_tensors = list(ins)
         return self.output
 
     def compute_output(self, ins):

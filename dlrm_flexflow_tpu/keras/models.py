@@ -16,6 +16,24 @@ from .layers import Input, KTensor, Layer
 from .optimizers import _resolve_optimizer
 
 
+def _capture_plan(output: "KTensor"):
+    """Topo-ordered [(layer, input_tids, output_tid)] by TENSOR
+    traversal (KTensor._in_tensors, stamped at Layer.__call__)."""
+    steps, seen = [], set()
+
+    def visit(t):
+        if t.layer is None or t.tid in seen:
+            return
+        seen.add(t.tid)
+        ins = getattr(t, "_in_tensors", t.layer.input_tensors)
+        for src in ins:
+            visit(src)
+        steps.append((t.layer, [s.tid for s in ins], t.tid))
+
+    visit(output)
+    return steps
+
+
 class Model:
     """Functional-API model over symbolic KTensors."""
 
@@ -29,6 +47,15 @@ class Model:
         self.loss = None
         self.metrics: List[str] = []
         self.ffmodel: Optional[FFModel] = None
+        # FREEZE the graph plan at construction: Layer.__call__ mutates
+        # the shared layer objects' wiring, so replaying a Model (nested
+        # call) or materializing it later must read this immutable plan,
+        # not the live wiring — otherwise a Model could only ever be
+        # called/fit once (the tids would drift after the first replay).
+        # Captured by TENSOR traversal (each KTensor records its own
+        # production step), so a layer called at several positions
+        # contributes every step, not just its latest wiring.
+        self._plan = _capture_plan(self.output)
 
     @property
     def input(self):
@@ -42,19 +69,26 @@ class Model:
         model's layer graph is replayed onto the new input tensor(s) and
         becomes part of the caller's graph. The SAME layer objects are
         reused, so surgery via set_weights on them still applies."""
+        out, _ = self._replay(tensor)
+        return out
+
+    def _replay(self, tensor):
+        """Replay the frozen plan onto new input(s); returns (output
+        KTensor, the replayed steps) — the steps let a CONTAINING model
+        (Sequential.add of a whole Model) record the expanded graph."""
         ts = tensor if isinstance(tensor, (list, tuple)) else [tensor]
         if len(ts) != len(self.inputs):
             raise ValueError(f"model {self.name!r} has {len(self.inputs)} "
                              f"inputs, got {len(ts)}")
-        # snapshot the original wiring BEFORE re-calling mutates it
-        plan = [(layer, [t.tid for t in layer.input_tensors],
-                 layer.output.tid) for layer in self._topo_layers()]
         mapping = {inp.tid: t for inp, t in zip(self.inputs, ts)}
-        out_tid = self.output.tid
-        for layer, in_tids, o_tid in plan:
+        steps = []
+        for layer, in_tids, _o in self._plan:
             ins = [mapping[t] for t in in_tids]
-            mapping[o_tid] = layer(ins if len(ins) > 1 else ins[0])
-        return mapping[out_tid]
+            out = layer(ins if len(ins) > 1 else ins[0])
+            mapping[_o] = out
+            steps.append((layer, [mapping[t].tid for t in in_tids],
+                          out.tid))
+        return mapping[self.output.tid], steps
 
     def compile(self, optimizer="sgd", loss="mean_squared_error",
                 metrics=None):
@@ -81,7 +115,9 @@ class Model:
         return order
 
     def _materialize(self, batch_size: int, seed: int = 0) -> FFModel:
-        """reference _create_flexflow_layers: keras graph -> FFModel ops."""
+        """reference _create_flexflow_layers: keras graph -> FFModel ops.
+        Reads the FROZEN construction-time plan, not the live layer
+        wiring (which nested-model replays may have rewired since)."""
         cfg = FFConfig(batch_size=batch_size, seed=seed)
         ff = FFModel(cfg)
         tmap: Dict[int, object] = {}
@@ -89,9 +125,18 @@ class Model:
             dtype = jnp.int32 if kt.dtype in ("int32", "int64") else jnp.float32
             tmap[kt.tid] = ff.create_tensor((batch_size,) + kt.shape,
                                             dtype=dtype, name=f"input_{i}")
-        for layer in self._topo_layers():
-            ins = [tmap[t.tid] for t in layer.input_tensors]
-            tmap[layer.output.tid] = layer.materialize(ff, ins)
+        done = set()
+        for layer, in_tids, out_tid in self._plan:
+            if id(layer) in done:
+                raise NotImplementedError(
+                    f"layer {layer.name!r} appears at multiple graph "
+                    "positions (weight tying/siamese reuse); "
+                    "materializing shared parameters is not supported — "
+                    "use separate layer instances (the reference frontend "
+                    "has the same single-position semantics)")
+            done.add(id(layer))
+            ins = [tmap[t] for t in in_tids]
+            tmap[out_tid] = layer.materialize(ff, ins)
         self.ffmodel = ff
         self._ff_out = tmap[self.output.tid]
         return ff
@@ -108,7 +153,7 @@ class Model:
         ff.init_layers()
         # weights stashed by Layer.set_weights before materialization
         # (the net2net student flow) land now, over the fresh init
-        for layer in self._topo_layers():
+        for layer, _, _ in self._plan:
             if layer._pending_weights is not None:
                 k, b = layer._pending_weights
                 layer.apply_weights(ff, k, b)
@@ -166,7 +211,7 @@ class Model:
 
     def summary(self) -> str:
         lines = [f'Model: "{self.name}"']
-        for layer in self._topo_layers():
+        for layer, _, _ in self._plan:
             lines.append(f"  {layer.name:<28} out={layer.output.shape}")
         return "\n".join(lines)
 
@@ -187,6 +232,7 @@ class Sequential(Model):
         self.loss = None
         self.metrics = []
         self.ffmodel = None
+        self._plan = []     # built incrementally by add()
         for l in layers or []:
             self.add(l)
 
@@ -210,7 +256,16 @@ class Sequential(Model):
                 "Sequential needs an Input first: Sequential([Input(...), "
                 "Dense(...), ...]), or give the first layer an "
                 "input_shape=")
-        self._out = layer(self._out)
+        if isinstance(layer, Model):
+            # a whole nested Model: record its EXPANDED steps so the
+            # frozen plan stays materializable (a Model has no
+            # .materialize of its own)
+            self._out, steps = layer._replay(self._out)
+            self._plan.extend(steps)
+        else:
+            in_tid = self._out.tid
+            self._out = layer(self._out)
+            self._plan.append((layer, [in_tid], self._out.tid))
         self._layers.append(layer)
 
     @property

@@ -164,7 +164,17 @@ class ONNXModel:
                 # reference handlePad is an explicit pass-through
                 # (python/flexflow/onnx/model.py:107-111: "pass-through
                 # pad") — exporters emit standalone Pads whose padding the
-                # following Conv/Pool already carries
+                # following Conv/Pool already carries. Only an all-zero
+                # pad may pass silently; dropping REAL padding would
+                # corrupt numerics without an error
+                pads = list(at.get("pads", []))
+                if ins[1:] and ins[1] in self.weights:
+                    pads = self.weights[ins[1]].astype(int).ravel().tolist()
+                if any(int(p) != 0 for p in pads):
+                    raise NotImplementedError(
+                        f"ONNX import: standalone Pad {name!r} carries "
+                        f"nonzero pads {pads}; fold it into the following "
+                        "Conv/Pool's pads attribute")
                 t = env[ins[0]]
             elif op == "Identity":
                 t = env[ins[0]]

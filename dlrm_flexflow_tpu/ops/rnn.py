@@ -69,6 +69,16 @@ def _dp_route(model, op, b, hidden, seq):
     return axes, nsh
 
 
+def _resident_route_ok(model, op, b, hidden, seq) -> bool:
+    """Single predicate for "the VMEM-resident kernel will carry this
+    op's scan" — single-chip direct call OR per-shard DP shard_map.
+    Used by apply() routing AND the cost-model hooks so they cannot
+    drift."""
+    from .pallas.lstm_kernel import resident_scan_ok
+    return (resident_scan_ok(model, b, hidden, seq)
+            or _dp_route(model, op, b, hidden, seq) is not None)
+
+
 def _recurrent_scan(model, xproj, whc, cdt, op=None):
     """The serial part of an LSTM layer: scan gate pre-activations
     `xproj` (b, s, 4h) with recurrent weights `whc`. Routes to the
@@ -208,11 +218,13 @@ class LSTM(Op):
         return int(self.inputs[0].shape[1])
 
     def scan_weights_resident(self) -> bool:
-        from .pallas.lstm_kernel import resident_scan_ok
         b, s, _ = self.inputs[0].shape
-        return (resident_scan_ok(self.model, b, self.hidden, s)
-                or _dp_route(self.model, self, b, self.hidden, s)
-                is not None)
+        return _resident_route_ok(self.model, self, b, self.hidden, s)
+
+    def scan_param_stream_bytes(self) -> int:
+        # only the recurrent matrix rides inside the loop; wx/bias are
+        # hoisted into one sequence-wide projection (apply())
+        return self.hidden * 4 * self.hidden * 4
 
 
 class LSTMStack(Op):
@@ -266,9 +278,7 @@ class LSTMStack(Op):
         cdt = self.model.compute_dtype
         h, L = self.hidden, self.num_layers
         b, s, _ = x.shape
-        from .pallas.lstm_kernel import resident_scan_ok
-        if (resident_scan_ok(self.model, b, h, s)
-                or _dp_route(self.model, self, b, h, s) is not None):
+        if _resident_route_ok(self.model, self, b, h, s):
             # layer-by-layer with the VMEM-resident kernel: EVERY
             # layer's input projection hoists to one big sequence-wide
             # MXU matmul (the fused single-scan form must project deep
@@ -370,8 +380,14 @@ class LSTMStack(Op):
         return s
 
     def scan_weights_resident(self) -> bool:
-        from .pallas.lstm_kernel import resident_scan_ok
         b, s, _ = self.inputs[0].shape
-        return (resident_scan_ok(self.model, b, self.hidden, s)
-                or _dp_route(self.model, self, b, self.hidden, s)
-                is not None)
+        return _resident_route_ok(self.model, self, b, self.hidden, s)
+
+    def scan_param_stream_bytes(self) -> int:
+        # fused single-scan form: every layer's wh rides in the loop,
+        # plus deep layers' wx (their inputs are produced inside the
+        # iteration; only layer 0's projection hoists)
+        h = self.hidden
+        wh = self.num_layers * h * 4 * h * 4
+        wx_deep = (self.num_layers - 1) * h * 4 * h * 4
+        return wh + wx_deep

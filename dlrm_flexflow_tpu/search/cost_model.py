@@ -206,16 +206,19 @@ class CostModel:
         p_touch = op.param_bytes_touched_per_step(max(pc.num_parts, 1))
         io_bytes += p_touch
         steps = op.sequential_steps()
-        if steps > 1 and p_touch and not op.scan_weights_resident():
-            # a serial scan re-streams its weights from HBM on EVERY
-            # iteration (measured round 4: the NMT LSTM cell's marginal
-            # per-iteration wall time ≈ its bf16 weight-stream time —
-            # XLA does not pin scan weights in VMEM at these sizes;
-            # the pallas resident kernel does, and then skips this).
-            # (steps - 1) extra passes at compute-dtype width (the 4 B
-            # fp32 master read is already counted once above)
+        if steps > 1 and not op.scan_weights_resident():
+            # a serial scan re-streams its IN-LOOP weights from HBM on
+            # EVERY iteration (measured round 4: the NMT LSTM cell's
+            # marginal per-iteration wall time ≈ its bf16 weight-stream
+            # time — XLA does not pin scan weights in VMEM at these
+            # sizes; the pallas resident kernel does, and then skips
+            # this). Only scan_param_stream_bytes counts — hoisted
+            # input projections stream once. (steps - 1) extra passes
+            # at compute-dtype width (the 4 B fp32 master read is
+            # already counted once above)
+            stream = op.scan_param_stream_bytes()
             itemsize = jnp.dtype(self.compute_dtype).itemsize
-            io_bytes += (steps - 1) * p_touch * (itemsize / 4.0)
+            io_bytes += (steps - 1) * stream * (itemsize / 4.0)
         io_bytes *= op.hbm_io_factor()
         if backward:
             # bwd ≈ 2x fwd flops (dX and dW gemms), grads written.

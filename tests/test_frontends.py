@@ -301,3 +301,47 @@ def test_fx_handler_coverage_vs_reference():
     loader(model)
     got = np.asarray(model.forward_batch({"x": x.numpy()}))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_model_reusable_as_layer():
+    """A Model must be callable MORE THAN ONCE (the frozen construction-
+    time plan decouples replays from the layers' live wiring) and still
+    fit()/summary() afterwards. True weight TYING (one layer at two
+    positions of one materialized graph) is not supported — it must
+    fail LOUDLY at materialization, never corrupt silently."""
+    import pytest as _pytest
+    r = np.random.RandomState(7)
+    inner_in = K.Input((6,))
+    enc = K.Model(inner_in, K.Dense(4, activation="relu")(inner_in))
+
+    # two separate graphs from the same model: both trainable
+    for trial in range(2):
+        a = K.Input((6,))
+        out = K.Dense(1)(enc(a))
+        m = K.Model(a, out)
+        m.compile(optimizer=K.SGD(learning_rate=0.1),
+                  loss="mean_squared_error", metrics=["mse"])
+        res = m.fit(r.rand(32, 6).astype(np.float32),
+                    r.rand(32, 1).astype(np.float32),
+                    batch_size=16, epochs=1, verbose=False)
+        assert np.isfinite(res["metrics"]["mse"])
+
+    # the inner model is STILL materializable on its own afterwards
+    enc.compile(optimizer=K.SGD(learning_rate=0.1),
+                loss="mean_squared_error", metrics=["mse"])
+    res2 = enc.fit(r.rand(32, 6).astype(np.float32),
+                   r.rand(32, 4).astype(np.float32), batch_size=16,
+                   epochs=1, verbose=False)
+    assert np.isfinite(res2["metrics"]["mse"])
+
+    # weight tying within ONE graph: loud error, not silent corruption
+    a2, b2 = K.Input((6,)), K.Input((6,))
+    tied = K.Model([a2, b2],
+                   K.Concatenate(axis=1)([enc(a2), enc(b2)]))
+    tied.compile(optimizer=K.SGD(learning_rate=0.1),
+                 loss="mean_squared_error", metrics=["mse"])
+    with _pytest.raises(NotImplementedError, match="multiple graph"):
+        tied.fit([r.rand(16, 6).astype(np.float32),
+                  r.rand(16, 6).astype(np.float32)],
+                 r.rand(16, 8).astype(np.float32), batch_size=16,
+                 epochs=1, verbose=False)

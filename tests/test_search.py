@@ -314,10 +314,13 @@ def test_scan_iteration_latency_floors_lstm():
     big.mesh = make_mesh(num_devices=1)
     opb = big.get_layer_by_name("lstm")
     t_big = CostModel().op_compute_time(opb, ff.ParallelConfig((1, 1, 1)))
-    restream = (39 * opb.param_bytes() * 0.5      # bf16 width
+    # only the IN-LOOP weights restream (wh; the input projection is
+    # hoisted to one sequence-wide matmul) — r4 advisor-proofing fix
+    restream = (39 * opb.scan_param_stream_bytes() * 0.5   # bf16 width
                 / (cm.spec.hbm_bytes_per_s * cm.spec.hbm_utilization))
     assert restream > 40 * cm.spec.scan_iter_s    # term actually dominates
     assert t_big >= restream
+    assert opb.scan_param_stream_bytes() < opb.param_bytes()
     # a non-scanned op of the same tiny size is NOT floored: it must
     # cost less than even ONE scan iteration, so any spurious floor
     # (an op wrongly reporting sequential_steps) fails loudly
