@@ -35,6 +35,7 @@ from ..config import FFConfig
 from ..parallel.mesh import make_mesh
 from ..parallel.pconfig import ParallelConfig, StrategyMap
 from ..parallel.sharding import AxisAssigner
+from ..parallel.distributed import put_global
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from . import losses as losses_mod
 from . import metrics as metrics_mod
@@ -998,7 +999,7 @@ class FFModel:
         # with deterministic shardings (uncommitted scalars would pin to
         # device 0 and mismatch the executable on the next call)
         rep = NamedSharding(self.mesh, PartitionSpec())
-        return {k: jax.device_put(jnp.zeros((), jnp.float32), rep)
+        return {k: put_global(np.zeros((), np.float32), rep)
                 for k in self._msums_keys}
 
     # ------------------------------------------------------------------
@@ -1016,7 +1017,10 @@ class FFModel:
         hres = getattr(self, "_host_resident_ops", set())
         self.host_params: Dict[str, Dict[str, np.ndarray]] = {}
         self.host_opt_state: Dict[str, Dict[str, np.ndarray]] = {}
-        with jax.default_device(jax.devices()[0]):
+        multiproc = jax.process_count() > 1
+        # init computation runs on a LOCAL device (jax.devices()[0] is not
+        # addressable from other ranks of a multi-controller job)
+        with jax.default_device(jax.local_devices()[0]):
             for i, op in enumerate(self.ops):
                 if isinstance(op, InputOp):
                     continue
@@ -1037,18 +1041,25 @@ class FFModel:
                     shards = self._param_sharding.get(op.name, {})
                     rep = NamedSharding(self.mesh, PartitionSpec())
                     params[op.name] = {
-                        n: jax.device_put(v, shards.get(n) or rep)
+                        n: put_global(v, shards.get(n) or rep)
                         for n, v in p.items()}
                 if hasattr(op, "state_defs"):
                     key, sub = jax.random.split(key)
                     defs = op.state_defs()
                     keys = jax.random.split(sub, len(defs))
+                    rep = NamedSharding(self.mesh, PartitionSpec())
                     op_state[op.name] = {
-                        n: d.initializer(k, d.shape, d.dtype)
+                        n: put_global(d.initializer(k, d.shape, d.dtype),
+                                      rep)
                         for (n, d), k in zip(sorted(defs.items()), keys)}
         self.params = params
         self.op_state = op_state
-        self.opt_state = self.optimizer.init_state(params)
+        # multi-controller: build optimizer state as one SPMD program so
+        # every leaf (incl. fresh scalars like Adam's step) is a global
+        # array, never a rank-local committed one
+        self.opt_state = (jax.jit(self.optimizer.init_state)(params)
+                          if multiproc and params
+                          else self.optimizer.init_state(params))
         self._step = 0
         self._step_dev = None
         self._msums = None
@@ -1117,8 +1128,8 @@ class FFModel:
         if not getattr(self, "_msums", None):
             self._msums = self._zero_msums()
         if getattr(self, "_step_dev", None) is None:
-            self._step_dev = jax.device_put(
-                jnp.asarray(self._step, jnp.int32),
+            self._step_dev = put_global(
+                np.asarray(self._step, np.int32),
                 NamedSharding(self.mesh, PartitionSpec()))
 
     def _split_host_idx(self, device_batch: Dict):

@@ -202,18 +202,24 @@ class Op:
         per op class; calibrated against benchmarks/sim_calibration.json."""
         return 1.0
 
-    def sequential_steps(self) -> int:
+    def sequential_steps(self, pc=None, vmem_bytes: int = 0) -> int:
         """Number of inherently serial inner iterations (a lax.scan's
         length — the recurrent time loop of an LSTM). Each costs a fixed
         per-iteration latency (TPUSpec.scan_iter_s) no matter how little
         work the body holds: a scanned op's wall time floors at
-        steps x iter latency, which dominates small-batch RNNs."""
+        steps x iter latency, which dominates small-batch RNNs.
+        `pc` (a CANDIDATE ParallelConfig, passed by the cost model) lets
+        scanned ops answer for the strategy being priced rather than the
+        currently-compiled one."""
         return 0
 
-    def scan_weights_resident(self) -> bool:
+    def scan_weights_resident(self, pc=None, vmem_bytes: int = 0) -> bool:
         """True when this op's serial scan keeps its weights resident in
         VMEM (the pallas LSTM kernel) — the cost model then skips the
-        per-iteration weight re-stream term it charges lax.scan ops."""
+        per-iteration weight re-stream term it charges lax.scan ops.
+        With `pc` (strategy search) the answer is for the CANDIDATE
+        config on the TPU target, independent of the attached backend
+        and of whatever sharding is currently compiled."""
         return False
 
     def scan_param_stream_bytes(self) -> int:

@@ -168,8 +168,19 @@ class ONNXModel:
                 # pad may pass silently; dropping REAL padding would
                 # corrupt numerics without an error
                 pads = list(at.get("pads", []))
-                if ins[1:] and ins[1] in self.weights:
-                    pads = self.weights[ins[1]].astype(int).ravel().tolist()
+                if ins[1:] and ins[1]:  # "" = absent optional input
+                    if ins[1] in self.weights:
+                        pads = self.weights[ins[1]].astype(
+                            int).ravel().tolist()
+                    else:
+                        # opset>=11 pads produced by a node, not an
+                        # initializer: unresolvable here — refusing keeps
+                        # the invariant that nonzero pads NEVER pass
+                        # silently (an all-zero default would)
+                        raise NotImplementedError(
+                            f"ONNX import: Pad {name!r} takes pads from "
+                            f"node output {ins[1]!r}, which cannot be "
+                            "resolved to constants at import time")
                 if any(int(p) != 0 for p in pads):
                     raise NotImplementedError(
                         f"ONNX import: standalone Pad {name!r} carries "

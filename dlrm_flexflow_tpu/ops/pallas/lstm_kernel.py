@@ -179,13 +179,35 @@ def _vjp_bwd(interpret, res, dys):
 lstm_scan.defvjp(_vjp_fwd, _vjp_bwd)
 
 
+def _device_vmem_bytes() -> int:
+    """VMEM capacity of the attached TPU core. Known generations by
+    device_kind; a conservative 16 MiB floor otherwise (the guide's
+    generic per-core figure) so an eligibility decision made for an
+    unknown chip under-claims rather than failing Mosaic compilation
+    with a VMEM OOM."""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return 16 * 1024 * 1024
+    for tag in ("v4", "v5", "v6", "v7"):
+        if tag in kind:
+            return 128 * 1024 * 1024
+    return 16 * 1024 * 1024
+
+
 def resident_scan_ok(model, batch: int, hidden: int, seq: int,
                      local: bool = False) -> bool:
     """Whether the VMEM-resident kernel path applies: TPU, lane-aligned
     hidden, sublane-aligned batch, and recurrent weights that fit VMEM
     residency comfortably. The budget is sized for the BACKWARD kernel,
     which pins wh AND whT simultaneously, at the model's actual
-    compute-dtype width (fp32 doubles it).
+    compute-dtype width (fp32 doubles it), PLUS the per-step streamed
+    blocks (xp/dz at b×4h, h/c residual and output blocks at b×h,
+    double-buffered by the pipeline) and the fp32 carry scratch —
+    against the ATTACHED device's VMEM with 40% headroom for Mosaic
+    temps, not a flat constant (an eligible-looking large-hidden config
+    on a 16 MiB-VMEM generation must fall back to lax.scan instead of
+    dying in Mosaic compilation).
 
     `local=False` additionally requires a single-device mesh (a direct
     pallas call cannot run inside GSPMD); `local=True` checks per-SHARD
@@ -199,8 +221,22 @@ def resident_scan_ok(model, batch: int, hidden: int, seq: int,
         mesh = getattr(model, "mesh", None)
         if mesh is not None and mesh.size > 1:
             return False
+    return scan_shape_fits(model, batch, hidden, seq)
+
+
+def scan_shape_fits(model, batch: int, hidden: int, seq: int,
+                    vmem_bytes: int = 0) -> bool:
+    """Alignment + VMEM-budget test alone (no backend/mesh gating) —
+    shared by the runtime route predicate and the strategy search's
+    backend-independent candidate predicate. `vmem_bytes` overrides the
+    attached device's VMEM (search prices for the TARGET chip)."""
     itemsize = jnp.dtype(getattr(model.config, "jnp_compute_dtype",
                                  jnp.bfloat16)).itemsize
     resident = 2 * hidden * 4 * hidden * itemsize   # bwd: wh + whT
+    # per-grid-step blocks: xp/dz (b,4h) + ~4 (b,h) blocks, x2 for the
+    # pipeline's double buffering; carries are fp32 scratch
+    blocks = 2 * (batch * 4 * hidden + 4 * batch * hidden) * itemsize
+    blocks += 2 * batch * hidden * 4
+    budget = 0.6 * (vmem_bytes or _device_vmem_bytes())
     return (hidden % 128 == 0 and batch % 8 == 0 and seq >= 2
-            and resident <= 48 * 1024 * 1024)
+            and resident + blocks <= budget)

@@ -17,6 +17,7 @@ long-sequence scaling on TPU is the job of sequence-parallel attention
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Optional
 
 import jax
@@ -77,6 +78,39 @@ def _resident_route_ok(model, op, b, hidden, seq) -> bool:
     from .pallas.lstm_kernel import resident_scan_ok
     return (resident_scan_ok(model, b, hidden, seq)
             or _dp_route(model, op, b, hidden, seq) is not None)
+
+
+@functools.lru_cache(maxsize=1)
+def _target_vmem_default() -> int:
+    """Fallback target VMEM for candidate pricing when the caller does
+    not thread a spec through (memoized — this sits in the MCMC inner
+    loop)."""
+    from ..search.cost_model import TPUSpec
+    return TPUSpec.detect().vmem_bytes
+
+
+def _resident_route_ok_candidate(model, b, hidden, seq, pc,
+                                 vmem_bytes: int = 0) -> bool:
+    """Residency under a CANDIDATE config, for strategy search: backend-
+    independent (an offline CPU search must price the scan the way it
+    will run on the TPU target — ADVICE r4) and judged against `pc`
+    rather than the currently-compiled sharding. Eligible iff the
+    candidate is pure batch-DP (hidden/seq unsharded; hidden-TP shards
+    wh, which the resident kernel cannot carry) and the per-shard shape
+    passes the same alignment/VMEM test against the TARGET chip
+    (`vmem_bytes`, threaded from the cost model's TPUSpec so a
+    user-injected spec is honored)."""
+    if not getattr(model.config, "pallas_lstm", True):
+        return False
+    degs = tuple(pc.degrees) + (1,) * (3 - len(pc.degrees))
+    if any(d > 1 for d in degs[1:3]):
+        return False
+    parts = max(degs[0], 1)
+    if b % parts:
+        return False
+    from .pallas.lstm_kernel import scan_shape_fits
+    return scan_shape_fits(model, b // parts, hidden, seq,
+                           vmem_bytes=vmem_bytes or _target_vmem_default())
 
 
 def _recurrent_scan(model, xproj, whc, cdt, op=None):
@@ -213,12 +247,15 @@ class LSTM(Op):
         s = self.inputs[0].shape[1]
         return 2.0 * s * 4 * self.hidden * (self.in_dim + self.hidden)
 
-    def sequential_steps(self) -> int:
+    def sequential_steps(self, pc=None, vmem_bytes: int = 0) -> int:
         # the recurrent scan: one serial iteration per sequence position
         return int(self.inputs[0].shape[1])
 
-    def scan_weights_resident(self) -> bool:
+    def scan_weights_resident(self, pc=None, vmem_bytes: int = 0) -> bool:
         b, s, _ = self.inputs[0].shape
+        if pc is not None:
+            return _resident_route_ok_candidate(self.model, b, self.hidden,
+                                                s, pc, vmem_bytes)
         return _resident_route_ok(self.model, self, b, self.hidden, s)
 
     def scan_param_stream_bytes(self) -> int:
@@ -370,17 +407,20 @@ class LSTMStack(Op):
         total += (self.num_layers - 1) * 4 * h * (h + h)
         return 2.0 * s * total
 
-    def sequential_steps(self) -> int:
+    def sequential_steps(self, pc=None, vmem_bytes: int = 0) -> int:
         # one fused scan of seq iterations — or, on the resident-kernel
         # path, num_layers scans of seq iterations each (the overhead
         # floor is ~10 us/iteration either way; weight traffic decides)
         s = int(self.inputs[0].shape[1])
-        if self.scan_weights_resident():
+        if self.scan_weights_resident(pc, vmem_bytes):
             return s * self.num_layers
         return s
 
-    def scan_weights_resident(self) -> bool:
+    def scan_weights_resident(self, pc=None, vmem_bytes: int = 0) -> bool:
         b, s, _ = self.inputs[0].shape
+        if pc is not None:
+            return _resident_route_ok_candidate(self.model, b, self.hidden,
+                                                s, pc, vmem_bytes)
         return _resident_route_ok(self.model, self, b, self.hidden, s)
 
     def scan_param_stream_bytes(self) -> int:

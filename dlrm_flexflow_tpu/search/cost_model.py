@@ -45,6 +45,7 @@ class TPUSpec:
     hbm_utilization: float = 0.75
     kernel_launch_s: float = 2e-6     # per-HLO overhead (XLA fused ≈ small)
     hbm_capacity_bytes: float = 16e9  # v5e HBM per chip
+    vmem_bytes: int = 128 * 1024 * 1024  # per-core VMEM (v4+ generations)
     # RANDOM HBM row-access model (embedding gather/scatter): fixed setup
     # plus per-row sustained cost. Measured on v5e (benchmarks/
     # calibrate_sim.py): 2048 random 512 B reads from an 8M-row table take
@@ -156,7 +157,8 @@ class CostModel:
             # calibrated (r4: scan weight re-stream priced, scan_iter_s
             # pinned by measurement) but serial scans still measure
             # noisier than single kernels on a shared chip
-            band = 3.0 if op.sequential_steps() else 2.0
+            band = (3.0 if op.sequential_steps(pc, self.spec.vmem_bytes)
+                    else 2.0)
             t = min(max(t_raw, t_roof / band), band * t_roof)
             if t != t_raw:
                 log_sim.debug(
@@ -205,8 +207,9 @@ class CostModel:
         # multi-GB table)
         p_touch = op.param_bytes_touched_per_step(max(pc.num_parts, 1))
         io_bytes += p_touch
-        steps = op.sequential_steps()
-        if steps > 1 and not op.scan_weights_resident():
+        steps = op.sequential_steps(pc, self.spec.vmem_bytes)
+        if steps > 1 and not op.scan_weights_resident(
+                pc, self.spec.vmem_bytes):
             # a serial scan re-streams its IN-LOOP weights from HBM on
             # EVERY iteration (measured round 4: the NMT LSTM cell's
             # marginal per-iteration wall time ≈ its bf16 weight-stream
@@ -368,7 +371,7 @@ class CostModel:
         self._cache[key] = dt
         return dt
 
-    def _time_fn(self, make_out, params, xs) -> float:
+    def _time_fn(self, make_out, params, xs, int_rows: int = 0) -> float:
         """Median-of-3 wall time of ONE application of `make_out`, measured
         as an in-graph lax.scan of N applications inside a single dispatch
         (the XLA analog of the reference's warmup-5/repeat-10 raw kernel
@@ -376,7 +379,14 @@ class CostModel:
         the carry so XLA cannot hoist the op out of the loop. N adapts so
         the loop wall time dwarfs the per-dispatch overhead — on a
         tunneled PJRT device that overhead is milliseconds of RPC jitter,
-        which would otherwise swamp sub-ms ops."""
+        which would otherwise swamp sub-ms ops.
+
+        `int_rows` > 0 rotates every integer input over [0, int_rows) by a
+        per-iteration multiplicative hash: a sparse op re-gathering the
+        SAME index set N times sees warm HBM row locality and measures
+        well below its fresh-random-rows cost — the round-4 artifact's
+        systematic −20…−32% DLRM-family under-prediction. Real steps see
+        fresh indices every batch, so the measurement must too."""
         import math as _math
         import time
 
@@ -384,17 +394,28 @@ class CostModel:
 
         def loop_fn(n):
             def loop(p, xs_):
-                def body(acc, _):
+                def body(acc, it):
                     # a data dependence the compiler cannot remove, at
                     # negligible cost: float operands get +tiny·acc; int
-                    # operands (embedding indices) get a data-dependent
-                    # zero — NEVER perturb params (adding eps to a
-                    # multi-GB table would stream it every iteration and
-                    # swamp the op being measured)
+                    # operands (embedding indices) rotate per-iteration
+                    # (or get a data-dependent zero) — NEVER perturb
+                    # params (adding eps to a multi-GB table would stream
+                    # it every iteration and swamp the op being measured)
                     eps = (acc * 1e-38).astype(jnp.float32)
                     izero = jnp.where(acc > 3e38, 1, 0).astype(jnp.int32)
                     pxs, bumped = [], False
                     for x in xs_:
+                        if int_rows > 0 and jnp.issubdtype(x.dtype,
+                                                           jnp.integer):
+                            # Knuth multiplicative rotation: uniform-ish
+                            # fresh rows every iteration, same range
+                            x = ((x.astype(jnp.uint32)
+                                  + it.astype(jnp.uint32)
+                                  * jnp.uint32(2654435761))
+                                 % jnp.uint32(int_rows)).astype(x.dtype)
+                            bumped = True
+                            pxs.append(x)
+                            continue
                         if not bumped and jnp.issubdtype(x.dtype,
                                                          jnp.floating):
                             x = x + eps.astype(x.dtype)
@@ -421,7 +442,7 @@ class CostModel:
                     return acc + tot, None
 
                 acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
-                                      None, length=n)
+                                      jnp.arange(n, dtype=jnp.int32))
                 return acc
             return jax.jit(loop)
 
@@ -494,7 +515,8 @@ class CostModel:
         xs = [_fill(s, t) for s, t in zip(shard_shapes, op.inputs)]
         try:
             t_fwd = self._time_fn(
-                lambda p, xs_: op.apply(p, xs_, training=False), params, xs)
+                lambda p, xs_: op.apply(p, xs_, training=False), params, xs,
+                int_rows=rows)
             if not backward:
                 dt = t_fwd
             else:
@@ -503,7 +525,7 @@ class CostModel:
                         lambda p2, x2: op.apply(p2, x2, training=True),
                         p, xs_)
                     return vjp(jax.tree.map(jnp.ones_like, y))
-                t_both = self._time_fn(fwdbwd, params, xs)
+                t_both = self._time_fn(fwdbwd, params, xs, int_rows=rows)
                 # floor at the analytical fwd/bwd ratio's spirit: vjp can't
                 # be cheaper than re-running forward
                 dt = max(t_both - t_fwd, 0.5 * t_fwd)

@@ -66,9 +66,20 @@ def main(argv=None):
         data_path = rest[rest.index("--data-path") + 1]
 
     import jax
-    ndev = min(cfg.num_devices, len(jax.devices())) or len(jax.devices())
-    mesh = make_mesh(num_devices=ndev)
-    log_app.info("devices=%d batch=%d tables=%d", ndev, cfg.batch_size,
+    multiproc = jax.process_count() > 1
+    if multiproc:
+        # every rank runs this same SPMD program over the global mesh;
+        # the process axis is the DCN axis (reference: one control-
+        # replicated top_level_task per node, model.cc:1384-1409)
+        from dlrm_flexflow_tpu.parallel.distributed import \
+            make_multihost_mesh
+        ndev = len(jax.devices())
+        mesh = make_multihost_mesh()
+    else:
+        ndev = min(cfg.num_devices, len(jax.devices())) or len(jax.devices())
+        mesh = make_mesh(num_devices=ndev)
+    log_app.info("devices=%d processes=%d batch=%d tables=%d", ndev,
+                 jax.process_count(), cfg.batch_size,
                  len(dcfg.embedding_size))
 
     model = ff.FFModel(cfg)
@@ -114,7 +125,15 @@ def main(argv=None):
     else:  # synthetic, like run_random.sh
         x, y = synthetic_batch(dcfg, cfg.batch_size)
         x["label"] = y
-        staged = model._device_batch(x)
+        if multiproc:
+            # each rank contributes its host-local slice of the global
+            # batch (reference: per-node zero-copy dataset residency,
+            # dlrm.cc:384-484)
+            from dlrm_flexflow_tpu.parallel.distributed import (
+                global_batch_from_host_local, host_local_slice)
+            staged = global_batch_from_host_local(host_local_slice(x), mesh)
+        else:
+            staged = model._device_batch(x)
         num_batches = 64
         next_batch = lambda: staged  # noqa: E731
 

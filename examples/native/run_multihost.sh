@@ -22,7 +22,7 @@ PIDS=()
 trap '[ "${#PIDS[@]}" -gt 0 ] && kill "${PIDS[@]}" 2>/dev/null || true' EXIT
 for RANK in 0 1; do
   COORDINATOR_ADDRESS=127.0.0.1:12355 NUM_PROCESSES=2 PROCESS_ID=$RANK \
-  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+  FF_CPU_DEVICES_PER_PROCESS=4 \
   python examples/native/dlrm.py -b 64 -e 1 \
       --arch-embedding-size 64-64-64-64 --arch-sparse-feature-size 8 \
       --arch-mlp-bot 4-16-8 --arch-mlp-top 40-16-1 &

@@ -1,0 +1,84 @@
+"""One rank of the 2-process distributed-training test (not collected by
+pytest — spawned as a subprocess by tests/test_multiprocess.py).
+
+This is the reference's multi-node train loop made real: process init
+(⇢ GASNet bootstrap, reference run_summit.sh jsrun launch), a global mesh
+whose DCN axis is the process axis (⇢ Legion control replication +
+DataParallelShardingFunctor, model.cc:1384-1409), per-process host-local
+batch shards assembled into global arrays (⇢ per-node zero-copy dataset
+residency + point-task scatter, dlrm.cc:384-589), and cross-process
+gradient collectives (⇢ Legion/Realm DMA replica-gather).
+
+Env contract (set by the test): COORDINATOR_ADDRESS, NUM_PROCESSES=2,
+PROCESS_ID, FF_CPU_DEVICES_PER_PROCESS=4, FF_MP_OUT=<npz path for rank 0>.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NUM_STEPS = 3
+GLOBAL_BATCH = 16
+
+
+def main():
+    from dlrm_flexflow_tpu.parallel.distributed import (
+        global_batch_from_host_local, host_local_slice,
+        initialize_distributed, make_multihost_mesh)
+
+    initialize_distributed()  # env-driven; forces the CPU cluster + gloo
+
+    import jax
+    import numpy as np
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.models.dlrm import (
+        DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch)
+
+    assert jax.process_count() == 2, \
+        f"expected 2 processes, got {jax.process_count()}"
+    assert len(jax.devices()) == 8, \
+        f"expected 8 global devices, got {len(jax.devices())}"
+    assert len(jax.local_devices()) == 4
+    pid = jax.process_index()
+
+    mesh = make_multihost_mesh()
+    assert mesh.axis_names[0] == "dcn" and mesh.shape["dcn"] == 2, \
+        f"process axis must be the DCN axis, got {dict(mesh.shape)}"
+
+    dcfg = DLRMConfig(embedding_size=[64] * 8, sparse_feature_size=8,
+                      mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1])
+    model = ff.FFModel(ff.FFConfig(batch_size=GLOBAL_BATCH, seed=2))
+    build_dlrm(model, dcfg)
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+                  mesh=mesh, strategies=dlrm_strategy(model, dcfg, 8))
+    model.init_layers()
+
+    loss = None
+    for step in range(NUM_STEPS):
+        x, y = synthetic_batch(dcfg, GLOBAL_BATCH, seed=100 + step)
+        x["label"] = y
+        # each process contributes ITS half of the global batch — the
+        # other half never exists in this process's host memory
+        gbatch = global_batch_from_host_local(host_local_slice(x), mesh)
+        mets = model.train_batch_device(gbatch)
+        loss = float(mets["loss"])
+        assert np.isfinite(loss), f"step {step}: loss {loss}"
+    jax.block_until_ready(model.params)
+
+    from jax.experimental import multihost_utils
+    flat = {}
+    for op_name, pdict in model.params.items():
+        for pname, val in pdict.items():
+            flat[f"{op_name}/{pname}"] = np.asarray(
+                multihost_utils.process_allgather(val, tiled=True))
+    flat["__loss__"] = np.asarray(loss, np.float32)
+    if pid == 0:
+        np.savez(os.environ["FF_MP_OUT"], **flat)
+    multihost_utils.sync_global_devices("mp_worker_done")
+    print(f"MP_WORKER_OK pid={pid} loss={loss:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

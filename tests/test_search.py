@@ -308,7 +308,13 @@ def test_scan_iteration_latency_floors_lstm():
     # (h=1024, seq=40) the restream bytes dwarf the scan_iter floor, so
     # dropping the (steps-1) weight-stream term from _roofline_time
     # fails HERE even though the tiny-LSTM floor above still passes
-    big = ff.FFModel(ff.FFConfig(batch_size=64, compute_dtype="bfloat16"))
+    # pallas_lstm=False: with the kernel disabled the scan is priced as
+    # lax.scan (weight restream every iteration). With it enabled the
+    # cost model now prices residency for the TPU TARGET even from a CPU
+    # search process (r4 ADVICE fix: backend-independent candidate
+    # predicate) — asserted separately below
+    big = ff.FFModel(ff.FFConfig(batch_size=64, compute_dtype="bfloat16",
+                                 pallas_lstm=False))
     tb = big.create_tensor((64, 40, 1024), name="x")
     big.lstm(tb, 1024, name="lstm")
     big.mesh = make_mesh(num_devices=1)
@@ -331,6 +337,33 @@ def test_scan_iteration_latency_floors_lstm():
     op2 = model2.get_layer_by_name("fc")
     assert CostModel().op_compute_time(
         op2, ff.ParallelConfig((1, 1))) < cm.spec.scan_iter_s
+
+
+def test_search_prices_resident_scan_for_target():
+    """r4 ADVICE: the residency predicate must be backend-independent and
+    judged on the CANDIDATE config — an offline CPU search prices the NMT
+    LSTM as the VMEM-resident kernel it will run on the TPU target (no
+    per-iteration weight restream), and a hidden-TP candidate (which
+    shards wh — the kernel can't carry it) keeps the restream."""
+    big = ff.FFModel(ff.FFConfig(batch_size=64, compute_dtype="bfloat16"))
+    tb = big.create_tensor((64, 40, 1024), name="x")
+    big.lstm(tb, 1024, name="lstm")
+    big.mesh = make_mesh(num_devices=1)
+    opb = big.get_layer_by_name("lstm")
+    dp = ff.ParallelConfig((1, 1, 1))
+    assert opb.scan_weights_resident(dp)          # candidate: resident
+    assert not opb.scan_weights_resident()        # compiled-state: CPU
+    t_resident = CostModel().op_compute_time(opb, dp)
+    cm2 = CostModel()
+    tp = ff.ParallelConfig((1, 1, 2))             # hidden-TP shards wh
+    assert not opb.scan_weights_resident(tp)
+    nores = ff.FFModel(ff.FFConfig(batch_size=64, compute_dtype="bfloat16",
+                                   pallas_lstm=False))
+    tn = nores.create_tensor((64, 40, 1024), name="x")
+    nores.lstm(tn, 1024, name="lstm")
+    nores.mesh = make_mesh(num_devices=1)
+    t_stream = cm2.op_compute_time(nores.get_layer_by_name("lstm"), dp)
+    assert t_resident < t_stream
 
 
 def test_disjoint_device_ids_simulate_concurrently():
