@@ -1090,6 +1090,19 @@ class FFModel:
                                op.name, "ON" if op._use_s2d else "off",
                                mode)
 
+    def _stage_input(self, arr, sharding):
+        """Host batch -> global device array under the model's sharding.
+        Multi-controller: every rank passes the SAME full host batch (the
+        loaders keep the whole dataset per host, like the reference's
+        per-node zero-copy residency, dlrm.cc:384-484) and jax extracts
+        this rank's addressable shards — a plain device_put cannot target
+        non-addressable devices."""
+        if jax.process_count() > 1:
+            arr = np.asarray(arr)
+            return jax.make_array_from_process_local_data(
+                sharding, arr, arr.shape)
+        return jax.device_put(arr, sharding)
+
     def _device_batch(self, batch: Dict[str, np.ndarray],
                       with_label: bool = True) -> Dict[str, Any]:
         out = {}
@@ -1101,7 +1114,7 @@ class FFModel:
                     # (no H2D; the wrapper reads it for the host gather)
                     out[t.name] = np.asarray(batch[t.name])
                 else:
-                    out[t.name] = jax.device_put(
+                    out[t.name] = self._stage_input(
                         batch[t.name], self._out_sharding[t.guid])
         if with_label:
             lab = batch["label"]
@@ -1113,7 +1126,7 @@ class FFModel:
                                 for a in self.mesh.axis_names]))
             if lab.shape[0] % ndev != 0:
                 sh = NamedSharding(self.mesh, PartitionSpec())
-            out["label"] = jax.device_put(lab, sh)
+            out["label"] = self._stage_input(lab, sh)
         return out
 
     def train_batch(self, batch: Dict[str, np.ndarray]):

@@ -77,6 +77,29 @@ class ONNXModel:
             name = node.name or f"{op.lower()}_{i}"
             at = _attrs(node)
             ins = node.input
+            if op == "Constant":
+                # fold into weights so downstream consumers (Pad pads,
+                # Reshape shape) resolve it exactly like an initializer —
+                # exporters emit these when constant folding is off
+                # (torch.onnx.export(do_constant_folding=False), tf2onnx)
+                val = None
+                for a in node.attribute:
+                    if a.name == "value" and a.type == 4:
+                        val = _tensor_to_np(a.t)
+                    elif a.name == "value_ints":
+                        val = np.asarray(list(a.ints), np.int64)
+                    elif a.name == "value_floats":
+                        val = np.asarray(list(a.floats), np.float32)
+                    elif a.name == "value_int":
+                        val = np.asarray(a.i, np.int64)
+                    elif a.name == "value_float":
+                        val = np.asarray(a.f, np.float32)
+                if val is None:
+                    raise NotImplementedError(
+                        f"ONNX import: Constant {name!r} carries an "
+                        "unsupported value attribute form")
+                self.weights[node.output[0]] = val
+                continue
 
             if op == "Gemm":
                 w = self.weights[ins[1]]

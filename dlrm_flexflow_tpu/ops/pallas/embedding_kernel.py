@@ -256,10 +256,8 @@ def scatter_add_rows(table: jax.Array, indices: jax.Array,
             # padding the view would copy the whole table — not worth it
             return table.at[indices].add(updates)
         view = table.reshape(rows // r_per_tile, _LANES)
-        tile_rows = indices // r_per_tile
-        offs = (indices % r_per_tile) * dim
-        padded = jnp.pad(updates, ((0, 0), (0, _LANES - dim)))
-        tile_upds = jax.vmap(jnp.roll)(padded, offs)
+        tile_rows, tile_upds = _pack_tile_updates(indices, updates, dim,
+                                                  updates.dtype)
     out = _dedup_and_scatter(view, tile_rows, tile_upds, interpret)
     return out.reshape(-1, dim)[:rows]
 
@@ -284,14 +282,31 @@ def scatter_add_rows_packed(view: jax.Array, indices: jax.Array,
 
 def _pack_tile_updates(indices, updates, dim, dtype):
     """(n,) unpacked-row indices + (n, dim) updates -> (tile_rows,
-    tile_upds (n, 128)): the packed-layout roll math shared by the RMW
-    and write-only scatters (tile = idx // r, lane offset = (idx % r)·d)."""
+    tile_upds (n, 128)): the packed-layout lane-placement math shared by
+    the RMW and write-only scatters (tile = idx // r, lane offset =
+    (idx % r)·d).
+
+    The lane placement selects among the r = 128/d STATIC rotations of
+    each padded row by a one-hot mask — a dynamic per-row `roll`
+    (vmap(jnp.roll)) lowers to a per-row dynamic lane permute that alone
+    cost ~8 ms for 8k rows on v5e (measured r5: it was the entire
+    DLRM-family sparse-update bottleneck, ~85% of the train step)."""
     r_per_tile = _LANES // dim
     indices = indices.astype(jnp.int32)
     tile_rows = indices // r_per_tile
-    offs = (indices % r_per_tile) * dim
     padded = jnp.pad(updates.astype(dtype), ((0, 0), (0, _LANES - dim)))
-    return tile_rows, jax.vmap(jnp.roll)(padded, offs)
+    if r_per_tile == 1:
+        return tile_rows, padded
+    slot = indices % r_per_tile                       # (n,)
+    out = None
+    for s in range(r_per_tile):
+        rolled = jnp.roll(padded, s * dim, axis=1)    # static lane rotate
+        # select, not multiply: 0 * NaN would smear a non-finite update
+        # into the other unpacked rows sharing this tile
+        sel = jnp.where((slot == s)[:, None], rolled,
+                        jnp.zeros_like(rolled))
+        out = sel if out is None else out + sel
+    return tile_rows, out
 
 
 def _dedup_tile_updates(tile_rows, tile_upds):

@@ -65,6 +65,15 @@ def main():
         mets = model.train_batch_device(gbatch)
         loss = float(mets["loss"])
         assert np.isfinite(loss), f"step {step}: loss {loss}"
+    # one more step through the LOADER path (train_batch -> _device_batch
+    # -> _stage_input): every rank holds the full host batch, jax
+    # extracts its addressable shards — what SingleDataLoader/
+    # FFBinDataLoader/keras fit() do under multi-controller
+    x, y = synthetic_batch(dcfg, GLOBAL_BATCH, seed=100 + NUM_STEPS)
+    x["label"] = y
+    mets = model.train_batch(x)
+    loss = float(mets["loss"])
+    assert np.isfinite(loss), f"loader-path step: loss {loss}"
     jax.block_until_ready(model.params)
 
     from jax.experimental import multihost_utils

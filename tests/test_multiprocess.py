@@ -59,6 +59,11 @@ def _single_process_reference() -> dict:
         x["label"] = y
         gbatch = global_batch_from_host_local(x, mesh)
         mets = model.train_batch_device(gbatch)
+    # the loader-path step the worker also runs (train_batch on the full
+    # host batch)
+    x, y = synthetic_batch(dcfg, GLOBAL_BATCH, seed=100 + NUM_STEPS)
+    x["label"] = y
+    mets = model.train_batch(x)
     jax.block_until_ready(model.params)
     out = {}
     for op_name, pdict in model.params.items():
