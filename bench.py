@@ -38,7 +38,7 @@ def _emit_error(exc):
     return 1
 
 
-def _chip_health(jax):
+def _chip_health(jax, size=2048, iters0=100):
     """Measure the chip itself: in-jit bf16 matmul TFLOP/s + RPC roundtrip.
 
     The tunneled chip's condition varies between rounds (round 2: healthy,
@@ -52,18 +52,7 @@ def _chip_health(jax):
     from jax import lax
 
     try:
-        a = jnp.ones((2048, 2048), jnp.bfloat16)
-        # return a scalar: reading back the full 8 MB product would cost
-        # ~0.5 s over the tunnel and swamp the compute being measured
-        mm = jax.jit(lambda a: lax.fori_loop(
-            0, 100, lambda i, x: x @ a, a)[0, 0].astype(jnp.float32))
-        float(mm(a))  # warm/compile + true wait
-        mms = []
-        for _ in range(5):
-            t0 = time.time()
-            float(mm(a))
-            mms.append(time.time() - t0)
-        mm_s = min(mms)
+        a = jnp.ones((size, size), jnp.bfloat16)
 
         tiny = jax.jit(lambda x: x + 1)
         float(tiny(jnp.float32(0.0)))
@@ -73,14 +62,31 @@ def _chip_health(jax):
             float(tiny(jnp.float32(0.0)))
             rts.append(time.time() - t0)
         rt = min(rts)
-        # the matmul window includes one roundtrip; subtract it, and give
-        # up (None) when the compute is buried under the roundtrip jitter
         jitter = max(rts) - rt
-        compute_s = mm_s - rt
-        if compute_s < max(2 * jitter, 1e-4):
-            return None, round(rt * 1e3, 1)
-        tflops = 100 * 2 * 2048 ** 3 / compute_s / 1e12
-        return round(tflops, 1), round(rt * 1e3, 1)
+
+        # the matmul window includes one roundtrip; subtract it. When the
+        # compute is buried under roundtrip jitter (r4's probe returned
+        # null at ~100 ms roundtrip), LENGTHEN the in-jit loop until it
+        # dominates instead of giving up — one extra compile per retry,
+        # bounded (VERDICT r4 weak #2)
+        iters = iters0
+        for _attempt in range(4):
+            # return a scalar: reading back the full 8 MB product would
+            # cost ~0.5 s over the tunnel and swamp the measurement
+            mm = jax.jit(lambda a, n=iters: lax.fori_loop(
+                0, n, lambda i, x: x @ a, a)[0, 0].astype(jnp.float32))
+            float(mm(a))  # warm/compile + true wait
+            mms = []
+            for _ in range(5):
+                t0 = time.time()
+                float(mm(a))
+                mms.append(time.time() - t0)
+            compute_s = min(mms) - rt
+            if compute_s >= max(2 * jitter, 1e-3):
+                tflops = iters * 2 * size ** 3 / compute_s / 1e12
+                return round(tflops, 1), round(rt * 1e3, 1)
+            iters *= 8
+        return None, round(rt * 1e3, 1)
     except Exception:
         return None, None
 

@@ -1388,14 +1388,25 @@ class FFModel:
         epochs = epochs or self.config.epochs
         bs = batch_size or self.config.batch_size
         if bs != self.config.batch_size:
-            raise ValueError(
-                f"fit(batch_size={bs}) differs from the compile-time batch "
-                f"size {self.config.batch_size}; graph shapes are static — "
-                f"rebuild the model with FFConfig(batch_size={bs})")
+            # the per-shape executable cache (train_batch_device) compiles
+            # the step at the requested shape; ops whose shapes bake the
+            # batch dimension (explicit Reshape targets) reject the trace
+            # below with an actionable error. Reference keras fit() takes
+            # whatever batch_size it is given (base_model.py:367-431).
+            log_model.warning(
+                "fit(batch_size=%d) differs from the compile-time batch "
+                "%d; compiling the train step at the new shape",
+                bs, self.config.batch_size)
         n = len(labels)
         if n < bs:
             raise ValueError(f"dataset has {n} samples < batch size {bs}")
         num_batches = n // bs
+        # the remainder (n % bs samples) trains as its OWN smaller batch
+        # through the same per-shape cache; if its shape cannot trace or
+        # stage, it is dropped with a loud warning (the reference loop
+        # silently trains only full batches)
+        rem = n - num_batches * bs
+        rem_ok = rem > 0
         if self.params is None:
             self.init_layers()
 
@@ -1404,7 +1415,16 @@ class FFModel:
         # trace during epoch 0 instead, dlrm.cc:178-185)
         first = {k: v[:bs] for k, v in inputs.items()}
         first["label"] = labels[:bs]
-        db, hidx = self._split_host_idx(self._device_batch(first))
+        try:
+            staged_first = self._device_batch(first)
+        except Exception as e:
+            if bs != self.config.batch_size:
+                raise ValueError(
+                    f"fit(batch_size={bs}) cannot stage against this "
+                    f"model's input shardings (compiled for batch "
+                    f"{self.config.batch_size}): {e}") from e
+            raise
+        db, hidx = self._split_host_idx(staged_first)
         self._ensure_step_state()
         wargs = (self.params, self.opt_state, self.op_state, self._msums,
                  db, self._step_dev)
@@ -1417,7 +1437,16 @@ class FFModel:
             execs = self._train_step_execs = {}
         wkey = self._exec_key(db)
         if wkey not in execs:
-            execs[wkey] = self._train_step.lower(*wargs).compile()
+            try:
+                execs[wkey] = self._train_step.lower(*wargs).compile()
+            except Exception as e:
+                if bs != self.config.batch_size:
+                    raise ValueError(
+                        f"fit(batch_size={bs}) cannot compile against this "
+                        f"graph (an op bakes the compile-time batch "
+                        f"{self.config.batch_size} into its shape): {e}"
+                    ) from e
+                raise
 
         if self.config.profiling:
             # per-op timing report (reference --profiling cudaEvent prints,
@@ -1473,6 +1502,7 @@ class FFModel:
                                  + labels.nbytes)
             budget = 2e9
         staged = None
+        staged_rem = None
         if staging_cost <= budget:
             staged = []
             for b in range(num_batches):
@@ -1480,6 +1510,19 @@ class FFModel:
                 batch = {k: v[sl] for k, v in inputs.items()}
                 batch["label"] = labels[sl]
                 staged.append(self._device_batch(batch))
+            if rem_ok:
+                # the remainder already fit the staging budget (the cost
+                # counted the whole dataset) — stage it once instead of
+                # re-transferring it every epoch
+                batch = {k: v[num_batches * bs:n] for k, v in inputs.items()}
+                batch["label"] = labels[num_batches * bs:n]
+                try:
+                    staged_rem = self._device_batch(batch)
+                except Exception as e:
+                    rem_ok = False
+                    log_model.warning(
+                        "dropping the remainder batch (%d samples): it "
+                        "cannot stage at its own shape (%s)", rem, e)
 
         from ..utils.profiling import TraceContext
         # bound in-flight async steps: XLA CPU's in-process collectives can
@@ -1507,6 +1550,22 @@ class FFModel:
                         batch = {k: v[sl] for k, v in inputs.items()}
                         batch["label"] = labels[sl]
                         mets = self.train_batch(batch)
+                if rem_ok:
+                    try:
+                        if staged_rem is not None:
+                            mets = self.train_batch_device(staged_rem)
+                        else:
+                            sl = slice(num_batches * bs, n)
+                            batch = {k: v[sl] for k, v in inputs.items()}
+                            batch["label"] = labels[sl]
+                            mets = self.train_batch(batch)
+                    except Exception as e:
+                        rem_ok = False
+                        log_model.warning(
+                            "dropping the remainder batch (%d samples): it "
+                            "cannot train at its own shape (%s) — pad the "
+                            "dataset or pick a batch size dividing %d",
+                            rem, e, n)
                 if verbose:
                     # host sync happens here only (metrics are async futures)
                     print(f"epoch {epoch}: loss={float(mets['loss']):.6f} "
@@ -1520,11 +1579,12 @@ class FFModel:
                 float(mets["loss"])
         self._host_drain()   # land the last async host scatter, if any
         elapsed = time.time() - start
-        num_samples = num_batches * bs * epochs
+        num_samples = (num_batches * bs + (rem if rem_ok else 0)) * epochs
         throughput = num_samples / elapsed if elapsed > 0 else float("inf")
         if verbose:
             # same report format intent as reference dlrm.cc:197-198
             print(f"ELAPSED TIME = {elapsed:.4f}s, "
                   f"THROUGHPUT = {throughput:.2f} samples/s")
         return {"elapsed": elapsed, "throughput": throughput,
+                "num_samples": num_samples,
                 "metrics": self.perf.report()}

@@ -47,13 +47,25 @@ class TPUSpec:
     hbm_capacity_bytes: float = 16e9  # v5e HBM per chip
     vmem_bytes: int = 128 * 1024 * 1024  # per-core VMEM (v4+ generations)
     # RANDOM HBM row-access model (embedding gather/scatter): fixed setup
-    # plus per-row sustained cost. Measured on v5e (benchmarks/
-    # calibrate_sim.py): 2048 random 512 B reads from an 8M-row table take
-    # ~1.1 ms — identical for XLA gather and a Pallas kernel with an
-    # 8-64-deep DMA pipeline (latency/row-activation bound, not
-    # bandwidth); larger counts amortize to ~0.3 µs/row.
-    hbm_random_fixed_s: float = 4.0e-4
-    hbm_random_row_s: float = 3.0e-7
+    # plus per-row sustained cost. RE-PINNED round 5 (the round-2 numbers
+    # were poisoned by the dynamic-roll bottleneck that sat in the same
+    # measured path): in-graph XLA gathers of fresh random 512 B rows
+    # from a 2 GB table measure 489 µs @ 2k rows, 847 µs @ 8k, 1.06 ms @
+    # 32k, 1.58 ms @ 128k — a ~0.5 ms setup plus ~10 ns/row sustained
+    # (HBM bank parallelism + deep DMA pipelining; the old 0.3 µs/row
+    # figure was off 25x).
+    # (the ~0.5 ms setup seen by an ISOLATED in-scan gather is mostly
+    # loop artifact — in composed graphs gathers overlap surrounding
+    # work, so the modeled fixed cost is far smaller)
+    hbm_random_fixed_s: float = 1.0e-4
+    hbm_random_row_s: float = 1.2e-8
+    # irreducible per-TRAIN-STEP overhead (dispatch + epilogue) at steady
+    # pipelined state: a one-dense-layer model's full train step floors
+    # at ~820 µs on the tunneled v5e (500-step windows, round 5) — the
+    # simulator adds this once per simulated step; without it every
+    # small-step model under-predicts by exactly this much (the r4
+    # measured-mode DLRM-family bias)
+    per_step_overhead_s: float = 8.2e-4
     # host-resident tables: PCIe host<->device link and host-DRAM random
     # row cost (the reference prices GPU<->DRAM at 16 MB/ms,
     # simulator.cu:27-29; v5e host link ~ PCIe gen3/4)

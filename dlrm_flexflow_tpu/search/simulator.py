@@ -400,10 +400,14 @@ class Simulator:
             # rejects illegal configs
             return float("inf")
         tasks = self.build_task_graph(strategies, ndev)
+        # per-step dispatch/epilogue floor (TPUSpec.per_step_overhead_s):
+        # constant across strategies, so it never changes WHICH strategy
+        # wins, but calibration against real step times needs it
+        overhead = self.cost.spec.per_step_overhead_s
         if use_native:
             ms = self._simulate_native(tasks)
             if ms is not None:
-                return ms
+                return ms + overhead
         device_free: Dict[int, float] = {}
         ready: List = []
         seq = 0
@@ -429,7 +433,7 @@ class Simulator:
         if done != len(tasks):
             raise RuntimeError(
                 f"simulation deadlock: {done}/{len(tasks)} tasks ran")
-        return makespan
+        return makespan + overhead
 
     def _simulate_native(self, tasks: List[SimTask]) -> Optional[float]:
         """Run the event loop in native/ffsim.cc. Returns None when the

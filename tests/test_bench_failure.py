@@ -14,6 +14,36 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_chip_health_probe_reports_on_cpu():
+    """The probe must produce a NUMBER under benign conditions (CPU
+    backend: tiny jitter, slow matmul) — r4's run returned null on the
+    real chip because it gave up instead of lengthening the window."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    import jax
+    tflops, rt_ms = bench._chip_health(jax, size=256, iters0=4)
+    assert tflops is not None and tflops > 0
+    assert rt_ms is not None and rt_ms >= 0
+
+
+def test_chip_health_probe_fallback_is_graceful():
+    """A broken backend degrades to (None, None), never an exception."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod2", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    class BrokenJax:
+        def jit(self, *a, **k):
+            raise RuntimeError("backend down")
+    tflops, rt_ms = bench._chip_health(BrokenJax())
+    assert tflops is None and rt_ms is None
+
+
 def test_bench_emits_json_error_line_when_backend_unavailable():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "nonexistent_backend"
