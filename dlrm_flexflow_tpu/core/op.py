@@ -171,11 +171,13 @@ class Op:
         """Forward FLOPs per sample, for the analytical simulator."""
         return 0.0
 
-    def random_hbm_rows(self, backward: bool = False) -> float:
+    def random_hbm_rows(self, backward: bool = False,
+                        raw: bool = False) -> float:
         """Number of RANDOM HBM row accesses this op makes per step
         (embedding gathers/scatters). These are priced at the measured
         per-row latency (TPUSpec.hbm_random_row_s), not at bandwidth —
-        the dominant cost of sparse lookups on TPU."""
+        the dominant cost of sparse lookups on TPU. `raw` bypasses
+        device-cache gating (host-DRAM pricing wants raw counts)."""
         return 0.0
 
     def update_random_hbm_rows(self, pc=None) -> float:

@@ -99,10 +99,62 @@ def _lookup_count(op) -> float:
     return float(batch * tables * bag)
 
 
-def _embedding_random_rows(op, backward: bool) -> float:
-    # forward = one random read per lookup; the sparse-path backward never
-    # re-gathers (the train step threads cotangents via overrides)
-    return 0.0 if backward else _lookup_count(op)
+# tables at/below this footprint don't pay the random-row latency: their
+# whole row space fits a few HBM pages / the chip's caches, so repeated
+# lookups behave like streaming. Measured r5: an MLP with 4x64-row tables
+# trains its full step in 0.79 ms while pricing its 4k lookups at the
+# random-row rate predicted +75%; Criteo-Kaggle's 14 tiny tables (4..13k
+# rows) similarly cost ~nothing next to its 12 multi-M-row tables.
+_SMALL_TABLE_BYTES = 2 << 20
+
+
+def _table_sizes(op):
+    sizes = getattr(op, "table_sizes", None)
+    if sizes is None:
+        sizes = [op.num_entries] * getattr(op, "num_tables", 1)
+    return sizes
+
+
+def _has_large_table(op) -> bool:
+    d4 = op.out_dim * 4.0
+    return any(rows * d4 > _SMALL_TABLE_BYTES for rows in _table_sizes(op))
+
+
+def _effective_random_rows(op, per_table_lookups: float) -> float:
+    """Sum of effective GATHER random-row counts across the op's tables:
+    small-table lookups are free (their row space behaves like a
+    streamed working set — mlp_heavy's 4k lookups into 64-row tables
+    hide entirely inside the step floor, measured r5) and large-table
+    counts cap at the table's row count (a gather cannot touch more
+    distinct rows than the table has)."""
+    d4 = op.out_dim * 4.0
+    total = 0.0
+    for rows in _table_sizes(op):
+        if rows * d4 <= _SMALL_TABLE_BYTES:
+            continue
+        total += min(per_table_lookups, float(rows))
+    return total
+
+
+def _is_host_resident(op, pc=None) -> bool:
+    return (op.name in getattr(op.model, "_host_resident_ops", set())
+            or (pc is not None and "ZCM" in pc.memory_types))
+
+
+def _embedding_random_rows(op, backward: bool, raw: bool = False) -> float:
+    # forward = one random read per lookup into a LARGE table; the
+    # sparse-path backward never re-gathers (the train step threads
+    # cotangents via overrides). `raw` skips the small-table/dedup
+    # gating — the HOST (ZCM) pricing path uses it: the 2 MB streaming
+    # heuristic was measured for on-device HBM, not host DRAM over PCIe
+    if backward:
+        return 0.0
+    if raw or _is_host_resident(op):
+        return _lookup_count(op)
+    t = op.inputs[0]
+    batch = t.shape[0]
+    bag = t.shape[-1] if t.num_dims > 1 else 1
+    return _effective_random_rows(op, float(batch * bag))
 
 
 def _embedding_update_rows(op, pc=None) -> float:
@@ -130,6 +182,17 @@ def _embedding_update_rows(op, pc=None) -> float:
     opt = getattr(op.model, "optimizer", None)
     if opt is not None:
         accesses += 2.0 * len(opt.sparse_slab_names())
+    # the update machinery (lane pack + dedup sort + scatter) processes
+    # EVERY raw lookup — unlike the gather, tiny-table lookups are not
+    # free here unless ALL the op's tables are tiny (then the whole
+    # working set streams: mlp_heavy's update hides in the step floor,
+    # while Criteo-Kaggle pays ~per-raw-lookup even though 19 of its 26
+    # tables are tiny — measured r5, 77-95 ns/lookup update-side on both
+    # kaggle and dlrm_random). HOST (ZCM) tables always count raw: the
+    # device-cache gating does not describe host DRAM, and a zero here
+    # would silently reroute host_update_time to its dense fallback
+    if not _is_host_resident(op, pc) and not _has_large_table(op):
+        return 0.0
     return accesses * _lookup_count(op)
 
 
@@ -593,8 +656,9 @@ class Embedding(Op):
         return {"kernel": (self.num_entries, max(self.out_dim // dc, 1))}
 
 
-    def random_hbm_rows(self, backward: bool = False) -> float:
-        return _embedding_random_rows(self, backward)
+    def random_hbm_rows(self, backward: bool = False,
+                        raw: bool = False) -> float:
+        return _embedding_random_rows(self, backward, raw)
 
     def update_random_hbm_rows(self, pc=None) -> float:
         return _embedding_update_rows(self, pc)
@@ -920,8 +984,9 @@ class EmbeddingBagStacked(Op):
                            self.num_entries // r, self.out_dim * r)}
 
 
-    def random_hbm_rows(self, backward: bool = False) -> float:
-        return _embedding_random_rows(self, backward)
+    def random_hbm_rows(self, backward: bool = False,
+                        raw: bool = False) -> float:
+        return _embedding_random_rows(self, backward, raw)
 
     def update_random_hbm_rows(self, pc=None) -> float:
         return _embedding_update_rows(self, pc)
@@ -1292,8 +1357,9 @@ class EmbeddingBagConcat(Op):
                            self.out_dim * r)}
 
 
-    def random_hbm_rows(self, backward: bool = False) -> float:
-        return _embedding_random_rows(self, backward)
+    def random_hbm_rows(self, backward: bool = False,
+                        raw: bool = False) -> float:
+        return _embedding_random_rows(self, backward, raw)
 
     def update_random_hbm_rows(self, pc=None) -> float:
         return _embedding_update_rows(self, pc)

@@ -40,6 +40,11 @@ _LANES = 128
 # outstanding row DMAs: random 512 B reads are latency-bound, so keep a
 # deep pipeline of in-flight fetches rather than classic double buffering
 _SLOTS = 8
+# scatter-kernel block: the update DMA pipeline drains at each grid-step
+# boundary, so the block size IS the outstanding-write depth; 64 keeps
+# the random-write pipeline full (8 left the update ~3x slower per row
+# than the gather, r5 calibration) at a modest 32 KB VMEM cost
+_SCATTER_B = 64
 
 
 def supports(dim: int) -> bool:
@@ -177,7 +182,7 @@ def scatter_supports(dim: int) -> bool:
 
 def _scatter_unique_kernel(idx_ref, upd_ref, tbl_ref, out_ref, bufs,
                            rsems, wsems):
-    """One grid step applies _TILE_B tile updates, pipelined.
+    """One grid step applies _SCATTER_B tile updates, pipelined.
 
     PRECONDITION (established by scatter_add_rows' dedup pre-pass): all
     view-row targets with row >= 0 are DISTINCT, so the 8 RMWs of a block
@@ -196,22 +201,22 @@ def _scatter_unique_kernel(idx_ref, upd_ref, tbl_ref, out_ref, bufs,
         return pltpu.make_async_copy(
             bufs.at[s], out_ref.at[pl.ds(row, 1), :], wsems.at[s])
 
-    for s in range(_TILE_B):            # static unroll: issue all reads
-        row = idx_ref[i * _TILE_B + s]
+    for s in range(_SCATTER_B):            # static unroll: issue all reads
+        row = idx_ref[i * _SCATTER_B + s]
 
         @pl.when(row >= 0)
         def _():
             rd(s, row).start()
-    for s in range(_TILE_B):            # add + async write-back
-        row = idx_ref[i * _TILE_B + s]
+    for s in range(_SCATTER_B):            # add + async write-back
+        row = idx_ref[i * _SCATTER_B + s]
 
         @pl.when(row >= 0)
         def _():
             rd(s, row).wait()
             bufs[s] = (bufs[s] + upd_ref[pl.ds(s, 1), :]).astype(bufs.dtype)
             wr(s, row).start()
-    for s in range(_TILE_B):            # drain before the next block
-        row = idx_ref[i * _TILE_B + s]
+    for s in range(_SCATTER_B):            # drain before the next block
+        row = idx_ref[i * _SCATTER_B + s]
 
         @pl.when(row >= 0)
         def _():
@@ -312,7 +317,7 @@ def _pack_tile_updates(indices, updates, dim, dtype):
 def _dedup_tile_updates(tile_rows, tile_upds):
     """Combine same-tile updates so a scatter kernel sees DISTINCT rows:
     sort → segment-sum → per-segment target row (-1 marks invalid/pad
-    slots) → pad to a _TILE_B multiple. Returns
+    slots) → pad to a _SCATTER_B multiple. Returns
     (target (m,), summed (m, 128), rep (m,), m) where rep[s] is one
     original position whose update landed in segment s (for callers that
     need a representative forward tile)."""
@@ -337,7 +342,7 @@ def _dedup_tile_updates(tile_rows, tile_upds):
     # target=-1 and are skipped by the kernels regardless)
     rep = jnp.where(valid, rep, 0)
 
-    pad_n = (-m) % _TILE_B
+    pad_n = (-m) % _SCATTER_B
     if pad_n:
         target = jnp.pad(target, (0, pad_n), constant_values=-1)
         summed = jnp.pad(summed, ((0, pad_n), (0, 0)))
@@ -351,16 +356,16 @@ def _dedup_and_scatter(view, tile_rows, tile_upds, interpret):
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(m // _TILE_B,),
+        grid=(m // _SCATTER_B,),
         in_specs=[
-            pl.BlockSpec((_TILE_B, _LANES), lambda i, idx: (i, 0)),
+            pl.BlockSpec((_SCATTER_B, _LANES), lambda i, idx: (i, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
-            pltpu.VMEM((_TILE_B, 1, _LANES), view.dtype),
-            pltpu.SemaphoreType.DMA((_TILE_B,)),
-            pltpu.SemaphoreType.DMA((_TILE_B,)),
+            pltpu.VMEM((_SCATTER_B, 1, _LANES), view.dtype),
+            pltpu.SemaphoreType.DMA((_SCATTER_B,)),
+            pltpu.SemaphoreType.DMA((_SCATTER_B,)),
         ],
     )
     return pl.pallas_call(
@@ -373,7 +378,7 @@ def _dedup_and_scatter(view, tile_rows, tile_upds, interpret):
 
 
 def _scatter_write_kernel(idx_ref, val_ref, tbl_ref, out_ref, wsems):
-    """Write-ONLY scatter: out[row] = val for _TILE_B distinct rows per
+    """Write-ONLY scatter: out[row] = val for _SCATTER_B distinct rows per
     grid step (row < 0 skipped). No read DMA: callers that kept the
     forward-gathered tiles compute new = fwd_tile + summed_update in XLA
     and this kernel just lands the rows — half the random-HBM traffic of
@@ -381,16 +386,16 @@ def _scatter_write_kernel(idx_ref, val_ref, tbl_ref, out_ref, wsems):
     embedding.cu:173-224, with distinctness + precomputed values replacing
     atomicity)."""
     i = pl.program_id(0)
-    for s in range(_TILE_B):            # static unroll: issue all writes
-        row = idx_ref[i * _TILE_B + s]
+    for s in range(_SCATTER_B):            # static unroll: issue all writes
+        row = idx_ref[i * _SCATTER_B + s]
 
         @pl.when(row >= 0)
         def _():
             pltpu.make_async_copy(
                 val_ref.at[pl.ds(s, 1), :], out_ref.at[pl.ds(row, 1), :],
                 wsems.at[s]).start()
-    for s in range(_TILE_B):            # drain before the next block
-        row = idx_ref[i * _TILE_B + s]
+    for s in range(_SCATTER_B):            # drain before the next block
+        row = idx_ref[i * _SCATTER_B + s]
 
         @pl.when(row >= 0)
         def _():
@@ -431,25 +436,25 @@ def scatter_write_tiles(view: jax.Array, target: jax.Array,
 
     PRECONDITIONS (the caller establishes them, e.g. via
     _dedup_tile_updates): targets are distinct; target < 0 marks a pad
-    slot to skip; len(target) is a _TILE_B multiple. Used by the write-
+    slot to skip; len(target) is a _SCATTER_B multiple. Used by the write-
     only sparse-SGD update and by the stateful (momentum/Adam) sparse
     update, which writes the new weight AND state tiles this way.
 
     view   : (vrows, 128) (donated/aliased)
-    target : (m,) int32, m % _TILE_B == 0
+    target : (m,) int32, m % _SCATTER_B == 0
     vals   : (m, 128) new tile values
     """
     m = target.shape[0]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(m // _TILE_B,),
+        grid=(m // _SCATTER_B,),
         in_specs=[
-            pl.BlockSpec((_TILE_B, _LANES), lambda i, idx: (i, 0)),
+            pl.BlockSpec((_SCATTER_B, _LANES), lambda i, idx: (i, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
-            pltpu.SemaphoreType.DMA((_TILE_B,)),
+            pltpu.SemaphoreType.DMA((_SCATTER_B,)),
         ],
     )
     return pl.pallas_call(

@@ -57,15 +57,24 @@ class TPUSpec:
     # (the ~0.5 ms setup seen by an ISOLATED in-scan gather is mostly
     # loop artifact — in composed graphs gathers overlap surrounding
     # work, so the modeled fixed cost is far smaller)
-    hbm_random_fixed_s: float = 1.0e-4
+    hbm_random_fixed_s: float = 0.5e-4
     hbm_random_row_s: float = 1.2e-8
+    # random-row SCATTER (the touched-rows update): per-raw-lookup cost
+    # of the whole update machinery — lane pack + dedup sort + the
+    # 64-deep write-DMA scatter (_SCATTER_B) — measured r5 on kaggle
+    # (26k lookups, 2.7 ms step) and dlrm_random; ~2x the pipelined
+    # gather rate because the sort/pack passes ride along, not because
+    # the writes themselves are slow
+    hbm_scatter_row_s: float = 2.6e-8
     # irreducible per-TRAIN-STEP overhead (dispatch + epilogue) at steady
     # pipelined state: a one-dense-layer model's full train step floors
-    # at ~820 µs on the tunneled v5e (500-step windows, round 5) — the
-    # simulator adds this once per simulated step; without it every
-    # small-step model under-predicts by exactly this much (the r4
-    # measured-mode DLRM-family bias)
-    per_step_overhead_s: float = 8.2e-4
+    # at ~820 µs on the tunneled v5e (500-step windows, round 5), but a
+    # compute-heavier graph (mlp_heavy, real 794 µs total) shows device
+    # work partially HIDES under the host-side floor — 650 µs is the
+    # additive share that fits all 12 calibration points; without it
+    # every small-step model under-predicts (the r4 measured-mode
+    # DLRM-family bias)
+    per_step_overhead_s: float = 5.5e-4
     # host-resident tables: PCIe host<->device link and host-DRAM random
     # row cost (the reference prices GPU<->DRAM at 16 MB/ms,
     # simulator.cu:27-29; v5e host link ~ PCIe gen3/4)
@@ -205,7 +214,8 @@ class CostModel:
             t = (self.spec.hbm_random_fixed_s
                  + out_bytes / self.spec.pcie_bytes_per_s)
             if not backward:
-                t += op.random_hbm_rows(False) * self.spec.host_random_row_s
+                t += (op.random_hbm_rows(False, raw=True)
+                      * self.spec.host_random_row_s)
             return t
         batch = op.outputs[0].shape[0] if op.outputs[0].num_dims > 0 else 1
         flops = op.flops_per_sample() * batch / max(pc.num_parts, 1)
@@ -269,7 +279,8 @@ class CostModel:
             # slab — mirrors the device path's _embedding_update_rows
             opt = getattr(op.model, "optimizer", None)
             nslabs = len(opt.sparse_slab_names()) if opt is not None else 0
-            rows = (2.0 + 2.0 * nslabs) * op.random_hbm_rows(False)
+            rows = (2.0 + 2.0 * nslabs) * op.random_hbm_rows(False,
+                                                             raw=True)
             return (self.spec.hbm_random_fixed_s
                     + rows * self.spec.host_random_row_s)
         # dense fallback (momentum/Adam without sparse state): stream the
@@ -283,6 +294,14 @@ class CostModel:
             return 0.0
         return (self.spec.hbm_random_fixed_s
                 + rows * self.spec.hbm_random_row_s)
+
+    def scatter_rows_time(self, rows: float) -> float:
+        """Touched-rows UPDATE scatter: same fixed setup, slower per-row
+        sustained rate (write DMAs drain every 8-tile block)."""
+        if rows <= 0:
+            return 0.0
+        return (self.spec.hbm_random_fixed_s
+                + rows * self.spec.hbm_scatter_row_s)
 
     def tensor_bytes(self, t) -> float:
         """Dtype-aware byte size: float activations flow in the model's

@@ -257,8 +257,9 @@ class Simulator:
                 upd_compute = max(
                     dev_bytes / self.cost._hbm_rate() * 3.0,  # r/w+momentum
                     # sparse touched-rows scatter is random-access
-                    # latency bound
-                    self.cost.random_rows_time(
+                    # latency bound (write-pipeline rate, slower than
+                    # the gather's)
+                    self.cost.scatter_rows_time(
                         op.update_random_hbm_rows(pc)
                         / max(pc.num_parts, 1)))
             for d in self._participants(pc, ndev, op):
