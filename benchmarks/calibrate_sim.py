@@ -203,6 +203,23 @@ def main():
         measured = measure_step_time(model, batches, steps=steps)
         strat = default_strategy(model, 1)
         sim_roof = Simulator(model).simulate(strat, 1)
+        # CAL_KEEP_BEST=1: merge with the best PREVIOUSLY recorded real
+        # for this point. The tunneled chip's per-step floor drifts
+        # ~1.5x between phases (identical code measured mlp_heavy at
+        # 0.79 and 1.27 ms hours apart, r5); interference and tunnel
+        # state only ever SLOW a run, so the minimum across sweeps is
+        # the closest observation of silicon truth — the same best-window
+        # principle measure_step_time applies within a run. Guard: the
+        # old best only survives while the point's ROOFLINE matches the
+        # recorded one (a changed workload definition, kernel lowering,
+        # or cost-model constant shifts it) — otherwise an obsolete fast
+        # number could mask a real regression forever
+        if os.environ.get("CAL_KEEP_BEST"):
+            prev = next((r for r in rows if r["point"] == name), None)
+            if prev is not None and abs(
+                    prev["sim_roofline_ms"] - sim_roof * 1e3) \
+                    <= 0.02 * sim_roof * 1e3:
+                measured = min(measured, prev["measured_ms"] / 1e3)
         cm = CostModel(measure=True,
                        compute_dtype=model.config.jnp_compute_dtype)
         sim_meas = Simulator(model, cost_model=cm).simulate(strat, 1)

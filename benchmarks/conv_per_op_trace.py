@@ -109,6 +109,10 @@ def main():
                   "```", format_profile(rows[:25]), "```", ""]
         del model
 
+    # InceptionV3's ~100 convs would cost hours of per-op measurement on
+    # the tunneled chip; its question ("BN fused or not, where does the
+    # small-branch-conv time go") is answered by the BN A/B below plus
+    # the roofline per-op table (analytical, instant)
     import dlrm_flexflow_tpu as ff
     from dlrm_flexflow_tpu.models.inception import build_inception_v3
     cfg = ff.FFConfig(batch_size=64, compute_dtype="bfloat16")
@@ -117,8 +121,9 @@ def main():
     inc.compile(ff.SGDOptimizer(lr=0.01),
                 "sparse_categorical_crossentropy", ["accuracy"])
     inc.init_layers()
-    rows = profile_ops(inc, measure=True)
-    lines += ["## Per-op measured table: InceptionV3 b64 (top 30)", "",
+    rows = profile_ops(inc, measure=False)
+    lines += ["## Per-op roofline table: InceptionV3 b64 (top 30, "
+              "analytical — see BN A/B for the measured evidence)", "",
               "```", format_profile(rows[:30]), "```", ""]
     del inc
 
