@@ -1185,8 +1185,21 @@ class FFModel:
             smemo[id(sh)] = (sh, s)
             return s
 
+        # numpy's dtype.name property is surprisingly slow (~µs each,
+        # 3+ arrays x every step); memoize by the (singleton-ish,
+        # hashable) dtype object
+        dmemo = getattr(self, "_dtype_name_memo", None)
+        if dmemo is None:
+            dmemo = self._dtype_name_memo = {}
+
+        def _dname(dt):
+            n = dmemo.get(dt)
+            if n is None:
+                n = dmemo[dt] = dt.name
+            return n
+
         return tuple(sorted(
-            (k, v.shape, v.dtype.name, _shs(v))
+            (k, v.shape, _dname(v.dtype), _shs(v))
             for k, v in device_batch.items()))
 
     def train_batch_device(self, device_batch: Dict):
