@@ -11,7 +11,9 @@ Legion DMA/GASNet.
 """
 
 from .config import FFConfig
-from .core.model import FFModel
+from .core.model import AnomalyError, FFModel
+from .utils.checkpoint import (CheckpointManager, restore_checkpoint,
+                               save_checkpoint)
 from .core.optimizers import AdamOptimizer, Optimizer, SGDOptimizer
 from .core.initializers import (ConstantInitializer, GlorotUniform,
                                 NormInitializer, UniformInitializer,
@@ -23,7 +25,8 @@ from .parallel.pconfig import ParallelConfig
 __version__ = "0.1.0"
 
 __all__ = [
-    "FFConfig", "FFModel", "Tensor",
+    "FFConfig", "FFModel", "Tensor", "AnomalyError",
+    "CheckpointManager", "save_checkpoint", "restore_checkpoint",
     "Optimizer", "SGDOptimizer", "AdamOptimizer",
     "GlorotUniform", "ZeroInitializer", "UniformInitializer",
     "NormInitializer", "ConstantInitializer",

@@ -94,6 +94,25 @@ class FFConfig:
     # the reference's cuDNN find-algorithm pick, conv_2d.cu:217).
     # Set with --conv-s2d {on,off,auto}.
     conv_s2d: str = "off"
+    # anomaly sentinel: per-step on-device finiteness check of the loss
+    # and global gradient norm, with a policy for non-finite steps.
+    # "none" (off, zero overhead) | "skip_step" (suppress the bad update
+    # on device — fully async) | "rollback" (restore the last good
+    # checkpoint and re-wind the step counter; needs fit(checkpoint_dir))
+    # | "raise" (raise AnomalyError at the step boundary). rollback/raise
+    # read the flag back every step (one host sync). Set with
+    # --anomaly-policy.
+    anomaly_policy: str = "none"
+    # cap on consecutive-ish rollback recoveries per fit() before the
+    # anomaly is re-raised (a persistently-NaN model must not loop)
+    max_rollbacks: int = 3
+    # rolling-checkpoint defaults for fit(); fit(checkpoint_dir=...)
+    # arguments override. save_every counts optimizer steps; 0 = only a
+    # final checkpoint. Set with --checkpoint-dir / --save-every /
+    # --keep-last.
+    checkpoint_dir: str = ""
+    save_every: int = 0
+    keep_last: int = 3
     unparsed: List[str] = field(default_factory=list)
 
     @property
@@ -174,6 +193,19 @@ class FFConfig:
                     raise ValueError(f"--conv-s2d expects on|off|auto, "
                                      f"got {v!r}")
                 cfg.conv_s2d = v
+            elif a == "--anomaly-policy":
+                v = take()
+                if v not in ("none", "skip_step", "rollback", "raise"):
+                    raise ValueError(
+                        f"--anomaly-policy expects "
+                        f"none|skip_step|rollback|raise, got {v!r}")
+                cfg.anomaly_policy = v
+            elif a == "--checkpoint-dir":
+                cfg.checkpoint_dir = take()
+            elif a == "--save-every":
+                cfg.save_every = int(take())
+            elif a == "--keep-last":
+                cfg.keep_last = int(take())
             elif a == "--host-tables":
                 cfg.host_resident_tables = True
             elif a == "--host-tables-async":

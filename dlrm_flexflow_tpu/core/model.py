@@ -43,6 +43,24 @@ from .op import InputOp, Op
 from .optimizers import Optimizer, SGDOptimizer
 from .tensor import Tensor
 from ..utils.logging import log_model
+from ..utils import faults
+
+
+class AnomalyError(RuntimeError):
+    """A train step produced a non-finite loss or gradient norm and the
+    anomaly policy is "rollback" or "raise" (FFConfig.anomaly_policy).
+    Under "rollback", fit(checkpoint_dir=...) catches this, restores the
+    last good snapshot, and re-winds; outside fit() it propagates.
+    The offending update was already suppressed on device — params/opt
+    state keep their pre-step values."""
+
+    def __init__(self, step: int, loss: float, grad_norm: float):
+        super().__init__(
+            f"non-finite training step {step}: loss={loss}, "
+            f"global grad norm={grad_norm}")
+        self.step = step
+        self.loss = loss
+        self.grad_norm = grad_norm
 
 
 class FFModel:
@@ -793,6 +811,13 @@ class FFModel:
         # function (a re-compile() with a new optimizer/loss/strategies
         # must not keep training with the old one)
         self._train_step_execs = {}
+        policy = getattr(self.config, "anomaly_policy", "none") or "none"
+        if policy not in ("none", "skip_step", "rollback", "raise"):
+            raise ValueError(
+                f"anomaly_policy must be none|skip_step|rollback|raise, "
+                f"got {policy!r}")
+        self._anomaly_policy = policy
+        sentinel = policy != "none"
         loss_f = losses_mod.loss_fn(self.loss_type)
         logits_guid = self._logits_tensor.guid
         preds_guid = self._preds_tensor.guid
@@ -904,6 +929,7 @@ class FFModel:
                 (loss, (preds, st2)), (gd, gev) = jax.value_and_grad(
                     objective, argnums=(0, 1), has_aux=True)(
                         p_dense, emb_vals, op_state)
+                grad_leaves = jax.tree.leaves((gd, gev))
                 # the optimizer state for sparse tables is NOT part of the
                 # dense update: split it out, update it touched-rows-only
                 # below, and merge back (keeps one opt_state pytree for
@@ -952,8 +978,29 @@ class FFModel:
 
                 (loss, (preds, st2)), grads = jax.value_and_grad(
                     objective, has_aux=True)(params, op_state)
+                grad_leaves = jax.tree.leaves(grads)
                 new_params, new_opt = self.optimizer.update(params, grads,
                                                             opt_state)
+            # anomaly sentinel: ONE on-device finiteness predicate over the
+            # loss and the global gradient norm. Under any active policy
+            # the non-finite update is suppressed ON DEVICE (jnp.where
+            # against the pre-step values — both live inside the step, so
+            # donation costs nothing), keeping params/opt/op-state clean
+            # without a host sync; rollback/raise additionally read the
+            # flag back at the step boundary (train_batch_device).
+            step_ok = None
+            if sentinel:
+                gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for g in grad_leaves)
+                gnorm = jnp.sqrt(gsq)
+                step_ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+
+                def _keep(new, old):
+                    return jax.tree.map(
+                        lambda n, o: jnp.where(step_ok, n, o), new, old)
+                new_params = _keep(new_params, params)
+                new_opt = _keep(new_opt, opt_state)
+                st2 = _keep(st2, op_state)
             # CCE metrics expect probabilities; when the graph doesn't end
             # in a Softmax op, preds are raw logits — normalize them here
             if "crossentropy" in loss_type and preds_guid == logits_guid:
@@ -966,8 +1013,18 @@ class FFModel:
             # reference accumulates in device memory with atomics and folds
             # once per epoch, metrics_functions.cu:57-135; host-side
             # accumulation would dispatch extra tiny kernels every step)
-            new_msums = {k: msums[k] + v for k, v in mets.items()}
+            if sentinel:
+                # a skipped step contributes nothing (NaNs would poison
+                # the epoch's running sums irreversibly)
+                new_msums = {k: msums[k]
+                             + jnp.where(step_ok, v, jnp.zeros_like(v))
+                             for k, v in mets.items()}
+            else:
+                new_msums = {k: msums[k] + v for k, v in mets.items()}
             mets["loss"] = loss
+            if sentinel:
+                mets["anomaly"] = ~step_ok
+                mets["grad_norm"] = gnorm
             if host_cts is not None:
                 mets["_host_cts"] = host_cts
             # the step counter stays device-resident across calls (feeding
@@ -1206,6 +1263,11 @@ class FFModel:
         """train_batch for a batch already staged on device (skips the
         host->device put; used by benchmark loops that pre-stage)."""
         self._ensure_step_state()
+        if faults.active() is not None and faults.take_nan_grad(self._step):
+            # fault harness: poison the batch so NaNs flow through the
+            # REAL autodiff into the loss/grad-norm the sentinel watches
+            # (same shapes/dtypes/shardings — the cached executable holds)
+            device_batch = faults.poison_batch(device_batch)
         device_batch, host_idx = self._split_host_idx(device_batch)
         args = (self.params, self.opt_state, self.op_state, self._msums,
                 device_batch, self._step_dev)
@@ -1238,6 +1300,11 @@ class FFModel:
         (self.params, self.opt_state, self.op_state, self._msums,
          self._step_dev, mets) = outs
         self._step += 1
+        policy = getattr(self, "_anomaly_policy", "none")
+        # the sentinel flag (device bool) guards the host-table scatter on
+        # every policy: NaN cotangents scattered into host tables could not
+        # be undone by skip_step's on-device suppression
+        anomaly_flag = mets.get("anomaly") if policy != "none" else None
         if hres:
             if getattr(self.config, "host_tables_async", False):
                 # pipelined: the cotangent readback + host scatter run on
@@ -1255,7 +1322,9 @@ class FFModel:
 
                 def scatter():
                     try:
-                        self._host_emb_update(host_idx, cts, step)
+                        if (anomaly_flag is None
+                                or not bool(np.asarray(anomaly_flag))):
+                            self._host_emb_update(host_idx, cts, step)
                     except BaseException as e:   # re-raised at drain
                         self._host_scatter_exc = e
                 t = threading.Thread(target=scatter, daemon=True)
@@ -1264,12 +1333,24 @@ class FFModel:
             else:
                 # exact ordering: the cotangent readback is the step's
                 # true completion
-                self._host_emb_update(host_idx, mets.pop("_host_cts"),
-                                      self._step - 1)
+                cts = mets.pop("_host_cts")
+                if (anomaly_flag is None
+                        or not bool(np.asarray(anomaly_flag))):
+                    self._host_emb_update(host_idx, cts, self._step - 1)
         # the running sums live on device; PerfMetrics syncs at report().
         # shallow-copy so perf.reset()/report() mutating perf.sums can
         # never corrupt the jit carry
         self.perf.sums = dict(self._msums)
+        if policy in ("rollback", "raise") and bool(
+                np.asarray(anomaly_flag)):
+            # the flag readback is the one host sync these policies cost;
+            # skip_step never syncs. The bad update was already suppressed
+            # on device, so state is clean whichever way the caller (fit's
+            # rollback loop, or the user) handles this.
+            raise AnomalyError(step=self._step - 1,
+                               loss=float(mets["loss"]),
+                               grad_norm=float(np.asarray(
+                                   mets["grad_norm"])))
         return mets
 
     @property
@@ -1397,9 +1478,36 @@ class FFModel:
     def fit(self, inputs: Dict[str, np.ndarray], labels: np.ndarray,
             epochs: Optional[int] = None, batch_size: Optional[int] = None,
             verbose: bool = True,
-            callbacks: Optional[List[Callable]] = None):
+            callbacks: Optional[List[Callable]] = None,
+            checkpoint_dir: Optional[str] = None,
+            save_every: Optional[int] = None,
+            keep_last: Optional[int] = None,
+            resume: bool = True):
+        """Train; with `checkpoint_dir` the run is fault-tolerant:
+
+        - rolling atomic snapshots every `save_every` optimizer steps
+          (written on a background thread; keep-last-`keep_last` files
+          plus a manifest), and a final one when training completes;
+        - `resume=True` scans the manifest first and continues from the
+          newest VALID snapshot — params, optimizer state, step counter,
+          and the (epoch, batch) dataloader position; corrupt/truncated/
+          foreign snapshots are skipped, so a run SIGKILLed mid-save
+          restarts from the previous good one;
+        - under `FFConfig.anomaly_policy == "rollback"`, a non-finite
+          step restores the last good snapshot, re-winds, and continues
+          (at most `FFConfig.max_rollbacks` times per fit call).
+
+        All three arguments default from FFConfig (`--checkpoint-dir`,
+        `--save-every`, `--keep-last`).
+        """
         epochs = epochs or self.config.epochs
         bs = batch_size or self.config.batch_size
+        checkpoint_dir = checkpoint_dir or (
+            getattr(self.config, "checkpoint_dir", "") or None)
+        save_every = (save_every if save_every is not None
+                      else getattr(self.config, "save_every", 0))
+        keep_last = (keep_last if keep_last is not None
+                     else getattr(self.config, "keep_last", 3))
         if bs != self.config.batch_size:
             # the per-shape executable cache (train_batch_device) compiles
             # the step at the requested shape; ops whose shapes bake the
@@ -1422,6 +1530,39 @@ class FFModel:
         rem_ok = rem > 0
         if self.params is None:
             self.init_layers()
+
+        # --- fault tolerance: rolling checkpoints + auto-resume ---------
+        mgr = None
+        start_epoch = start_batch = 0
+        if checkpoint_dir:
+            from ..utils.checkpoint import CheckpointManager
+            mgr = CheckpointManager(checkpoint_dir, keep_last=keep_last)
+            if resume:
+                entry = mgr.restore_latest(self)
+                if entry is not None:
+                    ls = entry.get("loader_state") or {}
+                    start_epoch = int(ls.get("epoch", 0))
+                    start_batch = min(int(ls.get("batch", 0)), num_batches)
+                    if verbose:
+                        print(f"resumed from checkpoint step "
+                              f"{entry['step']} (epoch {start_epoch}, "
+                              f"batch {start_batch})")
+            if start_epoch >= epochs:
+                log_model.warning(
+                    "checkpoint in %s is already at epoch %d >= epochs=%d; "
+                    "nothing to train", checkpoint_dir, start_epoch, epochs)
+                return {"elapsed": 0.0, "throughput": 0.0,
+                        "num_samples": 0, "rollbacks": 0,
+                        "metrics": self.perf.report()}
+            if getattr(self, "_anomaly_policy", "none") == "rollback" and \
+                    mgr.latest_valid() is None:
+                # rollback needs a target from step one: seed the directory
+                # with the initial state
+                mgr.save(self, {"epoch": start_epoch, "batch": start_batch})
+        elif getattr(self, "_anomaly_policy", "none") == "rollback":
+            raise ValueError(
+                'anomaly_policy="rollback" needs fit(checkpoint_dir=...) '
+                "(or FFConfig.checkpoint_dir) to roll back to")
 
         # AOT-compile the train step so the timed loop starts warm without
         # consuming a real optimizer step (the reference warms its Legion
@@ -1547,57 +1688,103 @@ class FFModel:
         inflight = deque()
         start = time.time()
         mets = None
+        num_samples = 0
+        rollbacks = 0
+        max_rollbacks = getattr(self.config, "max_rollbacks", 3)
+
+        def _maybe_save(next_epoch, next_batch):
+            # position = the NEXT (epoch, batch) to train; snapshots are
+            # written off-thread (the device→host gather is inline)
+            if mgr is not None and save_every and \
+                    self._step % save_every == 0:
+                mgr.save_async(self, {"epoch": next_epoch,
+                                      "batch": next_batch})
+
         with TraceContext(self.config.profile_dir or None):
-            for epoch in range(epochs):
-                self.reset_metrics()
-                for b in range(num_batches):
-                    if staged is not None:
-                        mets = self.train_batch_device(staged[b])
-                        # bound the pipeline without draining it: block on
-                        # the step issued `throttle` iterations AGO
-                        inflight.append(mets["loss"])
-                        if len(inflight) > throttle:
-                            jax.block_until_ready(inflight.popleft())
-                    else:
-                        sl = slice(b * bs, (b + 1) * bs)
-                        batch = {k: v[sl] for k, v in inputs.items()}
-                        batch["label"] = labels[sl]
-                        mets = self.train_batch(batch)
-                if rem_ok:
-                    try:
-                        if staged_rem is not None:
-                            mets = self.train_batch_device(staged_rem)
+            epoch, b0 = start_epoch, start_batch
+            while epoch < epochs:
+                if b0 == 0:
+                    self.reset_metrics()
+                try:
+                    for b in range(b0, num_batches):
+                        if staged is not None:
+                            mets = self.train_batch_device(staged[b])
+                            # bound the pipeline without draining it: block
+                            # on the step issued `throttle` iterations AGO
+                            inflight.append(mets["loss"])
+                            if len(inflight) > throttle:
+                                jax.block_until_ready(inflight.popleft())
                         else:
-                            sl = slice(num_batches * bs, n)
+                            sl = slice(b * bs, (b + 1) * bs)
                             batch = {k: v[sl] for k, v in inputs.items()}
                             batch["label"] = labels[sl]
                             mets = self.train_batch(batch)
-                    except Exception as e:
-                        rem_ok = False
-                        log_model.warning(
-                            "dropping the remainder batch (%d samples): it "
-                            "cannot train at its own shape (%s) — pad the "
-                            "dataset or pick a batch size dividing %d",
-                            rem, e, n)
-                if verbose:
-                    # host sync happens here only (metrics are async futures)
+                        num_samples += bs
+                        _maybe_save(epoch, b + 1)
+                    if rem_ok:
+                        try:
+                            if staged_rem is not None:
+                                mets = self.train_batch_device(staged_rem)
+                            else:
+                                sl = slice(num_batches * bs, n)
+                                batch = {k: v[sl]
+                                         for k, v in inputs.items()}
+                                batch["label"] = labels[sl]
+                                mets = self.train_batch(batch)
+                            num_samples += rem
+                            _maybe_save(epoch + 1, 0)
+                        except AnomalyError:
+                            raise   # recovery, not a shape problem
+                        except Exception as e:
+                            rem_ok = False
+                            log_model.warning(
+                                "dropping the remainder batch (%d "
+                                "samples): it cannot train at its own "
+                                "shape (%s) — pad the dataset or pick a "
+                                "batch size dividing %d", rem, e, n)
+                except AnomalyError as exc:
+                    if (getattr(self, "_anomaly_policy", "none")
+                            != "rollback" or mgr is None
+                            or rollbacks >= max_rollbacks):
+                        raise
+                    rollbacks += 1
+                    inflight.clear()
+                    mgr.wait()
+                    entry = mgr.restore_latest(self)
+                    if entry is None:
+                        raise
+                    ls = entry.get("loader_state") or {}
+                    epoch = int(ls.get("epoch", 0))
+                    b0 = min(int(ls.get("batch", 0)), num_batches)
+                    log_model.warning(
+                        "anomaly at step %d (%s); rolled back to step %d "
+                        "(epoch %d, batch %d) — recovery %d/%d",
+                        exc.step, exc, entry["step"], epoch, b0,
+                        rollbacks, max_rollbacks)
+                    continue
+                if verbose and mets is not None:
+                    # host sync happens here only (metrics are async)
                     print(f"epoch {epoch}: loss={float(mets['loss']):.6f} "
                           + self.perf.summary_line())
                 if callbacks:
                     for cb in callbacks:
                         cb(self, epoch, self.perf.report())
+                epoch += 1
+                b0 = 0
             if mets is not None:
                 # dependent readback = true completion (block_until_ready
                 # does not wait on some experimental PJRT backends)
                 float(mets["loss"])
         self._host_drain()   # land the last async host scatter, if any
+        if mgr is not None:
+            mgr.wait()        # surface any background-save error
+            mgr.save(self, {"epoch": epochs, "batch": 0})  # final snapshot
         elapsed = time.time() - start
-        num_samples = (num_batches * bs + (rem if rem_ok else 0)) * epochs
         throughput = num_samples / elapsed if elapsed > 0 else float("inf")
         if verbose:
             # same report format intent as reference dlrm.cc:197-198
             print(f"ELAPSED TIME = {elapsed:.4f}s, "
                   f"THROUGHPUT = {throughput:.2f} samples/s")
         return {"elapsed": elapsed, "throughput": throughput,
-                "num_samples": num_samples,
+                "num_samples": num_samples, "rollbacks": rollbacks,
                 "metrics": self.perf.report()}

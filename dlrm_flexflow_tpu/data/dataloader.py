@@ -17,11 +17,39 @@ launches.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Iterator, Optional
+import time
+from typing import Callable, Dict, Iterator, Optional
 
 import numpy as np
 
 import jax
+
+from ..utils import faults
+from ..utils.logging import get_logger
+
+log_data = get_logger("data")
+
+
+def read_with_retries(fn: Callable, site: str, retries: int = 3,
+                      backoff_s: float = 0.05):
+    """Run a read, absorbing up to `retries` transient IOError/OSErrors
+    with exponential backoff — the recovery discipline long preemptible
+    jobs need against NFS hiccups / flaky disks. Each attempt first gives
+    the fault harness (`utils.faults`) a chance to inject an error at
+    `site`, so the retry path is exercised by real tests."""
+    for attempt in range(retries + 1):
+        try:
+            faults.maybe_io_error(site)
+            return fn()
+        except (IOError, OSError) as e:
+            if attempt >= retries:
+                raise
+            delay = backoff_s * (2 ** attempt)
+            log_data.warning(
+                "transient read error at %s (attempt %d/%d): %s — "
+                "retrying in %.0f ms", site, attempt + 1, retries, e,
+                1e3 * delay)
+            time.sleep(delay)
 
 
 class SingleDataLoader:
@@ -90,6 +118,26 @@ class SingleDataLoader:
         self._idx += 1
         return b
 
+    def state(self) -> Dict:
+        """Serializable position (cursor + shuffle order + RNG state) for
+        checkpoint manifests — set_state() on a fresh loader over the same
+        data resumes the exact batch sequence."""
+        self._join()
+        s = self.rng.get_state()
+        return {"idx": int(self._idx),
+                "order": [int(i) for i in self._order],
+                "rng": [s[0], [int(v) for v in s[1]], int(s[2]),
+                        int(s[3]), float(s[4])]}
+
+    def set_state(self, state: Dict) -> None:
+        self._join()
+        self._next = None
+        self._idx = int(state["idx"])
+        self._order = np.asarray(state["order"], dtype=np.int64)
+        r = state["rng"]
+        self.rng.set_state((r[0], np.asarray(r[1], dtype=np.uint32),
+                            int(r[2]), int(r[3]), float(r[4])))
+
     def next_batch(self) -> Dict:
         """Device-resident batch dict (reference next_batch(ff):
         dlrm.cc:486-589). Wraps around at the end of the dataset."""
@@ -146,7 +194,8 @@ class FFBinDataLoader:
 
     def __init__(self, model, path: str, batch_size: Optional[int] = None,
                  shuffle: bool = False, seed: int = 0,
-                 sparse_shape: Optional[tuple] = None):
+                 sparse_shape: Optional[tuple] = None,
+                 io_retries: int = 3, io_backoff_s: float = 0.05):
         from ..native import get_lib
         lib = get_lib()
         if lib is None:
@@ -155,6 +204,8 @@ class FFBinDataLoader:
                 "SingleDataLoader instead")
         self._lib = lib
         self.model = model
+        self.io_retries = io_retries
+        self.io_backoff_s = io_backoff_s
         self.batch_size = batch_size or model.config.batch_size
         self._handle = lib.ffloader_open(
             path.encode(), self.batch_size, 1 if shuffle else 0, seed)
@@ -185,11 +236,16 @@ class FFBinDataLoader:
         sparse = np.empty((self.batch_size, self._sparse_flat),
                           dtype=np.int32)
         label = np.empty(self.batch_size, dtype=np.float32)
-        bi = self._lib.ffloader_next(
-            self._handle,
-            dense.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            sparse.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        # transient IO errors (flaky NFS, injected faults) are absorbed
+        # with exponential backoff instead of killing the training run
+        bi = read_with_retries(
+            lambda: self._lib.ffloader_next(
+                self._handle,
+                dense.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                sparse.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                label.ctypes.data_as(ctypes.POINTER(ctypes.c_float))),
+            "ffbin_read", retries=self.io_retries,
+            backoff_s=self.io_backoff_s)
         if bi < 0:
             raise RuntimeError("native loader stopped")
         return {

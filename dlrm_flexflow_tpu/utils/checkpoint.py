@@ -8,17 +8,47 @@ optimizer state + step counter, saved as a single .npz (portable; arrays are
 gathered to host, so checkpoints are host-memory-bound — for truly sharded
 async multi-host snapshots wire `model.params` into orbax yourself; this
 module deliberately has no orbax dependency).
+
+Fault tolerance (the part long preemptible-pod runs actually need):
+
+- every write is **atomic** — temp file in the target directory, fsync,
+  ``os.replace`` — so a crash mid-save can never corrupt an existing
+  snapshot (only ever leaves a ``*.tmp-<pid>`` orphan, which the manager
+  sweeps);
+- :class:`CheckpointManager` adds **rolling keep-last-K snapshots** with a
+  JSON manifest per directory carrying step, a model/config fingerprint
+  (op graph + param shapes + compute dtype, so fuse/lane-packing mismatches
+  are caught before any shape error), a CRC-32 content checksum, and an
+  opaque ``loader_state`` (``fit()`` stores its epoch/batch position there);
+- saves can run on a **background thread** (`save_async`) so the hot loop
+  never blocks on host file I/O — the device→host gather happens inline
+  (it must, for consistency), the compression+write+rename+manifest update
+  happen off-thread;
+- restore scans the manifest **newest-first and skips corrupt, truncated,
+  missing or foreign-fingerprint snapshots**, so a run killed mid-write
+  resumes from the last valid one.
+
+Fault-injection hooks from `utils.faults` are threaded through the write
+path so tests exercise each branch (abort-mid-save, torn file, kill window).
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Optional
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 import jax
+
+from . import faults
+from .logging import get_logger
+
+log_ckpt = get_logger("checkpoint")
 
 
 def _flatten(tree, prefix=""):
@@ -42,26 +72,100 @@ def _unflatten(flat):
     return tree
 
 
-def save_checkpoint(model, path: str):
-    """Save params + optimizer state + step to `path` (.npz)."""
+def _model_flat(model, copy_host: bool = False) -> Dict[str, np.ndarray]:
+    """Flatten a model's full training state into npz-ready host arrays.
+
+    `copy_host` deep-copies the host-resident tables: a background save
+    thread writes while the training loop keeps scattering into them
+    in-place, so the snapshot must own its bytes (device arrays already
+    do — np.asarray gathers them to fresh host memory)."""
     if hasattr(model, "_host_drain"):
         model._host_drain()   # land any in-flight async host scatter
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    flat = {}
+    flat: Dict[str, np.ndarray] = {}
     flat.update({f"params/{k}": v
                  for k, v in _flatten(model.params).items()})
     flat.update({f"opt/{k}": v
                  for k, v in _flatten(model.opt_state).items()})
     flat.update({f"state/{k}": v
                  for k, v in _flatten(model.op_state).items()})
-    flat.update({f"hostparams/{k}": v
-                 for k, v in _flatten(
-                     getattr(model, "host_params", {}) or {}).items()})
-    flat.update({f"hostopt/{k}": v
-                 for k, v in _flatten(
-                     getattr(model, "host_opt_state", {}) or {}).items()})
+    host = _flatten(getattr(model, "host_params", {}) or {})
+    hostopt = _flatten(getattr(model, "host_opt_state", {}) or {})
+    if copy_host:
+        host = {k: np.array(v) for k, v in host.items()}
+        hostopt = {k: np.array(v) for k, v in hostopt.items()}
+    flat.update({f"hostparams/{k}": v for k, v in host.items()})
+    flat.update({f"hostopt/{k}": v for k, v in hostopt.items()})
     flat["meta/step"] = np.asarray(model._step)
-    np.savez(path, **flat)
+    return flat
+
+
+def _write_npz_atomic(path: str, flat: Dict[str, np.ndarray]) -> int:
+    """Write `flat` to `path` atomically; returns the file's CRC-32.
+
+    Temp file lives in the SAME directory (os.replace must not cross
+    filesystems); fsync before rename so the rename never publishes a
+    file whose bytes are still in flight. A crash at ANY point leaves
+    either the previous file or the complete new one at `path`."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat)
+            f.flush()
+            os.fsync(f.fileno())
+        crc = _file_crc32(tmp)
+        faults.maybe_abort_write(path)   # injected save crash (pre-rename)
+        faults.maybe_delay_write()       # injected kill window
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if faults.maybe_truncate_file(path):   # injected torn write / bit rot
+        pass
+    return crc
+
+
+def _file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
+
+
+def config_fingerprint(model) -> str:
+    """Short digest of everything a checkpoint must agree with the model
+    on: the op graph (names+types), every parameter's shape (embedding
+    lane-packing / fuse options change these), and the compute dtype.
+    Stored per manifest entry; a mismatch means the snapshot was written
+    by a differently-built model and is skipped on resume."""
+    import hashlib
+
+    desc: List[Any] = [str(np.dtype(model.compute_dtype))]
+    desc.append(sorted((op.name, type(op).__name__) for op in model.ops))
+    for attr in ("params", "host_params"):
+        tree = getattr(model, attr, None) or {}
+        desc.append(sorted(
+            (k, tuple(np.asarray(v).shape) if not hasattr(v, "shape")
+             else tuple(v.shape))
+            for k, v in _flatten(tree).items()))
+    blob = json.dumps(desc, sort_keys=True, default=str).encode()
+    return hashlib.sha1(blob).hexdigest()[:12]
+
+
+def save_checkpoint(model, path: str):
+    """Save params + optimizer state + step to `path` (.npz), atomically
+    (temp file + os.replace — a crash mid-save never leaves a corrupt
+    file at the final path)."""
+    if not path.endswith(".npz"):
+        path += ".npz"   # np.savez would have appended it anyway
+    _write_npz_atomic(path, _model_flat(model))
 
 
 def restore_checkpoint(model, path: str):
@@ -104,6 +208,16 @@ def restore_checkpoint(model, path: str):
                         f"options used when the checkpoint was written, "
                         f"or convert via the op's unpack_kernel/"
                         f"pack_kernel helpers.")
+        # the inverse mismatch must be LOUD too: ops present in the model
+        # but absent from the checkpoint keep their current (e.g. freshly
+        # initialized) values — silent partial restores corrupt resumes
+        missing = sorted(set(model.params) - set(params))
+        if missing:
+            log_ckpt.warning(
+                "checkpoint %s has no parameters for %d model op(s) %s — "
+                "these keep their CURRENT in-memory values (checkpoint "
+                "written by a smaller/different graph?)",
+                path, len(missing), missing)
     # re-shard parameters per compile-time shardings
     for opname, pdict in params.items():
         shards = model._param_sharding.get(opname, {})
@@ -120,7 +234,219 @@ def restore_checkpoint(model, path: str):
     if hostopt_flat:
         model.host_opt_state = _unflatten(hostopt_flat)
     model._step = int(data["meta/step"])
+    # the jitted step threads a device-resident step counter and metric
+    # sums; drop them so the next step re-seeds from the restored _step
+    # (a rollback that re-winds _step would otherwise keep training from
+    # the stale device counter)
+    model._step_dev = None
+    model._msums = None
     return model
+
+
+# ---------------------------------------------------------------------
+# rolling checkpoints
+# ---------------------------------------------------------------------
+class CheckpointManager:
+    """Atomic rolling checkpoints in a directory, with manifest + resume.
+
+    Layout::
+
+        <dir>/ckpt-00000042.npz     keep-last-K snapshot files
+        <dir>/manifest.json         entries newest-last (atomic writes)
+
+    `save`/`save_async` snapshot the model (device→host gather inline,
+    host tables deep-copied), then write + rename + update the manifest —
+    on a background thread for `save_async`, so training never blocks on
+    file I/O. `restore_latest` walks entries newest-first and restores the
+    first one whose file exists, passes its CRC-32, and matches the
+    model's fingerprint — a run SIGKILLed mid-write (or a torn file
+    injected by `utils.faults`) falls back to the previous snapshot.
+    """
+
+    MANIFEST = "manifest.json"
+
+    def __init__(self, directory: str, keep_last: int = 3):
+        if keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+        self.directory = os.path.abspath(directory)
+        self.keep_last = keep_last
+        os.makedirs(self.directory, exist_ok=True)
+        self._thread: Optional[threading.Thread] = None
+        self._thread_exc: Optional[BaseException] = None
+        self._manifest_lock = threading.Lock()
+        self._sweep_orphan_tmps()
+
+    # --- manifest ------------------------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.directory, self.MANIFEST)
+
+    def _read_manifest(self) -> Dict[str, Any]:
+        try:
+            with open(self._manifest_path()) as f:
+                m = json.load(f)
+            if isinstance(m, dict) and isinstance(m.get("entries"), list):
+                return m
+        except FileNotFoundError:
+            pass
+        except (json.JSONDecodeError, OSError) as e:
+            # a torn manifest must not kill resume: fall back to empty
+            # (snapshot FILES stay on disk for manual recovery via
+            # restore_checkpoint)
+            log_ckpt.warning("unreadable manifest %s (%s); treating as "
+                             "empty", self._manifest_path(), e)
+        return {"version": 1, "entries": []}
+
+    def _write_manifest(self, manifest: Dict[str, Any]) -> None:
+        path = self._manifest_path()
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _sweep_orphan_tmps(self) -> None:
+        for name in os.listdir(self.directory):
+            if ".tmp-" in name:
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                    log_ckpt.info("removed orphan temp file %s (crashed "
+                                  "writer)", name)
+                except OSError:
+                    pass
+
+    # --- save ----------------------------------------------------------
+    def save(self, model, loader_state: Optional[Dict[str, Any]] = None):
+        """Blocking snapshot of the model's current state."""
+        self.wait()
+        step = int(model._step)
+        flat = _model_flat(model, copy_host=True)
+        self._write_snapshot(flat, step, config_fingerprint(model),
+                             dict(loader_state or {}))
+
+    def save_async(self, model,
+                   loader_state: Optional[Dict[str, Any]] = None):
+        """Snapshot now (device→host gather inline, for consistency),
+        write on a background thread. Joins any previous in-flight save
+        first — at most one writer; its errors re-raise here or at
+        wait()."""
+        self.wait()
+        step = int(model._step)
+        flat = _model_flat(model, copy_host=True)
+        fp = config_fingerprint(model)
+        state = dict(loader_state or {})
+
+        def work():
+            try:
+                self._write_snapshot(flat, step, fp, state)
+            except BaseException as e:   # surfaced at wait()/next save
+                self._thread_exc = e
+
+        self._thread = threading.Thread(target=work, daemon=True,
+                                        name="ckpt-writer")
+        self._thread.start()
+
+    def wait(self) -> None:
+        """Join the in-flight async save and re-raise its error, if any."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        exc = self._thread_exc
+        if exc is not None:
+            self._thread_exc = None
+            raise exc
+
+    def _write_snapshot(self, flat, step: int, fingerprint: str,
+                        loader_state: Dict[str, Any]) -> None:
+        fname = f"ckpt-{step:08d}.npz"
+        path = os.path.join(self.directory, fname)
+        t0 = time.time()
+        crc = _write_npz_atomic(path, flat)
+        entry = {"file": fname, "step": step, "crc32": crc,
+                 "fingerprint": fingerprint, "time": time.time(),
+                 "loader_state": loader_state}
+        with self._manifest_lock:
+            manifest = self._read_manifest()
+            manifest["entries"] = [e for e in manifest["entries"]
+                                   if e.get("file") != fname] + [entry]
+            self._gc(manifest)
+            self._write_manifest(manifest)
+        log_ckpt.info("saved checkpoint %s (step %d, %.0f ms)",
+                      fname, step, 1e3 * (time.time() - t0))
+
+    def _gc(self, manifest: Dict[str, Any]) -> None:
+        """Keep the newest `keep_last` entries; delete the rest's files.
+        Called under the manifest lock, BEFORE the manifest write — a
+        crash between unlink and manifest write only loses already-
+        superseded snapshots (the entry scan skips missing files)."""
+        entries = manifest["entries"]
+        entries.sort(key=lambda e: e.get("step", -1))
+        drop, keep = entries[:-self.keep_last], entries[-self.keep_last:]
+        for e in drop:
+            try:
+                os.unlink(os.path.join(self.directory, e["file"]))
+            except OSError:
+                pass
+        manifest["entries"] = keep
+
+    # --- restore -------------------------------------------------------
+    def entries(self) -> List[Dict[str, Any]]:
+        with self._manifest_lock:
+            return list(self._read_manifest()["entries"])
+
+    def _entry_valid(self, entry: Dict[str, Any],
+                     fingerprint: Optional[str]) -> bool:
+        path = os.path.join(self.directory, entry.get("file", ""))
+        if not os.path.isfile(path):
+            log_ckpt.warning("checkpoint %s listed in manifest but "
+                             "missing on disk; skipping", entry.get("file"))
+            return False
+        if (fingerprint is not None
+                and entry.get("fingerprint") not in (None, fingerprint)):
+            log_ckpt.warning(
+                "checkpoint %s was written by a differently-built model "
+                "(fingerprint %s != %s); skipping", entry["file"],
+                entry.get("fingerprint"), fingerprint)
+            return False
+        crc = entry.get("crc32")
+        if crc is not None and _file_crc32(path) != crc:
+            log_ckpt.warning("checkpoint %s fails its checksum (torn "
+                             "write / corruption); skipping", entry["file"])
+            return False
+        return True
+
+    def latest_valid(self, fingerprint: Optional[str] = None
+                     ) -> Optional[Dict[str, Any]]:
+        """Newest manifest entry that exists, checksums clean, and (when
+        given) matches `fingerprint`; None when no snapshot survives."""
+        for entry in reversed(self.entries()):
+            if self._entry_valid(entry, fingerprint):
+                return entry
+        return None
+
+    def restore_latest(self, model) -> Optional[Dict[str, Any]]:
+        """Restore the newest valid snapshot into `model`; returns its
+        manifest entry (step, loader_state, ...) or None when the
+        directory holds nothing restorable."""
+        fp = config_fingerprint(model)
+        for entry in reversed(self.entries()):
+            if not self._entry_valid(entry, fp):
+                continue
+            path = os.path.join(self.directory, entry["file"])
+            try:
+                restore_checkpoint(model, path)
+            except (ValueError, KeyError, OSError, zlib.error) as e:
+                # checksum passed but the content disagrees with this
+                # model (or the zip is unreadable) — keep walking back
+                log_ckpt.warning("checkpoint %s did not restore (%s); "
+                                 "trying an older snapshot",
+                                 entry["file"], e)
+                continue
+            log_ckpt.info("resumed from %s (step %d)", entry["file"],
+                          entry["step"])
+            return entry
+        return None
 
 
 def get_weights(model, op_name: str):

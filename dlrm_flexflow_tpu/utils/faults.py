@@ -1,0 +1,243 @@
+"""Deterministic fault injection for resilience testing.
+
+The reference has no fault tolerance to inherit (FlexFlow persists only
+strategy files; a preempted Legion run restarts from scratch), so the
+recovery paths built on top of it here — atomic rolling checkpoints,
+auto-resume, the anomaly sentinel, dataloader read retries — need a way to
+be EXERCISED, not just written. This module injects failures at fixed,
+reproducible points so every recovery branch runs under test:
+
+- **NaN gradients** (`nan_grad_steps`): poison the batch fed to the jitted
+  train step at chosen global step indices, driving the loss/grad-norm
+  non-finite through the real autodiff path (not a mocked flag), which the
+  anomaly sentinel in ``FFModel.train_batch_device`` must then catch.
+- **Checkpoint truncation** (`truncate_checkpoints`): truncate the next N
+  checkpoint files right after their atomic rename — simulating torn disk
+  writes / bit rot — so ``CheckpointManager.latest_valid`` must fall back
+  to the previous snapshot via the manifest checksum.
+- **Write aborts** (`abort_writes`): raise mid-save between the temp-file
+  write and the ``os.replace``, proving a crashed save never corrupts the
+  final path.
+- **Write delays** (`write_delay_s`): stretch the window between temp
+  write and rename so a kill-mid-checkpoint test can SIGKILL inside it
+  deterministically.
+- **Transient IO errors** (`io_errors`): raise ``IOError`` from dataloader
+  reads for the first N attempts at a named site, exercised against the
+  retry/backoff in ``FFBinDataLoader``.
+
+Faults are consume-once: each injection decrements its budget, so a
+recovery path that retries the same step does not re-fault (rollback would
+otherwise loop forever). Activate programmatically::
+
+    from dlrm_flexflow_tpu.utils import faults
+    with faults.active_plan(faults.FaultPlan(nan_grad_steps={5})):
+        model.fit(...)
+
+or from the environment (read once, at the first hook call — the hooks a
+subprocess kill-test needs):
+
+- ``FF_FAULT_NAN_STEPS=3,7``       NaN gradients at global steps 3 and 7
+- ``FF_FAULT_TRUNCATE_CKPTS=1``    truncate the next 1 checkpoint file
+- ``FF_FAULT_ABORT_WRITES=1``      abort the next 1 checkpoint save
+- ``FF_FAULT_WRITE_DELAY=0.5``     sleep 0.5s between temp write and rename
+- ``FF_FAULT_IO_ERRORS=ffbin_read:2``  2 transient IOErrors at that site
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .logging import get_logger
+
+log_faults = get_logger("faults")
+
+
+@dataclass
+class FaultPlan:
+    """A deterministic schedule of failures. All budgets are consume-once
+    and protected by a lock (checkpoint writes happen on a background
+    thread)."""
+
+    # global step indices at which the train batch is poisoned to NaN
+    nan_grad_steps: Set[int] = field(default_factory=set)
+    # number of future checkpoint files to truncate after their rename
+    truncate_checkpoints: int = 0
+    # bytes to leave when truncating (small enough to corrupt the zip)
+    truncate_bytes: int = 64
+    # number of future checkpoint saves to abort before the rename
+    abort_writes: int = 0
+    # seconds to sleep between temp-file write and rename (kill window)
+    write_delay_s: float = 0.0
+    # site name -> number of transient IOErrors to raise there
+    io_errors: Dict[str, int] = field(default_factory=dict)
+    # record of (hook, detail) actually fired, for test assertions
+    fired: List[tuple] = field(default_factory=list)
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+
+    def _record(self, hook: str, detail) -> None:
+        self.fired.append((hook, detail))
+        log_faults.warning("injected fault %s (%s)", hook, detail)
+
+
+_ACTIVE: Optional[FaultPlan] = None
+_ENV_CHECKED = False
+
+
+def plan_from_env() -> Optional[FaultPlan]:
+    """Build a plan from FF_FAULT_* env vars; None when none are set."""
+    nan = os.environ.get("FF_FAULT_NAN_STEPS", "")
+    trunc = os.environ.get("FF_FAULT_TRUNCATE_CKPTS", "")
+    aborts = os.environ.get("FF_FAULT_ABORT_WRITES", "")
+    delay = os.environ.get("FF_FAULT_WRITE_DELAY", "")
+    ioerrs = os.environ.get("FF_FAULT_IO_ERRORS", "")
+    if not any((nan, trunc, aborts, delay, ioerrs)):
+        return None
+    plan = FaultPlan()
+    if nan:
+        plan.nan_grad_steps = {int(s) for s in nan.split(",") if s.strip()}
+    if trunc:
+        plan.truncate_checkpoints = int(trunc)
+    if aborts:
+        plan.abort_writes = int(aborts)
+    if delay:
+        plan.write_delay_s = float(delay)
+    for part in ioerrs.split(","):
+        if ":" in part:
+            site, n = part.rsplit(":", 1)
+            plan.io_errors[site.strip()] = int(n)
+    return plan
+
+
+def install(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Set (or clear, with None) the process-wide active plan."""
+    global _ACTIVE, _ENV_CHECKED
+    _ACTIVE = plan
+    _ENV_CHECKED = True   # an explicit install overrides env discovery
+    return plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> Optional[FaultPlan]:
+    """The active plan; lazily adopts FF_FAULT_* env vars once."""
+    global _ACTIVE, _ENV_CHECKED
+    if _ACTIVE is None and not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        _ACTIVE = plan_from_env()
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def active_plan(plan: FaultPlan):
+    """Scoped installation for tests."""
+    global _ACTIVE, _ENV_CHECKED
+    prev, prev_checked = _ACTIVE, _ENV_CHECKED
+    install(plan)
+    try:
+        yield plan
+    finally:
+        _ACTIVE, _ENV_CHECKED = prev, prev_checked
+
+
+# ---------------------------------------------------------------------
+# hooks (called from the training/checkpoint/data layers; all are no-ops
+# when no plan is active)
+# ---------------------------------------------------------------------
+def take_nan_grad(step: int) -> bool:
+    """True exactly once for each scheduled NaN-gradient step."""
+    plan = active()
+    if plan is None:
+        return False
+    with plan._lock:
+        if step in plan.nan_grad_steps:
+            plan.nan_grad_steps.discard(step)
+            plan._record("nan_grad", step)
+            return True
+    return False
+
+
+def maybe_abort_write(path: str) -> None:
+    """Raise IOError before the atomic rename (simulated save crash)."""
+    plan = active()
+    if plan is None:
+        return
+    with plan._lock:
+        if plan.abort_writes > 0:
+            plan.abort_writes -= 1
+            plan._record("abort_write", path)
+            raise IOError(f"injected checkpoint write abort: {path}")
+
+
+def maybe_delay_write() -> None:
+    """Sleep inside the temp-write→rename window (kill-test window)."""
+    plan = active()
+    if plan is not None and plan.write_delay_s > 0:
+        time.sleep(plan.write_delay_s)
+
+
+def maybe_truncate_file(path: str) -> bool:
+    """Truncate a just-written checkpoint file (simulated torn write)."""
+    plan = active()
+    if plan is None:
+        return False
+    with plan._lock:
+        if plan.truncate_checkpoints <= 0:
+            return False
+        plan.truncate_checkpoints -= 1
+        plan._record("truncate", path)
+    with open(path, "r+b") as f:
+        f.truncate(plan.truncate_bytes)
+    return True
+
+
+def maybe_io_error(site: str) -> None:
+    """Raise a transient IOError at a named read site while its budget
+    lasts (the dataloader retry loop must absorb these)."""
+    plan = active()
+    if plan is None:
+        return
+    with plan._lock:
+        left = plan.io_errors.get(site, 0)
+        if left > 0:
+            plan.io_errors[site] = left - 1
+            plan._record("io_error", site)
+            raise IOError(f"injected transient IO error at {site!r} "
+                          f"({left - 1} left)")
+
+
+def poison_batch(device_batch: dict) -> dict:
+    """Return a copy of a staged batch with its float label (or, when the
+    label is integer, the first float input) replaced by NaNs — same
+    shapes/dtypes/shardings, so the cached step executable still applies
+    and the NaN flows through the real autodiff."""
+    import jax
+    import numpy as np
+
+    out = dict(device_batch)
+    target = None
+    lab = out.get("label")
+    if lab is not None and np.issubdtype(np.dtype(lab.dtype), np.floating):
+        target = "label"
+    else:
+        for k, v in out.items():
+            if k != "label" and np.issubdtype(np.dtype(v.dtype),
+                                              np.floating):
+                target = k
+                break
+    if target is None:
+        raise ValueError("no float tensor in batch to poison with NaNs")
+    v = out[target]
+    nan = np.full(v.shape, np.nan, dtype=np.dtype(v.dtype))
+    sharding = getattr(v, "sharding", None)
+    out[target] = (jax.device_put(nan, sharding)
+                   if sharding is not None else nan)
+    return out

@@ -1,0 +1,51 @@
+"""Subprocess target for the SIGKILL-mid-checkpoint resilience test.
+
+Trains a tiny MLP forever with rolling checkpoints every few steps; the
+parent test sets FF_FAULT_WRITE_DELAY to stretch the temp-write→rename
+window and SIGKILLs this process while a checkpoint write is in flight.
+The parent then asserts that resume lands on the last VALID snapshot.
+
+Run directly (never under pytest): python _resilience_worker.py <ckpt_dir>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlrm_flexflow_tpu.utils.testing import ensure_cpu_devices  # noqa: E402
+
+ensure_cpu_devices(2)
+
+import numpy as np  # noqa: E402
+
+import dlrm_flexflow_tpu as ff  # noqa: E402
+
+BATCH = 8
+SAVE_EVERY = 4
+
+
+def build_model():
+    m = ff.FFModel(ff.FFConfig(batch_size=BATCH, seed=3))
+    x = m.create_tensor((BATCH, 4), name="x")
+    h = m.dense(x, 8, activation="relu", name="fc1")
+    m.dense(h, 1, name="fc2")
+    m.compile(ff.SGDOptimizer(0.1, momentum=0.9), "mean_squared_error",
+              ["mse"])
+    m.init_layers()
+    return m
+
+
+def dataset():
+    r = np.random.RandomState(0)
+    return ({"x": r.rand(64, 4).astype(np.float32)},
+            r.rand(64, 1).astype(np.float32))
+
+
+if __name__ == "__main__":
+    ckdir = sys.argv[1]
+    xs, ys = dataset()
+    model = build_model()
+    # effectively-endless run; the parent kills us mid-checkpoint
+    model.fit(xs, ys, epochs=100000, verbose=False,
+              checkpoint_dir=ckdir, save_every=SAVE_EVERY)
