@@ -175,6 +175,19 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             resilience = {"error": str(exc)[:200]}
 
+    # opt-in input-pipeline smoke (BENCH_PIPELINE=1): staged vs streamed
+    # vs prefetched steps/s + staging overlap fraction + host-table
+    # double-buffering speedup
+    pipeline = None
+    if os.environ.get("BENCH_PIPELINE"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_pipeline import measure as _pipe_measure
+            pipeline = _pipe_measure(steps=30)
+        except Exception as exc:
+            pipeline = {"error": str(exc)[:200]}
+
     vs = 1.0
     base_file = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE")
     if os.path.exists(base_file):
@@ -196,6 +209,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
     }
     if resilience is not None:
         out["resilience"] = resilience
+    if pipeline is not None:
+        out["pipeline"] = pipeline
     print(json.dumps(out))
     return 0
 

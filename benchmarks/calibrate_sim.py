@@ -214,6 +214,7 @@ def main():
         # recorded one (a changed workload definition, kernel lowering,
         # or cost-model constant shifts it) — otherwise an obsolete fast
         # number could mask a real regression forever
+        measured_latest = measured
         if os.environ.get("CAL_KEEP_BEST"):
             prev = next((r for r in rows if r["point"] == name), None)
             if prev is not None and abs(
@@ -225,7 +226,13 @@ def main():
         sim_meas = Simulator(model, cost_model=cm).simulate(strat, 1)
         row = {
             "point": name,
+            # measured_ms: the number calibration consumes (CAL_KEEP_BEST
+            # may substitute the historical minimum); measured_ms_latest +
+            # kept_best make the artifact distinguish a fresh measurement
+            # from a kept minimum
             "measured_ms": measured * 1e3,
+            "measured_ms_latest": measured_latest * 1e3,
+            "kept_best": measured < measured_latest,
             "sim_roofline_ms": sim_roof * 1e3,
             "sim_measured_ms": sim_meas * 1e3,
             "err_roofline": sim_roof / measured - 1.0,

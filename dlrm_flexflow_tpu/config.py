@@ -66,14 +66,31 @@ class FFConfig:
     # tables larger than HBM train on one chip. Per-op form: strategy
     # memory_types ZCM. Enable with --host-tables.
     host_resident_tables: bool = False
-    # pipeline the host-table work: the previous step's cotangent
-    # readback + host scatter run on a worker thread, overlapping the
-    # next step's host gather + H2D + device dispatch. The racing gather
-    # sees the table atomically before or after the in-flight scatter
-    # (never torn — a model-level lock serializes table access on every
-    # path), i.e. bounded one-step staleness instead of exact ordering.
-    # Enable with --host-tables-async.
-    host_tables_async: bool = False
+    # pipeline the host-table work (double-buffering, ON by default): the
+    # previous step's cotangent readback + host scatter run on a worker
+    # thread, overlapping the next step's gather + H2D + device dispatch.
+    # When the input pipeline knows the next batch (fit's streaming
+    # prefetch does), the worker gathers the NEXT step's rows BEFORE its
+    # scatter, so the next dispatch never waits on the scatter. Either
+    # way the contract is bounded ONE-step staleness: step N+1's forward
+    # sees all updates through step N-1, maybe N (deterministically
+    # through N-1 under the prefetch chaining); the racing gather sees
+    # the table atomically before or after the in-flight scatter (never
+    # torn — a model-level lock serializes table access on every path).
+    # For bit-exact ordering (each gather sees every prior update),
+    # disable with --no-host-tables-async.
+    host_tables_async: bool = True
+    # input-pipeline lookahead: how many batches the background staging
+    # thread may slice + device_put (and host-gather) ahead of the device
+    # (data/prefetch.py ring depth). 0 stages synchronously in the hot
+    # loop. Set with --prefetch-depth N / --no-prefetch.
+    prefetch_depth: int = 2
+    # fit(): whether to pre-stage the WHOLE dataset on device when it fits
+    # the HBM budget ("auto"), always ("always" — trusts the caller on
+    # capacity), or never ("never" — forces the streaming/prefetch path;
+    # what bench_pipeline uses to compare paths). Set with
+    # --stage-dataset {auto,always,never}.
+    stage_dataset: str = "auto"
     # run the conv stack (Conv2D/Pool2D/BatchNorm) in NHWC internally —
     # the TPU-native layout (the NCHW API shape is the cuDNN-native
     # choice, reference conv_2d.cu); disable with --no-nhwc
@@ -210,6 +227,18 @@ class FFConfig:
                 cfg.host_resident_tables = True
             elif a == "--host-tables-async":
                 cfg.host_tables_async = True
+            elif a == "--no-host-tables-async":
+                cfg.host_tables_async = False
+            elif a == "--prefetch-depth":
+                cfg.prefetch_depth = int(take())
+            elif a == "--no-prefetch":
+                cfg.prefetch_depth = 0
+            elif a == "--stage-dataset":
+                v = take()
+                if v not in ("auto", "always", "never"):
+                    raise ValueError(f"--stage-dataset expects "
+                                     f"auto|always|never, got {v!r}")
+                cfg.stage_dataset = v
             else:
                 cfg.unparsed.append(a)
             i += 1

@@ -25,7 +25,8 @@ compile-once/execute-many.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
+                    Sequence)
 
 import jax
 import jax.numpy as jnp
@@ -61,6 +62,16 @@ class AnomalyError(RuntimeError):
         self.step = step
         self.loss = loss
         self.grad_norm = grad_norm
+
+
+class StagedStep(NamedTuple):
+    """One fully-staged train-step input (`FFModel._stage_step`): the
+    device-put batch (host-only inputs already popped) plus the numpy
+    indices for host-resident tables (None when there are none). The
+    prefetch pipeline stages these ahead of the hot loop."""
+
+    device_batch: Dict[str, Any]
+    host_idx: Optional[Dict[str, Any]]
 
 
 class FFModel:
@@ -1163,6 +1174,7 @@ class FFModel:
     def _device_batch(self, batch: Dict[str, np.ndarray],
                       with_label: bool = True) -> Dict[str, Any]:
         out = {}
+        puts: Dict[str, tuple] = {}   # name -> (host array, sharding)
         host_only = getattr(self, "_host_only_inputs", set())
         for t in self.input_tensors:
             if t.name in batch:
@@ -1171,8 +1183,8 @@ class FFModel:
                     # (no H2D; the wrapper reads it for the host gather)
                     out[t.name] = np.asarray(batch[t.name])
                 else:
-                    out[t.name] = self._stage_input(
-                        batch[t.name], self._out_sharding[t.guid])
+                    puts[t.name] = (batch[t.name],
+                                    self._out_sharding[t.guid])
         if with_label:
             lab = batch["label"]
             sh = self._label_sharding
@@ -1183,7 +1195,20 @@ class FFModel:
                                 for a in self.mesh.axis_names]))
             if lab.shape[0] % ndev != 0:
                 sh = NamedSharding(self.mesh, PartitionSpec())
-            out["label"] = self._stage_input(lab, sh)
+            puts["label"] = (lab, sh)
+        if jax.process_count() > 1:
+            for k, (v, sh) in puts.items():
+                out[k] = self._stage_input(v, sh)
+        elif puts:
+            # ONE batched device_put for the whole step input: the
+            # per-call dispatch overhead (not the bytes) dominates small
+            # H2D puts, and the hot loop pays it every step — batching
+            # the puts measured ~1.6x faster staging on the DLRM input
+            # dict (dense+sparse+label)
+            names = list(puts)
+            vals = jax.device_put([puts[k][0] for k in names],
+                                  [puts[k][1] for k in names])
+            out.update(zip(names, vals))
         return out
 
     def train_batch(self, batch: Dict[str, np.ndarray]):
@@ -1259,20 +1284,45 @@ class FFModel:
             (k, v.shape, _dname(v.dtype), _shs(v))
             for k, v in device_batch.items()))
 
-    def train_batch_device(self, device_batch: Dict):
+    def _stage_step(self, batch: Dict[str, np.ndarray],
+                    with_label: bool = True) -> "StagedStep":
+        """Fully stage one host batch for the jitted step: H2D put against
+        the input shardings + the host-index split. Everything here is
+        thread-safe jax/numpy, so the prefetch pipeline's staging thread
+        runs it for step N+1 while step N executes (data/prefetch.py)."""
+        db = self._device_batch(batch, with_label=with_label)
+        db, host_idx = self._split_host_idx(db)
+        return StagedStep(db, host_idx)
+
+    def train_batch_device(self, device_batch: Dict, next_host_idx=None):
         """train_batch for a batch already staged on device (skips the
         host->device put; used by benchmark loops that pre-stage)."""
+        device_batch, host_idx = self._split_host_idx(device_batch)
+        return self._train_dispatch(device_batch, host_idx, next_host_idx)
+
+    def train_batch_staged(self, staged: "StagedStep", next_host_idx=None):
+        """train step for a StagedStep from `_stage_step` (the prefetch
+        pipeline's item type). `next_host_idx` — the NEXT staged batch's
+        host-table indices (or a zero-arg callable returning them, eval'd
+        at scatter-launch time) — lets the async host-table worker stage
+        the gather for step N+1 while step N executes on device (gather
+        first, then this step's scatter: deterministic one-step
+        staleness, see FFConfig.host_tables_async)."""
+        return self._train_dispatch(staged.device_batch, staged.host_idx,
+                                    next_host_idx)
+
+    def _train_dispatch(self, device_batch: Dict, host_idx,
+                        next_host_idx=None):
         self._ensure_step_state()
         if faults.active() is not None and faults.take_nan_grad(self._step):
             # fault harness: poison the batch so NaNs flow through the
             # REAL autodiff into the loss/grad-norm the sentinel watches
             # (same shapes/dtypes/shardings — the cached executable holds)
             device_batch = faults.poison_batch(device_batch)
-        device_batch, host_idx = self._split_host_idx(device_batch)
         args = (self.params, self.opt_state, self.op_state, self._msums,
                 device_batch, self._step_dev)
         if host_idx is not None:
-            args = args + (self._host_emb_forward(host_idx),)
+            args = args + (self._host_emb_input(host_idx),)
         hres = host_idx is not None
         # hot loop: call the AOT-compiled executable directly — the pjit
         # python dispatch re-validates the big param pytree every call,
@@ -1306,22 +1356,42 @@ class FFModel:
         # be undone by skip_step's on-device suppression
         anomaly_flag = mets.get("anomaly") if policy != "none" else None
         if hres:
-            if getattr(self.config, "host_tables_async", False):
-                # pipelined: the cotangent readback + host scatter run on
-                # a worker thread, overlapping the NEXT step's host
-                # gather/H2D/dispatch (double-buffering; table reads and
-                # writes serialize on _host_table_lock, so the racing
-                # gather sees the table atomically before or after the
-                # scatter — bounded one-step staleness, never torn rows).
-                # Only one scatter in flight: join the previous first.
+            if getattr(self.config, "host_tables_async", True):
+                # pipelined (double-buffering): the cotangent readback +
+                # host scatter run on a worker thread, overlapping the
+                # NEXT step's gather/H2D/dispatch and device execution.
+                # When the caller knows the next batch (`next_host_idx` —
+                # fit's streaming prefetch does), the worker gathers the
+                # NEXT step's rows FIRST (they are ready almost
+                # immediately, so the next dispatch never waits on the
+                # scatter), then scatters this step's update — the
+                # documented bounded ONE-step staleness, made
+                # deterministic: the next step always sees updates
+                # through step N-1. Table reads and writes serialize on
+                # _host_table_lock, so any racing reader sees the table
+                # atomically before or after the scatter — never torn
+                # rows. Only one worker in flight: join the previous
+                # first.
                 self._host_drain()
                 import threading
                 cts = mets.pop("_host_cts")
                 step = self._step - 1   # capture NOW: the thread may run
                 # after the next call's increment
+                nh = (next_host_idx() if callable(next_host_idx)
+                      else next_host_idx)
+                gathered = threading.Event()
+                self._host_gather_pending = ((nh, gathered)
+                                             if nh is not None else None)
 
                 def scatter():
                     try:
+                        try:
+                            if nh is not None:
+                                self._host_gather_next = (
+                                    nh, self._host_emb_forward(nh))
+                        finally:
+                            gathered.set()   # never leave a consumer
+                            # parked on the event
                         if (anomaly_flag is None
                                 or not bool(np.asarray(anomaly_flag))):
                             self._host_emb_update(host_idx, cts, step)
@@ -1378,6 +1448,37 @@ class FFModel:
         if exc is not None:
             self._host_scatter_exc = None
             raise exc
+
+    def _host_prefetch_invalidate(self):
+        """Drop a chained host-table gather (it is stale after anything
+        that replaces the tables underneath it — checkpoint restore,
+        rollback)."""
+        self._host_gather_next = None
+        self._host_gather_pending = None
+
+    def _host_emb_input(self, host_idx):
+        """Forward rows for the host-resident tables feeding the jitted
+        step. Under the async pipeline the previous step's worker gathers
+        these rows FIRST (before its scatter — the bounded one-step
+        staleness the async mode documents), so by the time this step
+        dispatches, the rows are usually staged; the consumer waits only
+        on the gather event, never on the scatter, keeping the scatter
+        overlapped with this step's device execution. Without a chained
+        gather: inline gather (exact when async is off — there is no
+        worker; bounded one-step staleness when async is on and a scatter
+        is in flight — the table lock makes it atomic either-order)."""
+        pending = getattr(self, "_host_gather_pending", None)
+        if pending is not None and pending[0] is host_idx:
+            self._host_gather_pending = None
+            pending[1].wait()
+            got = getattr(self, "_host_gather_next", None)
+            self._host_gather_next = None
+            if got is not None and got[0] is host_idx:
+                return got[1]
+            # the worker died before gathering — surface its error here
+            # (the step boundary), then fall through to the inline path
+            self._host_drain()
+        return self._host_emb_forward(host_idx)
 
     def _host_emb_forward(self, host_idx):
         """Host-side gather for host-resident tables: numpy lookup on the
@@ -1657,6 +1758,14 @@ class FFModel:
             budget = 2e9
         staged = None
         staged_rem = None
+        # --stage-dataset: "never" forces the streaming/prefetch path
+        # (bench_pipeline compares the two); "always" trusts the caller
+        # on capacity
+        stage_mode = getattr(self.config, "stage_dataset", "auto")
+        if stage_mode == "never":
+            staging_cost = float("inf")
+        elif stage_mode == "always":
+            staging_cost = 0.0
         if staging_cost <= budget:
             staged = []
             for b in range(num_batches):
@@ -1700,7 +1809,116 @@ class FFModel:
                 mgr.save_async(self, {"epoch": next_epoch,
                                       "batch": next_batch})
 
-        with TraceContext(self.config.profile_dir or None):
+        # --- streaming prefetch pipeline ------------------------------
+        # When the dataset is NOT pre-staged, a background staging thread
+        # slices + device_puts (and host-index-splits) up to
+        # `prefetch_depth` future batches while the device trains the
+        # current one (data/prefetch.py) — the reference's DataLoader
+        # tasks staging batch N+1 under batch N's compute. With async
+        # host-resident tables, the scatter worker additionally chains
+        # the NEXT step's host gather using the staged item's indices.
+        # The pipeline drains (and re-stages, deterministically) around
+        # rollback and remainder-shape failures.
+        depth = max(int(getattr(self.config, "prefetch_depth", 2) or 0), 0)
+        use_pipe = staged is None and depth > 0
+        pipe = None
+        nxt = None          # staged item fetched ahead by the peek hook
+        pipe_exc: List[BaseException] = []
+
+        def _host_slice(e, b):
+            if b == "rem":
+                sl = slice(num_batches * bs, n)
+            else:
+                sl = slice(b * bs, (b + 1) * bs)
+            batch = {k: v[sl] for k, v in inputs.items()}
+            batch["label"] = labels[sl]
+            return batch
+
+        def _close_pipe():
+            nonlocal pipe, nxt
+            if pipe is not None:
+                pipe.close()
+                pipe = None
+            nxt = None
+            pipe_exc.clear()
+            self._host_prefetch_invalidate()
+
+        def _build_pipe(e0, b0_):
+            nonlocal pipe
+            _close_pipe()
+            sched = []
+            for e in range(e0, epochs):
+                for b in range(b0_ if e == e0 else 0, num_batches):
+                    sched.append((e, b))
+                if rem_ok:
+                    sched.append((e, "rem"))
+            if not sched:
+                return
+            from ..data.prefetch import PrefetchPipeline
+
+            def produce(k):
+                e, b = sched[k]
+                return self._stage_step(_host_slice(e, b))
+
+            pipe = PrefetchPipeline(produce, depth=depth,
+                                    num_items=len(sched), name="fit")
+
+        hres_async = bool(getattr(self, "_host_resident_list", None)
+                          and getattr(self.config, "host_tables_async",
+                                      True))
+
+        def _peek_next_host_idx():
+            # runs inside the train step at scatter-launch time (the
+            # device already executes this step): fetch the NEXT staged
+            # item so the async worker can chain its host gather after
+            # this step's scatter. A staging error here must not skip
+            # this step's scatter — defer it to the next consume.
+            nonlocal nxt
+            try:
+                nxt = pipe.get()
+                return nxt.host_idx
+            except IndexError:        # end of schedule
+                return None
+            except BaseException as e:
+                pipe_exc.append(e)
+                return None
+
+        def _next_staged():
+            nonlocal nxt
+            if pipe_exc:
+                raise pipe_exc.pop()
+            if nxt is not None:
+                cur, nxt = nxt, None
+                return cur
+            return pipe.get()
+
+        def _train_streamed():
+            m = self.train_batch_staged(
+                _next_staged(),
+                next_host_idx=_peek_next_host_idx if hres_async else None)
+            # same in-flight bound as the pre-staged path: the producer
+            # keeps the dispatch queue fed, so the throttle is what
+            # keeps XLA-CPU collectives from starving
+            inflight.append(m["loss"])
+            if len(inflight) > throttle:
+                jax.block_until_ready(inflight.popleft())
+            return m
+
+        if use_pipe:
+            _build_pipe(start_epoch, start_batch)
+
+        import contextlib
+
+        @contextlib.contextmanager
+        def _pipe_guard():
+            # the staging thread must not outlive fit() on ANY exit path
+            # (an AnomalyError under policy "raise" included)
+            try:
+                yield
+            finally:
+                _close_pipe()
+
+        with TraceContext(self.config.profile_dir or None), _pipe_guard():
             epoch, b0 = start_epoch, start_batch
             while epoch < epochs:
                 if b0 == 0:
@@ -1714,6 +1932,8 @@ class FFModel:
                             inflight.append(mets["loss"])
                             if len(inflight) > throttle:
                                 jax.block_until_ready(inflight.popleft())
+                        elif pipe is not None:
+                            mets = _train_streamed()
                         else:
                             sl = slice(b * bs, (b + 1) * bs)
                             batch = {k: v[sl] for k, v in inputs.items()}
@@ -1725,6 +1945,8 @@ class FFModel:
                         try:
                             if staged_rem is not None:
                                 mets = self.train_batch_device(staged_rem)
+                            elif pipe is not None:
+                                mets = _train_streamed()
                             else:
                                 sl = slice(num_batches * bs, n)
                                 batch = {k: v[sl]
@@ -1742,6 +1964,11 @@ class FFModel:
                                 "samples): it cannot train at its own "
                                 "shape (%s) — pad the dataset or pick a "
                                 "batch size dividing %d", rem, e, n)
+                            if use_pipe:
+                                # the ring may hold later rem items (and
+                                # a dead producer, if staging raised) —
+                                # re-stage the rest without them
+                                _build_pipe(epoch + 1, 0)
                 except AnomalyError as exc:
                     if (getattr(self, "_anomaly_policy", "none")
                             != "rollback" or mgr is None
@@ -1761,6 +1988,10 @@ class FFModel:
                         "(epoch %d, batch %d) — recovery %d/%d",
                         exc.step, exc, entry["step"], epoch, b0,
                         rollbacks, max_rollbacks)
+                    if use_pipe:
+                        # drop staged-ahead batches and re-stage from the
+                        # rewound position (deterministic, so exact)
+                        _build_pipe(epoch, b0)
                     continue
                 if verbose and mets is not None:
                     # host sync happens here only (metrics are async)

@@ -1,7 +1,8 @@
 from .dataloader import (FFBinDataLoader, ImgDataLoader2D, ImgDataLoader4D,
                          SingleDataLoader, load_dlrm_hdf5, write_ffbin,
                          write_img_ffbin)
+from .prefetch import PrefetchPipeline
 
 __all__ = ["SingleDataLoader", "FFBinDataLoader", "write_ffbin",
            "ImgDataLoader4D", "ImgDataLoader2D", "write_img_ffbin",
-           "load_dlrm_hdf5"]
+           "load_dlrm_hdf5", "PrefetchPipeline"]

@@ -9,9 +9,12 @@ same from HDF5, examples/cpp/DLRM/dlrm.cc:266-589).
 TPU redesign: the dataset stays in host RAM as numpy; `next_batch` stages
 one batch to device HBM via `jax.device_put` with the input's GSPMD
 sharding (each chip receives exactly its shard — the analog of the
-ZC-memory -> per-part scatter). An optional background prefetch of the next
-batch overlaps H2D with the device step, like the reference's async index
-launches.
+ZC-memory -> per-part scatter). Staging is pipelined through the shared
+depth-K prefetch ring (data/prefetch.py): a background thread slices and
+device_puts batch N+1..N+K while the device trains batch N, like the
+reference's async index launches. `FFConfig.prefetch_depth` sets K
+(0 disables); state()/reset()/set_state() drain the ring first, so
+prefetching never changes the delivered sequence.
 """
 
 from __future__ import annotations
@@ -52,14 +55,29 @@ def read_with_retries(fn: Callable, site: str, retries: int = 3,
             time.sleep(delay)
 
 
+def _config_depth(model, depth: Optional[int]) -> int:
+    if depth is not None:
+        return max(int(depth), 0)
+    cfg = getattr(model, "config", None)
+    return max(int(getattr(cfg, "prefetch_depth", 2) or 0), 0)
+
+
 class SingleDataLoader:
     """Cycles a dict of full arrays in batches (reference SingleDataLoader:
-    any 2-D/4-D tensor, full dataset resident, next_batch scatters)."""
+    any 2-D/4-D tensor, full dataset resident, next_batch scatters).
+
+    Staging runs through the shared PrefetchPipeline: the schedule (which
+    samples land in batch ordinal i) is a deterministic function of the
+    seed, so the background thread can slice + device_put ahead without
+    changing the delivered sequence; per-epoch shuffle orders are cached
+    (and their RNG snapshots kept) so `state()` still captures the exact
+    resume point even while the ring holds batches from the next epoch.
+    """
 
     def __init__(self, model, inputs: Dict[str, np.ndarray],
                  labels: np.ndarray, batch_size: Optional[int] = None,
                  shuffle: bool = False, seed: int = 0,
-                 prefetch: bool = True):
+                 prefetch: bool = True, depth: Optional[int] = None):
         self.model = model
         self.inputs = dict(inputs)
         self.labels = labels
@@ -72,93 +90,189 @@ class SingleDataLoader:
             raise ValueError(
                 f"dataset ({self.num_samples}) smaller than one batch "
                 f"({self.batch_size})")
-        self._order = np.arange(self.num_samples)
+        order = np.arange(self.num_samples)
         if self.shuffle:
-            self.rng.shuffle(self._order)
-        self._idx = 0
-        self._prefetch = prefetch
-        self._next: Optional[Dict] = None
-        self._thread: Optional[threading.Thread] = None
+            self.rng.shuffle(order)
+        # per-epoch shuffle orders, computed lazily IN SEQUENCE by the
+        # schedule lock owner (consumer or staging thread) and cached with
+        # the post-shuffle RNG snapshot: state() then reports the order/rng
+        # of the CONSUMED epoch even when the ring has prefetched into the
+        # next one
+        self._orders: Dict[int, np.ndarray] = {0: order}
+        self._rng_states: Dict[int, tuple] = {0: self.rng.get_state()}
+        self._max_epoch = 0
+        self._sched_lock = threading.Lock()
+        self._idx = 0      # batches CONSUMED (absolute ordinal)
+        self._depth = _config_depth(model, depth)
+        self._prefetch = bool(prefetch) and self._depth > 0
+        self._pipe = None
 
-    def reset(self):
-        """reference: dataloader reset() task."""
-        self._idx = 0
-        self._join()
-        self._next = None
-        if self.shuffle:
-            self.rng.shuffle(self._order)
+    # --- schedule -------------------------------------------------------
+    def _epoch_order(self, e: int) -> np.ndarray:
+        with self._sched_lock:
+            while self._max_epoch < e:
+                nxt = self._orders[self._max_epoch]
+                if self.shuffle:
+                    nxt = nxt.copy()
+                    self.rng.shuffle(nxt)
+                self._max_epoch += 1
+                self._orders[self._max_epoch] = nxt
+                self._rng_states[self._max_epoch] = self.rng.get_state()
+            return self._orders[e]
 
-    def _host_batch(self, b: int) -> Dict[str, np.ndarray]:
-        sl = self._order[b * self.batch_size:(b + 1) * self.batch_size]
+    def _consumed_epoch(self) -> int:
+        return (self._idx - 1) // self.num_batches if self._idx > 0 else 0
+
+    def _prune_epochs(self):
+        ce = self._consumed_epoch()
+        with self._sched_lock:
+            for e in [e for e in self._orders if e < ce]:
+                del self._orders[e]
+                del self._rng_states[e]
+
+    def _host_batch_at(self, ordinal: int) -> Dict[str, np.ndarray]:
+        e, b = divmod(ordinal, self.num_batches)
+        order = self._epoch_order(e)
+        sl = order[b * self.batch_size:(b + 1) * self.batch_size]
         batch = {k: v[sl] for k, v in self.inputs.items()}
         batch["label"] = self.labels[sl]
         return batch
 
-    def _stage(self, b: int) -> Dict:
-        return self.model._device_batch(self._host_batch(b))
+    # --- prefetch ring --------------------------------------------------
+    def _ensure_pipe(self):
+        if self._pipe is None:
+            from .prefetch import PrefetchPipeline
+            base = self._idx
 
-    def _join(self):
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
+            def produce(k):
+                hb = self._host_batch_at(base + k)
+                return (hb, self.model._device_batch(hb))
+
+            self._pipe = PrefetchPipeline(produce, depth=self._depth,
+                                          name="SingleDataLoader")
+        return self._pipe
+
+    def _close_pipe(self):
+        if self._pipe is not None:
+            self._pipe.close()
+            self._pipe = None
+
+    def reset(self):
+        """reference: dataloader reset() task."""
+        self._close_pipe()
+        order = self._orders[min(self._consumed_epoch(), self._max_epoch)]
+        if self.shuffle:
+            order = order.copy()
+            self.rng.shuffle(order)
+        self._orders = {0: order}
+        self._rng_states = {0: self.rng.get_state()}
+        self._max_epoch = 0
+        self._idx = 0
 
     def next_host_batch(self) -> Dict[str, np.ndarray]:
         """Next host-side (numpy) batch with full shuffle semantics.
-        Safe to interleave with next_batch: the prefetch pipeline is
-        drained first (it staged a batch this call now consumes)."""
-        self._join()
-        self._next = None
-        b = self._advance()
-        return self._host_batch(b)
-
-    def _advance(self) -> int:
-        b = self._idx % self.num_batches
-        if b == 0 and self._idx > 0 and self.shuffle:
-            self.rng.shuffle(self._order)
+        Safe to interleave with next_batch: both consume the same staged
+        stream, so the sequence is preserved."""
+        if self._prefetch:
+            hb, _ = self._ensure_pipe().get()
+        else:
+            hb = self._host_batch_at(self._idx)
         self._idx += 1
-        return b
-
-    def state(self) -> Dict:
-        """Serializable position (cursor + shuffle order + RNG state) for
-        checkpoint manifests — set_state() on a fresh loader over the same
-        data resumes the exact batch sequence."""
-        self._join()
-        s = self.rng.get_state()
-        return {"idx": int(self._idx),
-                "order": [int(i) for i in self._order],
-                "rng": [s[0], [int(v) for v in s[1]], int(s[2]),
-                        int(s[3]), float(s[4])]}
-
-    def set_state(self, state: Dict) -> None:
-        self._join()
-        self._next = None
-        self._idx = int(state["idx"])
-        self._order = np.asarray(state["order"], dtype=np.int64)
-        r = state["rng"]
-        self.rng.set_state((r[0], np.asarray(r[1], dtype=np.uint32),
-                            int(r[2]), int(r[3]), float(r[4])))
+        self._prune_epochs()
+        return hb
 
     def next_batch(self) -> Dict:
         """Device-resident batch dict (reference next_batch(ff):
         dlrm.cc:486-589). Wraps around at the end of the dataset."""
-        b = self._advance()
-        if not self._prefetch:
-            return self._stage(b)
-        self._join()
-        cur = self._next if self._next is not None else self._stage(b)
-        nxt_b = self._idx % self.num_batches
+        if self._prefetch:
+            _, db = self._ensure_pipe().get()
+        else:
+            db = self.model._device_batch(self._host_batch_at(self._idx))
+        self._idx += 1
+        self._prune_epochs()
+        return db
 
-        def work():
-            self._next = self._stage(nxt_b)
+    def state(self) -> Dict:
+        """Serializable position (cursor + shuffle order + RNG state) for
+        checkpoint manifests — set_state() on a fresh loader over the same
+        data resumes the exact batch sequence. Drains the prefetch ring
+        (staged-ahead batches re-stage identically after a restore)."""
+        self._close_pipe()
+        ce = self._consumed_epoch()
+        s = self._rng_states[ce]
+        return {"idx": int(self._idx),
+                "order": [int(i) for i in self._orders[ce]],
+                "rng": [s[0], [int(v) for v in s[1]], int(s[2]),
+                        int(s[3]), float(s[4])]}
 
-        self._thread = threading.Thread(target=work, daemon=True)
-        self._thread.start()
-        return cur
+    def set_state(self, state: Dict) -> None:
+        self._close_pipe()
+        self._idx = int(state["idx"])
+        order = np.asarray(state["order"], dtype=np.int64)
+        r = state["rng"]
+        self.rng.set_state((r[0], np.asarray(r[1], dtype=np.uint32),
+                            int(r[2]), int(r[3]), float(r[4])))
+        ce = self._consumed_epoch()
+        self._orders = {ce: order}
+        self._rng_states = {ce: self.rng.get_state()}
+        self._max_epoch = ce
 
     def __iter__(self) -> Iterator[Dict]:
         self.reset()
         for _ in range(self.num_batches):
             yield self.next_batch()
+
+
+class _PrefetchMixin:
+    """Prefetch plumbing shared by loaders whose host-batch source is a
+    STATEFUL sequential read (`_read_host_batch`). Ring items are
+    (host_batch, device_batch-or-None); whether the staging thread also
+    device_puts is decided by the consumer's FIRST call — a loader driven
+    only through next_host_batch never touches model._device_batch, so
+    metadata-only model stubs (tests/test_native.py) keep working."""
+
+    _pipe = None
+    _pipe_stages_device = False
+
+    def _init_prefetch(self, model, prefetch: bool,
+                       depth: Optional[int]) -> None:
+        self._depth = _config_depth(model, depth)
+        self._prefetch_on = bool(prefetch) and self._depth > 0
+
+    def _read_host_batch(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def _ensure_pipe(self, stage_device: bool):
+        if self._pipe is None:
+            from .prefetch import PrefetchPipeline
+            self._pipe_stages_device = stage_device
+
+            def produce(_k):
+                hb = self._read_host_batch()
+                db = (self.model._device_batch(hb)
+                      if self._pipe_stages_device else None)
+                return (hb, db)
+
+            self._pipe = PrefetchPipeline(produce, depth=self._depth,
+                                          name=type(self).__name__)
+        return self._pipe
+
+    def _close_pipe(self):
+        if self._pipe is not None:
+            self._pipe.close()
+            self._pipe = None
+
+    def next_host_batch(self) -> Dict[str, np.ndarray]:
+        if not self._prefetch_on:
+            return self._read_host_batch()
+        return self._ensure_pipe(stage_device=False).get()[0]
+
+    def next_batch(self) -> Dict:
+        if not self._prefetch_on:
+            return self.model._device_batch(self._read_host_batch())
+        hb, db = self._ensure_pipe(stage_device=True).get()
+        # a ring opened in host-only mode stages on the consumer instead
+        return db if db is not None else self.model._device_batch(hb)
 
 
 def write_ffbin(path: str, dense: np.ndarray, sparse: np.ndarray,
@@ -179,15 +293,17 @@ def write_ffbin(path: str, dense: np.ndarray, sparse: np.ndarray,
         labels.tofile(f)
 
 
-class FFBinDataLoader:
+class FFBinDataLoader(_PrefetchMixin):
     """Native prefetching loader over an .ffbin file.
 
     The C++ side (native/ffloader.cc) keeps the dataset mmap'd and a
     background thread assembling shuffled batches into a prefetch ring —
     the TPU analog of the reference's zero-copy-resident dataset + async
     batch scatter tasks (python/flexflow_dataloader.cc,
-    examples/cpp/DLRM/dlrm.cc:486-589). `next_batch` hands the staged host
-    batch to jax.device_put with the model's input shardings.
+    examples/cpp/DLRM/dlrm.cc:486-589). On the Python side the shared
+    PrefetchPipeline stages the assembled batches to device (the
+    `jax.device_put` H2D with the model's input shardings) ahead of the
+    training loop, so `next_batch` hands back an already-staged batch.
 
     `sparse_shape` restores the per-sample sparse layout, e.g. (T, bag).
     """
@@ -195,7 +311,8 @@ class FFBinDataLoader:
     def __init__(self, model, path: str, batch_size: Optional[int] = None,
                  shuffle: bool = False, seed: int = 0,
                  sparse_shape: Optional[tuple] = None,
-                 io_retries: int = 3, io_backoff_s: float = 0.05):
+                 io_retries: int = 3, io_backoff_s: float = 0.05,
+                 prefetch: bool = True, depth: Optional[int] = None):
         from ..native import get_lib
         lib = get_lib()
         if lib is None:
@@ -207,6 +324,7 @@ class FFBinDataLoader:
         self.io_retries = io_retries
         self.io_backoff_s = io_backoff_s
         self.batch_size = batch_size or model.config.batch_size
+        self._init_prefetch(model, prefetch, depth)
         self._handle = lib.ffloader_open(
             path.encode(), self.batch_size, 1 if shuffle else 0, seed)
         if not self._handle:
@@ -225,7 +343,7 @@ class FFBinDataLoader:
                 f"sparse_shape {self.sparse_shape} != stored width "
                 f"{self._sparse_flat}")
 
-    def next_host_batch(self) -> Dict[str, np.ndarray]:
+    def _read_host_batch(self) -> Dict[str, np.ndarray]:
         if not self._handle:
             raise RuntimeError("loader is closed")
         import ctypes
@@ -255,10 +373,8 @@ class FFBinDataLoader:
             "label": label.reshape(-1, 1),
         }
 
-    def next_batch(self) -> Dict:
-        return self.model._device_batch(self.next_host_batch())
-
     def close(self):
+        self._close_pipe()
         if self._handle:
             self._lib.ffloader_close(self._handle)
             self._handle = None
@@ -286,14 +402,15 @@ def write_img_ffbin(path: str, images: np.ndarray,
     write_ffbin(path, imgs, np.empty((n, 0), np.int32), labels)
 
 
-class ImgDataLoader4D:
+class ImgDataLoader4D(_PrefetchMixin):
     """Generic on-disk image loader feeding 4-D (N, C, H, W) inputs
     (reference ImgDataLoader4D, python/flexflow_dataloader.cc: numpy /
     legacy-binary image loading into resident memory + per-batch scatter).
 
     Sources by extension:
-      - `.ffbin`  — native mmap + background prefetch (write with
-        write_img_ffbin); `image_shape` restores (C, H, W)
+      - `.ffbin`  — native mmap read + the shared prefetch ring staging
+        reshaped batches to device (write with write_img_ffbin);
+        `image_shape` restores (C, H, W)
       - `.npz`    — arrays `images` (N,C,H,W) and `labels`
       - `.npy`    — images array; labels from `<stem>_labels.npy`
 
@@ -305,20 +422,25 @@ class ImgDataLoader4D:
 
     def __init__(self, model, path: str, image_shape=None,
                  input_name: str = "image", batch_size: Optional[int] = None,
-                 shuffle: bool = False, seed: int = 0):
+                 shuffle: bool = False, seed: int = 0,
+                 prefetch: bool = True, depth: Optional[int] = None):
         self.model = model
         self.input_name = input_name
         self.batch_size = batch_size or model.config.batch_size
+        self._init_prefetch(model, prefetch, depth)
         self._native = None
         if path.endswith(".ffbin"):
             if self.rank == 4 and image_shape is None:
                 raise ValueError(
                     ".ffbin stores images flattened; pass "
                     "image_shape=(C, H, W)")
+            # raw reads stay synchronous in the inner loader; THIS loader's
+            # ring prefetches the reshaped + device-staged batches
             self._native = FFBinDataLoader(model, path,
                                            batch_size=self.batch_size,
                                            shuffle=shuffle, seed=seed,
-                                           sparse_shape=(0, 1))
+                                           sparse_shape=(0, 1),
+                                           prefetch=False)
             flat = self._native.dense_dim
             if self.rank == 4:
                 if int(np.prod(image_shape)) != flat:
@@ -350,24 +472,32 @@ class ImgDataLoader4D:
         self._fallback = SingleDataLoader(
             model, {input_name: images},
             np.asarray(labels, np.int32).reshape(len(labels), -1),
-            batch_size=self.batch_size, shuffle=shuffle, seed=seed)
+            batch_size=self.batch_size, shuffle=shuffle, seed=seed,
+            prefetch=prefetch, depth=depth)
         self.num_samples = self._fallback.num_samples
         self.num_batches = self._fallback.num_batches
 
+    def _read_host_batch(self) -> Dict[str, np.ndarray]:
+        raw = self._native._read_host_batch()
+        imgs = raw["dense"].reshape((self.batch_size,) + self.image_shape)
+        return {self.input_name: imgs,
+                "label": raw["label"].astype(np.int32)}
+
     def next_host_batch(self) -> Dict[str, np.ndarray]:
-        if self._native is not None:
-            raw = self._native.next_host_batch()
-            imgs = raw["dense"].reshape((self.batch_size,)
-                                        + self.image_shape)
-            return {self.input_name: imgs,
-                    "label": raw["label"].astype(np.int32)}
-        return self._fallback.next_host_batch()  # keeps shuffle semantics
+        if self._native is None:
+            return self._fallback.next_host_batch()  # keeps shuffle semantics
+        return _PrefetchMixin.next_host_batch(self)
 
     def next_batch(self) -> Dict:
         if self._native is None:
-            # fallback keeps SingleDataLoader's background H2D prefetch
+            # fallback keeps SingleDataLoader's prefetch ring
             return self._fallback.next_batch()
-        return self.model._device_batch(self.next_host_batch())
+        return _PrefetchMixin.next_batch(self)
+
+    def close(self):
+        self._close_pipe()
+        if self._native is not None:
+            self._native.close()
 
     def __iter__(self) -> Iterator[Dict]:
         for _ in range(self.num_batches):

@@ -1,0 +1,172 @@
+"""Pipelined input staging: a depth-K prefetch ring fed by a background
+staging thread.
+
+The reference overlaps its data pipeline with device compute — Legion
+DataLoader tasks stage batch N+1 into each GPU's framebuffer while the
+device trains batch N (reference: examples/cpp/DLRM/dlrm.cc:486-589,
+python/flexflow_dataloader.cc keeps the dataset zero-copy resident and
+launches the per-batch scatters asynchronously). The TPU analog lives
+here: a staging thread runs ``produce(i)`` for future step indices —
+typically slice → ``jax.device_put`` against the model's input shardings
+→ host-table gather — and parks the results in a bounded ring while the
+consumer trains the current step.
+
+Contracts (tests/test_prefetch.py pins all three):
+
+- **Order**: items are delivered strictly in produce order
+  (i = 0, 1, 2, ...), so a deterministic ``produce`` makes prefetched
+  training bit-identical to calling it inline.
+- **Errors**: transient ``IOError``/``OSError`` from ``produce`` are first
+  absorbed by the shared :func:`~.dataloader.read_with_retries`
+  backoff (same discipline as the ``.ffbin`` reader); anything that
+  survives is re-raised at the consumer's next :meth:`get` — the step
+  boundary — exactly like ``FFModel._host_drain`` surfaces async
+  host-scatter failures. The error is sticky: the producer is dead, and
+  the pipeline must be rebuilt.
+- **Drain**: :meth:`close` stops the producer, discards staged items and
+  joins the thread. Call it before anything that invalidates staged work
+  (checkpoint restore, rollback, a reshuffle, loader state capture) and
+  rebuild afterwards — re-producing dropped items is exact because
+  ``produce`` is deterministic.
+
+:meth:`stats` reports how much staging time was hidden under compute
+(``overlap_fraction``), which benchmarks/bench_pipeline.py turns into the
+gather/H2D overlap metric.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+
+class PrefetchPipeline:
+    """Depth-K ring buffer fed by one background staging thread.
+
+    produce    : callable(i) -> item, for i = 0, 1, 2, ...; runs on the
+                 staging thread, so it must only do thread-safe work
+                 (numpy slicing and jax device_puts are).
+    depth      : ring capacity = how many items may be staged ahead.
+    num_items  : total items to produce (None = unbounded); `get()` past
+                 the end raises IndexError.
+    io_site    : fault-injection/retry site name for the transient-error
+                 backoff wrapped around every produce call.
+    """
+
+    def __init__(self, produce: Callable[[int], object], depth: int = 2,
+                 num_items: Optional[int] = None, name: str = "prefetch",
+                 io_site: str = "prefetch", io_retries: int = 3,
+                 io_backoff_s: float = 0.05):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._produce = produce
+        self._depth = int(depth)
+        self._num = num_items
+        self._io_site = io_site
+        self._io_retries = io_retries
+        self._io_backoff_s = io_backoff_s
+        self._buf: deque = deque()
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._exc: Optional[BaseException] = None
+        self._produced = 0
+        self._consumed = 0
+        # staging-time accounting for the overlap metric
+        self._produce_s = 0.0
+        self._wait_s = 0.0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"{name}-staging")
+        self._thread.start()
+
+    # --- producer side -------------------------------------------------
+    def _run(self):
+        from .dataloader import read_with_retries
+        i = 0
+        while True:
+            with self._cond:
+                while len(self._buf) >= self._depth and not self._stopped:
+                    self._cond.wait()
+                if self._stopped or (self._num is not None
+                                     and i >= self._num):
+                    return
+            t0 = time.perf_counter()
+            try:
+                item = read_with_retries(lambda: self._produce(i),
+                                         self._io_site,
+                                         retries=self._io_retries,
+                                         backoff_s=self._io_backoff_s)
+            except BaseException as e:
+                with self._cond:
+                    self._exc = e
+                    self._cond.notify_all()
+                return
+            dt = time.perf_counter() - t0
+            with self._cond:
+                if self._stopped:
+                    return
+                self._buf.append(item)
+                self._produced += 1
+                self._produce_s += dt
+                self._cond.notify_all()
+            i += 1
+
+    # --- consumer side -------------------------------------------------
+    def get(self):
+        """Next staged item, in produce order. Blocks until staged.
+
+        Raises the staging thread's error (sticky — rebuild the pipeline
+        after), or IndexError past `num_items`."""
+        t0 = time.perf_counter()
+        with self._cond:
+            while not self._buf:
+                if self._exc is not None:
+                    raise self._exc
+                if self._stopped:
+                    raise RuntimeError("prefetch pipeline is closed")
+                if self._num is not None and self._consumed >= self._num:
+                    raise IndexError(
+                        f"prefetch pipeline exhausted after {self._num} "
+                        f"items")
+                self._cond.wait()
+            item = self._buf.popleft()
+            self._consumed += 1
+            self._wait_s += time.perf_counter() - t0
+            self._cond.notify_all()
+        return item
+
+    def close(self):
+        """Stop the producer, discard staged items, join the thread.
+        Never raises — pending staging errors die with the pipeline
+        (a caller closing is abandoning the staged stream anyway)."""
+        with self._cond:
+            self._stopped = True
+            self._buf.clear()
+            self._cond.notify_all()
+        if self._thread is not threading.current_thread():
+            self._thread.join()
+
+    @property
+    def closed(self) -> bool:
+        return self._stopped
+
+    def stats(self) -> dict:
+        """Staging accounting: `overlap_fraction` is the share of total
+        staging time hidden under the consumer's compute (1.0 = the
+        consumer never waited on the ring)."""
+        with self._cond:
+            ps, ws = self._produce_s, self._wait_s
+            items = self._consumed
+        hidden = max(ps - min(ws, ps), 0.0)
+        return {"items": items,
+                "produce_s": ps,
+                "wait_s": ws,
+                "overlap_fraction": (hidden / ps) if ps > 0 else 1.0}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -115,9 +115,21 @@ def _table_sizes(op):
     return sizes
 
 
+def _table_itemsize(op) -> float:
+    """Bytes per table element from the op's ACTUAL param dtype — a bf16
+    table has half the fp32 footprint against the 2 MB streaming
+    threshold, and hardcoding 4 B would misclassify it as large."""
+    try:
+        pd = op.param_defs().get("kernel")
+        return float(jnp.dtype(pd.dtype).itemsize)
+    except Exception:
+        return 4.0
+
+
 def _has_large_table(op) -> bool:
-    d4 = op.out_dim * 4.0
-    return any(rows * d4 > _SMALL_TABLE_BYTES for rows in _table_sizes(op))
+    row_bytes = op.out_dim * _table_itemsize(op)
+    return any(rows * row_bytes > _SMALL_TABLE_BYTES
+               for rows in _table_sizes(op))
 
 
 def _effective_random_rows(op, per_table_lookups: float) -> float:
@@ -127,10 +139,10 @@ def _effective_random_rows(op, per_table_lookups: float) -> float:
     hide entirely inside the step floor, measured r5) and large-table
     counts cap at the table's row count (a gather cannot touch more
     distinct rows than the table has)."""
-    d4 = op.out_dim * 4.0
+    row_bytes = op.out_dim * _table_itemsize(op)
     total = 0.0
     for rows in _table_sizes(op):
-        if rows * d4 <= _SMALL_TABLE_BYTES:
+        if rows * row_bytes <= _SMALL_TABLE_BYTES:
             continue
         total += min(per_table_lookups, float(rows))
     return total

@@ -185,11 +185,11 @@ def _scatter_unique_kernel(idx_ref, upd_ref, tbl_ref, out_ref, bufs,
     """One grid step applies _SCATTER_B tile updates, pipelined.
 
     PRECONDITION (established by scatter_add_rows' dedup pre-pass): all
-    view-row targets with row >= 0 are DISTINCT, so the 8 RMWs of a block
-    are independent: issue all reads, then add+write-back, then drain.
-    row < 0 marks a padding slot and is skipped. The reference needed
-    atomicAdd for this (embedding.cu:173-224); here distinctness replaces
-    atomicity.
+    view-row targets with row >= 0 are DISTINCT, so the _SCATTER_B (64)
+    RMWs of a block are independent: issue all reads, then add+write-back,
+    then drain. row < 0 marks a padding slot and is skipped. The reference
+    needed atomicAdd for this (embedding.cu:173-224); here distinctness
+    replaces atomicity.
     """
     i = pl.program_id(0)
 
@@ -295,7 +295,11 @@ def _pack_tile_updates(indices, updates, dim, dtype):
     each padded row by a one-hot mask — a dynamic per-row `roll`
     (vmap(jnp.roll)) lowers to a per-row dynamic lane permute that alone
     cost ~8 ms for 8k rows on v5e (measured r5: it was the entire
-    DLRM-family sparse-update bottleneck, ~85% of the train step)."""
+    DLRM-family sparse-update bottleneck, ~85% of the train step). For
+    VERY narrow tables (r > 16, i.e. dim <= 8) the static unroll emits up
+    to 128 one-hot selects, inflating the HLO and compile time faster
+    than the runtime win pays back — those fall back to the dynamic
+    roll."""
     r_per_tile = _LANES // dim
     indices = indices.astype(jnp.int32)
     tile_rows = indices // r_per_tile
@@ -303,6 +307,11 @@ def _pack_tile_updates(indices, updates, dim, dtype):
     if r_per_tile == 1:
         return tile_rows, padded
     slot = indices % r_per_tile                       # (n,)
+    if r_per_tile > 16:
+        # low-dim fallback: one dynamic lane roll per row instead of r
+        # unrolled one-hot selects (compile-time guard; see docstring)
+        shift = (slot * dim).astype(jnp.int32)
+        return tile_rows, jax.vmap(jnp.roll)(padded, shift)
     out = None
     for s in range(r_per_tile):
         rolled = jnp.roll(padded, s * dim, axis=1)    # static lane rotate

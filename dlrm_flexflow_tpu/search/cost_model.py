@@ -70,10 +70,10 @@ class TPUSpec:
     # pipelined state: a one-dense-layer model's full train step floors
     # at ~820 µs on the tunneled v5e (500-step windows, round 5), but a
     # compute-heavier graph (mlp_heavy, real 794 µs total) shows device
-    # work partially HIDES under the host-side floor — 650 µs is the
-    # additive share that fits all 12 calibration points; without it
-    # every small-step model under-predicts (the r4 measured-mode
-    # DLRM-family bias)
+    # work partially HIDES under the host-side floor — ~550 µs (0.55 ms,
+    # BENCHMARKS.md r5) is the additive share that fits all 12
+    # calibration points; without it every small-step model
+    # under-predicts (the r4 measured-mode DLRM-family bias)
     per_step_overhead_s: float = 5.5e-4
     # host-resident tables: PCIe host<->device link and host-DRAM random
     # row cost (the reference prices GPU<->DRAM at 16 MB/ms,
@@ -297,7 +297,8 @@ class CostModel:
 
     def scatter_rows_time(self, rows: float) -> float:
         """Touched-rows UPDATE scatter: same fixed setup, slower per-row
-        sustained rate (write DMAs drain every 8-tile block)."""
+        sustained rate (write DMAs drain every 64-tile block — the
+        Pallas kernels' _SCATTER_B)."""
         if rows <= 0:
             return 0.0
         return (self.spec.hbm_random_fixed_s

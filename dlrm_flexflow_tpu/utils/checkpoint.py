@@ -171,6 +171,13 @@ def save_checkpoint(model, path: str):
 def restore_checkpoint(model, path: str):
     """Restore into a compiled model, re-applying each parameter's GSPMD
     sharding."""
+    # the restore replaces host tables underneath any in-flight async
+    # scatter / chained prefetch gather: land the scatter first, then
+    # drop the (now stale) prefetched gather
+    if hasattr(model, "_host_drain"):
+        model._host_drain()
+    if hasattr(model, "_host_prefetch_invalidate"):
+        model._host_prefetch_invalidate()
     data = np.load(path if path.endswith(".npz") else path + ".npz")
     params_flat, opt_flat, state_flat = {}, {}, {}
     host_flat, hostopt_flat = {}, {}

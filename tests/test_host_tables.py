@@ -23,8 +23,13 @@ def _dcfg(sizes=(64,) * 8):
 
 def _build(dcfg, host_tables=False, ndev=1, strategies=None,
            optimizer=None):
+    # exact-ordering mode: these tests assert bit-level equivalence with
+    # the device path, which the async default's bounded one-step
+    # staleness deliberately trades away (the async pipeline has its own
+    # tests below and in test_prefetch.py)
     cfg = ff.FFConfig(batch_size=16, seed=7,
-                      host_resident_tables=host_tables)
+                      host_resident_tables=host_tables,
+                      host_tables_async=False)
     model = ff.FFModel(cfg)
     build_dlrm(model, dcfg)
     model.compile(optimizer or ff.SGDOptimizer(lr=0.1),
@@ -167,7 +172,8 @@ class TestHostResidentTables:
         """Per-bag-slot (aggr='none') embedding on the host path."""
         def build(host):
             cfg = ff.FFConfig(batch_size=8, seed=3,
-                              host_resident_tables=host)
+                              host_resident_tables=host,
+                              host_tables_async=False)
             model = ff.FFModel(cfg)
             sl = model.create_tensor((8, 3), dtype="int32", name="slots")
             emb = model.embedding(sl, 32, 4, aggr="none", name="emb")

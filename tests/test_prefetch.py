@@ -1,0 +1,406 @@
+"""Pipelined input staging (data/prefetch.py + the fit()/loader wiring):
+
+- prefetched results must be BIT-identical to synchronous staging
+  (produce is deterministic; the ring only changes when work happens);
+- staging-thread errors surface at the consumer's next step boundary,
+  transient IO errors recover through the shared retry/backoff first;
+- the ring drains cleanly around state capture / reset / checkpoint
+  restore (dropped items re-stage exactly);
+- host-resident tables under the async default keep the documented
+  bounded one-step staleness: the chained gather for step N+1 runs
+  BEFORE step N's scatter (deterministically sees updates through N-1),
+  and a racing reader sees the table atomically before or after a
+  scatter — never torn rows.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.data import SingleDataLoader
+from dlrm_flexflow_tpu.data.prefetch import PrefetchPipeline
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                           synthetic_batch)
+from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+from dlrm_flexflow_tpu.utils import faults
+
+
+def _mlp(ndev=None, **cfg_kw):
+    m = ff.FFModel(ff.FFConfig(batch_size=8, seed=1, **cfg_kw))
+    x = m.create_tensor((8, 4), name="x")
+    m.dense(x, 8, activation="relu", name="fc1")
+    m.dense(m.ops[-1].outputs[0], 1, name="fc2")
+    mesh = make_mesh(num_devices=ndev) if ndev else None
+    m.compile(ff.SGDOptimizer(0.1), "mean_squared_error", ["mse"],
+              mesh=mesh)
+    m.init_layers()
+    return m
+
+
+def _data(n, seed=5):
+    r = np.random.RandomState(seed)
+    return ({"x": r.rand(n, 4).astype(np.float32)},
+            r.rand(n, 1).astype(np.float32))
+
+
+# ---------------------------------------------------------------------
+# the ring itself
+# ---------------------------------------------------------------------
+class TestPrefetchPipeline:
+    def test_delivers_in_order_and_exhausts(self):
+        pipe = PrefetchPipeline(lambda i: i * i, depth=3, num_items=10)
+        try:
+            assert [pipe.get() for _ in range(10)] == [i * i
+                                                       for i in range(10)]
+            with pytest.raises(IndexError):
+                pipe.get()
+            st = pipe.stats()
+            assert st["items"] == 10
+            assert 0.0 <= st["overlap_fraction"] <= 1.0
+        finally:
+            pipe.close()
+
+    def test_depth_bounds_staging_ahead(self):
+        produced = []
+
+        def produce(i):
+            produced.append(i)
+            return i
+
+        pipe = PrefetchPipeline(produce, depth=2, num_items=100)
+        try:
+            deadline = time.time() + 5
+            while len(produced) < 2 and time.time() < deadline:
+                time.sleep(0.005)
+            time.sleep(0.05)   # give an over-eager producer time to leak
+            assert len(produced) <= 3   # ring full (+1 in flight at most)
+            assert pipe.get() == 0
+        finally:
+            pipe.close()
+
+    def test_error_surfaces_at_step_boundary_and_sticks(self):
+        def produce(i):
+            if i == 2:
+                raise RuntimeError("staging exploded")
+            return i
+
+        pipe = PrefetchPipeline(produce, depth=2, num_items=10)
+        try:
+            assert pipe.get() == 0
+            assert pipe.get() == 1
+            with pytest.raises(RuntimeError, match="staging exploded"):
+                pipe.get()
+            # sticky: the producer is dead, the pipeline must be rebuilt
+            with pytest.raises(RuntimeError, match="staging exploded"):
+                pipe.get()
+        finally:
+            pipe.close()
+
+    def test_transient_io_error_recovers_via_retry(self):
+        """The existing read_with_retries backoff wraps every produce:
+        injected transient errors mid-prefetch are absorbed and the
+        delivered sequence is unchanged."""
+        with faults.active_plan(
+                faults.FaultPlan(io_errors={"prefetch": 2})) as plan:
+            pipe = PrefetchPipeline(lambda i: i, depth=2, num_items=5,
+                                    io_backoff_s=0.001)
+            try:
+                assert [pipe.get() for _ in range(5)] == list(range(5))
+            finally:
+                pipe.close()
+            assert [f for f in plan.fired if f[0] == "io_error"], \
+                "faults must actually have fired"
+
+    def test_close_unblocks_full_ring_and_is_idempotent(self):
+        pipe = PrefetchPipeline(lambda i: i, depth=1, num_items=1000)
+        assert pipe.get() == 0
+        pipe.close()
+        pipe.close()
+        assert pipe.closed
+        with pytest.raises(RuntimeError):
+            pipe.get()
+
+
+# ---------------------------------------------------------------------
+# loader wiring
+# ---------------------------------------------------------------------
+class TestSingleLoaderPrefetch:
+    def test_sequence_identical_across_epochs(self):
+        m = _mlp()
+        xs, ys = _data(40)
+        a = SingleDataLoader(m, xs, ys, shuffle=True, seed=3, prefetch=True)
+        b = SingleDataLoader(m, xs, ys, shuffle=True, seed=3,
+                             prefetch=False)
+        for i in range(12):   # 5 batches/epoch -> crosses two reshuffles
+            ba, bb = a.next_host_batch(), b.next_host_batch()
+            np.testing.assert_array_equal(ba["x"], bb["x"], err_msg=str(i))
+            np.testing.assert_array_equal(ba["label"], bb["label"])
+
+    def test_state_roundtrip_with_prefetch_on(self):
+        m = _mlp()
+        xs, ys = _data(40)
+        dl = SingleDataLoader(m, xs, ys, shuffle=True, seed=3,
+                              prefetch=True)
+        for _ in range(3):
+            dl.next_host_batch()
+        state = json.loads(json.dumps(dl.state()))   # JSON-safe
+        want = [dl.next_host_batch() for _ in range(7)]
+        dl2 = SingleDataLoader(m, xs, ys, shuffle=True, seed=99,
+                               prefetch=True)
+        dl2.set_state(state)
+        got = [dl2.next_host_batch() for _ in range(7)]
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w["x"], g["x"])
+            np.testing.assert_array_equal(w["label"], g["label"])
+
+    def test_interleaved_host_and_device_batches_stay_in_sequence(self):
+        m = _mlp()
+        xs, ys = _data(40)
+        pf = SingleDataLoader(m, xs, ys, shuffle=True, seed=3,
+                              prefetch=True)
+        ref = SingleDataLoader(m, xs, ys, shuffle=True, seed=3,
+                               prefetch=False)
+        db = pf.next_batch()
+        np.testing.assert_allclose(np.asarray(db["x"]),
+                                   ref.next_host_batch()["x"])
+        hb = pf.next_host_batch()
+        np.testing.assert_allclose(hb["x"],
+                                   np.asarray(ref.next_batch()["x"]))
+
+    def test_staging_error_propagates_at_next_batch(self):
+        m = _mlp()
+        xs, ys = _data(40)
+        dl = SingleDataLoader(m, xs, ys, prefetch=True)
+        orig = m._device_batch
+        calls = {"n": 0}
+
+        def flaky(batch, with_label=True):
+            calls["n"] += 1
+            if calls["n"] >= 3:
+                raise RuntimeError("H2D exploded")
+            return orig(batch, with_label)
+
+        m._device_batch = flaky
+        try:
+            dl.next_batch()
+            dl.next_batch()
+            with pytest.raises(RuntimeError, match="H2D exploded"):
+                for _ in range(3):
+                    dl.next_batch()
+        finally:
+            m._device_batch = orig
+
+    def test_transient_io_error_mid_prefetch_recovers(self):
+        """Loader staging rides the same retry/backoff as the .ffbin
+        reader: two injected transient errors mid-prefetch are absorbed
+        and the sequence is unchanged."""
+        m = _mlp()
+        xs, ys = _data(40)
+        ref = SingleDataLoader(m, xs, ys, shuffle=True, seed=3,
+                               prefetch=False)
+        with faults.active_plan(
+                faults.FaultPlan(io_errors={"prefetch": 2})) as plan:
+            dl = SingleDataLoader(m, xs, ys, shuffle=True, seed=3,
+                                  prefetch=True)
+            got = [dl.next_host_batch() for _ in range(5)]
+        want = [ref.next_host_batch() for _ in range(5)]
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w["x"], g["x"])
+        assert [f for f in plan.fired if f[0] == "io_error"]
+
+
+# ---------------------------------------------------------------------
+# fit() streaming fallback
+# ---------------------------------------------------------------------
+class TestFitStreamingPrefetch:
+    def _fit_params(self, n=44, epochs=3, **cfg_kw):
+        # 44 samples / batch 8 -> 5 full batches + a remainder of 4,
+        # exercising the remainder leg of the pipeline schedule
+        xs, ys = _data(n, seed=7)
+        m = _mlp(**cfg_kw)
+        res = m.fit(xs, ys, epochs=epochs, verbose=False)
+        return ({k: np.asarray(v) for k, v in m.params["fc1"].items()},
+                {k: np.asarray(v) for k, v in m.params["fc2"].items()},
+                res)
+
+    def test_prefetched_bit_identical_to_sync_and_staged(self):
+        staged = self._fit_params()                       # all-in-HBM path
+        sync = self._fit_params(stage_dataset="never", prefetch_depth=0)
+        pre = self._fit_params(stage_dataset="never", prefetch_depth=3)
+        for a, b in ((staged, pre), (sync, pre)):
+            for pa, pb in zip(a[:2], b[:2]):
+                for k in pa:
+                    np.testing.assert_array_equal(pa[k], pb[k])
+        # remainder handling identical on every path: on the 8-device
+        # test mesh the 4-sample remainder cannot shard, so all three
+        # paths must drop it the same way (the pipeline rebuilds its
+        # schedule without the remainder and keeps training)
+        assert (staged[2]["num_samples"] == sync[2]["num_samples"]
+                == pre[2]["num_samples"] == 40 * 3)
+
+    def test_remainder_trains_through_pipeline(self):
+        """On a mesh where the remainder CAN stage (single device), the
+        pipeline schedule includes it and it trains, every epoch."""
+        xs, ys = _data(44, seed=7)
+        m = _mlp(ndev=1, stage_dataset="never", prefetch_depth=2)
+        res = m.fit(xs, ys, epochs=3, verbose=False)
+        assert res["num_samples"] == 44 * 3
+        m0 = _mlp(ndev=1, stage_dataset="never", prefetch_depth=0)
+        res0 = m0.fit(xs, ys, epochs=3, verbose=False)
+        assert res0["num_samples"] == 44 * 3
+        for op in ("fc1", "fc2"):
+            for k in m.params[op]:
+                np.testing.assert_array_equal(np.asarray(m.params[op][k]),
+                                              np.asarray(m0.params[op][k]))
+
+    def test_prefetched_resume_from_checkpoint(self, tmp_path):
+        """The pipeline drains for background checkpoint saves and
+        rebuilds from the restored (epoch, batch) position."""
+        xs, ys = _data(40, seed=7)
+
+        m1 = _mlp(stage_dataset="never", prefetch_depth=2)
+        m1.fit(xs, ys, epochs=2, verbose=False,
+               checkpoint_dir=str(tmp_path / "ck"), save_every=3)
+
+        # fresh model resumes from the FINAL snapshot -> nothing to train,
+        # params identical to m1's
+        m2 = _mlp(stage_dataset="never", prefetch_depth=2)
+        m2.fit(xs, ys, epochs=2, verbose=False,
+               checkpoint_dir=str(tmp_path / "ck"), save_every=3)
+        for op in ("fc1", "fc2"):
+            for k in m1.params[op]:
+                np.testing.assert_array_equal(
+                    np.asarray(m1.params[op][k]),
+                    np.asarray(m2.params[op][k]))
+
+
+# ---------------------------------------------------------------------
+# host-resident tables under the async default
+# ---------------------------------------------------------------------
+def _host_model(**cfg_kw):
+    dcfg = DLRMConfig(embedding_size=[64] * 4, sparse_feature_size=8,
+                      mlp_bot=[4, 16, 8], mlp_top=[40, 16, 1])
+    cfg = ff.FFConfig(batch_size=16, seed=7, host_resident_tables=True,
+                      **cfg_kw)
+    m = ff.FFModel(cfg)
+    build_dlrm(m, dcfg)
+    m.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+              mesh=make_mesh(num_devices=1))
+    m.init_layers()
+    return m, dcfg
+
+
+def _staged_host_batch(m, dcfg, seed):
+    x, y = synthetic_batch(dcfg, 16, seed=seed)
+    x["label"] = y
+    return m._stage_step(x)
+
+
+class TestHostTablesPipelined:
+    def test_async_is_the_default(self):
+        assert ff.FFConfig().host_tables_async is True
+        assert ff.FFConfig.parse_args(
+            ["--no-host-tables-async"]).host_tables_async is False
+        assert ff.FFConfig.parse_args(
+            ["--prefetch-depth", "5"]).prefetch_depth == 5
+        assert ff.FFConfig.parse_args(["--no-prefetch"]).prefetch_depth == 0
+        assert ff.FFConfig.parse_args(
+            ["--stage-dataset", "never"]).stage_dataset == "never"
+
+    def test_chained_gather_sees_pre_scatter_table(self):
+        """The one-step staleness contract, pinned deterministically: the
+        worker gathers step N+1's rows BEFORE applying step N's scatter,
+        so the chained rows equal a lookup on the pre-step table; a fresh
+        gather after the drain sees the updated table."""
+        m, dcfg = _host_model()
+        emb = next(op for op in m.ops
+                   if op.name in m._host_resident_ops)
+        k_before = m.host_params[emb.name]["kernel"].copy()
+
+        a = _staged_host_batch(m, dcfg, seed=0)
+        b = _staged_host_batch(m, dcfg, seed=1)
+        m.train_batch_staged(a, next_host_idx=b.host_idx)
+        m._host_drain()
+        k_after = m.host_params[emb.name]["kernel"].copy()
+        assert not np.array_equal(k_before, k_after), "step must train"
+
+        stale = np.asarray(m._host_emb_input(b.host_idx)[emb.name])
+        want_stale = emb.host_lookup({"kernel": k_before},
+                                     b.host_idx[emb.name])
+        np.testing.assert_array_equal(stale, want_stale)
+
+        fresh = np.asarray(m._host_emb_forward(b.host_idx)[emb.name])
+        want_fresh = emb.host_lookup({"kernel": k_after},
+                                     b.host_idx[emb.name])
+        np.testing.assert_array_equal(fresh, want_fresh)
+
+    def test_racing_gather_is_atomic_either_order(self):
+        """A gather racing the in-flight scatter returns the table
+        exactly before OR exactly after the update — never a torn mix."""
+        m, dcfg = _host_model()
+        emb = next(op for op in m.ops
+                   if op.name in m._host_resident_ops)
+        orig = emb.host_sgd_update
+
+        def slow_update(*args, **kw):
+            time.sleep(0.05)
+            return orig(*args, **kw)
+
+        emb.host_sgd_update = slow_update
+        try:
+            k_before = m.host_params[emb.name]["kernel"].copy()
+            a = _staged_host_batch(m, dcfg, seed=0)
+            m.train_batch_staged(a)            # async scatter in flight
+            probe = _staged_host_batch(m, dcfg, seed=2)
+            got = np.asarray(m._host_emb_forward(probe.host_idx)[emb.name])
+            m._host_drain()
+            k_after = m.host_params[emb.name]["kernel"].copy()
+            want_pre = emb.host_lookup({"kernel": k_before},
+                                       probe.host_idx[emb.name])
+            want_post = emb.host_lookup({"kernel": k_after},
+                                        probe.host_idx[emb.name])
+            assert (np.array_equal(got, want_pre)
+                    or np.array_equal(got, want_post)), \
+                "gather saw a torn table"
+        finally:
+            emb.host_sgd_update = orig
+
+    def test_fit_prefetched_host_tables_trains_and_drains(self, tmp_path):
+        """End to end: streaming prefetch + async host tables + rolling
+        checkpoints. The pipeline and the scatter worker both drain for
+        the save and at the end of fit; the saved tables match the final
+        in-memory tables."""
+        from dlrm_flexflow_tpu.utils.checkpoint import restore_checkpoint
+        m, dcfg = _host_model(stage_dataset="never", prefetch_depth=2)
+        emb = next(iter(m._host_resident_ops))
+        x, y = synthetic_batch(dcfg, 80, seed=0)
+        before = m.host_params[emb]["kernel"].copy()
+        m.fit(x, y, epochs=2, verbose=False,
+              checkpoint_dir=str(tmp_path / "ck"), save_every=2)
+        assert m._host_scatter_thread is None         # drained
+        k = m.host_params[emb]["kernel"]
+        assert np.isfinite(k).all()
+        assert not np.array_equal(k, before), "tables must have trained"
+
+        m2, _ = _host_model(stage_dataset="never", prefetch_depth=2)
+        import glob
+        latest = sorted(glob.glob(str(tmp_path / "ck" / "ckpt-*.npz")))[-1]
+        restore_checkpoint(m2, latest)
+        np.testing.assert_array_equal(m2.host_params[emb]["kernel"], k)
+
+    def test_eval_after_async_steps_sees_latest_tables(self):
+        m, dcfg = _host_model()
+        for s in range(3):
+            x, y = synthetic_batch(dcfg, 16, seed=s)
+            x["label"] = y
+            m.train_batch(x)
+        x, _ = synthetic_batch(dcfg, 16, seed=9)
+        out = np.asarray(m.forward_batch(x))          # drains implicitly
+        assert m._host_scatter_thread is None
+        assert np.isfinite(out).all()

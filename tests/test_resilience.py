@@ -375,7 +375,11 @@ def _host_model():
     from dlrm_flexflow_tpu.parallel.mesh import make_mesh
     dcfg = DLRMConfig(embedding_size=[64] * 8, sparse_feature_size=8,
                       mlp_bot=[4, 16, 8], mlp_top=[72, 16, 1])
-    cfg = ff.FFConfig(batch_size=16, seed=7, host_resident_tables=True)
+    # exact-ordering mode (async off): the round-trip asserts below are
+    # bit-exact; the async default's one-step staleness is covered by the
+    # async-specific tests
+    cfg = ff.FFConfig(batch_size=16, seed=7, host_resident_tables=True,
+                      host_tables_async=False)
     m = ff.FFModel(cfg)
     build_dlrm(m, dcfg)
     # momentum SGD so host_opt_state carries a real slab ("v") to
