@@ -188,6 +188,19 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             pipeline = {"error": str(exc)[:200]}
 
+    # opt-in elastic-recovery smoke (BENCH_ELASTIC=1): detection latency,
+    # re-search time, reshard time, steps/s before vs after a half-fleet
+    # shrink
+    elastic = None
+    if os.environ.get("BENCH_ELASTIC"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_elastic import measure as _el_measure
+            elastic = _el_measure(steps=20)
+        except Exception as exc:
+            elastic = {"error": str(exc)[:200]}
+
     vs = 1.0
     base_file = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE")
     if os.path.exists(base_file):
@@ -211,6 +224,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         out["resilience"] = resilience
     if pipeline is not None:
         out["pipeline"] = pipeline
+    if elastic is not None:
+        out["elastic"] = elastic
     print(json.dumps(out))
     return 0
 

@@ -21,6 +21,8 @@ from .core.initializers import (ConstantInitializer, GlorotUniform,
 from .core.tensor import Tensor
 from .parallel.mesh import make_mesh
 from .parallel.pconfig import ParallelConfig
+from .parallel.distributed import MeshDegraded
+from .utils.watchdog import StallReport, WorkerStalled
 
 __version__ = "0.1.0"
 
@@ -31,4 +33,5 @@ __all__ = [
     "GlorotUniform", "ZeroInitializer", "UniformInitializer",
     "NormInitializer", "ConstantInitializer",
     "ParallelConfig", "make_mesh",
+    "MeshDegraded", "WorkerStalled", "StallReport",
 ]

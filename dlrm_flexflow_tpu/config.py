@@ -130,6 +130,28 @@ class FFConfig:
     checkpoint_dir: str = ""
     save_every: int = 0
     keep_last: int = 3
+    # elastic-mesh recovery (parallel/elastic.py): what fit() does when
+    # the mesh degrades (device loss via MeshDegraded, or a background
+    # worker missing its liveness deadline via WorkerStalled).
+    # "off" (propagate — legacy) | "resume" (re-plan onto the survivors
+    # and restore the newest rolling snapshot; exact, needs
+    # checkpoint_dir) | "inplace" (re-plan and reshard the in-memory
+    # state; no checkpoint needed, single-controller only). Set with
+    # --elastic {off,resume,inplace}.
+    elastic: str = "off"
+    # liveness deadline (seconds) for background workers — the prefetch
+    # ring's staging thread, the async host-table scatter worker — and
+    # the collective probe. 0 disables the watchdogs (blocking waits).
+    # Set with --worker-deadline SECONDS.
+    worker_deadline_s: float = 0.0
+    # MCMC budget for the post-degradation strategy re-search; 0 ships
+    # the greedy clamped plan without searching. Set with
+    # --elastic-budget N.
+    elastic_search_budget: int = 100
+    # cap on elastic recoveries per fit() call before the degradation is
+    # re-raised (a flapping fleet must not loop forever). Set with
+    # --max-recoveries N.
+    max_recoveries: int = 3
     unparsed: List[str] = field(default_factory=list)
 
     @property
@@ -223,6 +245,18 @@ class FFConfig:
                 cfg.save_every = int(take())
             elif a == "--keep-last":
                 cfg.keep_last = int(take())
+            elif a == "--elastic":
+                v = take()
+                if v not in ("off", "resume", "inplace"):
+                    raise ValueError(f"--elastic expects "
+                                     f"off|resume|inplace, got {v!r}")
+                cfg.elastic = v
+            elif a == "--worker-deadline":
+                cfg.worker_deadline_s = float(take())
+            elif a == "--elastic-budget":
+                cfg.elastic_search_budget = int(take())
+            elif a == "--max-recoveries":
+                cfg.max_recoveries = int(take())
             elif a == "--host-tables":
                 cfg.host_resident_tables = True
             elif a == "--host-tables-async":

@@ -36,7 +36,8 @@ from ..config import FFConfig
 from ..parallel.mesh import make_mesh
 from ..parallel.pconfig import ParallelConfig, StrategyMap
 from ..parallel.sharding import AxisAssigner
-from ..parallel.distributed import put_global
+from ..parallel.distributed import MeshDegraded, put_global
+from ..utils.watchdog import StallReport, WorkerStalled
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from . import losses as losses_mod
 from . import metrics as metrics_mod
@@ -1314,6 +1315,20 @@ class FFModel:
     def _train_dispatch(self, device_batch: Dict, host_idx,
                         next_host_idx=None):
         self._ensure_step_state()
+        if faults.active() is not None:
+            ndrop = faults.take_drop_device(self._step)
+            if ndrop:
+                # simulated preemption: the runtime's view of the mesh
+                # shrinks by the LAST ndrop devices (they stay physically
+                # alive on a CPU test mesh — exactly how a lost peer
+                # looks from the surviving hosts). Raised BEFORE dispatch
+                # so no state for this step is half-applied.
+                devs = list(self.mesh.devices.flat)
+                ndrop = min(ndrop, len(devs) - 1)
+                raise MeshDegraded(
+                    f"fault-injected loss of {ndrop} device(s) at step "
+                    f"{self._step}", lost=devs[len(devs) - ndrop:],
+                    surviving=devs[:len(devs) - ndrop])
         if faults.active() is not None and faults.take_nan_grad(self._step):
             # fault harness: poison the batch so NaNs flow through the
             # REAL autodiff into the loss/grad-norm the sentinel watches
@@ -1382,6 +1397,7 @@ class FFModel:
                 gathered = threading.Event()
                 self._host_gather_pending = ((nh, gathered)
                                              if nh is not None else None)
+                gen = getattr(self, "_host_gen", 0)
 
                 def scatter():
                     try:
@@ -1392,12 +1408,20 @@ class FFModel:
                         finally:
                             gathered.set()   # never leave a consumer
                             # parked on the event
+                        faults.maybe_stall("scatter")   # wedged-worker
+                        # fault: the drain watchdog must catch it
+                        if gen != getattr(self, "_host_gen", 0):
+                            # elastic recovery abandoned this worker and
+                            # replaced the tables underneath it — a late
+                            # scatter would corrupt the restored state
+                            return
                         if (anomaly_flag is None
                                 or not bool(np.asarray(anomaly_flag))):
                             self._host_emb_update(host_idx, cts, step)
                     except BaseException as e:   # re-raised at drain
                         self._host_scatter_exc = e
-                t = threading.Thread(target=scatter, daemon=True)
+                t = threading.Thread(target=scatter, daemon=True,
+                                     name="ff-scatter")
                 self._host_scatter_thread = t
                 t.start()
             else:
@@ -1435,19 +1459,52 @@ class FFModel:
             lk = self._host_table_lock = threading.Lock()
         return lk
 
-    def _host_drain(self):
+    def _worker_deadline_s(self) -> float:
+        """Configured background-worker liveness deadline (0 = watchdogs
+        off, every wait blocks forever — the pre-elastic behavior)."""
+        return float(getattr(self.config, "worker_deadline_s", 0.0)
+                     or 0.0)
+
+    def _host_drain(self, deadline_s: Optional[float] = None):
         """Join the in-flight async host scatter (no-op when none) and
         surface any exception it hit — a silently dropped scatter would
         corrupt training. Call before any read of host_params that needs
-        the latest update (eval, checkpoint, end of fit)."""
+        the latest update (eval, checkpoint, end of fit).
+
+        With a worker deadline configured (FFConfig.worker_deadline_s or
+        the explicit argument), a scatter worker that outlives it raises
+        a typed WorkerStalled (structured stall report, worker left
+        un-joined) instead of hanging the training loop; the elastic
+        layer abandons it via `_host_abandon` and recovers."""
         t = getattr(self, "_host_scatter_thread", None)
         if t is not None and t.is_alive():
-            t.join()
+            dl = (self._worker_deadline_s() if deadline_s is None
+                  else deadline_s)
+            if dl > 0:
+                t0 = time.perf_counter()
+                t.join(dl)
+                if t.is_alive():
+                    raise WorkerStalled(StallReport(
+                        worker=t.name, waiting_for="host-table scatter "
+                        "completion", waited_s=time.perf_counter() - t0,
+                        deadline_s=dl, detail=f"step {self._step}"))
+            else:
+                t.join()
         self._host_scatter_thread = None
         exc = getattr(self, "_host_scatter_exc", None)
         if exc is not None:
             self._host_scatter_exc = None
             raise exc
+
+    def _host_abandon(self):
+        """Drop (without joining) the in-flight scatter worker and any
+        chained gather, bumping the table generation so a late write
+        from the abandoned worker is discarded rather than scattered
+        into state the elastic recovery is about to replace."""
+        self._host_gen = getattr(self, "_host_gen", 0) + 1
+        self._host_scatter_thread = None
+        self._host_scatter_exc = None
+        self._host_prefetch_invalidate()
 
     def _host_prefetch_invalidate(self):
         """Drop a chained host-table gather (it is stale after anything
@@ -1470,7 +1527,18 @@ class FFModel:
         pending = getattr(self, "_host_gather_pending", None)
         if pending is not None and pending[0] is host_idx:
             self._host_gather_pending = None
-            pending[1].wait()
+            dl = self._worker_deadline_s()
+            if dl > 0:
+                if not pending[1].wait(dl):
+                    t = getattr(self, "_host_scatter_thread", None)
+                    raise WorkerStalled(StallReport(
+                        worker=getattr(t, "name", "ff-scatter"),
+                        waiting_for="chained host-table gather",
+                        waited_s=dl, deadline_s=dl,
+                        detail=f"step {self._step}",
+                        alive=bool(t is not None and t.is_alive())))
+            else:
+                pending[1].wait()
             got = getattr(self, "_host_gather_next", None)
             self._host_gather_next = None
             if got is not None and got[0] is host_idx:
@@ -1654,16 +1722,23 @@ class FFModel:
                     "nothing to train", checkpoint_dir, start_epoch, epochs)
                 return {"elapsed": 0.0, "throughput": 0.0,
                         "num_samples": 0, "rollbacks": 0,
+                        "recoveries": 0,
                         "metrics": self.perf.report()}
-            if getattr(self, "_anomaly_policy", "none") == "rollback" and \
-                    mgr.latest_valid() is None:
-                # rollback needs a target from step one: seed the directory
-                # with the initial state
+            if (getattr(self, "_anomaly_policy", "none") == "rollback"
+                    or getattr(self.config, "elastic", "off") == "resume") \
+                    and mgr.latest_valid() is None:
+                # rollback/elastic-resume need a target from step one:
+                # seed the directory with the initial state
                 mgr.save(self, {"epoch": start_epoch, "batch": start_batch})
         elif getattr(self, "_anomaly_policy", "none") == "rollback":
             raise ValueError(
                 'anomaly_policy="rollback" needs fit(checkpoint_dir=...) '
                 "(or FFConfig.checkpoint_dir) to roll back to")
+        elif getattr(self.config, "elastic", "off") == "resume":
+            log_model.warning(
+                'elastic="resume" without fit(checkpoint_dir=...): a '
+                "mesh degradation mid-run will have no snapshot to "
+                "resume from and will re-raise")
 
         # AOT-compile the train step so the timed loop starts warm without
         # consuming a real optimizer step (the reference warms its Legion
@@ -1766,13 +1841,19 @@ class FFModel:
             staging_cost = float("inf")
         elif stage_mode == "always":
             staging_cost = 0.0
-        if staging_cost <= budget:
+        def _stage_all():
+            # (re)build the device-resident batch list against the
+            # model's CURRENT input shardings — called once up front,
+            # and again by elastic recovery (arrays staged on the old
+            # mesh must not feed an executable compiled on the new one)
+            nonlocal staged, staged_rem, rem_ok
             staged = []
             for b in range(num_batches):
                 sl = slice(b * bs, (b + 1) * bs)
                 batch = {k: v[sl] for k, v in inputs.items()}
                 batch["label"] = labels[sl]
                 staged.append(self._device_batch(batch))
+            staged_rem = None
             if rem_ok:
                 # the remainder already fit the staging budget (the cost
                 # counted the whole dataset) — stage it once instead of
@@ -1787,6 +1868,9 @@ class FFModel:
                         "dropping the remainder batch (%d samples): it "
                         "cannot stage at its own shape (%s)", rem, e)
 
+        if staging_cost <= budget:
+            _stage_all()
+
         from ..utils.profiling import TraceContext
         # bound in-flight async steps: XLA CPU's in-process collectives can
         # starve when many multi-device executions queue up on few host
@@ -1800,6 +1884,9 @@ class FFModel:
         num_samples = 0
         rollbacks = 0
         max_rollbacks = getattr(self.config, "max_rollbacks", 3)
+        recoveries = 0
+        max_recoveries = getattr(self.config, "max_recoveries", 3)
+        elastic_mode = getattr(self.config, "elastic", "off")
 
         def _maybe_save(next_epoch, next_batch):
             # position = the NEXT (epoch, batch) to train; snapshots are
@@ -1860,8 +1947,9 @@ class FFModel:
                 e, b = sched[k]
                 return self._stage_step(_host_slice(e, b))
 
-            pipe = PrefetchPipeline(produce, depth=depth,
-                                    num_items=len(sched), name="fit")
+            pipe = PrefetchPipeline(
+                produce, depth=depth, num_items=len(sched), name="fit",
+                deadline_s=self._worker_deadline_s() or None)
 
         hres_async = bool(getattr(self, "_host_resident_list", None)
                           and getattr(self.config, "host_tables_async",
@@ -1920,11 +2008,17 @@ class FFModel:
 
         with TraceContext(self.config.profile_dir or None), _pipe_guard():
             epoch, b0 = start_epoch, start_batch
+            # resume position for the elastic "inplace" path: the batch
+            # about to train, plus whether its optimizer step actually
+            # applied before the degradation surfaced
+            cur = (start_epoch, start_batch)
+            step0 = self._step
             while epoch < epochs:
                 if b0 == 0:
                     self.reset_metrics()
                 try:
                     for b in range(b0, num_batches):
+                        cur, step0 = (epoch, b), self._step
                         if staged is not None:
                             mets = self.train_batch_device(staged[b])
                             # bound the pipeline without draining it: block
@@ -1942,6 +2036,11 @@ class FFModel:
                         num_samples += bs
                         _maybe_save(epoch, b + 1)
                     if rem_ok:
+                        # degradation during the remainder resumes at the
+                        # next epoch (the odd-shaped batch is not worth a
+                        # dedicated resume position; "resume" mode re-
+                        # winds exactly via the snapshot regardless)
+                        cur, step0 = (epoch + 1, 0), None
                         try:
                             if staged_rem is not None:
                                 mets = self.train_batch_device(staged_rem)
@@ -1993,6 +2092,50 @@ class FFModel:
                         # rewound position (deterministic, so exact)
                         _build_pipe(epoch, b0)
                     continue
+                except (MeshDegraded, WorkerStalled) as exc:
+                    if elastic_mode == "off" or recoveries >= max_recoveries:
+                        raise
+                    recoveries += 1
+                    inflight.clear()
+                    _close_pipe()
+                    if mgr is not None:
+                        try:
+                            mgr.wait()   # land/flush the in-flight save
+                        except Exception as save_exc:
+                            log_model.warning(
+                                "background checkpoint save failed "
+                                "during elastic recovery (%s); older "
+                                "snapshots remain usable", save_exc)
+                    from ..parallel.elastic import recover
+                    report = recover(self, lost=getattr(exc, "lost", []),
+                                     mode=elastic_mode, manager=mgr)
+                    if elastic_mode == "resume":
+                        ls = (report.entry or {}).get("loader_state") or {}
+                        epoch = int(ls.get("epoch", 0))
+                        b0 = min(int(ls.get("batch", 0)), num_batches)
+                    else:
+                        # inplace: continue at the batch about to train;
+                        # skip it if its optimizer step already applied
+                        # before the stall surfaced (post-step drain)
+                        e_, b_ = cur
+                        if step0 is not None and self._step > step0:
+                            b_ += 1
+                        if b_ >= num_batches:
+                            e_, b_ = e_ + 1, 0
+                        epoch, b0 = e_, b_
+                    log_model.warning(
+                        "mesh degradation (%s); elastic recovery %d/%d "
+                        "(%s) onto %d device(s) — resuming at epoch %d, "
+                        "batch %d", exc, recoveries, max_recoveries,
+                        elastic_mode, report.surviving, epoch, b0)
+                    if staged is not None:
+                        # re-stage the dataset against the NEW mesh's
+                        # input shardings (old-mesh arrays must not feed
+                        # the recompiled executable)
+                        _stage_all()
+                    if use_pipe:
+                        _build_pipe(epoch, b0)
+                    continue
                 if verbose and mets is not None:
                     # host sync happens here only (metrics are async)
                     print(f"epoch {epoch}: loss={float(mets['loss']):.6f} "
@@ -2018,4 +2161,5 @@ class FFModel:
                   f"THROUGHPUT = {throughput:.2f} samples/s")
         return {"elapsed": elapsed, "throughput": throughput,
                 "num_samples": num_samples, "rollbacks": rollbacks,
+                "recoveries": recoveries,
                 "metrics": self.perf.report()}

@@ -36,10 +36,17 @@ gather/H2D overlap metric.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
 from typing import Callable, Optional
+
+from ..utils.watchdog import StallReport, WorkerStalled
+
+# global ordinal for thread naming: every staging thread in the process
+# is distinguishable in a stack dump / stall report (ff-prefetch-0, ...)
+_PIPE_SEQ = itertools.count()
 
 
 class PrefetchPipeline:
@@ -53,12 +60,18 @@ class PrefetchPipeline:
                  the end raises IndexError.
     io_site    : fault-injection/retry site name for the transient-error
                  backoff wrapped around every produce call.
+    deadline_s : liveness deadline for the staging thread: `get()` that
+                 waits longer than this raises
+                 :class:`~..utils.watchdog.WorkerStalled` with a
+                 structured stall report instead of hanging (0/None =
+                 wait forever, the pre-watchdog behavior).
     """
 
     def __init__(self, produce: Callable[[int], object], depth: int = 2,
                  num_items: Optional[int] = None, name: str = "prefetch",
                  io_site: str = "prefetch", io_retries: int = 3,
-                 io_backoff_s: float = 0.05):
+                 io_backoff_s: float = 0.05,
+                 deadline_s: Optional[float] = None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._produce = produce
@@ -67,6 +80,7 @@ class PrefetchPipeline:
         self._io_site = io_site
         self._io_retries = io_retries
         self._io_backoff_s = io_backoff_s
+        self._deadline_s = deadline_s if deadline_s else None
         self._buf: deque = deque()
         self._cond = threading.Condition()
         self._stopped = False
@@ -76,13 +90,16 @@ class PrefetchPipeline:
         # staging-time accounting for the overlap metric
         self._produce_s = 0.0
         self._wait_s = 0.0
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name=f"{name}-staging")
+        self.name = name
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"ff-prefetch-{next(_PIPE_SEQ)}")
         self._thread.start()
 
     # --- producer side -------------------------------------------------
     def _run(self):
         from .dataloader import read_with_retries
+        from ..utils import faults
         i = 0
         while True:
             with self._cond:
@@ -93,6 +110,7 @@ class PrefetchPipeline:
                     return
             t0 = time.perf_counter()
             try:
+                faults.maybe_stall("prefetch")   # simulated wedged stager
                 item = read_with_retries(lambda: self._produce(i),
                                          self._io_site,
                                          retries=self._io_retries,
@@ -117,7 +135,11 @@ class PrefetchPipeline:
         """Next staged item, in produce order. Blocks until staged.
 
         Raises the staging thread's error (sticky — rebuild the pipeline
-        after), or IndexError past `num_items`."""
+        after), IndexError past `num_items`, or — when `deadline_s` is
+        set — :class:`WorkerStalled` if the staging thread misses its
+        liveness deadline (wedged device_put, stuck IO): the structured
+        stall report names the thread and what was awaited, and the
+        elastic layer recovers instead of the job hanging."""
         t0 = time.perf_counter()
         with self._cond:
             while not self._buf:
@@ -129,23 +151,45 @@ class PrefetchPipeline:
                     raise IndexError(
                         f"prefetch pipeline exhausted after {self._num} "
                         f"items")
-                self._cond.wait()
+                waited = time.perf_counter() - t0
+                if (self._deadline_s is not None
+                        and waited >= self._deadline_s):
+                    raise WorkerStalled(StallReport(
+                        worker=self._thread.name,
+                        waiting_for=f"staged item {self._consumed}",
+                        waited_s=waited, deadline_s=self._deadline_s,
+                        detail=(f"pipeline {self.name!r}: produced "
+                                f"{self._produced}, consumed "
+                                f"{self._consumed}, depth {self._depth}"),
+                        alive=self._thread.is_alive()))
+                timeout = (None if self._deadline_s is None
+                           else self._deadline_s - waited)
+                self._cond.wait(timeout)
             item = self._buf.popleft()
             self._consumed += 1
             self._wait_s += time.perf_counter() - t0
             self._cond.notify_all()
         return item
 
-    def close(self):
+    def close(self, join_timeout_s: float = 10.0):
         """Stop the producer, discard staged items, join the thread.
         Never raises — pending staging errors die with the pipeline
-        (a caller closing is abandoning the staged stream anyway)."""
+        (a caller closing is abandoning the staged stream anyway). The
+        join is BOUNDED: a wedged staging thread is abandoned (it is a
+        daemon, so interpreter shutdown and test teardown never hang on
+        it) rather than waited on forever."""
         with self._cond:
             self._stopped = True
             self._buf.clear()
             self._cond.notify_all()
         if self._thread is not threading.current_thread():
-            self._thread.join()
+            self._thread.join(timeout=join_timeout_s)
+            if self._thread.is_alive():
+                from ..utils.logging import get_logger
+                get_logger("prefetch").warning(
+                    "staging thread %s did not exit within %.3gs of "
+                    "close(); abandoning it (daemon)",
+                    self._thread.name, join_timeout_s)
 
     @property
     def closed(self) -> bool:

@@ -23,7 +23,9 @@ per-point-task scatter, dlrm.cc:384-589).
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional, Sequence
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -31,6 +33,150 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .mesh import _prime_factors
+from ..utils.logging import get_logger
+
+log_dist = get_logger("distributed")
+
+
+class MeshDegraded(RuntimeError):
+    """The device mesh lost participants (preempted host, dead chip,
+    stalled collective past its deadline).
+
+    This is the TYPED surface of topology change: anything that used to
+    hang (a collective waiting on a dead peer) or kill the job (a device
+    enumeration shrinking mid-run) raises this instead, carrying enough
+    structure for ``parallel.elastic.recover`` to re-plan onto the
+    survivors. ``lost`` / ``surviving`` are device (or host-id) lists;
+    either may be empty when the detection path only knows counts.
+    """
+
+    def __init__(self, reason: str, lost: Sequence = (),
+                 surviving: Optional[Sequence] = None,
+                 report=None):
+        lost = list(lost)
+        msg = f"mesh degraded: {reason}"
+        if lost:
+            msg += f" (lost {len(lost)}: {[str(d) for d in lost]})"
+        super().__init__(msg)
+        self.reason = reason
+        self.lost = lost
+        self.surviving = list(surviving) if surviving is not None else None
+        self.report = report   # optional utils.watchdog.StallReport
+
+
+class ParticipantRegistry:
+    """Heartbeat registry over the cluster's participants (hosts or
+    devices).
+
+    The reference's Legion runtime learns about node death from GASNet
+    conduit errors; JAX SPMD has no such channel — a dead host just makes
+    the next collective hang. This registry is the userspace substitute:
+    every participant calls :meth:`heartbeat` periodically (the training
+    loop does it once per step for its own host), and :meth:`check`
+    raises :class:`MeshDegraded` naming every participant whose last
+    heartbeat is older than the deadline. Thread-safe — workers heartbeat
+    from their own threads.
+    """
+
+    def __init__(self, participants: Sequence, deadline_s: float = 30.0):
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        self.deadline_s = float(deadline_s)
+        self._lock = threading.Lock()
+        now = time.monotonic()
+        self._last: Dict = {p: now for p in participants}
+
+    @property
+    def participants(self) -> List:
+        with self._lock:
+            return list(self._last)
+
+    def heartbeat(self, participant) -> None:
+        with self._lock:
+            self._last[participant] = time.monotonic()
+
+    def mark_dead(self, participant) -> None:
+        """Force-expire a participant (external failure signal — e.g. a
+        preemption notice — without waiting out the deadline)."""
+        with self._lock:
+            if participant in self._last:
+                self._last[participant] = float("-inf")
+
+    def dead(self, now: Optional[float] = None) -> List:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return [p for p, t in self._last.items()
+                    if now - t > self.deadline_s]
+
+    def check(self) -> None:
+        """Raise :class:`MeshDegraded` when any participant missed its
+        heartbeat deadline; no-op when everyone is live."""
+        lost = self.dead()
+        if lost:
+            with self._lock:
+                surviving = [p for p in self._last if p not in set(lost)]
+            raise MeshDegraded(
+                f"{len(lost)} participant(s) missed the "
+                f"{self.deadline_s:.3g}s heartbeat deadline",
+                lost=lost, surviving=surviving)
+
+
+def probe_mesh(mesh: Mesh, deadline_s: float = 30.0) -> float:
+    """Collective-deadline watchdog: run one tiny all-reduce over the
+    mesh with a wall-clock deadline; return its latency in seconds.
+
+    A dead or wedged host makes cross-host collectives block forever —
+    the canonical "job hangs at 100% idle" failure. The probe runs the
+    collective on a watchdog thread and waits with a timeout, so the
+    CALLER gets a typed :class:`MeshDegraded` at the deadline instead of
+    hanging (the probe thread is daemon and is abandoned; a genuinely
+    dead mesh cannot be un-blocked from userspace).
+
+    Fault injection: ``FF_FAULT_STALL_COLLECTIVE`` /
+    ``FaultPlan.stall_s["collective"]`` stalls the probe once so the
+    deadline path is test-driven on a healthy CPU mesh.
+    """
+    from ..utils import faults
+    from ..utils.watchdog import StallReport
+
+    done = threading.Event()
+    result: list = []
+
+    def _collective():
+        try:
+            faults.maybe_stall("collective")
+            ones = jax.device_put(
+                np.ones((mesh.size,), np.float32),
+                NamedSharding(mesh, PartitionSpec(mesh.axis_names)))
+            total = float(jax.jit(
+                lambda x: x.sum(),
+                out_shardings=NamedSharding(mesh, PartitionSpec()))(ones))
+            result.append(total)
+        except BaseException as e:   # surfaced below as degradation
+            result.append(e)
+        finally:
+            done.set()
+
+    t0 = time.monotonic()
+    t = threading.Thread(target=_collective, daemon=True,
+                         name="ff-mesh-probe")
+    t.start()
+    if not done.wait(deadline_s):
+        report = StallReport(
+            worker="ff-mesh-probe", waiting_for="mesh all-reduce",
+            waited_s=time.monotonic() - t0, deadline_s=deadline_s,
+            detail=f"mesh={dict(mesh.shape)}")
+        raise MeshDegraded(
+            f"collective did not complete within {deadline_s:.3g}s "
+            f"(dead or stalled host)", report=report)
+    out = result[0]
+    if isinstance(out, BaseException):
+        raise MeshDegraded(f"collective failed: {out}") from out
+    if out != float(mesh.size):
+        raise MeshDegraded(
+            f"collective returned {out} from a {mesh.size}-device "
+            f"all-reduce of ones (corrupt mesh state)")
+    return time.monotonic() - t0
 
 
 def _force_cpu_cluster(devices_per_process: int) -> None:
@@ -146,6 +292,17 @@ def make_multihost_mesh(devices: Optional[Sequence] = None,
     if num_slices is None:
         groups = _slice_groups(devices)
         num_slices = len(groups)
+        sizes = {k: len(g) for k, g in groups.items()}
+        if len(set(sizes.values())) > 1:
+            # uneven per-host device counts (a half-dead host after a
+            # chip failure): reshaping would silently MIX hosts within a
+            # slice row, putting DCN hops inside "ICI" axes — reject
+            # loudly; elastic recovery drops to the survivors instead
+            raise ValueError(
+                f"uneven devices per DCN domain {sizes}: every "
+                f"slice/host must contribute the same device count "
+                f"(drop the degraded host's devices, or re-plan via "
+                f"parallel.elastic on the surviving homogeneous set)")
         # stable order: by slice key, then device order within
         devices = [d for k in sorted(groups) for d in groups[k]]
     n = len(devices)

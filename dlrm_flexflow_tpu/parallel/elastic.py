@@ -1,0 +1,178 @@
+"""Elastic-mesh recovery: keep training after device loss.
+
+The paper's framework searches a SOAP parallelization for a FIXED machine
+model; on real TPU fleets preemptions and chip failures shrink the
+topology mid-run. Bamboo/Varuna-style elasticity is: detect the shrunken
+topology (``parallel.distributed.MeshDegraded`` — heartbeat registry,
+collective-deadline probe, fault injection), RE-PLAN parallelism for it
+(``search.replan`` — constrained MCMC with a greedy clamp fallback),
+reshard state, and continue. This module is the orchestration of those
+pieces into one verb:
+
+    report = recover(model, lost=dead_devices, manager=ckpt_mgr)
+
+Recovery modes (``FFConfig.elastic`` / ``--elastic``):
+
+- ``"off"``     — no recovery; MeshDegraded propagates (legacy behavior).
+- ``"resume"``  — recompile onto the survivors, then restore the newest
+  valid rolling snapshot through the manager. Exact: training repeats
+  from the last checkpoint, so the post-recovery trajectory is
+  bit-identical to a fresh job started on the shrunken mesh from the
+  same snapshot (tests/test_elastic.py pins this).
+- ``"inplace"`` — gather the CURRENT in-memory state to host, recompile,
+  re-split onto the new mesh, continue from the current step. No
+  checkpoint required and no lost steps, but single-controller only
+  (the host gather reads every shard; a multi-host job whose dead peer
+  held shards must use ``"resume"``). With ``host_tables_async`` the
+  dropped step's host scatter may be lost (the documented one-step
+  staleness also bounds recovery).
+
+The reshard itself is simple by construction: snapshots are
+host-gathered full arrays, so loading them through the model's freshly
+compiled ``_param_sharding`` (plain ``device_put`` per parameter) IS the
+gather-to-host → re-split per new partition degrees step; host-resident
+tables are already mesh-agnostic numpy.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from .distributed import MeshDegraded
+from .mesh import make_mesh
+from .pconfig import StrategyMap
+from ..utils.logging import get_logger
+
+log_elastic = get_logger("elastic")
+
+
+@dataclass
+class RecoveryReport:
+    """What one elastic recovery did, with timings for bench_elastic."""
+
+    mode: str
+    lost: List[Any]
+    surviving: int
+    strategies: StrategyMap
+    step: int                       # step training continues from
+    replan_s: float = 0.0
+    reshard_s: float = 0.0
+    total_s: float = 0.0
+    searched: bool = False          # MCMC ran (vs greedy clamp only)
+    greedy_fallback: bool = False
+    # manifest entry for "resume" mode (carries loader_state so fit can
+    # rewind its (epoch, batch) position); None for "inplace"
+    entry: Optional[Dict[str, Any]] = field(default=None, repr=False)
+
+
+def surviving_devices(mesh, lost: Sequence) -> List:
+    """The mesh's devices minus the lost ones, in mesh order."""
+    lost_ids = {id(d) for d in lost} | {str(d) for d in lost}
+    return [d for d in mesh.devices.flat
+            if id(d) not in lost_ids and str(d) not in lost_ids]
+
+
+def recover(model, lost: Sequence = (), mode: Optional[str] = None,
+            manager=None, budget: Optional[int] = None,
+            seed: int = 0) -> RecoveryReport:
+    """Re-plan + reshard `model` onto the devices surviving `lost`.
+
+    Steps: quiesce background workers → re-search strategies for the
+    surviving count (greedy fallback on failure/zero budget) → factorize
+    a fresh mesh → recompile the step functions → reshard params/opt
+    state/op state (from memory for ``inplace``, from the newest valid
+    snapshot via `manager` for ``resume``). Raises MeshDegraded when no
+    devices survive, ValueError on misuse (mode "off", resume without a
+    manager or restorable snapshot).
+    """
+    t_start = time.perf_counter()
+    cfg = getattr(model, "config", None)
+    mode = mode or getattr(cfg, "elastic", "off")
+    if mode not in ("resume", "inplace"):
+        raise ValueError(
+            f"elastic recovery needs mode 'resume' or 'inplace', got "
+            f"{mode!r} (set FFConfig.elastic / --elastic)")
+    if budget is None:
+        budget = int(getattr(cfg, "elastic_search_budget", 100) or 0)
+    if model.mesh is None:
+        raise ValueError("recover() needs a compiled model (no mesh)")
+
+    # 1. quiesce: abandon/drain background workers so nothing scatters
+    #    into state we are about to replace (a wedged worker is exactly
+    #    why we may be here — never block on it)
+    if hasattr(model, "_host_abandon"):
+        model._host_abandon()
+
+    old_mesh = model.mesh
+    survivors = surviving_devices(old_mesh, lost)
+    if not survivors:
+        raise MeshDegraded("no surviving devices to recover onto",
+                           lost=list(lost))
+    if len(survivors) == old_mesh.size and lost:
+        log_elastic.warning(
+            "lost devices %s were not part of the mesh; recovering "
+            "anyway (mesh rebuild + reshard on the same %d devices)",
+            [str(d) for d in lost], len(survivors))
+
+    # 2. re-plan parallelism for the surviving count (deterministic for
+    #    a fixed seed — the bit-identity contract depends on it)
+    from ..search.replan import replan_strategies
+    strategies, info = replan_strategies(
+        model, len(survivors), old=model.strategies, budget=budget,
+        seed=seed)
+
+    # 3. inplace: gather current state to host BEFORE the recompile
+    #    (device arrays stay valid either way — np.asarray reads any
+    #    sharding — but gathering first keeps the invariant that a
+    #    recompile failure leaves the model untouched)
+    flat = None
+    if mode == "inplace":
+        from ..utils.checkpoint import _model_flat
+        flat = _model_flat(model, copy_host=True)
+
+    # 4. fresh factorized mesh over the survivors + recompile the step.
+    #    compile() rebuilds shardings, host-residency sets, and the
+    #    jitted train/eval steps; the executable cache is dropped.
+    t_reshard = time.perf_counter()
+    new_mesh = make_mesh(devices=survivors)
+    model.compile(optimizer=model.optimizer, loss_type=model.loss_type,
+                  metrics=model.metrics, mesh=new_mesh,
+                  strategies=strategies,
+                  final_tensor=model._preds_tensor)
+
+    # 5. reshard state onto the new mesh
+    entry = None
+    if mode == "inplace":
+        from ..utils.checkpoint import restore_from_flat
+        restore_from_flat(model, flat, source="<elastic inplace>")
+    else:
+        if manager is None:
+            raise ValueError(
+                'elastic mode "resume" needs a CheckpointManager '
+                "(fit(checkpoint_dir=...) provides one)")
+        entry = manager.restore_latest(model)
+        if entry is None:
+            raise MeshDegraded(
+                "no restorable snapshot for elastic resume (checkpoint "
+                "directory empty or all snapshots invalid)",
+                lost=list(lost))
+    reshard_s = time.perf_counter() - t_reshard
+
+    report = RecoveryReport(
+        mode=mode, lost=list(lost), surviving=len(survivors),
+        strategies=strategies, step=int(model._step),
+        replan_s=float(info.get("replan_s", 0.0)),
+        reshard_s=reshard_s,
+        total_s=time.perf_counter() - t_start,
+        searched=bool(info.get("searched", False)),
+        greedy_fallback=bool(info.get("greedy_fallback", False)),
+        entry=entry)
+    log_elastic.warning(
+        "elastic recovery (%s): %d -> %d devices, replan %.0f ms "
+        "(%s), reshard %.0f ms, resuming at step %d",
+        mode, old_mesh.size, len(survivors), 1e3 * report.replan_s,
+        "searched" if report.searched else "greedy clamp",
+        1e3 * report.reshard_s, report.step)
+    return report

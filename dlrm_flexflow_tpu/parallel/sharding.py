@@ -70,6 +70,28 @@ def assignable(degrees: Sequence[int], axis_sizes: Sequence[int]) -> bool:
     return assign_indices(degrees, axis_sizes) is not None
 
 
+def clamp_degrees(degrees: Sequence[int],
+                  axis_sizes: Sequence[int]) -> Tuple[int, ...]:
+    """Project a degree tuple onto a (typically smaller) factorized mesh
+    — the per-op core of elastic re-planning (search/replan.py).
+
+    Each degree drops to the largest feasible degree not exceeding it;
+    if the result is not JOINTLY assignable (axes exhausted), parallelism
+    is shed from the LAST dims first — inner model-parallel dims are the
+    ones that cost collectives, while the leading sample dim is the
+    cheapest parallelism to keep. Always returns a jointly-assignable
+    tuple (all-1s in the worst case)."""
+    feas = feasible_degrees_for(axis_sizes)
+    degs = [max((f for f in feas if f <= d), default=1) for d in degrees]
+    for i in range(len(degs) - 1, -1, -1):
+        if assignable(degs, axis_sizes):
+            break
+        degs[i] = 1
+    if not assignable(degs, axis_sizes):
+        degs = [1] * len(degs)
+    return tuple(degs)
+
+
 class AxisAssigner:
     """Maps partition degrees to tuples of mesh axes, consuming axes in mesh
     order so equal degrees on the same dim index always get the same axes."""

@@ -1,0 +1,100 @@
+"""Strategy re-planning for a changed device count (elastic recovery).
+
+The MCMC search (`search/mcmc.py`) auto-discovers a SOAP strategy for a
+FIXED machine model, like the reference's simulator-driven search
+(model.cc:1093-1144). When preemption shrinks the fleet mid-run, the
+surviving devices need a NEW strategy — Varuna-style re-planning: keep
+what transfers from the old plan, re-search under the new constraint,
+and always have a cheap greedy answer when the search budget is zero or
+the search itself fails (recovery must never be the thing that dies).
+
+Two layers:
+
+- :func:`clamp_strategies` — deterministic, search-free projection of an
+  existing strategy map onto a smaller device count: every partition
+  degree drops to the largest feasible degree on the new factorized mesh
+  that divides into the old intent, and joint assignability is repaired
+  per-op. This is the greedy fallback AND the warm start for the search.
+- :func:`replan_strategies` — clamp, then (budget permitting) re-run the
+  simulated-annealing search constrained to the surviving count, seeded
+  from the clamped map so the walk starts from a feasible, near-optimal
+  point. Deterministic for a fixed seed — the elastic bit-identity test
+  relies on an independent caller reproducing the same plan.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+from ..core.op import InputOp
+from ..parallel.mesh import structural_axis_sizes
+from ..parallel.pconfig import ParallelConfig, StrategyMap
+from ..parallel.sharding import clamp_degrees
+from ..utils.logging import get_logger
+
+log_replan = get_logger("replan")
+
+
+def clamp_strategies(model, strategies: Optional[StrategyMap],
+                     ndev: int) -> StrategyMap:
+    """Project `strategies` onto an `ndev`-device target (greedy re-plan).
+
+    Per op: `parallel.sharding.clamp_degrees` drops every dim's degree
+    to the largest feasible one on the ndev factorized mesh and repairs
+    joint assignability. Ops missing from the old map (or with no map at
+    all) get their default data-parallel config for ndev.
+    """
+    axis_sizes = structural_axis_sizes(ndev)
+    strategies = dict(strategies or {})
+    out: StrategyMap = {}
+    for op in model.ops:
+        if isinstance(op, InputOp):
+            continue
+        pc = strategies.get(op.name)
+        if pc is None:
+            out[op.name] = op.default_parallel_config(ndev)
+            continue
+        out[op.name] = ParallelConfig(
+            clamp_degrees(pc.degrees, axis_sizes),
+            device_type=pc.device_type,
+            memory_types=pc.memory_types)
+    return out
+
+
+def replan_strategies(model, ndev: int,
+                      old: Optional[StrategyMap] = None,
+                      budget: int = 100, seed: int = 0,
+                      cost_model=None,
+                      ) -> Tuple[StrategyMap, Dict[str, float]]:
+    """Re-plan the per-op strategy map for `ndev` surviving devices.
+
+    Returns ``(strategies, info)`` where info carries ``replan_s`` (wall
+    time), ``searched`` (whether the MCMC walk actually ran) and
+    ``greedy_fallback`` (True when the search failed or the budget was
+    exhausted and the clamped map shipped as-is). Deterministic for fixed
+    (model, ndev, old, budget, seed).
+    """
+    t0 = time.perf_counter()
+    old = old if old is not None else dict(model.strategies or {})
+    greedy = clamp_strategies(model, old, ndev)
+    info: Dict[str, float] = {"searched": False, "greedy_fallback": True}
+    best = greedy
+    if budget and budget > 0:
+        try:
+            from .mcmc import optimize
+            best = optimize(model, budget=budget, ndev=ndev,
+                            seed=seed, start=greedy,
+                            cost_model=cost_model)
+            info["searched"] = True
+            info["greedy_fallback"] = False
+        except Exception as e:
+            # the search is an OPTIMIZATION of recovery, never a
+            # requirement: a cost-model/simulator failure must not turn
+            # a survivable preemption into a dead job
+            log_replan.warning(
+                "strategy re-search failed (%s); recovering on the "
+                "greedy clamped plan", e)
+            best = greedy
+    info["replan_s"] = time.perf_counter() - t0
+    return best, info

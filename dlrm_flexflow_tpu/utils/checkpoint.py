@@ -96,7 +96,30 @@ def _model_flat(model, copy_host: bool = False) -> Dict[str, np.ndarray]:
     flat.update({f"hostparams/{k}": v for k, v in host.items()})
     flat.update({f"hostopt/{k}": v for k, v in hostopt.items()})
     flat["meta/step"] = np.asarray(model._step)
+    # mesh provenance: arrays above are host-gathered (mesh-agnostic
+    # bytes), but the WRITER's topology is recorded so a restore onto a
+    # different mesh is an explicit decision (elastic mode), not an
+    # accident silently inheriting stale parallelism assumptions
+    mesh = getattr(model, "mesh", None)
+    if mesh is not None:
+        flat["meta/mesh_axes"] = np.asarray(
+            [mesh.shape[a] for a in mesh.axis_names], np.int64)
+        flat["meta/num_devices"] = np.asarray(mesh.size, np.int64)
     return flat
+
+
+def mesh_meta(model) -> Dict[str, Any]:
+    """Manifest-ready description of the mesh + per-op partition degrees
+    a snapshot was written under (JSON-serializable)."""
+    mesh = getattr(model, "mesh", None)
+    meta: Dict[str, Any] = {}
+    if mesh is not None:
+        meta["axes"] = {a: int(mesh.shape[a]) for a in mesh.axis_names}
+        meta["num_devices"] = int(mesh.size)
+    strategies = getattr(model, "strategies", None) or {}
+    meta["degrees"] = {name: list(map(int, pc.degrees))
+                       for name, pc in strategies.items()}
+    return meta
 
 
 def _write_npz_atomic(path: str, flat: Dict[str, np.ndarray]) -> int:
@@ -168,9 +191,19 @@ def save_checkpoint(model, path: str):
     _write_npz_atomic(path, _model_flat(model))
 
 
-def restore_checkpoint(model, path: str):
+def restore_checkpoint(model, path: str, elastic: Optional[bool] = None):
     """Restore into a compiled model, re-applying each parameter's GSPMD
-    sharding."""
+    sharding.
+
+    Snapshot arrays are host-gathered (full, unsharded), so the
+    device_put below IS the reshard: loading a snapshot written under
+    mesh A into a model compiled on mesh B re-splits every tensor per
+    B's partition degrees (host-resident tables stay numpy and need no
+    resharding at all). That cross-mesh load is only performed when
+    `elastic` is True (default: ``model.config.elastic != "off"``);
+    otherwise a mesh mismatch is rejected UP FRONT with the recorded
+    topology in the message — never half-applied mid-load.
+    """
     # the restore replaces host tables underneath any in-flight async
     # scatter / chained prefetch gather: land the scatter first, then
     # drop the (now stale) prefetched gather
@@ -179,6 +212,26 @@ def restore_checkpoint(model, path: str):
     if hasattr(model, "_host_prefetch_invalidate"):
         model._host_prefetch_invalidate()
     data = np.load(path if path.endswith(".npz") else path + ".npz")
+    if elastic is None:
+        elastic = getattr(getattr(model, "config", None), "elastic",
+                          "off") != "off"
+    if "meta/num_devices" in data.files and model.mesh is not None:
+        ck_ndev = int(data["meta/num_devices"])
+        ck_axes = [int(x) for x in data["meta/mesh_axes"]] \
+            if "meta/mesh_axes" in data.files else None
+        cur_axes = [int(model.mesh.shape[a])
+                    for a in model.mesh.axis_names]
+        if not elastic and (ck_ndev != model.mesh.size
+                            or (ck_axes is not None
+                                and ck_axes != cur_axes)):
+            raise ValueError(
+                f"checkpoint {path} was written under a "
+                f"{ck_ndev}-device mesh (axes {ck_axes}) but this model "
+                f"is compiled for {model.mesh.size} devices (axes "
+                f"{cur_axes}). Cross-mesh restore needs elastic mode: "
+                f"set FFConfig.elastic='resume' (--elastic resume) or "
+                f"pass restore_checkpoint(..., elastic=True) to reshard "
+                f"the snapshot onto the current mesh.")
     params_flat, opt_flat, state_flat = {}, {}, {}
     host_flat, hostopt_flat = {}, {}
     for k in data.files:
@@ -192,6 +245,32 @@ def restore_checkpoint(model, path: str):
             host_flat[k[len("hostparams/"):]] = data[k]
         elif k.startswith("hostopt/"):
             hostopt_flat[k[len("hostopt/"):]] = data[k]
+    return _apply_flat_state(model, params_flat, opt_flat, state_flat,
+                             host_flat, hostopt_flat,
+                             int(data["meta/step"]), source=path)
+
+
+def restore_from_flat(model, flat: Dict[str, np.ndarray],
+                      source: str = "<memory>"):
+    """Restore a `_model_flat` snapshot held in memory (no file round
+    trip) — the elastic IN-PLACE reshard path: gather-to-host happened in
+    `_model_flat`, the re-split onto the model's (new) mesh happens
+    here via the per-parameter device_put."""
+    parts = {"params/": {}, "opt/": {}, "state/": {},
+             "hostparams/": {}, "hostopt/": {}}
+    for k, v in flat.items():
+        for prefix, d in parts.items():
+            if k.startswith(prefix):
+                d[k[len(prefix):]] = v
+                break
+    return _apply_flat_state(model, parts["params/"], parts["opt/"],
+                             parts["state/"], parts["hostparams/"],
+                             parts["hostopt/"],
+                             int(flat["meta/step"]), source=source)
+
+
+def _apply_flat_state(model, params_flat, opt_flat, state_flat, host_flat,
+                      hostopt_flat, step: int, source: str):
     params = _unflatten(params_flat)
     # validate against the model's parameter spec before overwriting
     # anything: a mismatch (e.g. a checkpoint from a per-table or
@@ -224,7 +303,7 @@ def restore_checkpoint(model, path: str):
                 "checkpoint %s has no parameters for %d model op(s) %s — "
                 "these keep their CURRENT in-memory values (checkpoint "
                 "written by a smaller/different graph?)",
-                path, len(missing), missing)
+                source, len(missing), missing)
     # re-shard parameters per compile-time shardings
     for opname, pdict in params.items():
         shards = model._param_sharding.get(opname, {})
@@ -240,7 +319,7 @@ def restore_checkpoint(model, path: str):
         model.host_params = _unflatten(host_flat)
     if hostopt_flat:
         model.host_opt_state = _unflatten(hostopt_flat)
-    model._step = int(data["meta/step"])
+    model._step = int(step)
     # the jitted step threads a device-resident step counter and metric
     # sums; drop them so the next step re-seeds from the restored _step
     # (a rollback that re-winds _step would otherwise keep training from
@@ -329,7 +408,7 @@ class CheckpointManager:
         step = int(model._step)
         flat = _model_flat(model, copy_host=True)
         self._write_snapshot(flat, step, config_fingerprint(model),
-                             dict(loader_state or {}))
+                             dict(loader_state or {}), mesh_meta(model))
 
     def save_async(self, model,
                    loader_state: Optional[Dict[str, Any]] = None):
@@ -342,15 +421,16 @@ class CheckpointManager:
         flat = _model_flat(model, copy_host=True)
         fp = config_fingerprint(model)
         state = dict(loader_state or {})
+        mmeta = mesh_meta(model)
 
         def work():
             try:
-                self._write_snapshot(flat, step, fp, state)
+                self._write_snapshot(flat, step, fp, state, mmeta)
             except BaseException as e:   # surfaced at wait()/next save
                 self._thread_exc = e
 
         self._thread = threading.Thread(target=work, daemon=True,
-                                        name="ckpt-writer")
+                                        name="ff-ckpt-writer")
         self._thread.start()
 
     def wait(self) -> None:
@@ -365,7 +445,8 @@ class CheckpointManager:
             raise exc
 
     def _write_snapshot(self, flat, step: int, fingerprint: str,
-                        loader_state: Dict[str, Any]) -> None:
+                        loader_state: Dict[str, Any],
+                        mesh: Optional[Dict[str, Any]] = None) -> None:
         fname = f"ckpt-{step:08d}.npz"
         path = os.path.join(self.directory, fname)
         t0 = time.time()
@@ -373,6 +454,12 @@ class CheckpointManager:
         entry = {"file": fname, "step": step, "crc32": crc,
                  "fingerprint": fingerprint, "time": time.time(),
                  "loader_state": loader_state}
+        if mesh:
+            # mesh shape / device count / per-op partition degrees the
+            # snapshot was written under — elastic recovery reads these
+            # to decide whether a restore needs resharding, and the
+            # non-elastic path uses them to reject-with-reason
+            entry["mesh"] = mesh
         with self._manifest_lock:
             manifest = self._read_manifest()
             manifest["entries"] = [e for e in manifest["entries"]

@@ -24,6 +24,16 @@ reproducible points so every recovery branch runs under test:
 - **Transient IO errors** (`io_errors`): raise ``IOError`` from dataloader
   reads for the first N attempts at a named site, exercised against the
   retry/backoff in ``FFBinDataLoader``.
+- **Device loss** (`drop_device_steps`): at a chosen global step, report N
+  devices as lost so the elastic recovery layer sees a typed
+  ``MeshDegraded`` on a healthy CPU mesh (the devices stay physically
+  alive — only the runtime's view shrinks, which is exactly what a TPU
+  preemption looks like from the surviving hosts).
+- **Stalled workers/collectives** (`stall_s`): sleep a named site once —
+  ``"collective"`` freezes the mesh-liveness probe
+  (``parallel.distributed.probe_mesh``), ``"scatter"`` wedges the async
+  host-table scatter worker — so the deadline watchdogs
+  (``utils/watchdog.py``) must detect the stall, not a human.
 
 Faults are consume-once: each injection decrements its budget, so a
 recovery path that retries the same step does not re-fault (rollback would
@@ -41,6 +51,13 @@ subprocess kill-test needs):
 - ``FF_FAULT_ABORT_WRITES=1``      abort the next 1 checkpoint save
 - ``FF_FAULT_WRITE_DELAY=0.5``     sleep 0.5s between temp write and rename
 - ``FF_FAULT_IO_ERRORS=ffbin_read:2``  2 transient IOErrors at that site
+- ``FF_FAULT_DROP_DEVICE=4:2``     lose 2 devices at global step 4
+  (``=4`` alone loses 1 device at step 4)
+- ``FF_FAULT_STALL_COLLECTIVE=3``  stall the next collective probe 3s
+
+Unknown ``FF_FAULT_*`` keys are a WARNING, not a silent no-op: a typo'd
+key used to disable injection entirely, which made a passing resilience
+test meaningless.
 """
 
 from __future__ import annotations
@@ -75,6 +92,12 @@ class FaultPlan:
     write_delay_s: float = 0.0
     # site name -> number of transient IOErrors to raise there
     io_errors: Dict[str, int] = field(default_factory=dict)
+    # global step -> number of devices to report lost at that step
+    # (consume-once; drives parallel.elastic recovery on CPU meshes)
+    drop_device_steps: Dict[int, int] = field(default_factory=dict)
+    # site name ("collective", "scatter", "prefetch", ...) -> seconds to
+    # sleep there once (consume-once; the watchdog deadline must fire)
+    stall_s: Dict[str, float] = field(default_factory=dict)
     # record of (hook, detail) actually fired, for test assertions
     fired: List[tuple] = field(default_factory=list)
 
@@ -90,14 +113,35 @@ _ACTIVE: Optional[FaultPlan] = None
 _ENV_CHECKED = False
 
 
+_KNOWN_ENV_KEYS = ("FF_FAULT_NAN_STEPS", "FF_FAULT_TRUNCATE_CKPTS",
+                   "FF_FAULT_ABORT_WRITES", "FF_FAULT_WRITE_DELAY",
+                   "FF_FAULT_IO_ERRORS", "FF_FAULT_DROP_DEVICE",
+                   "FF_FAULT_STALL_COLLECTIVE")
+
+
 def plan_from_env() -> Optional[FaultPlan]:
-    """Build a plan from FF_FAULT_* env vars; None when none are set."""
+    """Build a plan from FF_FAULT_* env vars; None when none are set.
+
+    Unknown ``FF_FAULT_*`` keys warn loudly: a typo
+    (``FF_FAULT_NAN_STEP=3``) used to silently disable injection, so the
+    resilience test it was driving passed without exercising anything.
+    """
+    unknown = sorted(k for k in os.environ
+                     if k.startswith("FF_FAULT_")
+                     and k not in _KNOWN_ENV_KEYS)
+    if unknown:
+        log_faults.warning(
+            "ignoring unknown fault-injection env key(s) %s — known keys "
+            "are %s (typo? the fault you meant to inject is NOT active)",
+            unknown, list(_KNOWN_ENV_KEYS))
     nan = os.environ.get("FF_FAULT_NAN_STEPS", "")
     trunc = os.environ.get("FF_FAULT_TRUNCATE_CKPTS", "")
     aborts = os.environ.get("FF_FAULT_ABORT_WRITES", "")
     delay = os.environ.get("FF_FAULT_WRITE_DELAY", "")
     ioerrs = os.environ.get("FF_FAULT_IO_ERRORS", "")
-    if not any((nan, trunc, aborts, delay, ioerrs)):
+    drop = os.environ.get("FF_FAULT_DROP_DEVICE", "")
+    stall_coll = os.environ.get("FF_FAULT_STALL_COLLECTIVE", "")
+    if not any((nan, trunc, aborts, delay, ioerrs, drop, stall_coll)):
         return None
     plan = FaultPlan()
     if nan:
@@ -112,6 +156,17 @@ def plan_from_env() -> Optional[FaultPlan]:
         if ":" in part:
             site, n = part.rsplit(":", 1)
             plan.io_errors[site.strip()] = int(n)
+    for part in drop.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            step, cnt = part.split(":", 1)
+            plan.drop_device_steps[int(step)] = int(cnt)
+        else:
+            plan.drop_device_steps[int(part)] = 1
+    if stall_coll:
+        plan.stall_s["collective"] = float(stall_coll)
     return plan
 
 
@@ -163,6 +218,35 @@ def take_nan_grad(step: int) -> bool:
             plan._record("nan_grad", step)
             return True
     return False
+
+
+def take_drop_device(step: int) -> int:
+    """Number of devices to report lost at this global step (0 = none).
+    Consume-once: the same step never drops devices twice, so a recovery
+    that re-winds through the step does not re-degrade."""
+    plan = active()
+    if plan is None:
+        return 0
+    with plan._lock:
+        n = plan.drop_device_steps.pop(step, 0)
+        if n:
+            plan._record("drop_device", (step, n))
+    return n
+
+
+def maybe_stall(site: str) -> None:
+    """Sleep once at a named site (simulated wedged worker / stuck
+    collective). The sleep happens OUTSIDE the plan lock so a stalled
+    worker cannot block other hooks."""
+    plan = active()
+    if plan is None:
+        return
+    with plan._lock:
+        secs = plan.stall_s.pop(site, 0.0)
+        if secs > 0:
+            plan._record("stall", (site, secs))
+    if secs > 0:
+        time.sleep(secs)
 
 
 def maybe_abort_write(path: str) -> None:

@@ -1,0 +1,62 @@
+"""Worker liveness watchdogs: structured stall detection for background
+threads.
+
+The training stack runs three kinds of background workers — the prefetch
+ring's staging thread (``ff-prefetch-N``), the async host-table scatter
+worker (``ff-scatter``), and the checkpoint writer (``ff-ckpt-writer``).
+A wedged worker (device hang, filesystem stall, a stuck collective inside
+a staged ``device_put``) previously surfaced as a silent hang: the
+consumer blocked forever in ``Condition.wait``/``Thread.join``.
+
+This module gives every wait a deadline and a typed failure:
+
+- :class:`StallReport` — structured description of WHICH worker stalled,
+  what the consumer was waiting for, and for how long (the README's
+  troubleshooting table is keyed off these fields);
+- :class:`WorkerStalled` — the typed error carrying the report. The
+  elastic recovery layer (``parallel/elastic.py`` + ``fit(--elastic)``)
+  catches it and recovers (abandon the wedged worker, restore the last
+  good snapshot, rebuild the pipeline) instead of hanging.
+
+Deadlines come from ``FFConfig.worker_deadline_s`` (``--worker-deadline``,
+0 disables — blocking waits, the pre-elastic behavior).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class StallReport:
+    """What a watchdog saw when its deadline expired."""
+
+    worker: str          # thread name: ff-prefetch-0, ff-scatter, ...
+    waiting_for: str     # what the consumer needed from it
+    waited_s: float      # how long the consumer actually waited
+    deadline_s: float    # the configured liveness deadline
+    detail: str = ""     # worker-specific context (ring depth, step, ...)
+    alive: bool = True   # False = the thread died rather than wedged
+
+    def __str__(self) -> str:
+        state = "alive but unresponsive" if self.alive else "dead"
+        s = (f"worker {self.worker!r} ({state}) missed its "
+             f"{self.deadline_s:.3g}s liveness deadline: waited "
+             f"{self.waited_s:.3g}s for {self.waiting_for}")
+        if self.detail:
+            s += f" [{self.detail}]"
+        return s
+
+
+class WorkerStalled(RuntimeError):
+    """A background worker missed its liveness deadline.
+
+    Raised at the consumer's wait site (never from the worker thread), so
+    the training loop sees it at a step boundary where recovery is
+    possible. ``report`` carries the structured :class:`StallReport`.
+    """
+
+    def __init__(self, report: StallReport):
+        super().__init__(str(report))
+        self.report = report

@@ -1,0 +1,61 @@
+"""One rank of the 3-process CPU-cluster test (not collected by pytest —
+spawned by tests/test_multihost.py).
+
+Exercises the mesh layout the 2-process test cannot: an ODD number of
+DCN domains (3 processes x 2 devices), checking that `_slice_groups`
+puts the process axis first, `make_multihost_mesh` factorizes the
+per-slice devices under it, and a real cross-process collective over the
+6-device global mesh reduces correctly.
+
+Env contract (set by the test): COORDINATOR_ADDRESS, NUM_PROCESSES=3,
+PROCESS_ID, FF_CPU_DEVICES_PER_PROCESS=2.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from dlrm_flexflow_tpu.parallel.distributed import (
+        global_batch_from_host_local, host_local_slice,
+        initialize_distributed, make_multihost_mesh)
+
+    initialize_distributed()  # env-driven; forces the CPU cluster + gloo
+
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    assert jax.process_count() == 3, \
+        f"expected 3 processes, got {jax.process_count()}"
+    assert len(jax.devices()) == 6, \
+        f"expected 6 global devices, got {len(jax.devices())}"
+    assert len(jax.local_devices()) == 2
+
+    mesh = make_multihost_mesh()
+    assert mesh.axis_names[0] == "dcn", mesh.axis_names
+    assert mesh.shape["dcn"] == 3, dict(mesh.shape)
+    assert mesh.size == 6
+    # per-slice factorization: 2 devices -> one f0=2 axis
+    assert dict(mesh.shape) == {"dcn": 3, "f0": 2}, dict(mesh.shape)
+
+    # a real cross-process collective: each rank contributes ITS third of
+    # the batch; the global sum must see every element exactly once
+    n = 12
+    x = {"v": np.arange(n, dtype=np.float32).reshape(n, 1)}
+    g = global_batch_from_host_local(host_local_slice(x), mesh)
+    total = float(jax.jit(
+        lambda a: a.sum(),
+        out_shardings=NamedSharding(mesh, PartitionSpec()))(g["v"]))
+    want = float(np.arange(n).sum())
+    assert total == want, f"all-reduce over 3-process mesh: {total} != {want}"
+
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("mp3_worker_done")
+    print(f"MP3_WORKER_OK pid={jax.process_index()}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

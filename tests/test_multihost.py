@@ -6,7 +6,13 @@ on the virtual CPU mesh: with process_count == 1 the global batch equals
 the local one, and `num_slices` stands in for DCN domains.
 """
 
+import os
+import socket
+import subprocess
+import sys
+
 import numpy as np
+import pytest
 
 import jax
 
@@ -14,7 +20,22 @@ import dlrm_flexflow_tpu as ff
 from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
                                            dlrm_strategy, synthetic_batch)
 from dlrm_flexflow_tpu.parallel.distributed import (
-    global_batch_from_host_local, make_multihost_mesh)
+    _slice_groups, global_batch_from_host_local, make_multihost_mesh)
+
+
+class _StubDev:
+    """Minimal device stand-in for _slice_groups/make_multihost_mesh
+    layout tests: only the attributes the grouping logic reads."""
+
+    def __init__(self, i, process_index=0, slice_index=None,
+                 platform="cpu"):
+        self.id = i
+        self.process_index = process_index
+        self.slice_index = slice_index
+        self.platform = platform
+
+    def __repr__(self):
+        return f"dev{self.id}(p{self.process_index})"
 
 
 class TestMultihostMesh:
@@ -50,6 +71,94 @@ class TestMultihostMesh:
         x["label"] = y
         mets = model.train_batch(x)
         assert np.isfinite(float(mets["loss"]))
+
+
+class TestSliceGroups:
+    """_slice_groups / make_multihost_mesh with per-host device counts
+    the even 2-process test never sees (ISSUE 3 satellite)."""
+
+    def test_groups_by_process_when_slice_index_uninformative(self):
+        devs = ([_StubDev(i, process_index=0) for i in range(3)]
+                + [_StubDev(3 + i, process_index=1) for i in range(5)])
+        groups = _slice_groups(devs)
+        assert {k: len(g) for k, g in groups.items()} == {0: 3, 1: 5}
+
+    def test_groups_by_slice_index_when_present(self):
+        devs = [_StubDev(i, process_index=i % 4, slice_index=i // 4)
+                for i in range(8)]
+        groups = _slice_groups(devs)
+        assert {k: len(g) for k, g in groups.items()} == {0: 4, 1: 4}
+
+    def test_uneven_per_host_counts_rejected(self):
+        # a half-dead host (3 of its devices vs the peer's 5): reshaping
+        # would mix hosts within a slice row — must reject loudly, not
+        # silently build a mesh whose "ICI" axes cross DCN
+        devs = ([_StubDev(i, process_index=0) for i in range(3)]
+                + [_StubDev(3 + i, process_index=1) for i in range(5)])
+        with pytest.raises(ValueError, match="uneven"):
+            make_multihost_mesh(devs)
+
+    def test_uneven_three_hosts_rejected(self):
+        devs = ([_StubDev(i, process_index=0) for i in range(2)]
+                + [_StubDev(2 + i, process_index=1) for i in range(2)]
+                + [_StubDev(4 + i, process_index=2) for i in range(1)])
+        with pytest.raises(ValueError, match="uneven"):
+            make_multihost_mesh(devs)
+
+    def test_even_three_hosts_layout(self):
+        # 3 processes x 2 real CPU devices: reuse the actual jax devices
+        # so Mesh construction succeeds, but group them as 3 virtual
+        # hosts via num_slices
+        mesh = make_multihost_mesh(jax.devices()[:6], num_slices=3)
+        assert mesh.axis_names[0] == "dcn"
+        assert dict(mesh.shape) == {"dcn": 3, "f0": 2}
+
+
+_WORKER3 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_mp3_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.skipif(os.environ.get("FF_SKIP_MULTIPROCESS") == "1",
+                    reason="FF_SKIP_MULTIPROCESS=1: multi-process CPU "
+                    "cluster tests explicitly disabled by the environment")
+def test_three_process_cluster_mesh_and_collective():
+    """A REAL 3-process CPU cluster (odd DCN domain count): coordinator
+    handshake, dcn=3 mesh layout, and a cross-process all-reduce."""
+    port = _free_port()
+    base_env = dict(os.environ)
+    base_env.update({
+        "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "NUM_PROCESSES": "3",
+        "FF_CPU_DEVICES_PER_PROCESS": "2",
+    })
+    procs = []
+    for rank in range(3):
+        env = dict(base_env, PROCESS_ID=str(rank))
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER3], env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    # drain all pipes CONCURRENTLY: ranks are coupled by collectives, so
+    # sequential reads can deadlock on a full stdout pipe
+    from concurrent.futures import ThreadPoolExecutor
+    try:
+        with ThreadPoolExecutor(3) as pool:
+            futs = [pool.submit(p.communicate, timeout=600) for p in procs]
+            outs = [f.result()[0] for f in futs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"rank {rank} exited {p.returncode}:\n{out[-4000:]}")
+        assert f"MP3_WORKER_OK pid={rank}" in out, (
+            f"rank {rank} did not reach completion:\n{out[-4000:]}")
 
 
 class TestGlobalBatch:
