@@ -201,6 +201,20 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             elastic = {"error": str(exc)[:200]}
 
+    # opt-in fused-superstep smoke (BENCH_SUPERSTEP=1): ms/step for
+    # K ∈ {1,2,4,8,16} on the floor-sensitive DLRM configs plus the
+    # measured dispatch floor (the K→∞ intercept)
+    superstep = None
+    if os.environ.get("BENCH_SUPERSTEP"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_superstep import measure as _ss_measure
+            superstep = _ss_measure(
+                steps=int(os.environ.get("BENCH_SUPERSTEP_STEPS", "48")))
+        except Exception as exc:
+            superstep = {"error": str(exc)[:200]}
+
     vs = 1.0
     base_file = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE")
     if os.path.exists(base_file):
@@ -226,6 +240,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         out["pipeline"] = pipeline
     if elastic is not None:
         out["elastic"] = elastic
+    if superstep is not None:
+        out["superstep"] = superstep
     print(json.dumps(out))
     return 0
 

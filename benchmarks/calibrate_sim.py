@@ -167,6 +167,60 @@ def calibration_points():
                 32 * 1024, 1024)
 
 
+def measure_dispatch_floor(steps=200, ks=(1, 2, 4, 8, 16)):
+    """Measure the per-step dispatch floor via the fused-superstep K→∞
+    intercept (bench_superstep.fit_dispatch_floor): a one-dense-layer
+    model is floor-bound by construction, so sweeping K and fitting
+    t(K) = t_device + floor/K recovers the floor as the slope — a
+    direct observation of the constant the cost model pins as
+    MEASURED_DISPATCH_FLOOR_S (search/cost_model.py). Recording it each
+    sweep lets future rounds tell floor drift (the documented ~1.5×
+    tunnel volatility, BENCHMARKS.md r5) from code regressions."""
+    import numpy as np
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.data.prefetch import stack_batches
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from bench_superstep import fit_dispatch_floor
+
+    bs = 256
+    model = ff.FFModel(ff.FFConfig(batch_size=bs,
+                                   compute_dtype="bfloat16"))
+    x = model.create_tensor((bs, 64), name="x")
+    t = model.dense(x, 64, activation="relu", name="fc1")
+    t = model.dense(t, 1, name="head")
+    model.compile(ff.SGDOptimizer(0.01), "mean_squared_error", ["mse"],
+                  final_tensor=t)
+    model.init_layers()
+    rng = np.random.RandomState(0)
+    host = {"x": rng.rand(bs, 64).astype(np.float32),
+            "label": rng.rand(bs, 1).astype(np.float32)}
+    per_k = {}
+    for k in sorted(ks):
+        if k == 1:
+            db = model._device_batch(host)
+            mets = model.train_batch_device(db)       # warm/compile
+            float(mets["loss"])
+            t0 = time.time()
+            for _ in range(steps):
+                mets = model.train_batch_device(db)
+            float(mets["loss"])                       # true completion
+            per_k[1] = (time.time() - t0) / steps * 1e3
+        else:
+            mega = model._stage_superstep(stack_batches([host] * k))
+            mets = model.train_batch_staged(mega)     # warm/compile
+            float(mets["loss"])
+            rounds = max(1, steps // k)
+            t0 = time.time()
+            for _ in range(rounds):
+                mets = model.train_batch_staged(mega)
+            float(mets["loss"])
+            per_k[k] = (time.time() - t0) / (rounds * k) * 1e3
+    floor_ms, t_dev_ms = fit_dispatch_floor(per_k)
+    return floor_ms, t_dev_ms, per_k
+
+
 def main():
     from dlrm_flexflow_tpu.search.cost_model import CostModel
     from dlrm_flexflow_tpu.search.mcmc import default_strategy
@@ -250,6 +304,35 @@ def main():
             json.dump(rows, f, indent=1)
         os.replace(tmp, out)   # atomic: a mid-write kill can't corrupt
         # the only copy of completed rows
+
+    # dispatch-floor record (skipped under CAL_ONLY point-debugging):
+    # the measured K→∞ intercept lands in dispatch_floor.json next to
+    # the sweep artifact, compared against the cost model's pinned
+    # MEASURED_DISPATCH_FLOOR_S so floor drift is visible as data
+    if not only:
+        from dlrm_flexflow_tpu.search.cost_model import \
+            MEASURED_DISPATCH_FLOOR_S
+        floor_ms, t_dev_ms, per_k = measure_dispatch_floor(
+            steps=min(steps, 200))
+        pinned_ms = MEASURED_DISPATCH_FLOOR_S * 1e3
+        rec = {
+            "dispatch_floor_ms": round(floor_ms, 4),
+            "t_device_ms": round(t_dev_ms, 4),
+            "ms_per_step_by_k": {str(k): round(v, 4)
+                                 for k, v in sorted(per_k.items())},
+            "pinned_ms": round(pinned_ms, 4),
+            "drift_vs_pinned": (round(floor_ms / pinned_ms, 3)
+                                if pinned_ms else None),
+        }
+        floor_out = os.path.join(os.path.dirname(out),
+                                 "dispatch_floor.json")
+        tmp = floor_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(tmp, floor_out)
+        print(f"dispatch floor: measured {floor_ms:.3f} ms vs pinned "
+              f"{pinned_ms:.3f} ms (x{rec['drift_vs_pinned']}) -> "
+              f"{floor_out}")
 
     if not rows:
         print("no calibration points matched (CAL_ONLY filter?)")

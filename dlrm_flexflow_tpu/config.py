@@ -85,6 +85,19 @@ class FFConfig:
     # (data/prefetch.py ring depth). 0 stages synchronously in the hot
     # loop. Set with --prefetch-depth N / --no-prefetch.
     prefetch_depth: int = 2
+    # fused supersteps: compile K training steps into ONE executable (a
+    # lax.scan over K pre-staged batches, core/model.py _train_superstep)
+    # so a single host→device dispatch trains K steps — amortizing the
+    # ~0.55 ms per-step dispatch floor that dominates small-batch DLRM
+    # (BENCHMARKS.md r5 "floor-bound"). 1 = the exact legacy per-step
+    # dispatch; "auto" picks K from the megabatch bytes against a
+    # staging budget (search/cost_model.py HBM capacity on TPU, a host
+    # RAM cap elsewhere). Checkpoints/save_every snap to superstep
+    # boundaries (fit() validates save_every % K == 0); host-resident-
+    # table models fall back to K=1 with a one-time warning (their
+    # per-step host gather/scatter cannot run inside the scan). Set
+    # with --superstep {K,auto}.
+    superstep: "int | str" = 1
     # fit(): whether to pre-stage the WHOLE dataset on device when it fits
     # the HBM budget ("auto"), always ("always" — trusts the caller on
     # capacity), or never ("never" — forces the streaming/prefetch path;
@@ -267,6 +280,20 @@ class FFConfig:
                 cfg.prefetch_depth = int(take())
             elif a == "--no-prefetch":
                 cfg.prefetch_depth = 0
+            elif a == "--superstep":
+                v = take()
+                if v == "auto":
+                    cfg.superstep = "auto"
+                else:
+                    try:
+                        cfg.superstep = int(v)
+                    except ValueError:
+                        raise ValueError(
+                            f"--superstep expects a positive integer K or "
+                            f"'auto', got {v!r}")
+                    if cfg.superstep < 1:
+                        raise ValueError(
+                            f"--superstep expects K >= 1, got {v}")
             elif a == "--stage-dataset":
                 v = take()
                 if v not in ("auto", "always", "never"):

@@ -37,6 +37,7 @@ from ..parallel.mesh import make_mesh
 from ..parallel.pconfig import ParallelConfig, StrategyMap
 from ..parallel.sharding import AxisAssigner
 from ..parallel.distributed import MeshDegraded, put_global
+from ..utils.profiling import superstep_annotation
 from ..utils.watchdog import StallReport, WorkerStalled
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from . import losses as losses_mod
@@ -69,10 +70,17 @@ class StagedStep(NamedTuple):
     """One fully-staged train-step input (`FFModel._stage_step`): the
     device-put batch (host-only inputs already popped) plus the numpy
     indices for host-resident tables (None when there are none). The
-    prefetch pipeline stages these ahead of the hot loop."""
+    prefetch pipeline stages these ahead of the hot loop.
+
+    `k` > 1 marks a fused-superstep megabatch (`_stage_superstep`):
+    `device_batch` holds `[k, batch, ...]` stacked arrays and
+    `train_batch_staged` routes it to the K-step scan executable (one
+    dispatch trains k steps); host_idx is always None there — host-
+    resident-table models fall back to k=1."""
 
     device_batch: Dict[str, Any]
     host_idx: Optional[Dict[str, Any]]
+    k: int = 1
 
 
 class FFModel:
@@ -823,6 +831,8 @@ class FFModel:
         # function (a re-compile() with a new optimizer/loss/strategies
         # must not keep training with the old one)
         self._train_step_execs = {}
+        self._superstep_execs = {}
+        self._eval_step_execs = {}
         policy = getattr(self.config, "anomaly_policy", "none") or "none"
         if policy not in ("none", "skip_step", "rollback", "raise"):
             raise ValueError(
@@ -1049,8 +1059,32 @@ class FFModel:
             # _env_preds exposes the user-facing logical NCHW form
             return _env_preds(env)
 
+        def train_superstep(params, opt_state, op_state, msums, sbatch,
+                            step):
+            """K fused steps in ONE executable: lax.scan over the
+            stacked [K, ...] megabatch with the train-step body,
+            donating the carries. One host→device dispatch then trains
+            K steps — deleting K-1 of every K ~0.55 ms dispatch floors
+            (BENCHMARKS.md r5 "floor-bound"). The per-step RNG fold,
+            on-device sentinel suppression, and metric-sum accumulation
+            all run unchanged inside the scan, so K>1 is bit-identical
+            to K sequential dispatches of the same batches."""
+            def body(carry, bk):
+                p, o, st, ms, sp = carry
+                p, o, st, ms, sp, mets = train_step(p, o, st, ms, bk, sp)
+                return (p, o, st, ms, sp), mets
+
+            (p, o, st, ms, sp), stacked = jax.lax.scan(
+                body, (params, opt_state, op_state, msums, step), sbatch)
+            # boundary-facing scalars (fit's loss print, the throttle)
+            # are the LAST step's values; per-step [K] arrays (metrics,
+            # anomaly flags) ride alongside for the boundary policies
+            last = jax.tree.map(lambda a: a[-1], stacked)
+            return p, o, st, ms, sp, last, stacked
+
         donate = (0, 1, 2, 3)
         self._train_step = jax.jit(train_step, donate_argnums=donate)
+        self._superstep_fn = jax.jit(train_superstep, donate_argnums=donate)
         self._eval_step = jax.jit(eval_step)
         # discover the metric-sum pytree structure with tiny dummies (the
         # keys depend on metric names + loss type only)
@@ -1308,9 +1342,209 @@ class FFModel:
         at scatter-launch time) — lets the async host-table worker stage
         the gather for step N+1 while step N executes on device (gather
         first, then this step's scatter: deterministic one-step
-        staleness, see FFConfig.host_tables_async)."""
+        staleness, see FFConfig.host_tables_async).
+
+        A `_stage_superstep` megabatch item (`staged.k > 1`) routes to
+        the fused K-step scan executable instead — one dispatch, k
+        optimizer steps."""
+        if getattr(staged, "k", 1) > 1:
+            return self.train_superstep_device(staged.device_batch)
         return self._train_dispatch(staged.device_batch, staged.host_idx,
                                     next_host_idx)
+
+    # --- fused supersteps ---------------------------------------------
+    def resolve_superstep(self, batch_size: Optional[int] = None) -> int:
+        """The superstep K this model actually trains with.
+
+        FFConfig.superstep: 1 = the exact legacy per-step dispatch; an
+        int K>1 fuses K steps per dispatch; "auto" picks the largest
+        power-of-two K <= 16 whose stacked megabatch fits the staging
+        budget (5% of per-chip HBM on TPU — the megabatch lives beside
+        params/opt state/activations — or a 128 MB host-RAM cap
+        elsewhere). Host-resident-table models always resolve to 1 with
+        a one-time warning: their per-step host gather/scatter cannot
+        run inside the fused scan yet."""
+        raw = getattr(self.config, "superstep", 1)
+        if raw in (None, "", 1, "1"):
+            return 1
+        if getattr(self, "_host_resident_list", None):
+            if not getattr(self, "_superstep_host_warned", False):
+                self._superstep_host_warned = True
+                log_model.warning(
+                    "superstep=%s requested, but ops %s keep their "
+                    "tables host-resident: the per-step host gather/"
+                    "scatter cannot run inside the fused scan — falling "
+                    "back to superstep=1", raw,
+                    [op.name for op in self._host_resident_list])
+            return 1
+        if raw != "auto":
+            k = int(raw)
+            if k < 1:
+                raise ValueError(f"superstep must be >= 1, got {raw!r}")
+            return k
+        bs = int(batch_size or self.config.batch_size)
+        scale = bs / max(self.config.batch_size, 1)
+        tensors = list(self.input_tensors)
+        if self.label_tensor is not None:
+            tensors.append(self.label_tensor)
+        per_batch = sum(float(np.prod(t.shape))
+                        * np.dtype(t.dtype).itemsize * scale
+                        for t in tensors)
+        if jax.default_backend() == "tpu":
+            from ..search.cost_model import TPUSpec
+            budget = 0.05 * TPUSpec.detect().hbm_capacity_bytes
+        else:
+            budget = 128e6
+        k = 16
+        while k > 1 and k * per_batch > budget:
+            k //= 2
+        return k
+
+    def _superstep_sharding(self, sh: NamedSharding) -> NamedSharding:
+        """Input sharding for a stacked [K, batch, ...] megabatch: the
+        new leading step axis is unsharded, the per-step dims keep the
+        model's input specs. Memoized by source-sharding identity (the
+        model's sharding objects are long-lived — same trick as
+        _exec_key's string memo)."""
+        memo = getattr(self, "_super_sharding_memo", None)
+        if memo is None:
+            memo = self._super_sharding_memo = {}
+        hit = memo.get(id(sh))
+        if hit is not None and hit[0] is sh:
+            return hit[1]
+        if len(memo) > 256:
+            memo.clear()
+        s = NamedSharding(self.mesh,
+                          PartitionSpec(*((None,) + tuple(sh.spec))))
+        memo[id(sh)] = (sh, s)
+        return s
+
+    def _device_superbatch(self, stacked: Dict[str, Any]) -> Dict:
+        """Stage a [K, batch, ...] stacked megabatch on device in ONE
+        device_put (the K-step extension of _device_batch's single-put
+        win): every input rides the model's per-step sharding with the
+        leading step axis unsharded, so `sbatch[k]` inside the scan has
+        exactly the per-step layout the K=1 executable sees."""
+        if getattr(self, "_host_resident_list", None):
+            raise ValueError(
+                "superstep megabatches do not support host-resident "
+                "tables (resolve_superstep falls back to K=1)")
+        puts: Dict[str, tuple] = {}
+        for t in self.input_tensors:
+            if t.name in stacked:
+                puts[t.name] = (stacked[t.name], self._superstep_sharding(
+                    self._out_sharding[t.guid]))
+        lab = np.asarray(stacked["label"])
+        sh = self._label_sharding
+        ndev = int(np.prod([self.mesh.shape[a]
+                            for a in self.mesh.axis_names]))
+        # same per-step divisibility re-check as _device_batch, against
+        # the PER-STEP sample dim (axis 1 of the stacked array)
+        if lab.shape[1] % ndev != 0:
+            sh = NamedSharding(self.mesh, PartitionSpec())
+        puts["label"] = (lab, self._superstep_sharding(sh))
+        out: Dict[str, Any] = {}
+        if jax.process_count() > 1:
+            for name, (v, shd) in puts.items():
+                out[name] = self._stage_input(v, shd)
+        else:
+            names = list(puts)
+            vals = jax.device_put([puts[n][0] for n in names],
+                                  [puts[n][1] for n in names])
+            out.update(zip(names, vals))
+        return out
+
+    def _stage_superstep(self, stacked: Dict[str, Any]) -> "StagedStep":
+        """Fully stage one K-step megabatch (stacked host arrays with
+        leading axis K — data.prefetch.stack_batches, or a free reshape
+        of a contiguous dataset slice) for the fused-scan executable.
+        Thread-safe like _stage_step, so the prefetch ring stages
+        megabatch G+1 while the device trains megabatch G."""
+        k = int(np.asarray(next(iter(stacked.values()))).shape[0])
+        return StagedStep(self._device_superbatch(stacked), None, k)
+
+    def train_superstep(self, batches: Sequence[Dict[str, Any]]):
+        """Train K fused steps from a list of same-shaped host batches
+        (each including its "label"): one dispatch, len(batches)
+        optimizer steps. Returns the LAST step's metrics plus
+        `per_step` stacked [K] arrays for every metric."""
+        from ..data.prefetch import stack_batches
+        return self.train_batch_staged(
+            self._stage_superstep(stack_batches(batches)))
+
+    def train_superstep_device(self, sbatch: Dict):
+        """Train step for a staged [K, batch, ...] megabatch: ONE
+        host→device dispatch of the AOT-cached fused-scan executable
+        trains K steps (step accounting advances by K). Boundary
+        semantics match K sequential steps: the anomaly sentinel runs
+        per step INSIDE the scan (skip_step suppresses there, with zero
+        host syncs); rollback/raise fire here from the stacked flags
+        with the faulting step index; fault-injected device loss
+        scheduled for ANY step in the window surfaces as MeshDegraded
+        BEFORE dispatch (elastic recovery checks at superstep
+        boundaries, so no state for the window is half-applied)."""
+        k = int(next(iter(sbatch.values())).shape[0])
+        self._ensure_step_state()
+        if faults.active() is not None:
+            for s in range(self._step, self._step + k):
+                ndrop = faults.take_drop_device(s)
+                if ndrop:
+                    devs = list(self.mesh.devices.flat)
+                    ndrop = min(ndrop, len(devs) - 1)
+                    raise MeshDegraded(
+                        f"fault-injected loss of {ndrop} device(s) at "
+                        f"superstep boundary (step {self._step}, K={k})",
+                        lost=devs[len(devs) - ndrop:],
+                        surviving=devs[:len(devs) - ndrop])
+            for s in range(k):
+                if faults.take_nan_grad(self._step + s):
+                    # poison ONLY the faulting step's slice: the sibling
+                    # steps in the scan must stay clean, exactly like
+                    # the K=1 path poisons one step's batch
+                    sbatch = faults.poison_batch(sbatch, row=s)
+        args = (self.params, self.opt_state, self.op_state, self._msums,
+                sbatch, self._step_dev)
+        key = (k,) + self._exec_key(sbatch)
+        execs = getattr(self, "_superstep_execs", None)
+        if execs is None:
+            execs = self._superstep_execs = {}
+        exec_ = execs.get(key)
+        if exec_ is None:
+            exec_ = execs[key] = self._superstep_fn.lower(*args).compile()
+        with superstep_annotation(self._step, k,
+                                  enabled=bool(self.config.profile_dir)):
+            try:
+                outs = exec_(*args)
+            except ValueError as e:
+                # same GSPMD recompile-on-sharding-disagree fallback as
+                # the K=1 dispatch
+                if "disagree" not in str(e):
+                    raise
+                exec_ = execs[key] = self._superstep_fn.lower(
+                    *args).compile()
+                outs = exec_(*args)
+        (self.params, self.opt_state, self.op_state, self._msums,
+         self._step_dev, last, stacked) = outs
+        step0 = self._step
+        self._step += k
+        self.perf.sums = dict(self._msums)
+        mets = dict(last)
+        mets["per_step"] = stacked
+        mets["superstep"] = k
+        policy = getattr(self, "_anomaly_policy", "none")
+        if policy in ("rollback", "raise"):
+            flags = np.asarray(stacked["anomaly"])
+            if flags.any():
+                # every bad update was already suppressed per step ON
+                # DEVICE inside the scan (state is clean); report the
+                # FIRST faulting step so the caller's recovery targets it
+                idx = int(np.argmax(flags))
+                raise AnomalyError(
+                    step=step0 + idx,
+                    loss=float(np.asarray(stacked["loss"])[idx]),
+                    grad_norm=float(np.asarray(
+                        stacked["grad_norm"])[idx]))
+        return mets
 
     def _train_dispatch(self, device_batch: Dict, host_idx,
                         next_host_idx=None):
@@ -1604,9 +1838,35 @@ class FFModel:
                 host_idx[op.name] = np.asarray(db[name])
                 if name in getattr(self, "_host_only_inputs", set()):
                     db.pop(name)
-            return self._eval_step(self.params, self.op_state, db,
-                                   self._host_emb_forward(host_idx))
-        return self._eval_step(self.params, self.op_state, db)
+            return self._eval_dispatch(db, self._host_emb_forward(host_idx))
+        return self._eval_dispatch(db)
+
+    def _eval_dispatch(self, db: Dict, host_emb=None):
+        """Eval through the same AOT executable cache as the train path:
+        calling the pjit wrapper re-validates the whole param pytree in
+        python on EVERY call, which costs more than a fast model's
+        forward itself — the cached `.lower().compile()` executable
+        skips that, keyed by the batch signature (alternating shapes
+        each compile once), with the usual GSPMD
+        recompile-on-sharding-disagree fallback."""
+        args = (self.params, self.op_state, db)
+        key = self._exec_key(db)
+        if host_emb is not None:
+            args = args + (host_emb,)
+            key = key + ("host_emb",) + self._exec_key(host_emb)
+        execs = getattr(self, "_eval_step_execs", None)
+        if execs is None:
+            execs = self._eval_step_execs = {}
+        exec_ = execs.get(key)
+        if exec_ is None:
+            exec_ = execs[key] = self._eval_step.lower(*args).compile()
+        try:
+            return exec_(*args)
+        except ValueError as e:
+            if "disagree" not in str(e):
+                raise
+            exec_ = execs[key] = self._eval_step.lower(*args).compile()
+            return exec_(*args)
 
     def reset_metrics(self):
         """Reference FFModel::reset_metrics (model.cc:934-940)."""
@@ -1700,6 +1960,41 @@ class FFModel:
         if self.params is None:
             self.init_layers()
 
+        # --- fused supersteps -------------------------------------------
+        # K full batches train as ONE dispatch (lax.scan executable);
+        # batches that can't align to a K boundary — the tail of an
+        # epoch, a mid-group resume position, the odd-shaped remainder —
+        # fall back to exact K=1 steps. K=1 IS the legacy path, bitwise.
+        k_super = self.resolve_superstep(bs)
+        if k_super > num_batches:
+            if getattr(self.config, "superstep", 1) == "auto":
+                # auto picked more lookahead than one epoch holds:
+                # shrink to the largest power of two that fits
+                while k_super > num_batches:
+                    k_super //= 2
+            else:
+                log_model.warning(
+                    "superstep K=%d exceeds the %d batches per epoch; "
+                    "running per-step (K=1)", k_super, num_batches)
+                k_super = 1
+        if k_super > 1 and save_every and save_every % k_super != 0:
+            raise ValueError(
+                f"save_every={save_every} is not a multiple of the "
+                f"superstep K={k_super}: snapshots can only land on "
+                f"superstep boundaries (the K fused steps commit "
+                f"atomically) — pick save_every % K == 0, or "
+                f"--superstep 1 for exact per-step checkpointing")
+
+        def _super_slice(b_, k_):
+            # [K, batch, ...] stacked host views of K contiguous batches
+            # (reshape of a contiguous slice: no copy)
+            sl = slice(b_ * bs, (b_ + k_) * bs)
+            out = {kk: np.asarray(v)[sl].reshape((k_, bs) + v.shape[1:])
+                   for kk, v in inputs.items()}
+            out["label"] = np.asarray(labels)[sl].reshape(
+                (k_, bs) + labels.shape[1:])
+            return out
+
         # --- fault tolerance: rolling checkpoints + auto-resume ---------
         mgr = None
         start_epoch = start_batch = 0
@@ -1777,6 +2072,18 @@ class FFModel:
                         f"{self.config.batch_size} into its shape): {e}"
                     ) from e
                 raise
+        if k_super > 1:
+            # warm the fused-scan executable too, so the timed loop's
+            # first superstep doesn't pay its (K-body) compile
+            sdb = self._device_superbatch(_super_slice(0, k_super))
+            skey = (k_super,) + self._exec_key(sdb)
+            sexecs = getattr(self, "_superstep_execs", None)
+            if sexecs is None:
+                sexecs = self._superstep_execs = {}
+            if skey not in sexecs:
+                sargs = (self.params, self.opt_state, self.op_state,
+                         self._msums, sdb, self._step_dev)
+                sexecs[skey] = self._superstep_fn.lower(*sargs).compile()
 
         if self.config.profiling:
             # per-op timing report (reference --profiling cudaEvent prints,
@@ -1833,6 +2140,7 @@ class FFModel:
             budget = 2e9
         staged = None
         staged_rem = None
+        staged_super = None
         # --stage-dataset: "never" forces the streaming/prefetch path
         # (bench_pipeline compares the two); "always" trusts the caller
         # on capacity
@@ -1842,17 +2150,27 @@ class FFModel:
         elif stage_mode == "always":
             staging_cost = 0.0
         def _stage_all():
-            # (re)build the device-resident batch list against the
-            # model's CURRENT input shardings — called once up front,
-            # and again by elastic recovery (arrays staged on the old
-            # mesh must not feed an executable compiled on the new one)
-            nonlocal staged, staged_rem, rem_ok
-            staged = []
-            for b in range(num_batches):
+            # (re)build the device-resident batches against the model's
+            # CURRENT input shardings — called once up front, and again
+            # by elastic recovery (arrays staged on the old mesh must
+            # not feed an executable compiled on the new one; megabatches
+            # are re-staged the same way). With a superstep, aligned full
+            # groups stage as [K, bs, ...] megabatches (one put each) and
+            # only the unaligned tail stages per-batch.
+            nonlocal staged, staged_rem, staged_super, rem_ok
+            staged = {}
+            staged_super = {} if k_super > 1 else None
+            tail0 = 0
+            if k_super > 1:
+                tail0 = (num_batches // k_super) * k_super
+                for g in range(0, tail0, k_super):
+                    staged_super[g] = self._device_superbatch(
+                        _super_slice(g, k_super))
+            for b in range(tail0, num_batches):
                 sl = slice(b * bs, (b + 1) * bs)
                 batch = {k: v[sl] for k, v in inputs.items()}
                 batch["label"] = labels[sl]
-                staged.append(self._device_batch(batch))
+                staged[b] = self._device_batch(batch)
             staged_rem = None
             if rem_ok:
                 # the remainder already fit the staging budget (the cost
@@ -1933,18 +2251,32 @@ class FFModel:
         def _build_pipe(e0, b0_):
             nonlocal pipe
             _close_pipe()
+            # one schedule entry per DISPATCH: (epoch, batch, k) — k>1
+            # entries stage a whole superstep megabatch in one ring slot
+            # (one device_put feeding K fused steps); unaligned batches
+            # and the remainder stay k=1. The consumer loop walks batches
+            # with the same alignment rule, so the two stay in lockstep.
             sched = []
             for e in range(e0, epochs):
-                for b in range(b0_ if e == e0 else 0, num_batches):
-                    sched.append((e, b))
+                b = b0_ if e == e0 else 0
+                while b < num_batches:
+                    if (k_super > 1 and b % k_super == 0
+                            and b + k_super <= num_batches):
+                        sched.append((e, b, k_super))
+                        b += k_super
+                    else:
+                        sched.append((e, b, 1))
+                        b += 1
                 if rem_ok:
-                    sched.append((e, "rem"))
+                    sched.append((e, "rem", 1))
             if not sched:
                 return
             from ..data.prefetch import PrefetchPipeline
 
-            def produce(k):
-                e, b = sched[k]
+            def produce(i):
+                e, b, kk = sched[i]
+                if kk > 1:
+                    return self._stage_superstep(_super_slice(b, kk))
                 return self._stage_step(_host_slice(e, b))
 
             pipe = PrefetchPipeline(
@@ -2017,10 +2349,42 @@ class FFModel:
                 if b0 == 0:
                     self.reset_metrics()
                 try:
-                    for b in range(b0, num_batches):
+                    b = b0
+                    while b < num_batches:
+                        # a group of K batches anchored on a K boundary
+                        # trains as ONE fused dispatch; everything else
+                        # (epoch tail, mid-group resume position) is an
+                        # exact K=1 step
+                        k = (k_super if (k_super > 1 and b % k_super == 0
+                                         and b + k_super <= num_batches)
+                             else 1)
                         cur, step0 = (epoch, b), self._step
-                        if staged is not None:
-                            mets = self.train_batch_device(staged[b])
+                        if k > 1:
+                            if staged is not None:
+                                mets = self.train_superstep_device(
+                                    staged_super[b])
+                                inflight.append(mets["loss"])
+                                if len(inflight) > throttle:
+                                    jax.block_until_ready(
+                                        inflight.popleft())
+                            elif pipe is not None:
+                                mets = _train_streamed()
+                            else:
+                                mets = self.train_superstep_device(
+                                    self._device_superbatch(
+                                        _super_slice(b, k)))
+                        elif staged is not None:
+                            db_b = staged.get(b)
+                            if db_b is None:
+                                # a resume position inside a megabatch-
+                                # staged group: stage this one batch on
+                                # the fly (one-off until re-aligned)
+                                sl = slice(b * bs, (b + 1) * bs)
+                                batch = {kk: v[sl]
+                                         for kk, v in inputs.items()}
+                                batch["label"] = labels[sl]
+                                db_b = self._device_batch(batch)
+                            mets = self.train_batch_device(db_b)
                             # bound the pipeline without draining it: block
                             # on the step issued `throttle` iterations AGO
                             inflight.append(mets["loss"])
@@ -2030,11 +2394,12 @@ class FFModel:
                             mets = _train_streamed()
                         else:
                             sl = slice(b * bs, (b + 1) * bs)
-                            batch = {k: v[sl] for k, v in inputs.items()}
+                            batch = {kk: v[sl] for kk, v in inputs.items()}
                             batch["label"] = labels[sl]
                             mets = self.train_batch(batch)
-                        num_samples += bs
-                        _maybe_save(epoch, b + 1)
+                        num_samples += bs * k
+                        _maybe_save(epoch, b + k)
+                        b += k
                     if rem_ok:
                         # degradation during the remainder resumes at the
                         # next epoch (the odd-shaped batch is not worth a
@@ -2115,11 +2480,13 @@ class FFModel:
                         b0 = min(int(ls.get("batch", 0)), num_batches)
                     else:
                         # inplace: continue at the batch about to train;
-                        # skip it if its optimizer step already applied
-                        # before the stall surfaced (post-step drain)
+                        # skip however many optimizer steps actually
+                        # applied before the stall surfaced (post-step
+                        # drain) — a fused superstep commits its K steps
+                        # atomically, so this is 0, 1, or K batches
                         e_, b_ = cur
                         if step0 is not None and self._step > step0:
-                            b_ += 1
+                            b_ += self._step - step0
                         if b_ >= num_batches:
                             e_, b_ = e_ + 1, 0
                         epoch, b0 = e_, b_

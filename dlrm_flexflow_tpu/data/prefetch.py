@@ -32,6 +32,14 @@ Contracts (tests/test_prefetch.py pins all three):
 :meth:`stats` reports how much staging time was hidden under compute
 (``overlap_fraction``), which benchmarks/bench_pipeline.py turns into the
 gather/H2D overlap metric.
+
+Fused supersteps (``FFConfig.superstep``) ride the same ring: ``fit()``'s
+schedule emits one entry per K-step *megabatch* and ``produce`` stages the
+K host batches as ONE stacked ``[K, batch, ...]`` device_put
+(``FFModel._stage_superstep``), so a single ring slot — and a single H2D
+transfer, extending the PR-2 single-put win — feeds K fused training
+steps. :func:`stack_batches` is the host-side stacking helper for
+non-contiguous batch lists (contiguous dataset slices reshape for free).
 """
 
 from __future__ import annotations
@@ -47,6 +55,32 @@ from ..utils.watchdog import StallReport, WorkerStalled
 # global ordinal for thread naming: every staging thread in the process
 # is distinguishable in a stack dump / stall report (ff-prefetch-0, ...)
 _PIPE_SEQ = itertools.count()
+
+
+def stack_batches(batches):
+    """Stack a list of same-keyed host batches into one ``[K, ...]``
+    megabatch dict (the input to ``FFModel._stage_superstep``). All
+    batches must share keys, shapes, and dtypes — a ragged list cannot
+    fuse into one scan and raises here rather than at trace time."""
+    import numpy as np
+    if not batches:
+        raise ValueError("stack_batches needs at least one batch")
+    keys = set(batches[0])
+    for i, b in enumerate(batches[1:], 1):
+        if set(b) != keys:
+            raise ValueError(
+                f"batch {i} keys {sorted(b)} differ from batch 0 keys "
+                f"{sorted(keys)}; superstep batches must be homogeneous")
+    out = {}
+    for k in batches[0]:
+        arrs = [np.asarray(b[k]) for b in batches]
+        if any(a.shape != arrs[0].shape or a.dtype != arrs[0].dtype
+               for a in arrs[1:]):
+            raise ValueError(
+                f"input {k!r} has ragged shapes/dtypes across batches; "
+                f"superstep batches must be homogeneous")
+        out[k] = np.stack(arrs)
+    return out
 
 
 class PrefetchPipeline:

@@ -27,6 +27,16 @@ from ..core.op import InputOp, Op
 from ..parallel.pconfig import ParallelConfig
 from ..utils.logging import log_sim
 
+# Measured per-train-step dispatch floor on the tunneled v5e (round 5,
+# 500-step pipelined windows; the additive share fitting all 12
+# calibration points — see per_step_overhead_s below, which this pins).
+# benchmarks/calibrate_sim.py re-measures the floor every sweep (the
+# K→∞ intercept of the bench_superstep ms/step-vs-1/K line) and records
+# the fresh value in benchmarks/dispatch_floor.json next to this
+# constant, so future rounds can tell floor drift (the documented ~1.5×
+# tunnel volatility, BENCHMARKS.md r5) from code regressions.
+MEASURED_DISPATCH_FLOOR_S = 5.5e-4
+
 
 @dataclass
 class TPUSpec:
@@ -74,7 +84,7 @@ class TPUSpec:
     # BENCHMARKS.md r5) is the additive share that fits all 12
     # calibration points; without it every small-step model
     # under-predicts (the r4 measured-mode DLRM-family bias)
-    per_step_overhead_s: float = 5.5e-4
+    per_step_overhead_s: float = MEASURED_DISPATCH_FLOOR_S
     # host-resident tables: PCIe host<->device link and host-DRAM random
     # row cost (the reference prices GPU<->DRAM at 16 MB/ms,
     # simulator.cu:27-29; v5e host link ~ PCIe gen3/4)
@@ -93,6 +103,16 @@ class TPUSpec:
     # _roofline_time's scan term) — the residual loop overhead is ~5 us;
     # 10 us keeps a margin for smaller cells where bookkeeping dominates
     scan_iter_s: float = 1.0e-5
+
+    def per_step_overhead_amortized(self, superstep: int = 1) -> float:
+        """Dispatch floor per TRAINED step when K steps fuse into one
+        dispatch (core/model.py _train_superstep: a lax.scan over K
+        pre-staged batches inside one executable). One host→device
+        dispatch then trains K steps, so the per-step share of the floor
+        is ``per_step_overhead_s / K`` — the simulator must price this
+        or it would call every floor-bound small-batch config K× slower
+        than the fused runtime actually runs it."""
+        return self.per_step_overhead_s / max(int(superstep), 1)
 
     @staticmethod
     def v4() -> "TPUSpec":

@@ -79,6 +79,20 @@ class Simulator:
         self.overlap_weight_sync = overlap_weight_sync
         self.topology = list(topology) if topology is not None else None
 
+    def _effective_superstep(self) -> int:
+        """The superstep K this model's fit() would actually run
+        (FFModel.resolve_superstep handles "auto" and the host-resident-
+        table K=1 fallback), so the simulated dispatch floor amortizes
+        exactly like the runtime's. Models without the resolver (config
+        stubs in older tests) price the legacy K=1 floor."""
+        resolve = getattr(self.model, "resolve_superstep", None)
+        if resolve is None:
+            return 1
+        try:
+            return max(int(resolve()), 1)
+        except Exception:
+            return 1
+
     # ---- topology ----------------------------------------------------
     def _topo(self, ndev: int) -> List[Tuple[str, int]]:
         if self.topology is not None:
@@ -403,8 +417,13 @@ class Simulator:
         tasks = self.build_task_graph(strategies, ndev)
         # per-step dispatch/epilogue floor (TPUSpec.per_step_overhead_s):
         # constant across strategies, so it never changes WHICH strategy
-        # wins, but calibration against real step times needs it
-        overhead = self.cost.spec.per_step_overhead_s
+        # wins, but calibration against real step times needs it. Fused
+        # supersteps (FFConfig.superstep) amortize the floor — K steps
+        # share ONE dispatch — so the per-step price is overhead / K or
+        # the simulator would stay wrong about every floor-bound
+        # small-batch config the fusion exists for.
+        overhead = self.cost.spec.per_step_overhead_amortized(
+            self._effective_superstep())
         if use_native:
             ms = self._simulate_native(tasks)
             if ms is not None:

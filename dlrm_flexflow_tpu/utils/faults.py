@@ -298,11 +298,16 @@ def maybe_io_error(site: str) -> None:
                           f"({left - 1} left)")
 
 
-def poison_batch(device_batch: dict) -> dict:
+def poison_batch(device_batch: dict, row: Optional[int] = None) -> dict:
     """Return a copy of a staged batch with its float label (or, when the
     label is integer, the first float input) replaced by NaNs — same
     shapes/dtypes/shardings, so the cached step executable still applies
-    and the NaN flows through the real autodiff."""
+    and the NaN flows through the real autodiff.
+
+    With ``row`` given, only that leading-axis index is poisoned: a
+    superstep megabatch (``[K, batch, ...]`` stacked arrays) gets NaNs in
+    exactly ONE of its K fused steps, so a mid-superstep anomaly drives
+    the sentinel inside the scan while the sibling steps stay clean."""
     import jax
     import numpy as np
 
@@ -320,7 +325,11 @@ def poison_batch(device_batch: dict) -> dict:
     if target is None:
         raise ValueError("no float tensor in batch to poison with NaNs")
     v = out[target]
-    nan = np.full(v.shape, np.nan, dtype=np.dtype(v.dtype))
+    if row is None:
+        nan = np.full(v.shape, np.nan, dtype=np.dtype(v.dtype))
+    else:
+        nan = np.asarray(v).copy()
+        nan[row] = np.nan
     sharding = getattr(v, "sharding", None)
     out[target] = (jax.device_put(nan, sharding)
                    if sharding is not None else nan)

@@ -66,6 +66,26 @@ def format_profile(rows: List[Dict]) -> str:
     return "\n".join(lines)
 
 
+def superstep_annotation(step: int, num_steps: int = 1,
+                         enabled: bool = True):
+    """Wrap one (super)step dispatch in a `jax.profiler.
+    StepTraceAnnotation` so `--profile-dir` traces show superstep
+    boundaries and per-K timing instead of one undifferentiated blob:
+    xprof groups device work under step markers, and the `superstep`
+    metadata key carries K so a trace reader can divide a fused span
+    into per-trained-step time.
+
+    `enabled=False` returns a no-op context — the hot loop must not pay
+    even a TraceMe when no trace is being captured (this PR exists to
+    delete per-step host overhead)."""
+    if not enabled:
+        import contextlib
+        return contextlib.nullcontext()
+    import jax
+    return jax.profiler.StepTraceAnnotation(
+        "ff_superstep", step_num=int(step), superstep=int(num_steps))
+
+
 class TraceContext:
     """jax.profiler.trace wrapper that no-ops when dir is empty."""
 
