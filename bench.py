@@ -215,6 +215,19 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             superstep = {"error": str(exc)[:200]}
 
+    # opt-in serving smoke (BENCH_SERVE=1): offline vs online throughput,
+    # p99 across bucket/deadline settings, embedding cache on/off
+    serve = None
+    if os.environ.get("BENCH_SERVE"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_serve import measure as _serve_measure
+            serve = _serve_measure(
+                requests=int(os.environ.get("BENCH_SERVE_REQUESTS", "256")))
+        except Exception as exc:
+            serve = {"error": str(exc)[:200]}
+
     vs = 1.0
     base_file = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE")
     if os.path.exists(base_file):
@@ -242,6 +255,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         out["elastic"] = elastic
     if superstep is not None:
         out["superstep"] = superstep
+    if serve is not None:
+        out["serve"] = serve
     print(json.dumps(out))
     return 0
 

@@ -22,7 +22,9 @@ from .core.tensor import Tensor
 from .parallel.mesh import make_mesh
 from .parallel.pconfig import ParallelConfig
 from .parallel.distributed import MeshDegraded
-from .utils.watchdog import StallReport, WorkerStalled
+from .utils.watchdog import Deadline, StallReport, WorkerStalled
+from .serve import (DeadlineExceeded, InferenceEngine, Overloaded,
+                    Prediction, ServeConfig, SnapshotWatcher)
 
 __version__ = "0.1.0"
 
@@ -33,5 +35,7 @@ __all__ = [
     "GlorotUniform", "ZeroInitializer", "UniformInitializer",
     "NormInitializer", "ConstantInitializer",
     "ParallelConfig", "make_mesh",
-    "MeshDegraded", "WorkerStalled", "StallReport",
+    "MeshDegraded", "WorkerStalled", "StallReport", "Deadline",
+    "InferenceEngine", "ServeConfig", "Prediction", "Overloaded",
+    "DeadlineExceeded", "SnapshotWatcher",
 ]

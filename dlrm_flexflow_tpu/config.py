@@ -165,6 +165,36 @@ class FFConfig:
     # re-raised (a flapping fleet must not loop forever). Set with
     # --max-recoveries N.
     max_recoveries: int = 3
+    # ---- online serving (serve/engine.py InferenceEngine) -------------
+    # largest dynamic batch per dispatch; requests coalesce up to this
+    # and pad to the smallest power-of-two bucket, every bucket AOT-
+    # compiled at engine startup. Set with --serve-max-batch N.
+    serve_max_batch: int = 64
+    # dynamic-batching flush deadline: a batch dispatches when it reaches
+    # serve_max_batch OR when its oldest request has waited this long.
+    # Set with --serve-max-delay-ms MS.
+    serve_max_delay_ms: float = 5.0
+    # bounded request queue; a submit against a full queue is rejected
+    # immediately with a typed Overloaded (backpressure, not buffering
+    # bloat). Set with --serve-queue N.
+    serve_queue: int = 256
+    # per-request deadline: a request still queued (or in flight) past
+    # this budget fails with DeadlineExceeded instead of occupying a
+    # batch slot. 0 disables. Set with --serve-deadline-ms MS.
+    serve_deadline_ms: float = 0.0
+    # LRU embedding-row cache for host-RESIDENT tables on the serving
+    # path: per-sample lookup results are cached so hot rows skip the
+    # host gather. Capacity in cached samples; 0 disables. Invalidated
+    # on every hot reload. Set with --serve-cache-rows N.
+    serve_cache_rows: int = 0
+    # snapshot-watcher poll interval for zero-downtime hot reload of a
+    # CheckpointManager directory. Set with --serve-poll SECONDS.
+    serve_poll_s: float = 0.5
+    # LRU cap on the eval-path AOT executable cache (_eval_step_execs):
+    # serving many ad-hoc shapes must not leak executables. Evictions
+    # are counted (FFModel.eval_exec_cache_stats / engine stats()). Set
+    # with --eval-exec-cache N.
+    eval_exec_cache: int = 32
     unparsed: List[str] = field(default_factory=list)
 
     @property
@@ -294,6 +324,20 @@ class FFConfig:
                     if cfg.superstep < 1:
                         raise ValueError(
                             f"--superstep expects K >= 1, got {v}")
+            elif a == "--serve-max-batch":
+                cfg.serve_max_batch = int(take())
+            elif a == "--serve-max-delay-ms":
+                cfg.serve_max_delay_ms = float(take())
+            elif a == "--serve-queue":
+                cfg.serve_queue = int(take())
+            elif a == "--serve-deadline-ms":
+                cfg.serve_deadline_ms = float(take())
+            elif a == "--serve-cache-rows":
+                cfg.serve_cache_rows = int(take())
+            elif a == "--serve-poll":
+                cfg.serve_poll_s = float(take())
+            elif a == "--eval-exec-cache":
+                cfg.eval_exec_cache = int(take())
             elif a == "--stage-dataset":
                 v = take()
                 if v not in ("auto", "always", "never"):

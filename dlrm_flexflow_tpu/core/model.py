@@ -829,10 +829,13 @@ class FFModel:
     def _build_steps(self):
         # drop any AOT executables compiled against the previous step
         # function (a re-compile() with a new optimizer/loss/strategies
-        # must not keep training with the old one)
+        # must not keep training with the old one). This also runs on
+        # every elastic reshard (recover() re-enters compile()), so
+        # old-mesh executables can never serve a post-reshard dispatch.
+        from collections import OrderedDict
         self._train_step_execs = {}
         self._superstep_execs = {}
-        self._eval_step_execs = {}
+        self._eval_step_execs = OrderedDict()
         policy = getattr(self.config, "anomaly_policy", "none") or "none"
         if policy not in ("none", "skip_step", "rollback", "raise"):
             raise ValueError(
@@ -1826,7 +1829,13 @@ class FFModel:
             return jnp.transpose(value, (0, 3, 1, 2))
         return value
 
-    def forward_batch(self, batch: Dict[str, np.ndarray]):
+    def forward_batch(self, batch: Dict[str, np.ndarray],
+                      host_gather: Optional[Callable] = None):
+        """Forward pass for one host batch (no labels). ``host_gather``
+        overrides the host-resident-table row gather — the serving
+        engine passes its LRU-cached gather (serve/cache.py) so hot rows
+        skip the numpy table lookup; the default is the exact
+        ``_host_emb_forward`` path."""
         db = self._device_batch(batch, with_label=False)
         hres = getattr(self, "_host_resident_list", None)
         if hres:
@@ -1838,8 +1847,101 @@ class FFModel:
                 host_idx[op.name] = np.asarray(db[name])
                 if name in getattr(self, "_host_only_inputs", set()):
                     db.pop(name)
-            return self._eval_dispatch(db, self._host_emb_forward(host_idx))
+            gather = host_gather or self._host_emb_forward
+            return self._eval_dispatch(db, gather(host_idx))
         return self._eval_dispatch(db)
+
+    # --- serving entry points (serve/engine.py) -----------------------
+    def bucket_sizes(self, max_batch: int) -> tuple:
+        """The power-of-two eval batch buckets this model admits, small
+        to large. Serving pads every dynamic batch up to the smallest
+        bucket so each dispatch hits one of a FIXED set of pre-compiled
+        executables (warmup_buckets). The floor is the mesh size when
+        the input shardings split the sample dim — a 3-row device_put
+        against an 8-way sharded spec has no even shards."""
+        ndev = max(int(self.mesh.size), 1) if self.mesh is not None else 1
+        sharded = any(
+            bool(self._out_sharding[t.guid].spec)
+            for t in self.input_tensors
+            if t.guid in getattr(self, "_out_sharding", {}))
+        floor = ndev if sharded else 1
+        out, b = [], 1
+        while b <= max(int(max_batch), 1):
+            if b >= floor:
+                out.append(b)
+            b *= 2
+        if not out:
+            out = [floor]
+        return tuple(out)
+
+    def forward_bucket(self, batch: Dict[str, np.ndarray],
+                       bucket: Optional[int] = None,
+                       host_gather: Optional[Callable] = None):
+        """Bucketed eval entry: zero-pad the batch's rows up to `bucket`
+        (default: the smallest admissible power-of-two), dispatch the
+        padded batch through the AOT eval cache, and return predictions
+        for ONLY the real rows. Row-wise graphs (every model in the zoo
+        ends per-sample) make the unpadded rows bit-identical to a
+        direct ``forward_batch`` of the same rows — tests/test_serve.py
+        pins that contract."""
+        from ..data.dataloader import pad_batch_rows
+        n = int(next(iter(batch.values())).shape[0])
+        if bucket is None:
+            # smallest admissible power-of-two >= n
+            bucket = self.bucket_sizes(1)[-1]
+            while bucket < n:
+                bucket *= 2
+        if bucket < n:
+            raise ValueError(f"bucket {bucket} < batch rows {n}")
+        padded = pad_batch_rows(batch, bucket) if bucket > n else batch
+        out = self.forward_batch(padded, host_gather=host_gather)
+        return out[:n] if bucket > n else out
+
+    def warmup_buckets(self, buckets: Sequence[int],
+                       host_gather: Optional[Callable] = None) -> float:
+        """AOT-compile the eval executable for every bucket size up
+        front (synthetic zero batches from the input specs), so no live
+        request ever pays a compile. Returns the warmup seconds."""
+        t0 = time.perf_counter()
+        for b in buckets:
+            batch = {}
+            for t in self.input_tensors:
+                shape = (int(b),) + tuple(t.shape[1:])
+                if jnp.issubdtype(jnp.dtype(t.dtype), jnp.integer):
+                    batch[t.name] = np.zeros(shape, np.int32)
+                else:
+                    batch[t.name] = np.zeros(shape, np.float32)
+            jax.block_until_ready(
+                self.forward_batch(batch, host_gather=host_gather))
+        return time.perf_counter() - t0
+
+    def swap_params(self, params=None, host_params=None, op_state=None):
+        """Atomically install new inference state (the hot-reload hook).
+
+        The serving engine calls this under its dispatch lock, BETWEEN
+        dispatches: an executable already dispatched keeps computing on
+        the old arrays (functional state — nothing is mutated in
+        place), so in-flight requests finish on the old weights and the
+        next dispatch sees the new ones — never a mix. Tree structures
+        must match the compiled model (the cached AOT executables were
+        compiled against these shapes/shardings); a mismatch raises
+        before anything is replaced."""
+        if params is not None:
+            old = jax.tree.structure(self.params)
+            new = jax.tree.structure(params)
+            if old != new:
+                raise ValueError(
+                    f"swap_params: new params tree {new} does not match "
+                    f"the compiled model's {old} — a snapshot from a "
+                    f"differently-built model cannot hot-swap")
+        self._host_drain()   # land any in-flight training scatter
+        self._host_prefetch_invalidate()
+        if params is not None:
+            self.params = params
+        if host_params is not None:
+            self.host_params = host_params
+        if op_state is not None:
+            self.op_state = op_state
 
     def _eval_dispatch(self, db: Dict, host_emb=None):
         """Eval through the same AOT executable cache as the train path:
@@ -1849,6 +1951,7 @@ class FFModel:
         skips that, keyed by the batch signature (alternating shapes
         each compile once), with the usual GSPMD
         recompile-on-sharding-disagree fallback."""
+        from collections import OrderedDict
         args = (self.params, self.op_state, db)
         key = self._exec_key(db)
         if host_emb is not None:
@@ -1856,10 +1959,20 @@ class FFModel:
             key = key + ("host_emb",) + self._exec_key(host_emb)
         execs = getattr(self, "_eval_step_execs", None)
         if execs is None:
-            execs = self._eval_step_execs = {}
+            execs = self._eval_step_execs = OrderedDict()
         exec_ = execs.get(key)
         if exec_ is None:
             exec_ = execs[key] = self._eval_step.lower(*args).compile()
+            # LRU-bound the cache: a serving engine fed many ad-hoc
+            # shapes must not leak one compiled executable per shape
+            # forever (config.eval_exec_cache, 0/negative = unbounded)
+            cap = int(getattr(self.config, "eval_exec_cache", 0) or 0)
+            while cap > 0 and len(execs) > cap:
+                execs.popitem(last=False)
+                self._eval_exec_evictions = getattr(
+                    self, "_eval_exec_evictions", 0) + 1
+        else:
+            execs.move_to_end(key)
         try:
             return exec_(*args)
         except ValueError as e:
@@ -1867,6 +1980,17 @@ class FFModel:
                 raise
             exec_ = execs[key] = self._eval_step.lower(*args).compile()
             return exec_(*args)
+
+    def eval_exec_cache_stats(self) -> Dict[str, int]:
+        """Occupancy of the eval-path AOT executable cache plus the
+        CUMULATIVE eviction count (across recompiles/reshards) — the
+        serving engine surfaces these in ``stats()`` so an executable
+        leak or thrash shows up as a number, not an OOM."""
+        execs = getattr(self, "_eval_step_execs", None) or {}
+        return {"size": len(execs),
+                "capacity": int(getattr(self.config, "eval_exec_cache", 0)
+                                or 0),
+                "evictions": int(getattr(self, "_eval_exec_evictions", 0))}
 
     def reset_metrics(self):
         """Reference FFModel::reset_metrics (model.cc:934-940)."""

@@ -55,6 +55,53 @@ def read_with_retries(fn: Callable, site: str, retries: int = 3,
             time.sleep(delay)
 
 
+def coalesce_batches(batches):
+    """Concatenate a list of same-keyed host feature dicts along the
+    sample dim (axis 0) into ONE batch — the serving engine's request
+    coalescing (each request carries 1+ rows). Reuses the same
+    homogeneity discipline as ``prefetch.stack_batches``: ragged keys or
+    per-sample shapes/dtypes fail HERE with the offending key, not at
+    trace time inside the eval executable."""
+    if not batches:
+        raise ValueError("coalesce_batches needs at least one batch")
+    keys = set(batches[0])
+    for i, b in enumerate(batches[1:], 1):
+        if set(b) != keys:
+            raise ValueError(
+                f"batch {i} keys {sorted(b)} differ from batch 0 keys "
+                f"{sorted(keys)}; coalesced requests must be homogeneous")
+    out = {}
+    for k in batches[0]:
+        arrs = [np.asarray(b[k]) for b in batches]
+        if any(a.shape[1:] != arrs[0].shape[1:] or a.dtype != arrs[0].dtype
+               for a in arrs[1:]):
+            raise ValueError(
+                f"input {k!r} has ragged per-sample shapes/dtypes across "
+                f"requests; cannot coalesce into one batch")
+        out[k] = (arrs[0] if len(arrs) == 1
+                  else np.concatenate(arrs, axis=0))
+    return out
+
+
+def pad_batch_rows(batch, rows: int):
+    """Zero-pad every array's sample dim up to `rows` (the serving
+    bucket). Zeros are always in-domain: float features pad with 0.0,
+    categorical indices pad with row 0 — the padded samples' outputs are
+    computed and then DISCARDED (``FFModel.forward_bucket`` returns only
+    the real rows), so their values never surface."""
+    n = int(next(iter(batch.values())).shape[0])
+    if rows < n:
+        raise ValueError(f"pad_batch_rows: target {rows} < batch rows {n}")
+    if rows == n:
+        return batch
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        pad = np.zeros((rows - n,) + v.shape[1:], v.dtype)
+        out[k] = np.concatenate([v, pad], axis=0)
+    return out
+
+
 def _config_depth(model, depth: Optional[int]) -> int:
     if depth is not None:
         return max(int(depth), 0)

@@ -110,7 +110,20 @@ class Reshape(Op):
         self.outputs = [self._make_output(shape, input_tensor.dtype)]
 
     def apply(self, params, xs, *, training=False, rng=None):
-        return [xs[0].reshape(self.outputs[0].shape)]
+        (x,) = xs
+        shape = self.outputs[0].shape
+        if (x.shape[0] != shape[0]
+                and math.prod(x.shape[1:]) == math.prod(shape[1:])):
+            # sample-dim polymorphism: the graph bakes the compile-time
+            # batch into the target shape, but eval may trace at a
+            # different (e.g. serving-bucket) batch. When the reshape
+            # keeps the per-sample element count — it never mixes the
+            # sample dim with features — re-deriving the target against
+            # the traced batch is exact. Folding reshapes (NMT's
+            # (b,s,h)->(b*s,h)) fail this guard and keep the baked
+            # shape, erroring at trace time as before.
+            shape = (x.shape[0],) + tuple(shape[1:])
+        return [x.reshape(shape)]
 
 
 class Transpose(Op):

@@ -1,0 +1,11 @@
+"""Online serving: dynamic-batched inference over the AOT eval cache,
+with an embedding-row cache for host-resident tables and zero-downtime
+snapshot hot reload. See engine.py for the design notes."""
+
+from .cache import EmbeddingCache
+from .engine import (DeadlineExceeded, InferenceEngine, Overloaded,
+                     Prediction, ServeConfig)
+from .watcher import SnapshotWatcher
+
+__all__ = ["InferenceEngine", "ServeConfig", "Prediction", "Overloaded",
+           "DeadlineExceeded", "EmbeddingCache", "SnapshotWatcher"]

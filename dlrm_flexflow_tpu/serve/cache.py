@@ -1,0 +1,98 @@
+"""LRU embedding-row cache for the serving read path.
+
+Host-RESIDENT tables (``--host-tables`` / ZCM strategies — the >HBM DLRM
+configuration) pay a numpy gather on the host for every lookup. Online
+recommendation traffic is extremely skewed (a few hot users/items
+dominate), so the serving engine caches per-sample lookup RESULTS: a
+request whose categorical index tuple was seen recently skips the host
+gather entirely and only the cold samples touch the table.
+
+Keying is per (op, per-sample index row): the cached value is exactly
+``op.host_lookup``'s output for that sample, so cache hits are
+bit-identical to the uncached path (the lookup is row-wise across the
+batch — each sample's bag gather/reduce never sees its neighbors).
+
+The cache is dropped wholesale on every hot reload (`invalidate`): new
+tables mean every cached row is stale. During serving the tables are
+otherwise immutable (training scatters never run in the engine), so no
+finer-grained invalidation is needed.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict
+
+import numpy as np
+
+
+class EmbeddingCache:
+    """Bounded LRU of per-sample host-table lookup results.
+
+    Thread-safe (the engine's batcher and a stats() reader may race);
+    the table gather itself additionally serializes on the model's
+    ``_host_lock`` at the call site, same as training's gather.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"cache capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._d: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    def lookup(self, op, table_params, idx_np: np.ndarray) -> np.ndarray:
+        """Per-sample-cached equivalent of
+        ``op.host_lookup(table_params, idx_np)``: hit samples come from
+        the cache, miss samples go through ONE sub-batch host_lookup and
+        are inserted."""
+        rows = int(idx_np.shape[0])
+        vals = [None] * rows
+        miss: list = []
+        with self._lock:
+            for i in range(rows):
+                key = (op.name, idx_np[i].tobytes())
+                v = self._d.get(key)
+                if v is None:
+                    miss.append(i)
+                else:
+                    self._d.move_to_end(key)
+                    vals[i] = v
+            self.hits += rows - len(miss)
+            self.misses += len(miss)
+        if miss:
+            sub = op.host_lookup(table_params, idx_np[np.asarray(miss)])
+            sub = np.asarray(sub)
+            with self._lock:
+                for j, i in enumerate(miss):
+                    v = np.ascontiguousarray(sub[j])
+                    vals[i] = v
+                    self._d[(op.name, idx_np[i].tobytes())] = v
+                    self._d.move_to_end((op.name, idx_np[i].tobytes()))
+                while len(self._d) > self.capacity:
+                    self._d.popitem(last=False)
+        return np.stack(vals, axis=0)
+
+    def invalidate(self) -> None:
+        """Drop everything (hot reload replaced the tables)."""
+        with self._lock:
+            self._d.clear()
+            self.invalidations += 1
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def stats(self) -> Dict[str, float]:
+        total = self.hits + self.misses
+        return {
+            "size": len(self._d),
+            "capacity": self.capacity,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": (self.hits / total) if total else 0.0,
+            "invalidations": self.invalidations,
+        }

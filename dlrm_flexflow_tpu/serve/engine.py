@@ -1,0 +1,441 @@
+"""Online inference engine: dynamic batching over the AOT eval cache.
+
+The training side of this framework amortizes dispatch overhead by fusing
+steps (supersteps); the read path amortizes it by COALESCING REQUESTS —
+the Clipper/Orca discipline. ``InferenceEngine`` accepts per-request
+feature dicts from any number of threads, queues them in a bounded queue,
+and a single batcher thread flushes a dynamic batch when it reaches
+``max_batch`` rows or when the oldest request has waited ``max_delay_ms``
+(size-flush vs deadline-flush). Every batch is zero-padded up to a small
+set of power-of-two buckets so each dispatch hits one of a FIXED set of
+pre-compiled AOT executables (all buckets are warmed at ``start()`` —
+no live request ever pays a compile), and the padded rows are sliced off
+before the response: per-request scores are bit-identical to a direct
+``forward_batch`` of the same rows.
+
+Operational contracts:
+
+- **Backpressure**: a submit against a full queue raises a typed
+  :class:`Overloaded` immediately — the caller sheds load; the engine
+  never buffers unboundedly.
+- **Deadlines**: a request still waiting past ``deadline_ms`` fails with
+  :class:`DeadlineExceeded` (a :class:`~..utils.watchdog.WorkerStalled`
+  carrying the structured :class:`~..utils.watchdog.StallReport`) instead
+  of occupying a batch slot.
+- **Zero-downtime reload**: :class:`~.watcher.SnapshotWatcher` polls a
+  ``CheckpointManager`` directory and installs new params via
+  ``FFModel.swap_params`` under the engine's dispatch lock — in-flight
+  batches finish on the old weights, the next dispatch sees the new
+  ones, and every response carries the version (checkpoint step) it was
+  computed with: old-or-new, never a mix.
+- **Observability**: ``stats()`` reports p50/p99 latency, batch-fill
+  fraction, queue depth, embedding-cache hit rate, reload counts, and
+  the eval-executable-cache occupancy/evictions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+from ..data.dataloader import coalesce_batches
+from ..utils import faults
+from ..utils.logging import get_logger
+from ..utils.watchdog import Deadline, WorkerStalled
+from .cache import EmbeddingCache
+
+log_serve = get_logger("serve")
+
+
+class Overloaded(RuntimeError):
+    """The bounded request queue is full — typed backpressure. Callers
+    shed or retry with backoff; the engine never buffers unboundedly."""
+
+    def __init__(self, depth: int, capacity: int):
+        super().__init__(
+            f"serving queue full ({depth}/{capacity} requests) — "
+            f"rejecting (backpressure); retry with backoff or raise "
+            f"--serve-queue")
+        self.depth = depth
+        self.capacity = capacity
+
+
+class DeadlineExceeded(WorkerStalled, TimeoutError):
+    """A request missed its per-request deadline while queued. Reuses
+    the watchdog's structured StallReport so serving timeouts and
+    training-worker stalls read the same way in logs/alerts."""
+
+
+class Prediction(NamedTuple):
+    """Per-request result: model scores for the request's rows, the
+    weight version (checkpoint step) that computed them, and the
+    end-to-end latency."""
+
+    scores: np.ndarray
+    version: int
+    latency_ms: float
+
+
+@dataclass
+class ServeConfig:
+    """Engine knobs; ``from_config`` lifts the ``--serve-*`` flags."""
+
+    max_batch: int = 64          # flush-on-size threshold / largest bucket
+    max_delay_ms: float = 5.0    # flush-on-deadline for a partial batch
+    queue_capacity: int = 256    # bounded queue -> Overloaded past this
+    deadline_ms: float = 0.0     # per-request budget; 0 = none
+    cache_rows: int = 0          # embedding-row cache capacity; 0 = off
+    poll_s: float = 0.5          # snapshot-watcher poll interval
+    warmup: bool = True          # AOT-compile all buckets at start()
+
+    @staticmethod
+    def from_config(cfg) -> "ServeConfig":
+        return ServeConfig(
+            max_batch=int(getattr(cfg, "serve_max_batch", 64)),
+            max_delay_ms=float(getattr(cfg, "serve_max_delay_ms", 5.0)),
+            queue_capacity=int(getattr(cfg, "serve_queue", 256)),
+            deadline_ms=float(getattr(cfg, "serve_deadline_ms", 0.0)),
+            cache_rows=int(getattr(cfg, "serve_cache_rows", 0)),
+            poll_s=float(getattr(cfg, "serve_poll_s", 0.5)))
+
+
+class _Request:
+    __slots__ = ("features", "rows", "future", "t0", "deadline")
+
+    def __init__(self, features, rows, deadline_s: float):
+        self.features = features
+        self.rows = rows
+        self.future: Future = Future()
+        self.t0 = time.monotonic()
+        self.deadline = Deadline(deadline_s) if deadline_s > 0 else None
+
+
+class InferenceEngine:
+    """Thread-safe dynamic-batching server over a compiled FFModel.
+
+    The model must be compiled + initialized (or restored). The engine
+    owns the model's serving lifecycle from ``start()`` to ``close()``;
+    training the same model concurrently is not supported (the trainer
+    runs in its own process and publishes snapshots via
+    ``CheckpointManager`` — see :class:`~.watcher.SnapshotWatcher`).
+    """
+
+    def __init__(self, model, config: Optional[ServeConfig] = None,
+                 checkpoint_dir: Optional[str] = None):
+        if model.params is None:
+            raise ValueError("InferenceEngine needs an initialized model "
+                             "(init_layers() or restore_checkpoint())")
+        self._model = model
+        self.config = config or ServeConfig.from_config(model.config)
+        if self.config.max_batch < 1:
+            raise ValueError("serve max_batch must be >= 1")
+        self._buckets = tuple(model.bucket_sizes(self.config.max_batch))
+        if self._buckets[-1] != self.config.max_batch:
+            log_serve.warning(
+                "serve max_batch %d is not an admissible bucket; "
+                "clamping to %d (buckets %s)", self.config.max_batch,
+                self._buckets[-1], self._buckets)
+        self.max_batch = self._buckets[-1]
+        self._input_names = {t.name for t in model.input_tensors}
+        # embedding-row cache only applies to host-resident tables
+        self._cache: Optional[EmbeddingCache] = None
+        if (self.config.cache_rows > 0
+                and getattr(model, "_host_resident_list", None)):
+            self._cache = EmbeddingCache(self.config.cache_rows)
+        self._checkpoint_dir = checkpoint_dir
+        self._watcher = None
+        # queue + batcher state
+        self._q: "deque[_Request]" = deque()
+        self._q_rows = 0
+        self._cond = threading.Condition()
+        self._closing = False
+        self._started = False
+        self._thread: Optional[threading.Thread] = None
+        # dispatch/swap critical section: params are read (dispatch) and
+        # swapped (hot reload) only under this lock
+        self._swap_lock = threading.Lock()
+        self._version = int(getattr(model, "_step", 0))
+        # stats (their own lock: stats() readers race the batcher's
+        # appends — iterating a deque mid-append raises)
+        self._stats_lock = threading.Lock()
+        self._lat_ms: "deque[float]" = deque(maxlen=4096)
+        self._n_requests = 0
+        self._n_responses = 0
+        self._n_overloaded = 0
+        self._n_timeouts = 0
+        self._n_batches = 0
+        self._rows_served = 0
+        self._rows_padded = 0
+        self._reloads = 0
+        self._reload_rejects = 0
+        self._last_reject = ""
+        self._warmup_s = 0.0
+
+    # --- lifecycle -----------------------------------------------------
+    def start(self) -> "InferenceEngine":
+        """Warm every bucket's executable, start the batcher (and the
+        snapshot watcher when a checkpoint dir was given)."""
+        if self._started:
+            return self
+        self._started = True
+        if self.config.warmup:
+            self._warmup_s = self._model.warmup_buckets(
+                self._buckets, host_gather=self._host_gather())
+            log_serve.info("warmed %d bucket executables %s in %.0f ms",
+                           len(self._buckets), list(self._buckets),
+                           1e3 * self._warmup_s)
+        self._thread = threading.Thread(target=self._batcher, daemon=True,
+                                        name="ff-serve-batcher")
+        self._thread.start()
+        if self._checkpoint_dir:
+            from .watcher import SnapshotWatcher
+            self._watcher = SnapshotWatcher(
+                self, self._checkpoint_dir, poll_s=self.config.poll_s)
+            self._watcher.start()
+        return self
+
+    def close(self, deadline_s: float = 10.0) -> None:
+        """Drain the queue (pending requests still get answers), stop
+        the batcher + watcher. A wedged batcher surfaces as a typed
+        WorkerStalled instead of hanging the caller."""
+        with self._cond:
+            if not self._started or self._closing:
+                self._closing = True
+                return
+            self._closing = True
+            self._cond.notify_all()
+        if self._watcher is not None:
+            self._watcher.stop()
+        t = self._thread
+        if t is not None and t.is_alive():
+            dl = Deadline(deadline_s)
+            t.join(deadline_s if deadline_s > 0 else None)
+            if t.is_alive():
+                raise WorkerStalled(dl.report(
+                    worker=t.name, waiting_for="serving queue drain",
+                    detail=f"{len(self._q)} requests still queued"))
+
+    def __enter__(self) -> "InferenceEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- request path --------------------------------------------------
+    def submit(self, features: Dict[str, np.ndarray]) -> Future:
+        """Enqueue one request (1+ rows); returns a Future resolving to
+        a :class:`Prediction`. Raises :class:`Overloaded` when the
+        bounded queue is full, ValueError on malformed features."""
+        feats = {}
+        for k, v in features.items():
+            if k not in self._input_names:
+                raise ValueError(
+                    f"unknown input {k!r}; model inputs are "
+                    f"{sorted(self._input_names)}")
+            feats[k] = np.asarray(v)
+        missing = self._input_names - set(feats)
+        if missing:
+            raise ValueError(f"request is missing inputs {sorted(missing)}")
+        rows = {int(v.shape[0]) if v.ndim else -1 for v in feats.values()}
+        if len(rows) != 1 or -1 in rows:
+            raise ValueError(
+                f"request inputs disagree on the sample dim: {rows}")
+        n = rows.pop()
+        if n < 1:
+            raise ValueError("request must carry at least one row")
+        if n > self.max_batch:
+            raise ValueError(
+                f"request rows {n} exceed serve max_batch "
+                f"{self.max_batch}; split the request")
+        req = _Request(feats, n, self.config.deadline_ms / 1e3)
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("engine is closed")
+            if not self._started:
+                raise RuntimeError("engine not started (call start())")
+            if len(self._q) >= self.config.queue_capacity:
+                self._n_overloaded += 1
+                raise Overloaded(len(self._q), self.config.queue_capacity)
+            self._q.append(req)
+            self._q_rows += n
+            self._n_requests += 1
+            self._cond.notify_all()
+        return req.future
+
+    def predict(self, features: Dict[str, np.ndarray],
+                timeout: Optional[float] = None) -> Prediction:
+        """Synchronous submit+wait."""
+        return self.submit(features).result(timeout)
+
+    # --- batcher -------------------------------------------------------
+    def _batcher(self) -> None:
+        while True:
+            take: List[_Request] = []
+            with self._cond:
+                while not self._q and not self._closing:
+                    self._cond.wait(0.1)
+                if not self._q and self._closing:
+                    return
+                # a batch is open from the moment its OLDEST request
+                # arrived; flush on size (max_batch rows coalesced) or
+                # on that request's age (max_delay)
+                t_flush = self._q[0].t0 + self.config.max_delay_ms / 1e3
+                while (self._q_rows < self.max_batch
+                       and not self._closing):
+                    left = t_flush - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cond.wait(left)
+                    if not self._q:      # all timed out? (can't happen:
+                        break            # only this thread pops)
+                rows = 0
+                while self._q and rows + self._q[0].rows <= self.max_batch:
+                    r = self._q.popleft()
+                    self._q_rows -= r.rows
+                    rows += r.rows
+                    take.append(r)
+            if take:
+                try:
+                    self._dispatch(take)
+                except BaseException as e:   # noqa: BLE001 — a model
+                    # error must fail THESE requests, not kill serving
+                    for r in take:
+                        if not r.future.done():
+                            r.future.set_exception(e)
+
+    def _host_gather(self):
+        """The cached host-table gather (None = model default)."""
+        if self._cache is None:
+            return None
+        model = self._model
+        cache = self._cache
+
+        def gather(host_idx):
+            import jax
+            out = {}
+            with model._host_lock:
+                for op in model._host_resident_list:
+                    val = cache.lookup(op, model.host_params[op.name],
+                                       host_idx[op.name])
+                    out[op.name] = jax.device_put(
+                        val, model._out_sharding[op.outputs[0].guid])
+            return out
+
+        return gather
+
+    def _dispatch(self, reqs: List[_Request]) -> None:
+        # expired requests fail with the structured report instead of
+        # wasting a batch slot
+        live: List[_Request] = []
+        for r in reqs:
+            if r.deadline is not None and r.deadline.expired():
+                self._n_timeouts += 1
+                r.future.set_exception(DeadlineExceeded(r.deadline.report(
+                    worker="ff-serve-batcher",
+                    waiting_for="a dynamic-batch dispatch slot",
+                    detail=f"{r.rows} row(s), queue depth "
+                           f"{len(self._q)}")))
+            else:
+                live.append(r)
+        if not live:
+            return
+        faults.maybe_serve_delay()
+        batch = coalesce_batches([r.features for r in live])
+        n = sum(r.rows for r in live)
+        bucket = next(b for b in self._buckets if b >= n)
+        # dispatch under the swap lock: the version tag and the params
+        # the executable reads are captured together, so a concurrent
+        # hot reload is either entirely before or entirely after this
+        # batch — never a mix
+        with self._swap_lock:
+            version = self._version
+            out = self._model.forward_bucket(
+                batch, bucket=bucket, host_gather=self._host_gather())
+        scores = np.asarray(out)          # device→host sync, outside lock
+        t_done = time.monotonic()
+        off = 0
+        for r in live:
+            r.future.set_result(Prediction(
+                scores[off:off + r.rows], version,
+                1e3 * (t_done - r.t0)))
+            off += r.rows
+        with self._stats_lock:
+            for r in live:
+                self._lat_ms.append(1e3 * (t_done - r.t0))
+            self._n_responses += len(live)
+            self._n_batches += 1
+            self._rows_served += n
+            self._rows_padded += bucket - n
+
+    # --- hot reload (called by SnapshotWatcher) ------------------------
+    def install_snapshot(self, state: Dict[str, Any], version: int,
+                         source: str = "") -> None:
+        """Atomically swap in pre-loaded inference state (the output of
+        ``checkpoint.load_params_for_swap``) between dispatches."""
+        with self._swap_lock:
+            self._model.swap_params(params=state["params"],
+                                    host_params=state.get("host_params"),
+                                    op_state=state.get("op_state"))
+            self._version = int(version)
+            if self._cache is not None:
+                self._cache.invalidate()
+            self._reloads += 1
+        log_serve.info("hot-reloaded weights to version %d%s", version,
+                       f" from {source}" if source else "")
+
+    def record_reload_reject(self, reason: str) -> None:
+        self._reload_rejects += 1
+        self._last_reject = reason
+        log_serve.warning("snapshot reload rejected: %s — continuing to "
+                          "serve version %d", reason, self._version)
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    @property
+    def model(self):
+        return self._model
+
+    # --- observability -------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._stats_lock:
+            lat = sorted(self._lat_ms)
+
+        def pct(p):
+            if not lat:
+                return 0.0
+            return float(lat[min(len(lat) - 1,
+                                 int(round(p / 100 * (len(lat) - 1))))])
+
+        dispatched = self._rows_served + self._rows_padded
+        out = {
+            "requests": self._n_requests,
+            "responses": self._n_responses,
+            "overloaded": self._n_overloaded,
+            "timeouts": self._n_timeouts,
+            "queue_depth": len(self._q),
+            "batches": self._n_batches,
+            "batch_fill": (self._rows_served / dispatched
+                           if dispatched else 0.0),
+            "p50_ms": pct(50),
+            "p99_ms": pct(99),
+            "version": self._version,
+            "reloads": self._reloads,
+            "reload_rejects": self._reload_rejects,
+            "last_reload_reject": self._last_reject,
+            "buckets": list(self._buckets),
+            "warmup_s": round(self._warmup_s, 4),
+            "eval_exec_cache": self._model.eval_exec_cache_stats(),
+        }
+        if self._cache is not None:
+            out["embedding_cache"] = self._cache.stats()
+        if self._watcher is not None:
+            out["watcher"] = self._watcher.stats()
+        return out

@@ -1,0 +1,150 @@
+"""Zero-downtime snapshot hot reload for the serving engine.
+
+A trainer publishes rolling snapshots through ``CheckpointManager``
+(atomic npz + manifest with step/fingerprint/CRC-32). The
+:class:`SnapshotWatcher` polls that directory READ-ONLY from the serving
+process — it deliberately does not construct a ``CheckpointManager``
+(whose init sweeps ``*.tmp-*`` orphans, which would race a live trainer's
+in-flight atomic write) — validates the newest manifest entry exactly
+like ``CheckpointManager._entry_valid`` (file present, fingerprint
+matches THIS model's build, CRC-32 clean), loads the params with the
+``params_only`` fast path into FRESH arrays outside any lock, and then
+swaps them into the engine between dispatches.
+
+Failure is always non-fatal: a torn manifest, a fingerprint from a
+differently-built model, a CRC mismatch, or a snapshot corrupted between
+validation and load (the ``FF_FAULT_CORRUPT_RELOAD`` injection) is
+recorded as a reject-with-reason in ``stats()`` and the engine keeps
+serving the current version — zero failed requests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from ..utils import faults
+from ..utils.checkpoint import (_file_crc32, config_fingerprint,
+                                load_params_for_swap)
+
+
+class SnapshotWatcher:
+    """Background poller installing newer valid snapshots into an
+    :class:`~.engine.InferenceEngine`."""
+
+    MANIFEST = "manifest.json"
+
+    def __init__(self, engine, directory: str, poll_s: float = 0.5):
+        self._engine = engine
+        self.directory = os.path.abspath(directory)
+        self.poll_s = max(float(poll_s), 0.01)
+        self._fingerprint = config_fingerprint(engine.model)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._polls = 0
+        # a permanently-bad snapshot (foreign fingerprint, torn file
+        # left on disk) would otherwise re-record the same reject every
+        # poll interval, forever
+        self._rejected: set = set()
+
+    def _reject_once(self, key: tuple, reason: str) -> None:
+        if key in self._rejected:
+            return
+        self._rejected.add(key)
+        self._engine.record_reload_reject(reason)
+
+    # --- lifecycle -----------------------------------------------------
+    def start(self) -> "SnapshotWatcher":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ff-serve-watcher")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(5.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:   # noqa: BLE001 — the watcher must
+                # never die; a failed poll is a reject, not an outage
+                self._engine.record_reload_reject(
+                    f"watcher poll error: {e}")
+            self._stop.wait(self.poll_s)
+
+    # --- one poll ------------------------------------------------------
+    def _read_entries(self) -> list:
+        try:
+            with open(os.path.join(self.directory, self.MANIFEST)) as f:
+                m = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError, OSError):
+            return []
+        entries = m.get("entries") if isinstance(m, dict) else None
+        return entries if isinstance(entries, list) else []
+
+    def _latest_valid(self) -> Optional[Dict[str, Any]]:
+        """Newest manifest entry that exists on disk, matches this
+        model's fingerprint, and checksums clean — the same discipline
+        as ``CheckpointManager.latest_valid``, read-only."""
+        for entry in sorted(self._read_entries(),
+                            key=lambda e: e.get("step", -1), reverse=True):
+            path = os.path.join(self.directory, entry.get("file", ""))
+            if not os.path.isfile(path):
+                continue
+            fp = entry.get("fingerprint")
+            if fp not in (None, self._fingerprint):
+                self._reject_once(
+                    (entry.get("file"), "fingerprint"),
+                    f"snapshot {entry.get('file')} fingerprint {fp} != "
+                    f"this model's {self._fingerprint} (differently-"
+                    f"built model)")
+                return None
+            crc = entry.get("crc32")
+            if crc is not None and _file_crc32(path) != crc:
+                self._reject_once(
+                    (entry.get("file"), "crc"),
+                    f"snapshot {entry.get('file')} fails its CRC-32 "
+                    f"(torn write / corruption)")
+                continue   # an older snapshot may still be good
+            return entry
+        return None
+
+    def poll_once(self) -> bool:
+        """Check for a newer valid snapshot; install it if found.
+        Returns True when a reload happened."""
+        self._polls += 1
+        entry = self._latest_valid()
+        if entry is None:
+            return False
+        step = int(entry.get("step", -1))
+        if step <= self._engine.version:
+            return False
+        path = os.path.join(self.directory, entry["file"])
+        # fault window: the file can be corrupted AFTER the CRC check
+        # above and BEFORE the load below (a torn replace, bit rot) —
+        # the injection truncates it right here and the load must reject
+        faults.maybe_corrupt_reload(path)
+        try:
+            # slow part (read + validate + device_put) outside the
+            # engine's dispatch lock: serving continues on old weights
+            state = load_params_for_swap(self._engine.model, path)
+        except Exception as e:   # noqa: BLE001
+            self._reject_once(
+                (entry["file"], "load"),
+                f"snapshot {entry['file']} failed to load: {e}")
+            return False
+        self._engine.install_snapshot(state, step, source=entry["file"])
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        return {"directory": self.directory, "polls": self._polls,
+                "poll_s": self.poll_s}

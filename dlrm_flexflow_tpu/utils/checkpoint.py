@@ -191,30 +191,8 @@ def save_checkpoint(model, path: str):
     _write_npz_atomic(path, _model_flat(model))
 
 
-def restore_checkpoint(model, path: str, elastic: Optional[bool] = None):
-    """Restore into a compiled model, re-applying each parameter's GSPMD
-    sharding.
-
-    Snapshot arrays are host-gathered (full, unsharded), so the
-    device_put below IS the reshard: loading a snapshot written under
-    mesh A into a model compiled on mesh B re-splits every tensor per
-    B's partition degrees (host-resident tables stay numpy and need no
-    resharding at all). That cross-mesh load is only performed when
-    `elastic` is True (default: ``model.config.elastic != "off"``);
-    otherwise a mesh mismatch is rejected UP FRONT with the recorded
-    topology in the message — never half-applied mid-load.
-    """
-    # the restore replaces host tables underneath any in-flight async
-    # scatter / chained prefetch gather: land the scatter first, then
-    # drop the (now stale) prefetched gather
-    if hasattr(model, "_host_drain"):
-        model._host_drain()
-    if hasattr(model, "_host_prefetch_invalidate"):
-        model._host_prefetch_invalidate()
-    data = np.load(path if path.endswith(".npz") else path + ".npz")
-    if elastic is None:
-        elastic = getattr(getattr(model, "config", None), "elastic",
-                          "off") != "off"
+def _check_mesh_meta(model, data, path: str, elastic: bool) -> None:
+    """Reject-with-reason on a mesh mismatch (non-elastic restores)."""
     if "meta/num_devices" in data.files and model.mesh is not None:
         ck_ndev = int(data["meta/num_devices"])
         ck_axes = [int(x) for x in data["meta/mesh_axes"]] \
@@ -232,6 +210,10 @@ def restore_checkpoint(model, path: str, elastic: Optional[bool] = None):
                 f"set FFConfig.elastic='resume' (--elastic resume) or "
                 f"pass restore_checkpoint(..., elastic=True) to reshard "
                 f"the snapshot onto the current mesh.")
+
+
+def _split_sections(data):
+    """npz files -> the five per-section flat dicts."""
     params_flat, opt_flat, state_flat = {}, {}, {}
     host_flat, hostopt_flat = {}, {}
     for k in data.files:
@@ -245,9 +227,73 @@ def restore_checkpoint(model, path: str, elastic: Optional[bool] = None):
             host_flat[k[len("hostparams/"):]] = data[k]
         elif k.startswith("hostopt/"):
             hostopt_flat[k[len("hostopt/"):]] = data[k]
+    return params_flat, opt_flat, state_flat, host_flat, hostopt_flat
+
+
+def restore_checkpoint(model, path: str, elastic: Optional[bool] = None,
+                       params_only: bool = False):
+    """Restore into a compiled model, re-applying each parameter's GSPMD
+    sharding.
+
+    Snapshot arrays are host-gathered (full, unsharded), so the
+    device_put below IS the reshard: loading a snapshot written under
+    mesh A into a model compiled on mesh B re-splits every tensor per
+    B's partition degrees (host-resident tables stay numpy and need no
+    resharding at all). That cross-mesh load is only performed when
+    `elastic` is True (default: ``model.config.elastic != "off"``);
+    otherwise a mesh mismatch is rejected UP FRONT with the recorded
+    topology in the message — never half-applied mid-load.
+
+    ``params_only=True`` is the serving fast path: load params, host
+    tables, and op state (inference needs e.g. batch-norm running
+    stats) but SKIP the optimizer-state slabs — for big embedding
+    models that halves the bytes read and device_put. The model's
+    current opt_state is left untouched (resuming TRAINING from a
+    params-only load silently reuses stale optimizer state — don't).
+    All reject-with-reason checks (mesh above, per-op shape validation
+    in the apply) run the same in both modes.
+    """
+    # the restore replaces host tables underneath any in-flight async
+    # scatter / chained prefetch gather: land the scatter first, then
+    # drop the (now stale) prefetched gather
+    if hasattr(model, "_host_drain"):
+        model._host_drain()
+    if hasattr(model, "_host_prefetch_invalidate"):
+        model._host_prefetch_invalidate()
+    data = np.load(path if path.endswith(".npz") else path + ".npz")
+    if elastic is None:
+        elastic = getattr(getattr(model, "config", None), "elastic",
+                          "off") != "off"
+    _check_mesh_meta(model, data, path, elastic)
+    (params_flat, opt_flat, state_flat,
+     host_flat, hostopt_flat) = _split_sections(data)
+    if params_only:
+        opt_flat = hostopt_flat = None
     return _apply_flat_state(model, params_flat, opt_flat, state_flat,
                              host_flat, hostopt_flat,
                              int(data["meta/step"]), source=path)
+
+
+def load_params_for_swap(model, path: str):
+    """Read a snapshot's inference state WITHOUT touching the model:
+    validated + device_put against the model's compiled shardings, but
+    returned instead of assigned. The serving hot-reload does the slow
+    part (file read, validation, H2D) here — outside the engine's
+    dispatch lock — then installs the result atomically between
+    dispatches via ``FFModel.swap_params``. Optimizer state is never
+    read (serving has none). Raises with a reason on mesh or per-op
+    shape mismatch; the watcher logs it and keeps serving old weights.
+    """
+    data = np.load(path if path.endswith(".npz") else path + ".npz")
+    _check_mesh_meta(model, data, path, elastic=False)
+    params_flat, _, state_flat, host_flat, _ = _split_sections(data)
+    params = _validated_params(model, params_flat, source=path)
+    return {
+        "params": params,
+        "op_state": jax.tree.map(jax.device_put, _unflatten(state_flat)),
+        "host_params": _unflatten(host_flat) if host_flat else None,
+        "step": int(data["meta/step"]),
+    }
 
 
 def restore_from_flat(model, flat: Dict[str, np.ndarray],
@@ -269,8 +315,10 @@ def restore_from_flat(model, flat: Dict[str, np.ndarray],
                              int(flat["meta/step"]), source=source)
 
 
-def _apply_flat_state(model, params_flat, opt_flat, state_flat, host_flat,
-                      hostopt_flat, step: int, source: str):
+def _validated_params(model, params_flat, source: str):
+    """Unflatten + validate + device_put a snapshot's params section
+    against the model's compiled parameter spec, returning the sharded
+    tree (nothing on the model is touched)."""
     params = _unflatten(params_flat)
     # validate against the model's parameter spec before overwriting
     # anything: a mismatch (e.g. a checkpoint from a per-table or
@@ -311,8 +359,18 @@ def _apply_flat_state(model, params_flat, opt_flat, state_flat, host_flat,
             n: jax.device_put(v, shards.get(n)) if shards.get(n) else
             jax.device_put(v)
             for n, v in pdict.items()}
-    model.params = params
-    model.opt_state = jax.tree.map(jax.device_put, _unflatten(opt_flat))
+    return params
+
+
+def _apply_flat_state(model, params_flat, opt_flat, state_flat, host_flat,
+                      hostopt_flat, step: int, source: str):
+    """Install snapshot sections on the model. ``opt_flat`` /
+    ``hostopt_flat`` of None mean "leave the model's current value
+    untouched" (the params_only serving fast path)."""
+    model.params = _validated_params(model, params_flat, source)
+    if opt_flat is not None:
+        model.opt_state = jax.tree.map(jax.device_put,
+                                       _unflatten(opt_flat))
     model.op_state = jax.tree.map(jax.device_put, _unflatten(state_flat))
     if host_flat:
         # host-resident tables stay numpy on the host — no device_put

@@ -34,6 +34,16 @@ reproducible points so every recovery branch runs under test:
   (``parallel.distributed.probe_mesh``), ``"scatter"`` wedges the async
   host-table scatter worker — so the deadline watchdogs
   (``utils/watchdog.py``) must detect the stall, not a human.
+- **Serving dispatch delay** (`serve_delay_s`): sleep EVERY serving-engine
+  batch dispatch (``serve.engine.InferenceEngine``) by a fixed amount —
+  NOT consume-once, so a hot-reload test can hold a steady stream of
+  slow in-flight batches while the snapshot watcher swaps params
+  underneath them (the old-or-new-never-a-mix contract).
+- **Corrupt snapshot mid-reload** (`corrupt_reloads`): truncate a
+  snapshot file at the moment the serving hot-reload path opens it —
+  after the manifest listed it as valid — so the reload must reject the
+  torn file (CRC/load failure) and KEEP SERVING the old weights with
+  zero failed requests.
 
 Faults are consume-once: each injection decrements its budget, so a
 recovery path that retries the same step does not re-fault (rollback would
@@ -54,6 +64,10 @@ subprocess kill-test needs):
 - ``FF_FAULT_DROP_DEVICE=4:2``     lose 2 devices at global step 4
   (``=4`` alone loses 1 device at step 4)
 - ``FF_FAULT_STALL_COLLECTIVE=3``  stall the next collective probe 3s
+- ``FF_FAULT_SERVE_DELAY=0.05``    sleep 50 ms inside EVERY serving batch
+  dispatch (not consume-once)
+- ``FF_FAULT_CORRUPT_RELOAD=1``    truncate the next 1 snapshot file as
+  the serving hot-reload opens it
 
 Unknown ``FF_FAULT_*`` keys are a WARNING, not a silent no-op: a typo'd
 key used to disable injection entirely, which made a passing resilience
@@ -98,6 +112,16 @@ class FaultPlan:
     # site name ("collective", "scatter", "prefetch", ...) -> seconds to
     # sleep there once (consume-once; the watchdog deadline must fire)
     stall_s: Dict[str, float] = field(default_factory=dict)
+    # seconds to sleep inside EVERY serving batch dispatch (NOT
+    # consume-once — a reload-atomicity test needs a steady stream of
+    # slow in-flight batches)
+    serve_delay_s: float = 0.0
+    # number of future hot-reload snapshot opens to corrupt (truncate the
+    # file the watcher is about to load; the reload must reject it and
+    # keep serving the old weights)
+    corrupt_reloads: int = 0
+    # bytes to leave when corrupting a reload snapshot
+    corrupt_reload_bytes: int = 64
     # record of (hook, detail) actually fired, for test assertions
     fired: List[tuple] = field(default_factory=list)
 
@@ -116,7 +140,8 @@ _ENV_CHECKED = False
 _KNOWN_ENV_KEYS = ("FF_FAULT_NAN_STEPS", "FF_FAULT_TRUNCATE_CKPTS",
                    "FF_FAULT_ABORT_WRITES", "FF_FAULT_WRITE_DELAY",
                    "FF_FAULT_IO_ERRORS", "FF_FAULT_DROP_DEVICE",
-                   "FF_FAULT_STALL_COLLECTIVE")
+                   "FF_FAULT_STALL_COLLECTIVE", "FF_FAULT_SERVE_DELAY",
+                   "FF_FAULT_CORRUPT_RELOAD")
 
 
 def plan_from_env() -> Optional[FaultPlan]:
@@ -141,7 +166,10 @@ def plan_from_env() -> Optional[FaultPlan]:
     ioerrs = os.environ.get("FF_FAULT_IO_ERRORS", "")
     drop = os.environ.get("FF_FAULT_DROP_DEVICE", "")
     stall_coll = os.environ.get("FF_FAULT_STALL_COLLECTIVE", "")
-    if not any((nan, trunc, aborts, delay, ioerrs, drop, stall_coll)):
+    serve_delay = os.environ.get("FF_FAULT_SERVE_DELAY", "")
+    corrupt_reload = os.environ.get("FF_FAULT_CORRUPT_RELOAD", "")
+    if not any((nan, trunc, aborts, delay, ioerrs, drop, stall_coll,
+                serve_delay, corrupt_reload)):
         return None
     plan = FaultPlan()
     if nan:
@@ -167,6 +195,10 @@ def plan_from_env() -> Optional[FaultPlan]:
             plan.drop_device_steps[int(part)] = 1
     if stall_coll:
         plan.stall_s["collective"] = float(stall_coll)
+    if serve_delay:
+        plan.serve_delay_s = float(serve_delay)
+    if corrupt_reload:
+        plan.corrupt_reloads = int(corrupt_reload)
     return plan
 
 
@@ -296,6 +328,36 @@ def maybe_io_error(site: str) -> None:
             plan._record("io_error", site)
             raise IOError(f"injected transient IO error at {site!r} "
                           f"({left - 1} left)")
+
+
+def maybe_serve_delay() -> None:
+    """Sleep inside a serving batch dispatch (EVERY dispatch while the
+    plan is active — not consume-once — so reload-atomicity tests hold a
+    stream of slow in-flight batches)."""
+    plan = active()
+    if plan is not None and plan.serve_delay_s > 0:
+        time.sleep(plan.serve_delay_s)
+
+
+def maybe_corrupt_reload(path: str) -> bool:
+    """Truncate a snapshot file at the moment the serving hot-reload is
+    about to load it (after the manifest already listed it as valid) —
+    the torn-file-discovered-mid-reload race. The reload must reject it
+    (CRC/zip failure) and keep serving the old weights."""
+    plan = active()
+    if plan is None:
+        return False
+    with plan._lock:
+        if plan.corrupt_reloads <= 0:
+            return False
+        plan.corrupt_reloads -= 1
+        plan._record("corrupt_reload", path)
+    try:
+        with open(path, "r+b") as f:
+            f.truncate(plan.corrupt_reload_bytes)
+    except OSError:
+        return False
+    return True
 
 
 def poison_batch(device_batch: dict, row: Optional[int] = None) -> dict:

@@ -24,6 +24,7 @@ Deadlines come from ``FFConfig.worker_deadline_s`` (``--worker-deadline``,
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -60,3 +61,38 @@ class WorkerStalled(RuntimeError):
     def __init__(self, report: StallReport):
         super().__init__(str(report))
         self.report = report
+
+
+@dataclass
+class Deadline:
+    """A wall-clock budget shared by the serving path's per-request
+    timeouts and the worker-join watchdogs: construct when the wait
+    begins, poll :meth:`expired`, and hand :meth:`report` the structured
+    description the typed error carries.
+
+    ``seconds <= 0`` means no deadline (never expires) — the same
+    convention as ``FFConfig.worker_deadline_s``.
+    """
+
+    seconds: float
+    t0: float = field(default_factory=time.monotonic)
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.t0
+
+    def remaining(self) -> float:
+        """Seconds left (``inf`` when no deadline is configured)."""
+        if self.seconds <= 0:
+            return float("inf")
+        return self.seconds - self.elapsed()
+
+    def expired(self) -> bool:
+        return self.seconds > 0 and self.remaining() <= 0
+
+    def report(self, worker: str, waiting_for: str, detail: str = "",
+               alive: bool = True) -> StallReport:
+        """StallReport snapshot of this deadline's state."""
+        return StallReport(worker=worker, waiting_for=waiting_for,
+                          waited_s=self.elapsed(),
+                          deadline_s=self.seconds, detail=detail,
+                          alive=alive)

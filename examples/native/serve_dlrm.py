@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""DLRM online serving app: dynamic-batched JSON inference over HTTP.
+
+The read-path counterpart of examples/native/dlrm.py — the trainer
+publishes rolling snapshots (fit(checkpoint_dir=...)); this app builds
+the same graph, restores the newest snapshot params-only, and serves it
+with the dynamic-batching engine (power-of-two bucket padding, AOT
+warmup, bounded queue backpressure, per-request deadlines) while a
+snapshot watcher hot-reloads newer checkpoints with zero downtime.
+
+No framework webserver: a stdlib ``http.server`` ThreadingHTTPServer is
+all the engine needs — every handler thread just submits into the
+engine's queue and blocks on its future, the batcher coalesces across
+handler threads.
+
+  # terminal 1: train, publishing snapshots
+  python examples/native/dlrm.py --checkpoint-dir /tmp/dlrm-ckpt --save-every 50
+
+  # terminal 2: serve them, hot-reloading as they land
+  python examples/native/serve_dlrm.py --checkpoint-dir /tmp/dlrm-ckpt \\
+      --serve-max-batch 64 --serve-max-delay-ms 3 --port 8000
+
+  curl -s localhost:8000/healthz
+  curl -s localhost:8000/stats
+  curl -s -X POST localhost:8000/predict -d \\
+      '{"dense": [[0.1, 0.2, 0.3, 0.4]], "sparse": [[[1],[2],[3],[4]]]}'
+
+Endpoints:
+  POST /predict  {"dense": [...], "sparse": [...]}  ->
+                 {"scores": [...], "version": N, "latency_ms": ...}
+                 429 on Overloaded, 504 on DeadlineExceeded
+  GET  /stats    engine stats() (p50/p99, batch fill, cache hit rate,
+                 reloads, executable-cache occupancy)
+  GET  /healthz  {"ok": true, "version": N}
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+from dlrm_flexflow_tpu.serve import DeadlineExceeded, Overloaded
+from dlrm_flexflow_tpu.utils.logging import get_logger
+
+log_app = get_logger("serve_dlrm")
+
+
+def build_server_model(cfg, dcfg):
+    """Same graph as the trainer (fingerprints must match for hot
+    reload); compiled at the largest serve bucket so every bucket pads
+    under the compile batch."""
+    model = ff.FFModel(cfg)
+    build_dlrm(model, dcfg)
+    model.compile(ff.SGDOptimizer(lr=cfg.learning_rate),
+                  "mean_squared_error", ["mse"])
+    model.init_layers()
+    return model
+
+
+def make_handler(engine, input_names):
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):   # route through our logger
+            log_app.debug(fmt, *args)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"ok": True, "version": engine.version})
+            elif self.path == "/stats":
+                self._reply(200, engine.stats())
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/predict":
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                feats = {}
+                for name in input_names:
+                    if name not in req:
+                        raise ValueError(f"missing input {name!r}")
+                    arr = np.asarray(req[name])
+                    feats[name] = (arr.astype(np.int32)
+                                   if name == "sparse"
+                                   else arr.astype(np.float32))
+            except (ValueError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": str(e)})
+                return
+            try:
+                pred = engine.predict(feats)
+                self._reply(200, {
+                    "scores": np.asarray(pred.scores).reshape(-1).tolist(),
+                    "version": pred.version,
+                    "latency_ms": round(pred.latency_ms, 3)})
+            except Overloaded as e:
+                self._reply(429, {"error": str(e)})
+            except (DeadlineExceeded, TimeoutError) as e:
+                self._reply(504, {"error": str(e)})
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+
+    return Handler
+
+
+def main(argv=None):
+    # same CPU-virtualization escape hatch as _common.setup (the axon
+    # sitecustomize pins an accelerator plugin; FF_FORCE_CPU=<ndev>
+    # virtualizes host devices explicitly for tests/CPU-only serving)
+    force_cpu = int(os.environ.get("FF_FORCE_CPU") or 0)
+    if force_cpu > 0:
+        from dlrm_flexflow_tpu.utils.testing import ensure_cpu_devices
+        ensure_cpu_devices(force_cpu)
+    cfg = ff.FFConfig.parse_args(argv)
+    dcfg = DLRMConfig.parse_args(cfg.unparsed)
+    port = 8000
+    rest = list(cfg.unparsed)
+    if "--port" in rest:
+        port = int(rest[rest.index("--port") + 1])
+
+    model = build_server_model(cfg, dcfg)
+    ckpt_dir = cfg.checkpoint_dir or None
+    engine = ff.InferenceEngine(model, checkpoint_dir=ckpt_dir)
+    if ckpt_dir:
+        # initial load through the watcher's READ-ONLY manifest scan (a
+        # CheckpointManager here would sweep tmp files under a live
+        # trainer) — params_only restore of the newest valid snapshot
+        if ff.SnapshotWatcher(engine, ckpt_dir).poll_once():
+            log_app.info("serving snapshot version %d", engine.version)
+        else:
+            log_app.warning("no restorable snapshot in %s — serving "
+                            "fresh init until the trainer publishes one",
+                            ckpt_dir)
+    input_names = [t.name for t in model.input_tensors]
+
+    from http.server import ThreadingHTTPServer
+    with engine:
+        httpd = ThreadingHTTPServer(
+            ("0.0.0.0", port), make_handler(engine, input_names))
+        log_app.info("serving DLRM on :%d (buckets %s, max delay %.1f ms"
+                     "%s)", port, engine.stats()["buckets"],
+                     engine.config.max_delay_ms,
+                     f", hot-reload from {ckpt_dir}" if ckpt_dir else "")
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
