@@ -228,6 +228,24 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             serve = {"error": str(exc)[:200]}
 
+    # opt-in serving-fleet smoke (BENCH_SERVE_FLEET=1): attained QPS at
+    # a p99 SLO for 1/2/4 replicas under open-loop Poisson load, zero
+    # failed requests with one replica killed mid-run, continuous vs
+    # flush-cycle batching throughput
+    serve_fleet = None
+    if os.environ.get("BENCH_SERVE_FLEET"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_serve_fleet import measure as _fleet_measure
+            serve_fleet = _fleet_measure(
+                requests=int(os.environ.get("BENCH_SERVE_FLEET_REQUESTS",
+                                            "256")),
+                slo_ms=float(os.environ.get("BENCH_SERVE_FLEET_SLO_MS",
+                                            "50")))
+        except Exception as exc:
+            serve_fleet = {"error": str(exc)[:200]}
+
     vs = 1.0
     base_file = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE")
     if os.path.exists(base_file):
@@ -257,6 +275,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         out["superstep"] = superstep
     if serve is not None:
         out["serve"] = serve
+    if serve_fleet is not None:
+        out["serve_fleet"] = serve_fleet
     print(json.dumps(out))
     return 0
 

@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""Serving-fleet benchmark: what does the router buy under open-loop load?
+
+Three questions, matching the ISSUE-6 acceptance bar:
+
+- **Scaling**: attained QPS at a p99 SLO for 1/2/4 replicas under
+  open-loop Poisson arrivals (open loop so a slow server cannot slow the
+  arrival process down and flatter its own tail — the coordinated-
+  omission trap of closed-loop drivers). Reported as the highest offered
+  rate whose measured p99 stays inside the SLO.
+- **Survival**: a 2-replica fleet at a fixed offered rate with one
+  replica killed mid-run (`FF_FAULT_REPLICA_DOWN`) — failed requests
+  (the bar is ZERO: every request retried to success on the survivor)
+  and p99 before/during the outage.
+- **Continuous vs flush batching**: the same open-loop ladder through
+  one engine in continuous (iteration-level) admission vs the
+  pre-continuous size/deadline flush cycle. Continuous batching is
+  self-clocked — the previous dispatch IS the coalescing window, so the
+  batch grows adaptively with load — where flush mode caps a batch at
+  whatever ``max_delay`` collects and adds that delay to every partial
+  batch; attained QPS at the SLO must be >= for continuous. (A
+  closed-loop drive would flatter flush mode: N threads resubmitting in
+  lock-step after each batch hand it a perfectly re-formed burst to
+  collect — exactly the coordination open loop exists to avoid.)
+
+Prints ONE JSON line; `measure()` is imported by bench.py when
+BENCH_SERVE_FLEET=1. Usage:
+  python benchmarks/bench_serve_fleet.py [--requests N] [--slo-ms MS]
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _build(dev=None, max_batch=64):
+    """One replica's model on its own single-device mesh (replicas must
+    not share a mesh — concurrent dispatches would serialize, and on
+    CPU can deadlock interleaved collectives)."""
+    import jax
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+    from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+    dcfg = DLRMConfig(embedding_size=[8192] * 8, sparse_feature_size=16,
+                      mlp_bot=[16, 64, 16], mlp_top=[144, 64, 1])
+    cfg = ff.FFConfig(batch_size=max_batch, seed=3,
+                      serve_max_batch=max_batch)
+    model = ff.FFModel(cfg)
+    build_dlrm(model, dcfg)
+    mesh = None
+    if dev is not None:
+        devs = jax.devices()
+        lo = dev % len(devs)
+        mesh = make_mesh(devices=devs[lo:lo + 1])
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+                  mesh=mesh)
+    model.init_layers()
+    return model, dcfg
+
+
+def _requests(dcfg, n, seed=0):
+    from dlrm_flexflow_tpu.models.dlrm import synthetic_batch
+    x, _ = synthetic_batch(dcfg, n, seed=seed)
+    return [{k: v[i:i + 1] for k, v in x.items()} for i in range(n)]
+
+
+def _router(n, retries=3):
+    import dlrm_flexflow_tpu as ff
+    scfg = ff.ServeConfig(max_batch=64, queue_capacity=4096)
+    fleet = ff.Fleet.build(lambda i: _build(dev=i)[0], n, scfg)
+    rcfg = ff.RouterConfig(retries=retries, backoff_ms=2.0,
+                           cooldown_s=0.5, health_interval_s=0.1,
+                           probe_deadline_s=30.0)
+    return ff.FleetRouter(fleet, rcfg)
+
+
+def _poisson_drive(submit, reqs, rate_qps, n=None, seed=7):
+    """Open-loop Poisson arrivals: submit request i at its scheduled
+    arrival time regardless of how the server is doing, measure latency
+    FROM THE SCHEDULE (late submission counts against the server).
+    ``n`` requests are drawn cyclically from ``reqs``.
+    Returns (latencies_ms sorted, failed_count, elapsed_s)."""
+    import numpy as np
+    n = len(reqs) if n is None else n
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_qps, size=n))
+    lat_ms = []
+    lat_lock = threading.Lock()
+    failed = [0]
+    futs = []
+    t0 = time.perf_counter()
+    for i in range(n):
+        now = time.perf_counter() - t0
+        wait = arrivals[i] - now
+        if wait > 0:
+            time.sleep(wait)
+        t_sched = t0 + arrivals[i]
+
+        def _done(f, t_sched=t_sched):
+            try:
+                f.result()
+                with lat_lock:
+                    lat_ms.append(1e3 * (time.perf_counter() - t_sched))
+            except Exception:   # noqa: BLE001 — counted, not raised
+                failed[0] += 1
+
+        try:
+            fut = submit(reqs[i % len(reqs)])
+        except Exception:   # noqa: BLE001 — Overloaded at submit time
+            failed[0] += 1  # is a failed request in an open-loop world
+            continue
+        fut.add_done_callback(_done)
+        futs.append(fut)
+    for f in futs:
+        try:
+            f.result(120)
+        except Exception:   # noqa: BLE001 — already counted
+            pass
+    return sorted(lat_ms), failed[0], time.perf_counter() - t0
+
+
+def _trial_n(reqs, rate_qps, min_s=0.5):
+    """Requests per trial: at least the base set, and enough to SUSTAIN
+    the offered rate for ``min_s`` — a burst that fits in the queue and
+    drains after the last arrival would otherwise report a flawless
+    tail at an unsustainable rate (p99-from-schedule of a 30 ms burst
+    says nothing about steady state). The absolute cap only bounds the
+    trial's memory/runtime; past the driver's own submit ceiling the
+    schedule slips, which correctly counts against the server."""
+    return int(min(max(len(reqs), rate_qps * min_s), 32768))
+
+
+def _qps_at_slo(submit, reqs, slo_ms, rates):
+    """Highest offered rate whose p99 meets the SLO with zero failures;
+    rates are tried in ascending order and the sweep stops at the first
+    miss (the attained-QPS knee). A short untimed Poisson pre-run
+    absorbs first-dispatch jitter (lazy imports, thread spin-up)."""
+    from dlrm_flexflow_tpu.serve import percentile
+    _poisson_drive(submit, reqs, rates[0], n=min(64, len(reqs)))
+    best = 0.0
+    detail = []
+    for rate in rates:
+        lat, failed, _ = _poisson_drive(submit, reqs, rate,
+                                        n=_trial_n(reqs, rate))
+        p99 = percentile(lat, 99)
+        ok = failed == 0 and p99 is not None and p99 <= slo_ms
+        detail.append({"offered_qps": round(rate, 1),
+                       "n": _trial_n(reqs, rate),
+                       "p99_ms": round(p99, 2) if p99 else None,
+                       "failed": failed, "ok": ok})
+        if not ok:
+            break
+        best = rate
+    return best, detail
+
+
+def measure(requests=256, slo_ms=50.0, replica_counts=(1, 2, 4)):
+    import jax
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.serve import percentile
+    from dlrm_flexflow_tpu.utils import faults
+
+    out = {"requests": requests, "slo_ms": slo_ms,
+           "devices": len(jax.devices()),
+           # read the scaling section with the platform in mind: on a
+           # shared-CPU host, N in-process replicas fight for the same
+           # cores AND each sees 1/N of the traffic (smaller batches,
+           # worse amortization), so attained QPS can go DOWN with N —
+           # per-host replicas on real accelerators share neither
+           "note": ("in-process replicas share host cores; scaling "
+                    "numbers on CPU reflect batch dilution + core "
+                    "contention, not the router")}
+
+    # --- scaling sweep: attained QPS at the p99 SLO ---------------------
+    # calibrate the rate ladder off a 1-replica closed-loop probe so the
+    # same ladder exercises every fleet size
+    scaling = {}
+    probe_model, dcfg = _build(dev=0)
+    reqs = _requests(dcfg, requests)
+    eng = ff.InferenceEngine(probe_model, ff.ServeConfig(
+        max_batch=64, queue_capacity=4096))
+    with eng:
+        for r in reqs[:8]:
+            eng.predict(r, timeout=60)
+        t0 = time.perf_counter()
+        for r in reqs[:64]:
+            eng.predict(r, timeout=60)
+        base_qps = 64 / (time.perf_counter() - t0)
+    rates = [base_qps * f for f in (0.5, 1.0, 2.0, 4.0, 8.0, 16.0)]
+    out["single_replica_closed_loop_qps"] = round(base_qps, 1)
+
+    for n in replica_counts:
+        router = _router(n).start()
+        try:
+            for r in reqs[:16]:          # warm every replica's buckets
+                router.predict(r, timeout=60)
+            best, detail = _qps_at_slo(router.submit, reqs, slo_ms,
+                                       rates)
+            scaling[str(n)] = {"qps_at_slo": round(best, 1),
+                               "sweep": detail}
+        finally:
+            router.close()
+    out["scaling"] = scaling
+
+    # --- survival: kill 1 of 2 replicas mid-run -------------------------
+    router = _router(2).start()
+    try:
+        for r in reqs[:16]:
+            router.predict(r, timeout=60)
+        rate = max(rates[0], scaling.get("2", {}).get(
+            "qps_at_slo", rates[0]) * 0.5)
+        half = len(reqs) // 2
+        lat_before, failed_before, _ = _poisson_drive(
+            router.submit, reqs[:half], rate)
+        with faults.active_plan(faults.FaultPlan(replica_down={1: -1})):
+            lat_during, failed_during, _ = _poisson_drive(
+                router.submit, reqs[half:], rate)
+        st = router.stats()
+        out["replica_kill"] = {
+            "offered_qps": round(rate, 1),
+            "failed_before": failed_before,
+            "failed_during_kill": failed_during,
+            "p99_ms_before": round(percentile(lat_before, 99) or 0, 2),
+            "p99_ms_during_kill": round(percentile(lat_during, 99) or 0, 2),
+            "retries": st["retries"],
+            "ejections": st["fleet"]["replicas"][1]["ejections"],
+        }
+    finally:
+        router.close()
+
+    # --- continuous vs flush batching (open-loop ladder each) -----------
+    modes = {}
+    for continuous in (False, True):
+        model, _ = _build(dev=0)
+        eng = ff.InferenceEngine(model, ff.ServeConfig(
+            max_batch=64, max_delay_ms=2.0, queue_capacity=4096,
+            continuous=continuous))
+        with eng:
+            for r in reqs[:16]:
+                eng.predict(r, timeout=60)              # warm
+            best, detail = _qps_at_slo(eng.submit, reqs, slo_ms, rates)
+            st = eng.stats()
+        modes["continuous" if continuous else "flush"] = {
+            "qps_at_slo": round(best, 1),
+            "batch_fill": round(st["batch_fill"], 3),
+            "flushes": st["flushes"],
+            "sweep": detail,
+        }
+    out["batching"] = modes
+    # None (not an astronomical epsilon ratio) when flush attains no
+    # rate at all inside the SLO — continuous wins outright
+    flush_qps = modes["flush"]["qps_at_slo"]
+    out["continuous_vs_flush"] = (
+        round(modes["continuous"]["qps_at_slo"] / flush_qps, 2)
+        if flush_qps > 0 else None)
+    return out
+
+
+if __name__ == "__main__":
+    n = 256
+    slo = 50.0
+    if "--requests" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--requests") + 1])
+    if "--slo-ms" in sys.argv:
+        slo = float(sys.argv[sys.argv.index("--slo-ms") + 1])
+    print(json.dumps(measure(requests=n, slo_ms=slo)))

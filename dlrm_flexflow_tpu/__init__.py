@@ -23,8 +23,10 @@ from .parallel.mesh import make_mesh
 from .parallel.pconfig import ParallelConfig
 from .parallel.distributed import MeshDegraded
 from .utils.watchdog import Deadline, StallReport, WorkerStalled
-from .serve import (DeadlineExceeded, InferenceEngine, Overloaded,
-                    Prediction, ServeConfig, SnapshotWatcher)
+from .serve import (DeadlineExceeded, Fleet, FleetRouter,
+                    FleetUnavailable, InferenceEngine, Overloaded,
+                    Prediction, ReplicaDown, RouterConfig, ServeConfig,
+                    SnapshotWatcher)
 
 __version__ = "0.1.0"
 
@@ -38,4 +40,6 @@ __all__ = [
     "MeshDegraded", "WorkerStalled", "StallReport", "Deadline",
     "InferenceEngine", "ServeConfig", "Prediction", "Overloaded",
     "DeadlineExceeded", "SnapshotWatcher",
+    "Fleet", "FleetRouter", "FleetUnavailable", "RouterConfig",
+    "ReplicaDown",
 ]

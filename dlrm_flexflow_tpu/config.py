@@ -190,6 +190,29 @@ class FFConfig:
     # snapshot-watcher poll interval for zero-downtime hot reload of a
     # CheckpointManager directory. Set with --serve-poll SECONDS.
     serve_poll_s: float = 0.5
+    # batch-formation discipline: "continuous" (default) admits
+    # whatever queued during the previous dispatch into the next one
+    # immediately — iteration-level batching à la Orca, the dispatch IS
+    # the coalescing window; "flush" restores the pure size/deadline
+    # flush cycle (a partial batch always waits out serve_max_delay_ms).
+    # Set with --serve-batching {continuous,flush}.
+    serve_batching: str = "continuous"
+    # ---- serving fleet (serve/router.py FleetRouter) ------------------
+    # replica count for the multi-replica serving fleet (one engine per
+    # device/host, data-parallel params); 1 = single engine, no router.
+    # Set with --serve-replicas N.
+    serve_replicas: int = 1
+    # bounded per-request re-dispatches (exponential backoff, different
+    # replica) on Overloaded/DeadlineExceeded/replica failure. Set with
+    # --serve-retries N.
+    serve_retries: int = 2
+    # tail-latency hedging: a request unresolved after this long is
+    # duplicated to a second replica, first result wins. 0 disables.
+    # Set with --serve-hedge-ms MS.
+    serve_hedge_ms: float = 0.0
+    # share of traffic routed to the canary cohort while a canary
+    # deploy is active. Set with --serve-canary-fraction F.
+    serve_canary_fraction: float = 0.1
     # LRU cap on the eval-path AOT executable cache (_eval_step_execs):
     # serving many ad-hoc shapes must not leak executables. Evictions
     # are counted (FFModel.eval_exec_cache_stats / engine stats()). Set
@@ -336,6 +359,23 @@ class FFConfig:
                 cfg.serve_cache_rows = int(take())
             elif a == "--serve-poll":
                 cfg.serve_poll_s = float(take())
+            elif a == "--serve-batching":
+                v = take()
+                if v not in ("continuous", "flush"):
+                    raise ValueError(f"--serve-batching expects "
+                                     f"continuous|flush, got {v!r}")
+                cfg.serve_batching = v
+            elif a == "--serve-replicas":
+                cfg.serve_replicas = int(take())
+                if cfg.serve_replicas < 1:
+                    raise ValueError(f"--serve-replicas expects N >= 1, "
+                                     f"got {cfg.serve_replicas}")
+            elif a == "--serve-retries":
+                cfg.serve_retries = int(take())
+            elif a == "--serve-hedge-ms":
+                cfg.serve_hedge_ms = float(take())
+            elif a == "--serve-canary-fraction":
+                cfg.serve_canary_fraction = float(take())
             elif a == "--eval-exec-cache":
                 cfg.eval_exec_cache = int(take())
             elif a == "--stage-dataset":

@@ -1,11 +1,17 @@
-"""Online serving: dynamic-batched inference over the AOT eval cache,
-with an embedding-row cache for host-resident tables and zero-downtime
-snapshot hot reload. See engine.py for the design notes."""
+"""Online serving: dynamic-batched inference over the AOT eval cache
+(continuous iteration-level admission), an embedding-row cache for
+host-resident tables, zero-downtime snapshot hot reload, and a
+fault-tolerant multi-replica fleet router with canary/shadow rollout.
+See engine.py / router.py for the design notes."""
 
 from .cache import EmbeddingCache
 from .engine import (DeadlineExceeded, InferenceEngine, Overloaded,
-                     Prediction, ServeConfig)
+                     Prediction, ReplicaDown, ServeConfig, percentile)
+from .fleet import Fleet, Replica
+from .router import FleetRouter, FleetUnavailable, RouterConfig
 from .watcher import SnapshotWatcher
 
 __all__ = ["InferenceEngine", "ServeConfig", "Prediction", "Overloaded",
-           "DeadlineExceeded", "EmbeddingCache", "SnapshotWatcher"]
+           "DeadlineExceeded", "ReplicaDown", "EmbeddingCache",
+           "SnapshotWatcher", "Fleet", "Replica", "FleetRouter",
+           "FleetUnavailable", "RouterConfig", "percentile"]

@@ -4,9 +4,17 @@ The training side of this framework amortizes dispatch overhead by fusing
 steps (supersteps); the read path amortizes it by COALESCING REQUESTS —
 the Clipper/Orca discipline. ``InferenceEngine`` accepts per-request
 feature dicts from any number of threads, queues them in a bounded queue,
-and a single batcher thread flushes a dynamic batch when it reaches
-``max_batch`` rows or when the oldest request has waited ``max_delay_ms``
-(size-flush vs deadline-flush). Every batch is zero-padded up to a small
+and a single batcher thread forms dynamic batches. Admission is
+**continuous** (iteration-level, à la Orca) by default: the moment a
+dispatch completes, everything that queued up WHILE it ran forms the
+next batch and goes out immediately — the dispatch itself is the
+coalescing window, no artificial delay is ever inserted, and a request
+never waits out a flush cycle it arrived in the middle of. The
+pre-continuous **flush-cycle** mode (``continuous=False``) is kept for
+comparison: there a batch flushes only when it reaches ``max_batch``
+rows (size-flush) or when its oldest request has waited ``max_delay_ms``
+(deadline-flush), so a partial batch always pays the delay even on an
+idle engine. Either way every batch is zero-padded up to a small
 set of power-of-two buckets so each dispatch hits one of a FIXED set of
 pre-compiled AOT executables (all buckets are warmed at ``start()`` —
 no live request ever pays a compile), and the padded rows are sliced off
@@ -47,10 +55,33 @@ import numpy as np
 from ..data.dataloader import coalesce_batches
 from ..utils import faults
 from ..utils.logging import get_logger
-from ..utils.watchdog import Deadline, WorkerStalled
+from ..utils.watchdog import Deadline, Heartbeat, WorkerStalled
 from .cache import EmbeddingCache
 
 log_serve = get_logger("serve")
+
+
+def percentile(sorted_vals, p: float) -> Optional[float]:
+    """Linear-interpolated percentile over an ASCENDING sequence
+    (numpy's default method), ``None`` on an empty window.
+
+    The previous nearest-index pick (``int(round(p/100*(n-1)))``) was
+    degenerate on tiny windows: an empty window reported 0.0 ms — a
+    flawless p99 for a server that has answered nothing, which reads as
+    healthy to an SLO monitor — and Python's banker's rounding put the
+    p50 of a 2-sample window on the lower sample instead of between
+    them. Shared by the engine's stats() and the fleet router's
+    cohort/SLO comparisons, which must agree on what "p99" means.
+    """
+    n = len(sorted_vals)
+    if n == 0:
+        return None
+    if n == 1:
+        return float(sorted_vals[0])
+    k = (p / 100.0) * (n - 1)
+    f = int(k)
+    c = min(f + 1, n - 1)
+    return float(sorted_vals[f] + (k - f) * (sorted_vals[c] - sorted_vals[f]))
 
 
 class Overloaded(RuntimeError):
@@ -72,6 +103,19 @@ class DeadlineExceeded(WorkerStalled, TimeoutError):
     training-worker stalls read the same way in logs/alerts."""
 
 
+class ReplicaDown(RuntimeError):
+    """This replica's serving process is gone — a crash (injected by
+    ``FF_FAULT_REPLICA_DOWN``), a dead batcher thread, or the router's
+    circuit breaker draining an ejected replica's queue. Retryable: the
+    fleet router re-routes the failed request to a surviving replica."""
+
+    def __init__(self, replica_id: Optional[int] = None, detail: str = ""):
+        rid = "?" if replica_id is None else replica_id
+        super().__init__(f"serving replica {rid} is down"
+                         + (f": {detail}" if detail else ""))
+        self.replica_id = replica_id
+
+
 class Prediction(NamedTuple):
     """Per-request result: model scores for the request's rows, the
     weight version (checkpoint step) that computed them, and the
@@ -86,13 +130,18 @@ class Prediction(NamedTuple):
 class ServeConfig:
     """Engine knobs; ``from_config`` lifts the ``--serve-*`` flags."""
 
-    max_batch: int = 64          # flush-on-size threshold / largest bucket
-    max_delay_ms: float = 5.0    # flush-on-deadline for a partial batch
+    max_batch: int = 64          # largest bucket / flush-on-size bound
+    max_delay_ms: float = 5.0    # flush-mode deadline for a partial batch
     queue_capacity: int = 256    # bounded queue -> Overloaded past this
     deadline_ms: float = 0.0     # per-request budget; 0 = none
     cache_rows: int = 0          # embedding-row cache capacity; 0 = off
     poll_s: float = 0.5          # snapshot-watcher poll interval
     warmup: bool = True          # AOT-compile all buckets at start()
+    continuous: bool = True      # iteration-level admission (Orca);
+    #                              False = pure size/deadline flush
+    reshard: bool = False        # allow cross-mesh snapshot reloads (a
+    #                              per-device fleet replica following a
+    #                              multi-device trainer's snapshots)
 
     @staticmethod
     def from_config(cfg) -> "ServeConfig":
@@ -102,7 +151,10 @@ class ServeConfig:
             queue_capacity=int(getattr(cfg, "serve_queue", 256)),
             deadline_ms=float(getattr(cfg, "serve_deadline_ms", 0.0)),
             cache_rows=int(getattr(cfg, "serve_cache_rows", 0)),
-            poll_s=float(getattr(cfg, "serve_poll_s", 0.5)))
+            poll_s=float(getattr(cfg, "serve_poll_s", 0.5)),
+            continuous=(getattr(cfg, "serve_batching", "continuous")
+                        != "flush"),
+            reshard=bool(getattr(cfg, "serve_replicas", 1) > 1))
 
 
 class _Request:
@@ -127,11 +179,15 @@ class InferenceEngine:
     """
 
     def __init__(self, model, config: Optional[ServeConfig] = None,
-                 checkpoint_dir: Optional[str] = None):
+                 checkpoint_dir: Optional[str] = None,
+                 replica_id: Optional[int] = None):
         if model.params is None:
             raise ValueError("InferenceEngine needs an initialized model "
                              "(init_layers() or restore_checkpoint())")
         self._model = model
+        # fleet identity: names the batcher thread, keys the per-replica
+        # fault hooks (FF_FAULT_REPLICA_DOWN / per-replica serve delay)
+        self.replica_id = replica_id
         self.config = config or ServeConfig.from_config(model.config)
         if self.config.max_batch < 1:
             raise ValueError("serve max_batch must be >= 1")
@@ -143,6 +199,13 @@ class InferenceEngine:
                 self._buckets[-1], self._buckets)
         self.max_batch = self._buckets[-1]
         self._input_names = {t.name for t in model.input_tensors}
+        # per-sample shapes for submit-time validation: a wrong-shaped
+        # feature must fail THERE as a non-retryable ValueError — at
+        # dispatch it would fail the whole batch, burn the router's
+        # retry budget, and trip the circuit breaker (one malformed
+        # client ejecting every replica is how a fleet goes down)
+        self._input_sample_shapes = {t.name: tuple(t.shape[1:])
+                                     for t in model.input_tensors}
         # embedding-row cache only applies to host-resident tables
         self._cache: Optional[EmbeddingCache] = None
         if (self.config.cache_rows > 0
@@ -176,6 +239,18 @@ class InferenceEngine:
         self._reload_rejects = 0
         self._last_reject = ""
         self._warmup_s = 0.0
+        # how each dispatched batch was formed (continuous admission vs
+        # flush-mode size/deadline) — lets the fleet bench verify the
+        # continuous path is actually taken
+        self._flushes = {"continuous": 0, "size": 0, "deadline": 0}
+        # liveness: the batcher beats once around its loop; the fleet
+        # router's health thread ejects a replica whose heartbeat goes
+        # stale (wedged dispatch) before any request even errors
+        self._heartbeat = Heartbeat(self._thread_name())
+
+    def _thread_name(self) -> str:
+        return ("ff-serve-batcher" if self.replica_id is None
+                else f"ff-serve-batcher-{self.replica_id}")
 
     # --- lifecycle -----------------------------------------------------
     def start(self) -> "InferenceEngine":
@@ -191,12 +266,13 @@ class InferenceEngine:
                            len(self._buckets), list(self._buckets),
                            1e3 * self._warmup_s)
         self._thread = threading.Thread(target=self._batcher, daemon=True,
-                                        name="ff-serve-batcher")
+                                        name=self._thread_name())
         self._thread.start()
         if self._checkpoint_dir:
             from .watcher import SnapshotWatcher
             self._watcher = SnapshotWatcher(
-                self, self._checkpoint_dir, poll_s=self.config.poll_s)
+                self, self._checkpoint_dir, poll_s=self.config.poll_s,
+                elastic=self.config.reshard)
             self._watcher.start()
         return self
 
@@ -238,7 +314,22 @@ class InferenceEngine:
                 raise ValueError(
                     f"unknown input {k!r}; model inputs are "
                     f"{sorted(self._input_names)}")
-            feats[k] = np.asarray(v)
+            arr = np.asarray(v)
+            want = self._input_sample_shapes[k]
+            if arr.ndim >= 1 and tuple(arr.shape[1:]) != want:
+                import math
+                if (arr.ndim and want
+                        and math.prod(arr.shape[1:]) == math.prod(want)):
+                    # same per-sample element count, different layout
+                    # (e.g. sparse (n, T) for a (n, T, 1) bag input):
+                    # the reshape is unambiguous, accept it
+                    arr = arr.reshape((arr.shape[0],) + want)
+                else:
+                    raise ValueError(
+                        f"input {k!r} rows have per-sample shape "
+                        f"{tuple(arr.shape[1:])}; the model expects "
+                        f"{want}")
+            feats[k] = arr
         missing = self._input_names - set(feats)
         if missing:
             raise ValueError(f"request is missing inputs {sorted(missing)}")
@@ -277,23 +368,37 @@ class InferenceEngine:
     def _batcher(self) -> None:
         while True:
             take: List[_Request] = []
+            flush = "continuous"
             with self._cond:
+                self._heartbeat.beat()
                 while not self._q and not self._closing:
                     self._cond.wait(0.1)
+                    self._heartbeat.beat()
                 if not self._q and self._closing:
                     return
-                # a batch is open from the moment its OLDEST request
-                # arrived; flush on size (max_batch rows coalesced) or
-                # on that request's age (max_delay)
-                t_flush = self._q[0].t0 + self.config.max_delay_ms / 1e3
-                while (self._q_rows < self.max_batch
-                       and not self._closing):
-                    left = t_flush - time.monotonic()
-                    if left <= 0:
-                        break
-                    self._cond.wait(left)
-                    if not self._q:      # all timed out? (can't happen:
-                        break            # only this thread pops)
+                if not self.config.continuous:
+                    # flush-cycle mode: a batch is open from the moment
+                    # its OLDEST request arrived; flush on size
+                    # (max_batch rows coalesced) or on that request's
+                    # age (max_delay)
+                    t_flush = (self._q[0].t0
+                               + self.config.max_delay_ms / 1e3)
+                    while (self._q_rows < self.max_batch
+                           and not self._closing):
+                        left = t_flush - time.monotonic()
+                        if left <= 0:
+                            break
+                        self._cond.wait(left)
+                        self._heartbeat.beat()
+                        if not self._q:  # all timed out? (can't happen:
+                            break        # only this thread pops)
+                    flush = ("size" if self._q_rows >= self.max_batch
+                             else "deadline")
+                # continuous mode pops straight away: whatever queued up
+                # while the previous dispatch executed (or the request
+                # that just woke an idle batcher) IS the next batch —
+                # the dispatch latency is the coalescing window, and a
+                # request never waits out a flush cycle
                 rows = 0
                 while self._q and rows + self._q[0].rows <= self.max_batch:
                     r = self._q.popleft()
@@ -301,6 +406,8 @@ class InferenceEngine:
                     rows += r.rows
                     take.append(r)
             if take:
+                with self._stats_lock:
+                    self._flushes[flush] += 1
                 try:
                     self._dispatch(take)
                 except BaseException as e:   # noqa: BLE001 — a model
@@ -345,7 +452,12 @@ class InferenceEngine:
                 live.append(r)
         if not live:
             return
-        faults.maybe_serve_delay()
+        # a crashed replica (FF_FAULT_REPLICA_DOWN) answers nothing: the
+        # typed ReplicaDown fails the whole batch and the fleet router
+        # re-routes every request to a surviving replica
+        if faults.take_replica_down(self.replica_id):
+            raise ReplicaDown(self.replica_id, "fault injection")
+        faults.maybe_serve_delay(self.replica_id)
         batch = coalesce_batches([r.features for r in live])
         n = sum(r.rows for r in live)
         bucket = next(b for b in self._buckets if b >= n)
@@ -403,16 +515,81 @@ class InferenceEngine:
     def model(self):
         return self._model
 
+    # --- fleet hooks (called by serve.fleet / serve.router) ------------
+    @property
+    def queue_depth(self) -> int:
+        """Current queued request count — the router's load-balancing
+        signal, cheap enough to read per pick (stats() sorts the whole
+        latency window)."""
+        return len(self._q)
+
+    def alive(self) -> bool:
+        """True while the batcher thread is running and the engine is
+        neither unstarted nor draining."""
+        t = self._thread
+        return bool(self._started and not self._closing
+                    and t is not None and t.is_alive())
+
+    def heartbeat_age(self) -> float:
+        """Seconds since the batcher last went around its loop. Grows
+        past the dispatch latency only when the batcher is wedged —
+        the router's heartbeat health check keys off this."""
+        return self._heartbeat.age()
+
+    @property
+    def heartbeat(self) -> Heartbeat:
+        return self._heartbeat
+
+    def drain_pending(self, exc: Optional[BaseException] = None) -> int:
+        """Fail every still-queued (not yet dispatched) request with
+        ``exc`` (default: this replica's ReplicaDown) and empty the
+        queue. The router calls this when its circuit breaker ejects the
+        replica: the rescued futures' retry callbacks re-route their
+        requests to surviving replicas instead of leaving them to rot
+        behind a dead batcher. Returns how many requests were failed."""
+        if exc is None:
+            exc = ReplicaDown(self.replica_id, "queue drained on ejection")
+        with self._cond:
+            taken = list(self._q)
+            self._q.clear()
+            self._q_rows = 0
+        n = 0
+        for r in taken:
+            if not r.future.done():
+                r.future.set_exception(exc)
+                n += 1
+        return n
+
+    def healthz(self) -> Dict[str, Any]:
+        """Readiness snapshot for a /healthz endpoint. ``ok`` is False
+        when sending this replica traffic is pointless: the engine is
+        draining (close() begun / never started), its batcher thread
+        died, or the bounded queue is saturated (submits are being
+        rejected with Overloaded right now)."""
+        depth = len(self._q)
+        saturated = depth >= self.config.queue_capacity
+        draining = self._closing or not self._started
+        t = self._thread
+        batcher_alive = bool(t is not None and t.is_alive())
+        dead = self._started and not self._closing and not batcher_alive
+        return {
+            "ok": not (saturated or draining or dead),
+            "version": self._version,
+            "draining": draining,
+            "saturated": saturated,
+            "batcher_alive": batcher_alive,
+            "queue_depth": depth,
+            "queue_capacity": self.config.queue_capacity,
+        }
+
     # --- observability -------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         with self._stats_lock:
             lat = sorted(self._lat_ms)
+            flushes = dict(self._flushes)
 
         def pct(p):
-            if not lat:
-                return 0.0
-            return float(lat[min(len(lat) - 1,
-                                 int(round(p / 100 * (len(lat) - 1))))])
+            return percentile(lat, p)
 
         dispatched = self._rows_served + self._rows_padded
         out = {
@@ -432,8 +609,12 @@ class InferenceEngine:
             "last_reload_reject": self._last_reject,
             "buckets": list(self._buckets),
             "warmup_s": round(self._warmup_s, 4),
+            "flushes": flushes,
+            "continuous": self.config.continuous,
             "eval_exec_cache": self._model.eval_exec_cache_stats(),
         }
+        if self.replica_id is not None:
+            out["replica_id"] = self.replica_id
         if self._cache is not None:
             out["embedding_cache"] = self._cache.stats()
         if self._watcher is not None:

@@ -1,0 +1,286 @@
+"""Serving fleet: replica lifecycle and circuit-breaker state machine.
+
+One :class:`~.engine.InferenceEngine` is one device's worth of traffic
+and a single point of failure. A fleet is N engines over data-parallel
+params (one per device/host; in-process replicas for tests and the CPU
+bench, one per host process in production), each wrapped in a
+:class:`Replica` that tracks its health:
+
+::
+
+    HEALTHY --(eject_after consecutive errors,
+               stale heartbeat, dead batcher)--> EJECTED
+    EJECTED --(cooldown elapsed)------------------> PROBING
+    PROBING --(probe succeeds)--------------------> HEALTHY
+    PROBING --(probe fails)-----------------------> EJECTED
+
+Ejection is the Clipper-style isolation move: the replica stops
+receiving traffic, its still-queued futures are DRAINED (failed with a
+typed ``ReplicaDown`` so the router's retry callbacks re-route them to
+survivors), and only a successful end-to-end probe — a real request
+through the real dispatch path, under a watchdog deadline — re-admits
+it. One slow or crashed replica therefore costs retries, never answers.
+
+Routing, retry/hedging policy, and the canary/shadow deployment
+machinery live in :mod:`.router`; this module is the per-replica truth
+the router acts on, plus fleet-wide ``stats()`` aggregation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import get_logger
+from .engine import InferenceEngine, ReplicaDown, percentile
+
+log_fleet = get_logger("serve.fleet")
+
+# replica states (plain strings: they go straight into stats() JSON)
+HEALTHY = "healthy"
+EJECTED = "ejected"
+PROBING = "probing"
+
+
+class Replica:
+    """One engine plus its circuit-breaker state.
+
+    All transitions happen under the replica's own lock and are driven
+    by the router (request callbacks + health thread); the engine knows
+    nothing about fleet membership beyond its ``replica_id``.
+    """
+
+    def __init__(self, engine: InferenceEngine, rid: int,
+                 cohort: str = "stable"):
+        self.engine = engine
+        self.rid = rid
+        # deployment cohort: "stable" serves normal traffic, "canary"
+        # serves the routed fraction on a candidate snapshot, "shadow"
+        # serves only duplicated traffic and never answers a client
+        self.cohort = cohort
+        self.state = HEALTHY
+        self._lock = threading.Lock()
+        self.consecutive_errors = 0
+        self.ejected_at = 0.0
+        self.last_error = ""
+        # counters (monotonic, surfaced in stats)
+        self.ejections = 0
+        self.readmissions = 0
+        self.probes = 0
+        self.dispatch_errors = 0
+        # pre-deploy state kept while this replica runs a canary/shadow
+        # snapshot: rollback = install this back (the arrays are
+        # immutable JAX trees, so holding references is free)
+        self.rollback_state: Optional[Dict[str, Any]] = None
+        self.rollback_version: int = 0
+
+    # --- routing signals ----------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return self.engine.queue_depth
+
+    def routable(self, cohort: str = "stable") -> bool:
+        """Eligible for client traffic of the given cohort."""
+        return self.state == HEALTHY and self.cohort == cohort
+
+    # --- circuit breaker ----------------------------------------------
+    def record_success(self) -> None:
+        with self._lock:
+            self.consecutive_errors = 0
+
+    def record_error(self, err: BaseException, eject_after: int) -> bool:
+        """Count one dispatch error; True when the consecutive-error
+        threshold was just crossed and the caller should eject."""
+        with self._lock:
+            self.dispatch_errors += 1
+            self.consecutive_errors += 1
+            self.last_error = f"{type(err).__name__}: {err}"
+            return (self.state == HEALTHY
+                    and self.consecutive_errors >= eject_after)
+
+    def eject(self, reason: str) -> int:
+        """HEALTHY/PROBING -> EJECTED: stop routing here, drain the
+        queue so every waiting future fails fast with ReplicaDown (the
+        router retries each on a survivor). Returns drained count."""
+        with self._lock:
+            if self.state == EJECTED:
+                return 0
+            self.state = EJECTED
+            self.ejected_at = time.monotonic()
+            self.ejections += 1
+            self.last_error = reason
+        drained = self.engine.drain_pending(
+            ReplicaDown(self.rid, f"ejected: {reason}"))
+        log_fleet.warning(
+            "ejected replica %d (%s) — drained %d queued request(s) "
+            "onto the surviving replicas", self.rid, reason, drained)
+        return drained
+
+    def due_for_probe(self, cooldown_s: float) -> bool:
+        with self._lock:
+            return (self.state == EJECTED
+                    and time.monotonic() - self.ejected_at >= cooldown_s)
+
+    def begin_probe(self) -> None:
+        with self._lock:
+            if self.state == EJECTED:
+                self.state = PROBING
+            self.probes += 1
+
+    def probe_failed(self, reason: str) -> None:
+        with self._lock:
+            if self.state == PROBING:
+                self.state = EJECTED
+                self.ejected_at = time.monotonic()  # restart cooldown
+            self.last_error = f"probe failed: {reason}"
+
+    def readmit(self) -> None:
+        with self._lock:
+            prev = self.state
+            self.state = HEALTHY
+            self.consecutive_errors = 0
+            self.readmissions += 1
+        log_fleet.info("re-admitted replica %d (was %s) after probe "
+                       "success", self.rid, prev)
+
+    # --- deployment helpers (used by the router's canary/shadow) -------
+    def capture_rollback_state(self) -> None:
+        """Snapshot the CURRENT inference state by reference before a
+        candidate snapshot is installed."""
+        m = self.engine.model
+        self.rollback_state = {
+            "params": m.params,
+            "host_params": m.host_params,
+            "op_state": m.op_state,
+        }
+        self.rollback_version = self.engine.version
+
+    def restore_rollback_state(self) -> None:
+        if self.rollback_state is None:
+            raise RuntimeError(
+                f"replica {self.rid} has no captured rollback state")
+        self.engine.install_snapshot(self.rollback_state,
+                                     self.rollback_version,
+                                     source="rollback")
+        self.rollback_state = None
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "state": self.state,
+            "cohort": self.cohort,
+            "queue_depth": self.queue_depth,
+            "consecutive_errors": self.consecutive_errors,
+            "dispatch_errors": self.dispatch_errors,
+            "ejections": self.ejections,
+            "readmissions": self.readmissions,
+            "probes": self.probes,
+            "last_error": self.last_error,
+            "heartbeat_age_s": round(self.engine.heartbeat_age(), 4),
+            "engine": self.engine.stats(),
+        }
+
+
+class Fleet:
+    """The replica set: lifecycle + fleet-wide stats aggregation.
+
+    Construct from engines (``replica_id`` is assigned positionally when
+    the engine doesn't carry one) or via :meth:`build` from a model
+    factory — each replica needs its OWN model instance (its own param
+    arrays to hot-swap independently); data-parallelism comes from every
+    model being compiled/restored identically.
+    """
+
+    def __init__(self, engines: List[InferenceEngine]):
+        if not engines:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas: List[Replica] = []
+        for i, eng in enumerate(engines):
+            if eng.replica_id is None:
+                eng.replica_id = i
+            self.replicas.append(Replica(eng, eng.replica_id))
+        rids = [r.rid for r in self.replicas]
+        if len(set(rids)) != len(rids):
+            raise ValueError(f"duplicate replica ids {rids}")
+
+    @classmethod
+    def build(cls, model_factory, n: int, config=None,
+              checkpoint_dir: Optional[str] = None) -> "Fleet":
+        """N engines over N fresh models from ``model_factory(i)``; each
+        gets its own SnapshotWatcher when a checkpoint dir is given, so
+        the whole fleet follows the trainer's publications.
+
+        The factory receives the replica index so it can pin each
+        replica's model to ITS OWN device/mesh — replicas sharing one
+        mesh would serialize (and on CPU can deadlock: two dispatches'
+        collective participants interleave on the shared device set).
+        A data-parallel fleet means N independent single-replica meshes,
+        not N views of one mesh."""
+        engines = [InferenceEngine(model_factory(i), config,
+                                   checkpoint_dir=checkpoint_dir,
+                                   replica_id=i)
+                   for i in range(n)]
+        return cls(engines)
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    def __iter__(self):
+        return iter(self.replicas)
+
+    def get(self, rid: int) -> Replica:
+        for r in self.replicas:
+            if r.rid == rid:
+                return r
+        raise KeyError(f"no replica {rid} in fleet "
+                       f"{[r.rid for r in self.replicas]}")
+
+    def healthy(self, cohort: Optional[str] = None) -> List[Replica]:
+        out = [r for r in self.replicas if r.state == HEALTHY
+               and r.cohort != "shadow"]
+        if cohort is not None:
+            out = [r for r in out if r.cohort == cohort]
+        return out
+
+    # --- lifecycle -----------------------------------------------------
+    def start(self) -> "Fleet":
+        for r in self.replicas:
+            r.engine.start()
+        return self
+
+    def close(self, deadline_s: float = 10.0) -> None:
+        errs = []
+        for r in self.replicas:
+            try:
+                r.engine.close(deadline_s)
+            except Exception as e:   # noqa: BLE001 — close every
+                errs.append(e)       # replica before reporting
+        if errs:
+            raise errs[0]
+
+    # --- observability -------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """Fleet-wide aggregation: totals across replicas plus merged
+        latency percentiles over every replica's window (percentiles do
+        not average — merge the samples, then cut)."""
+        per = {r.rid: r.stats() for r in self.replicas}
+        lat: List[float] = []
+        for r in self.replicas:
+            with r.engine._stats_lock:
+                lat.extend(r.engine._lat_ms)
+        lat.sort()
+        totals = {k: sum(p["engine"][k] for p in per.values())
+                  for k in ("requests", "responses", "overloaded",
+                            "timeouts", "batches", "queue_depth",
+                            "reloads", "reload_rejects")}
+        dispatched = sum(p["engine"]["requests"] for p in per.values())
+        return {
+            "replicas": per,
+            "size": len(self.replicas),
+            "healthy": len(self.healthy()),
+            "states": {r.rid: r.state for r in self.replicas},
+            "p50_ms": percentile(lat, 50),
+            "p99_ms": percentile(lat, 99),
+            "totals": totals,
+            "requests_dispatched": dispatched,
+        }

@@ -11,11 +11,22 @@ matches THIS model's build, CRC-32 clean), loads the params with the
 ``params_only`` fast path into FRESH arrays outside any lock, and then
 swaps them into the engine between dispatches.
 
-Failure is always non-fatal: a torn manifest, a fingerprint from a
-differently-built model, a CRC mismatch, or a snapshot corrupted between
-validation and load (the ``FF_FAULT_CORRUPT_RELOAD`` injection) is
-recorded as a reject-with-reason in ``stats()`` and the engine keeps
-serving the current version — zero failed requests.
+Failure is always non-fatal, and is handled in two tiers:
+
+- **Transient IO** (an NFS hiccup mid-``np.load``, a manifest read
+  racing a writer) is absorbed by the shared
+  :func:`~..data.dataloader.read_with_retries` backoff — the same
+  retry discipline the training dataloaders use — before it ever counts
+  as a failure.
+- **Real failures** (retries exhausted, a torn manifest, a fingerprint
+  from a differently-built model, a CRC mismatch, or a snapshot
+  corrupted between validation and load — the
+  ``FF_FAULT_CORRUPT_RELOAD`` injection) are recorded: the engine gets
+  a reject-with-reason, and the watcher's own ``stats()`` carries the
+  cumulative ``reload_failures`` count plus ``last_reload_error`` so a
+  silently-never-reloading server is visible from /stats instead of
+  just skipping to the next poll. Either way the engine keeps serving
+  the current version — zero failed requests.
 """
 
 from __future__ import annotations
@@ -25,6 +36,7 @@ import os
 import threading
 from typing import Any, Dict, Optional
 
+from ..data.dataloader import read_with_retries
 from ..utils import faults
 from ..utils.checkpoint import (_file_crc32, config_fingerprint,
                                 load_params_for_swap)
@@ -36,10 +48,14 @@ class SnapshotWatcher:
 
     MANIFEST = "manifest.json"
 
-    def __init__(self, engine, directory: str, poll_s: float = 0.5):
+    def __init__(self, engine, directory: str, poll_s: float = 0.5,
+                 elastic: bool = False):
         self._engine = engine
         self.directory = os.path.abspath(directory)
         self.poll_s = max(float(poll_s), 0.01)
+        # cross-mesh reshard on load: a per-device fleet replica follows
+        # a multi-device trainer's snapshots (ServeConfig.reshard)
+        self.elastic = bool(elastic)
         self._fingerprint = config_fingerprint(engine.model)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -48,8 +64,18 @@ class SnapshotWatcher:
         # left on disk) would otherwise re-record the same reject every
         # poll interval, forever
         self._rejected: set = set()
+        # cumulative failure record (every failed attempt, unlike the
+        # reject-once engine notification): a watcher that never manages
+        # to reload must be visible in stats(), not silent
+        self._reload_failures = 0
+        self._last_reload_error = ""
+
+    def _record_failure(self, reason: str) -> None:
+        self._reload_failures += 1
+        self._last_reload_error = reason
 
     def _reject_once(self, key: tuple, reason: str) -> None:
+        self._record_failure(reason)
         if key in self._rejected:
             return
         self._rejected.add(key)
@@ -77,16 +103,29 @@ class SnapshotWatcher:
                 self.poll_once()
             except Exception as e:   # noqa: BLE001 — the watcher must
                 # never die; a failed poll is a reject, not an outage
+                self._record_failure(f"watcher poll error: {e}")
                 self._engine.record_reload_reject(
                     f"watcher poll error: {e}")
             self._stop.wait(self.poll_s)
 
     # --- one poll ------------------------------------------------------
     def _read_entries(self) -> list:
+        path = os.path.join(self.directory, self.MANIFEST)
+        if not os.path.isfile(path):
+            return []   # normal pre-publish state, not a failure
+
+        def _load():
+            with open(path) as f:
+                return json.load(f)
+
         try:
-            with open(os.path.join(self.directory, self.MANIFEST)) as f:
-                m = json.load(f)
-        except (FileNotFoundError, json.JSONDecodeError, OSError):
+            # transient IO (NFS hiccup, a read racing the trainer's
+            # atomic manifest replace) gets the shared retry/backoff
+            m = read_with_retries(_load, site="snapshot_manifest")
+        except FileNotFoundError:
+            return []   # swept between the isfile check and the open
+        except (json.JSONDecodeError, OSError) as e:
+            self._record_failure(f"manifest unreadable: {e}")
             return []
         entries = m.get("entries") if isinstance(m, dict) else None
         return entries if isinstance(entries, list) else []
@@ -135,16 +174,28 @@ class SnapshotWatcher:
         faults.maybe_corrupt_reload(path)
         try:
             # slow part (read + validate + device_put) outside the
-            # engine's dispatch lock: serving continues on old weights
-            state = load_params_for_swap(self._engine.model, path)
+            # engine's dispatch lock: serving continues on old weights.
+            # Transient IOErrors retry with the shared backoff before
+            # counting as a failure; anything else (torn zip, shape
+            # mismatch) rejects immediately
+            state = read_with_retries(
+                lambda: load_params_for_swap(self._engine.model, path,
+                                             elastic=self.elastic),
+                site="snapshot_reload")
         except Exception as e:   # noqa: BLE001
             self._reject_once(
                 (entry["file"], "load"),
                 f"snapshot {entry['file']} failed to load: {e}")
             return False
+        # bad-deploy injection: the snapshot loaded CLEAN but the
+        # weights are garbage — exactly what the canary controller's
+        # score-divergence rollback exists to catch
+        state = faults.maybe_poison_reload(state)
         self._engine.install_snapshot(state, step, source=entry["file"])
         return True
 
     def stats(self) -> Dict[str, Any]:
         return {"directory": self.directory, "polls": self._polls,
-                "poll_s": self.poll_s}
+                "poll_s": self.poll_s,
+                "reload_failures": self._reload_failures,
+                "last_reload_error": self._last_reload_error}

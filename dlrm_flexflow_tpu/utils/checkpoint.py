@@ -274,7 +274,7 @@ def restore_checkpoint(model, path: str, elastic: Optional[bool] = None,
                              int(data["meta/step"]), source=path)
 
 
-def load_params_for_swap(model, path: str):
+def load_params_for_swap(model, path: str, elastic: bool = False):
     """Read a snapshot's inference state WITHOUT touching the model:
     validated + device_put against the model's compiled shardings, but
     returned instead of assigned. The serving hot-reload does the slow
@@ -283,9 +283,15 @@ def load_params_for_swap(model, path: str):
     dispatches via ``FFModel.swap_params``. Optimizer state is never
     read (serving has none). Raises with a reason on mesh or per-op
     shape mismatch; the watcher logs it and keeps serving old weights.
+
+    ``elastic=True`` permits a cross-mesh load — the snapshot's global
+    arrays are resharded onto THIS model's compiled shardings. That is
+    the serving-fleet topology (per-device replicas consuming a
+    multi-device trainer's snapshots), so fleet replicas opt in via
+    ``ServeConfig.reshard``; the default stays reject-with-reason.
     """
     data = np.load(path if path.endswith(".npz") else path + ".npz")
-    _check_mesh_meta(model, data, path, elastic=False)
+    _check_mesh_meta(model, data, path, elastic=elastic)
     params_flat, _, state_flat, host_flat, _ = _split_sections(data)
     params = _validated_params(model, params_flat, source=path)
     return {

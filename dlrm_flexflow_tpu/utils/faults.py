@@ -44,6 +44,23 @@ reproducible points so every recovery branch runs under test:
   after the manifest listed it as valid — so the reload must reject the
   torn file (CRC/load failure) and KEEP SERVING the old weights with
   zero failed requests.
+- **Replica crash** (`replica_down`): report a serving-fleet replica as
+  dead — every dispatch (and probe) against that replica's engine raises
+  a typed ``ReplicaDown`` — so the router's circuit breaker must eject
+  it, drain its queued futures onto the survivors, and (with a finite
+  budget, ``rid:N``) re-admit it once a probe finally succeeds. Unlike
+  the other hooks this one is NOT consume-once by default: a crashed
+  process stays crashed until the budget (if any) runs out.
+- **Slow replica** (per-replica `serve_delay_replica`): stretch ONE
+  replica's dispatches while its siblings stay fast, so queue-depth load
+  balancing, tail-latency hedging, and heartbeat ejection can be driven
+  deterministically.
+- **Poisoned snapshot** (`poison_reloads`): scale the params of the next
+  snapshot the serving hot-reload path loads — the file is VALID (CRC
+  clean, fingerprint matches) but the weights are garbage, the
+  bad-deploy case no checksum catches. The canary controller must see
+  the score divergence and auto-roll the canary cohort back with zero
+  client-visible errors.
 
 Faults are consume-once: each injection decrements its budget, so a
 recovery path that retries the same step does not re-fault (rollback would
@@ -65,9 +82,17 @@ subprocess kill-test needs):
   (``=4`` alone loses 1 device at step 4)
 - ``FF_FAULT_STALL_COLLECTIVE=3``  stall the next collective probe 3s
 - ``FF_FAULT_SERVE_DELAY=0.05``    sleep 50 ms inside EVERY serving batch
-  dispatch (not consume-once)
+  dispatch (not consume-once); ``1:0.2`` delays only replica 1, and the
+  forms combine: ``0.05,1:0.2`` is 50 ms everywhere but 200 ms on
+  replica 1
 - ``FF_FAULT_CORRUPT_RELOAD=1``    truncate the next 1 snapshot file as
   the serving hot-reload opens it
+- ``FF_FAULT_REPLICA_DOWN=1``      serving replica 1 is dead (every
+  dispatch/probe raises); ``1:8`` fails its next 8 attempts then
+  recovers, so the probe/re-admit path runs
+- ``FF_FAULT_POISON_RELOAD=1``     scale the params of the next 1
+  snapshot the hot-reload loads (valid file, garbage weights — the
+  canary auto-rollback trigger)
 
 Unknown ``FF_FAULT_*`` keys are a WARNING, not a silent no-op: a typo'd
 key used to disable injection entirely, which made a passing resilience
@@ -116,6 +141,20 @@ class FaultPlan:
     # consume-once — a reload-atomicity test needs a steady stream of
     # slow in-flight batches)
     serve_delay_s: float = 0.0
+    # replica id -> seconds: per-replica dispatch delay overriding the
+    # global one (drives load balancing / hedging / heartbeat tests with
+    # ONE slow replica in an otherwise fast fleet)
+    serve_delay_replica: Dict[int, float] = field(default_factory=dict)
+    # replica id -> remaining failed attempts: every dispatch/probe
+    # against that replica reports it dead (engine raises ReplicaDown).
+    # -1 = dead forever (a crashed process); N > 0 = the next N attempts
+    # fail, then the replica recovers (the probe/re-admit path)
+    replica_down: Dict[int, int] = field(default_factory=dict)
+    # number of future hot-reload snapshot loads whose params are scaled
+    # by poison_reload_scale: the file is valid, the weights are garbage
+    # — the bad deploy a canary must catch by score divergence
+    poison_reloads: int = 0
+    poison_reload_scale: float = 1e3
     # number of future hot-reload snapshot opens to corrupt (truncate the
     # file the watcher is about to load; the reload must reject it and
     # keep serving the old weights)
@@ -141,7 +180,8 @@ _KNOWN_ENV_KEYS = ("FF_FAULT_NAN_STEPS", "FF_FAULT_TRUNCATE_CKPTS",
                    "FF_FAULT_ABORT_WRITES", "FF_FAULT_WRITE_DELAY",
                    "FF_FAULT_IO_ERRORS", "FF_FAULT_DROP_DEVICE",
                    "FF_FAULT_STALL_COLLECTIVE", "FF_FAULT_SERVE_DELAY",
-                   "FF_FAULT_CORRUPT_RELOAD")
+                   "FF_FAULT_CORRUPT_RELOAD", "FF_FAULT_REPLICA_DOWN",
+                   "FF_FAULT_POISON_RELOAD")
 
 
 def plan_from_env() -> Optional[FaultPlan]:
@@ -168,8 +208,11 @@ def plan_from_env() -> Optional[FaultPlan]:
     stall_coll = os.environ.get("FF_FAULT_STALL_COLLECTIVE", "")
     serve_delay = os.environ.get("FF_FAULT_SERVE_DELAY", "")
     corrupt_reload = os.environ.get("FF_FAULT_CORRUPT_RELOAD", "")
+    replica_down = os.environ.get("FF_FAULT_REPLICA_DOWN", "")
+    poison_reload = os.environ.get("FF_FAULT_POISON_RELOAD", "")
     if not any((nan, trunc, aborts, delay, ioerrs, drop, stall_coll,
-                serve_delay, corrupt_reload)):
+                serve_delay, corrupt_reload, replica_down,
+                poison_reload)):
         return None
     plan = FaultPlan()
     if nan:
@@ -195,10 +238,28 @@ def plan_from_env() -> Optional[FaultPlan]:
             plan.drop_device_steps[int(part)] = 1
     if stall_coll:
         plan.stall_s["collective"] = float(stall_coll)
-    if serve_delay:
-        plan.serve_delay_s = float(serve_delay)
+    for part in serve_delay.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:                       # "rid:secs" — one replica
+            rid, secs = part.split(":", 1)
+            plan.serve_delay_replica[int(rid)] = float(secs)
+        else:                                 # bare seconds — everyone
+            plan.serve_delay_s = float(part)
+    for part in replica_down.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:                       # "rid:N" — N failures
+            rid, n = part.split(":", 1)
+            plan.replica_down[int(rid)] = int(n)
+        else:                                 # bare rid — dead forever
+            plan.replica_down[int(part)] = -1
     if corrupt_reload:
         plan.corrupt_reloads = int(corrupt_reload)
+    if poison_reload:
+        plan.poison_reloads = int(poison_reload)
     return plan
 
 
@@ -330,13 +391,78 @@ def maybe_io_error(site: str) -> None:
                           f"({left - 1} left)")
 
 
-def maybe_serve_delay() -> None:
+def maybe_serve_delay(replica_id: Optional[int] = None) -> None:
     """Sleep inside a serving batch dispatch (EVERY dispatch while the
     plan is active — not consume-once — so reload-atomicity tests hold a
-    stream of slow in-flight batches)."""
+    stream of slow in-flight batches). A per-replica entry overrides the
+    global delay for that replica, so a fleet test can slow exactly one
+    replica and watch the router route around it."""
     plan = active()
-    if plan is not None and plan.serve_delay_s > 0:
-        time.sleep(plan.serve_delay_s)
+    if plan is None:
+        return
+    secs = plan.serve_delay_s
+    if replica_id is not None:
+        secs = plan.serve_delay_replica.get(replica_id, secs)
+    if secs > 0:
+        time.sleep(secs)
+
+
+def take_replica_down(replica_id: Optional[int]) -> bool:
+    """True while a serving replica is scheduled dead: the engine raises
+    a typed ``ReplicaDown`` from its dispatch (and from probes), which
+    the router's circuit breaker must absorb. A ``-1`` budget is a crash
+    (dead until the plan is cleared); a positive budget fails that many
+    attempts then recovers — the re-admit path."""
+    plan = active()
+    if plan is None or replica_id is None:
+        return False
+    with plan._lock:
+        left = plan.replica_down.get(replica_id)
+        if left is None or left == 0:
+            return False
+        if left > 0:
+            plan.replica_down[replica_id] = left - 1
+        # record the transition once, not every refused dispatch — a
+        # hammered dead replica would otherwise flood `fired`
+        if ("replica_down", replica_id) not in plan.fired:
+            plan._record("replica_down", replica_id)
+    return True
+
+
+def maybe_poison_reload(state: dict) -> dict:
+    """Scale the float params of a freshly-loaded snapshot state (the
+    output of ``load_params_for_swap``) while the poison budget lasts —
+    a snapshot that passes every integrity check but computes garbage,
+    i.e. a bad deploy. Returns the (possibly poisoned) state. Shardings
+    are preserved so the swapped params still feed the cached AOT
+    executables."""
+    plan = active()
+    if plan is None:
+        return state
+    with plan._lock:
+        if plan.poison_reloads <= 0:
+            return state
+        plan.poison_reloads -= 1
+        scale = plan.poison_reload_scale
+        plan._record("poison_reload", scale)
+    import jax
+    import numpy as np
+
+    def _scale(v):
+        a = np.asarray(v)
+        if not np.issubdtype(a.dtype, np.floating):
+            return v
+        sharding = getattr(v, "sharding", None)
+        poisoned = (a * np.dtype(a.dtype).type(scale)).astype(a.dtype)
+        return (jax.device_put(poisoned, sharding)
+                if sharding is not None else poisoned)
+
+    out = dict(state)
+    if out.get("params") is not None:
+        out["params"] = jax.tree.map(_scale, out["params"])
+    if out.get("host_params") is not None:
+        out["host_params"] = jax.tree.map(_scale, out["host_params"])
+    return out
 
 
 def maybe_corrupt_reload(path: str) -> bool:

@@ -63,6 +63,40 @@ class WorkerStalled(RuntimeError):
         self.report = report
 
 
+class Heartbeat:
+    """Last-sign-of-life timestamp for a long-lived worker.
+
+    The worker calls :meth:`beat` each time around its loop; a monitor
+    on another thread reads :meth:`age` and, past a deadline, builds the
+    same structured :class:`StallReport` the join watchdogs raise. Used
+    by the serving fleet: each engine's batcher beats per iteration, and
+    the router's health thread ejects a replica whose heartbeat goes
+    stale (a wedged dispatch — device hang, runaway host gather) even
+    when no request has errored yet. A bare float store/load is atomic
+    under the GIL, so neither side takes a lock.
+    """
+
+    __slots__ = ("name", "_t")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t = time.monotonic()
+
+    def beat(self) -> None:
+        self._t = time.monotonic()
+
+    def age(self) -> float:
+        """Seconds since the last beat."""
+        return time.monotonic() - self._t
+
+    def report(self, deadline_s: float, waiting_for: str,
+               detail: str = "", alive: bool = True) -> StallReport:
+        """StallReport for a monitor that found this heartbeat stale."""
+        return StallReport(worker=self.name, waiting_for=waiting_for,
+                          waited_s=self.age(), deadline_s=deadline_s,
+                          detail=detail, alive=alive)
+
+
 @dataclass
 class Deadline:
     """A wall-clock budget shared by the serving path's per-request

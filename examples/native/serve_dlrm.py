@@ -8,6 +8,17 @@ with the dynamic-batching engine (power-of-two bucket padding, AOT
 warmup, bounded queue backpressure, per-request deadlines) while a
 snapshot watcher hot-reloads newer checkpoints with zero downtime.
 
+``--serve-replicas N`` turns the single engine into a FLEET: N replicas
+(each compiled on its own slice of the local devices, data-parallel
+params) behind a ``FleetRouter`` — queue-depth load balancing, a
+circuit breaker that ejects and re-admits crashed replicas, bounded
+retry with backoff, optional tail-latency hedging
+(``--serve-hedge-ms``), and canary/shadow rollout knobs
+(``--serve-canary-fraction``). Each replica follows the trainer's
+snapshots independently (cross-mesh reshard is automatic in fleet
+mode: per-device replicas consume the multi-device trainer's
+checkpoints).
+
 No framework webserver: a stdlib ``http.server`` ThreadingHTTPServer is
 all the engine needs — every handler thread just submits into the
 engine's queue and blocks on its future, the batcher coalesces across
@@ -16,9 +27,9 @@ handler threads.
   # terminal 1: train, publishing snapshots
   python examples/native/dlrm.py --checkpoint-dir /tmp/dlrm-ckpt --save-every 50
 
-  # terminal 2: serve them, hot-reloading as they land
+  # terminal 2: serve them, hot-reloading as they land (2 replicas)
   python examples/native/serve_dlrm.py --checkpoint-dir /tmp/dlrm-ckpt \\
-      --serve-max-batch 64 --serve-max-delay-ms 3 --port 8000
+      --serve-replicas 2 --serve-max-batch 64 --port 8000
 
   curl -s localhost:8000/healthz
   curl -s localhost:8000/stats
@@ -28,10 +39,16 @@ handler threads.
 Endpoints:
   POST /predict  {"dense": [...], "sparse": [...]}  ->
                  {"scores": [...], "version": N, "latency_ms": ...}
-                 429 on Overloaded, 504 on DeadlineExceeded
-  GET  /stats    engine stats() (p50/p99, batch fill, cache hit rate,
-                 reloads, executable-cache occupancy)
-  GET  /healthz  {"ok": true, "version": N}
+                 429 on Overloaded, 504 on DeadlineExceeded,
+                 503 when no replica can take the request
+  GET  /stats    engine stats() — or fleet-wide router stats() with
+                 per-replica circuit-breaker state in fleet mode
+  GET  /healthz  200 {"ok": true, ...} while the engine (fleet: at
+                 least one healthy replica) is accepting requests;
+                 503 {"ok": false, ...} when the queue is saturated,
+                 the server is draining, or the batcher died — load
+                 balancers must stop sending traffic HERE, not learn
+                 it from request errors
 """
 
 import json
@@ -44,25 +61,29 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import dlrm_flexflow_tpu as ff
 from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
-from dlrm_flexflow_tpu.serve import DeadlineExceeded, Overloaded
+from dlrm_flexflow_tpu.serve import (DeadlineExceeded, FleetUnavailable,
+                                     Overloaded)
 from dlrm_flexflow_tpu.utils.logging import get_logger
 
 log_app = get_logger("serve_dlrm")
 
 
-def build_server_model(cfg, dcfg):
+def build_server_model(cfg, dcfg, mesh=None):
     """Same graph as the trainer (fingerprints must match for hot
     reload); compiled at the largest serve bucket so every bucket pads
-    under the compile batch."""
+    under the compile batch. ``mesh`` pins a fleet replica to its own
+    device slice."""
     model = ff.FFModel(cfg)
     build_dlrm(model, dcfg)
     model.compile(ff.SGDOptimizer(lr=cfg.learning_rate),
-                  "mean_squared_error", ["mse"])
+                  "mean_squared_error", ["mse"], mesh=mesh)
     model.init_layers()
     return model
 
 
-def make_handler(engine, input_names):
+def make_handler(serve, input_names):
+    """``serve`` is an InferenceEngine or a FleetRouter — both expose
+    predict()/stats()/healthz() with the same contract."""
     from http.server import BaseHTTPRequestHandler
 
     class Handler(BaseHTTPRequestHandler):
@@ -79,9 +100,13 @@ def make_handler(engine, input_names):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._reply(200, {"ok": True, "version": engine.version})
+                hz = serve.healthz()
+                # 503 tells the balancer to stop routing here while the
+                # queue is saturated or the server is draining; a 200
+                # with ok:false would keep the traffic coming
+                self._reply(200 if hz["ok"] else 503, hz)
             elif self.path == "/stats":
-                self._reply(200, engine.stats())
+                self._reply(200, serve.stats())
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
@@ -104,19 +129,62 @@ def make_handler(engine, input_names):
                 self._reply(400, {"error": str(e)})
                 return
             try:
-                pred = engine.predict(feats)
+                pred = serve.predict(feats)
                 self._reply(200, {
                     "scores": np.asarray(pred.scores).reshape(-1).tolist(),
                     "version": pred.version,
                     "latency_ms": round(pred.latency_ms, 3)})
             except Overloaded as e:
                 self._reply(429, {"error": str(e)})
+            except FleetUnavailable as e:
+                self._reply(503, {"error": str(e)})
             except (DeadlineExceeded, TimeoutError) as e:
                 self._reply(504, {"error": str(e)})
             except ValueError as e:
                 self._reply(400, {"error": str(e)})
+            except Exception as e:   # noqa: BLE001 — e.g. a shape that
+                # passed coercion but failed inside the dispatch; an
+                # uncaught handler exception would DROP the connection
+                # (no status at all) instead of answering 500
+                log_app.exception("predict failed")
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
     return Handler
+
+
+def _replica_mesh(i, n):
+    """Replica i's device slice: the local devices split n ways (each
+    replica MUST own its own mesh — replicas sharing devices would
+    serialize, and on CPU can deadlock concurrent collectives)."""
+    import jax
+
+    from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+    devs = jax.devices()
+    per = max(1, len(devs) // n)
+    lo = (i * per) % len(devs)
+    return make_mesh(devices=devs[lo:lo + per])
+
+
+def _build_fleet(cfg, dcfg, n, ckpt_dir):
+    """N replicas on disjoint device slices behind a FleetRouter."""
+    scfg = ff.ServeConfig.from_config(cfg)
+    fleet = ff.Fleet.build(
+        lambda i: build_server_model(cfg, dcfg, mesh=_replica_mesh(i, n)),
+        n, scfg, checkpoint_dir=ckpt_dir)
+    if ckpt_dir:
+        for rep in fleet:
+            # initial restore through the watcher's READ-ONLY manifest
+            # scan, resharding the trainer's mesh onto the replica's
+            if ff.SnapshotWatcher(rep.engine, ckpt_dir,
+                                  elastic=True).poll_once():
+                log_app.info("replica %d serving snapshot version %d",
+                             rep.rid, rep.engine.version)
+            else:
+                log_app.warning(
+                    "replica %d: no restorable snapshot in %s — serving "
+                    "fresh init until the trainer publishes one",
+                    rep.rid, ckpt_dir)
+    return ff.FleetRouter(fleet, ff.RouterConfig.from_config(cfg))
 
 
 def main(argv=None):
@@ -134,29 +202,36 @@ def main(argv=None):
     if "--port" in rest:
         port = int(rest[rest.index("--port") + 1])
 
-    model = build_server_model(cfg, dcfg)
     ckpt_dir = cfg.checkpoint_dir or None
-    engine = ff.InferenceEngine(model, checkpoint_dir=ckpt_dir)
-    if ckpt_dir:
-        # initial load through the watcher's READ-ONLY manifest scan (a
-        # CheckpointManager here would sweep tmp files under a live
-        # trainer) — params_only restore of the newest valid snapshot
-        if ff.SnapshotWatcher(engine, ckpt_dir).poll_once():
-            log_app.info("serving snapshot version %d", engine.version)
-        else:
-            log_app.warning("no restorable snapshot in %s — serving "
-                            "fresh init until the trainer publishes one",
-                            ckpt_dir)
+    n = int(getattr(cfg, "serve_replicas", 1))
+    if n > 1:
+        serve = _build_fleet(cfg, dcfg, n, ckpt_dir)
+        model = serve.fleet.replicas[0].engine.model
+    else:
+        model = build_server_model(cfg, dcfg)
+        serve = ff.InferenceEngine(model, checkpoint_dir=ckpt_dir)
+        if ckpt_dir:
+            # initial load through the watcher's READ-ONLY manifest
+            # scan (a CheckpointManager here would sweep tmp files
+            # under a live trainer) — params_only restore of the newest
+            # valid snapshot
+            if ff.SnapshotWatcher(serve, ckpt_dir).poll_once():
+                log_app.info("serving snapshot version %d", serve.version)
+            else:
+                log_app.warning(
+                    "no restorable snapshot in %s — serving fresh init "
+                    "until the trainer publishes one", ckpt_dir)
     input_names = [t.name for t in model.input_tensors]
 
     from http.server import ThreadingHTTPServer
-    with engine:
+    with serve:
         httpd = ThreadingHTTPServer(
-            ("0.0.0.0", port), make_handler(engine, input_names))
-        log_app.info("serving DLRM on :%d (buckets %s, max delay %.1f ms"
-                     "%s)", port, engine.stats()["buckets"],
-                     engine.config.max_delay_ms,
-                     f", hot-reload from {ckpt_dir}" if ckpt_dir else "")
+            ("0.0.0.0", port), make_handler(serve, input_names))
+        log_app.info(
+            "serving DLRM on :%d (%s%s)", port,
+            f"{n} replicas" if n > 1 else
+            f"buckets {serve.stats()['buckets']}",
+            f", hot-reload from {ckpt_dir}" if ckpt_dir else "")
         try:
             httpd.serve_forever()
         except KeyboardInterrupt:

@@ -1,0 +1,739 @@
+"""Serving fleet (ISSUE 6): fault-tolerant multi-replica router with
+canary/shadow rollout and graceful degradation.
+
+Pinned contracts (the ISSUE-6 acceptance criteria):
+
+- under ``FF_FAULT_REPLICA_DOWN`` killing one of N>=2 replicas mid-load
+  the fleet returns ZERO failed (non-retried-to-success) requests: the
+  circuit breaker ejects the dead replica, drains its queue onto the
+  survivors, and a probe re-admits it once the fault clears;
+- canary auto-rollback fires on an injected poisoned (score-divergent)
+  snapshot and on a p99 regression, with zero client-visible errors,
+  and reinstalls the captured pre-deploy weights bit-exactly;
+- shadow traffic never affects client responses; its score diffs land
+  in ``shadow_report()``;
+- continuous (iteration-level) admission dispatches a lone request
+  immediately instead of waiting out the flush deadline;
+- fleet ``stats()`` merges the replicas' latency windows (percentiles
+  are cut over the merged samples, never averaged);
+- ``percentile`` is None on an empty window and interpolates on tiny
+  ones (no more flawless-p99-at-zero-traffic).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                           synthetic_batch)
+from dlrm_flexflow_tpu.serve import (Fleet, FleetRouter, FleetUnavailable,
+                                     InferenceEngine, Overloaded, Replica,
+                                     ReplicaDown, RouterConfig, ServeConfig,
+                                     percentile)
+from dlrm_flexflow_tpu.utils import faults
+from dlrm_flexflow_tpu.utils.checkpoint import CheckpointManager
+
+DCFG = DLRMConfig(embedding_size=[64] * 4, sparse_feature_size=8,
+                  mlp_bot=[4, 16, 8], mlp_top=[40, 16, 1])
+BS = 16
+
+
+def _build(seed=2, dev=None, **cfg_kw):
+    """One replica's model. ``dev`` pins it to a single device — the
+    fleet topology is N independent single-replica meshes (replicas
+    sharing the 8-device CPU mesh would interleave their dispatches'
+    collective participants and deadlock XLA)."""
+    from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+    model = ff.FFModel(ff.FFConfig(batch_size=BS, seed=seed, **cfg_kw))
+    build_dlrm(model, DCFG)
+    mesh = None
+    if dev is not None:
+        devs = jax.devices()
+        mesh = make_mesh(devices=devs[dev % len(devs):dev % len(devs) + 1])
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+                  mesh=mesh)
+    model.init_layers()
+    return model
+
+
+def _rows(n, seed=0):
+    x, _ = synthetic_batch(DCFG, n, seed=seed)
+    return x
+
+
+def _slice(x, a, b):
+    return {k: v[a:b] for k, v in x.items()}
+
+
+def _fleet(n=2, scfg=None, **router_kw):
+    """A small fleet + router tuned for fast CPU tests: tight health
+    interval, short cooldown, generous probe budget. One device per
+    replica (see _build)."""
+    scfg = scfg or ServeConfig(max_batch=8, queue_capacity=512)
+    fleet = Fleet.build(lambda i: _build(dev=i), n, scfg)
+    defaults = dict(retries=3, backoff_ms=2.0, eject_after=3,
+                    cooldown_s=0.15, probe_deadline_s=10.0,
+                    health_interval_s=0.05)
+    defaults.update(router_kw)
+    return FleetRouter(fleet, RouterConfig(**defaults))
+
+
+def _snapshot(tmp_path, steps=1):
+    """Train `steps` steps and publish one snapshot; returns its path.
+    The trainer uses a 1-device mesh to match the fleet replicas (a
+    snapshot carries its mesh metadata; mismatches reject)."""
+    import os
+    x, y = synthetic_batch(DCFG, BS, seed=0)
+    trainer = _build(dev=0)
+    xb = dict(x)
+    xb["label"] = y
+    for _ in range(steps):
+        trainer.train_batch(xb)
+    mgr = CheckpointManager(str(tmp_path), keep_last=3)
+    mgr.save(trainer, {})
+    return os.path.join(str(tmp_path), f"ckpt-{steps:08d}.npz")
+
+
+def _hammer(router, x, direct, stop, failures, n_ok, threads=4):
+    """Background client load asserting bit-identity per response."""
+    def worker(tid):
+        i = 0
+        while not stop.is_set():
+            row = (tid + i) % BS
+            try:
+                p = router.predict(_slice(x, row, row + 1), timeout=30)
+                if not np.array_equal(p.scores, direct[row:row + 1]):
+                    failures.append(("scores", p.version, row))
+                else:
+                    n_ok[0] += 1
+            except Exception as e:   # noqa: BLE001 — the chaos bar is
+                failures.append(repr(e))   # ZERO client-visible failures
+            i += 1
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(threads)]
+    for t in ts:
+        t.start()
+    return ts
+
+
+# ---------------------------------------------------------------------
+# percentile semantics (satellite: degenerate tiny-window fix)
+# ---------------------------------------------------------------------
+class TestPercentile:
+    def test_empty_window_is_none_not_zero(self):
+        assert percentile([], 50) is None
+        assert percentile([], 99) is None
+
+    def test_single_sample(self):
+        assert percentile([7.0], 50) == 7.0
+        assert percentile([7.0], 99) == 7.0
+
+    def test_two_samples_interpolate(self):
+        # numpy's linear method: p50 of [1, 3] is 2, not 1
+        assert percentile([1.0, 3.0], 50) == pytest.approx(2.0)
+        assert percentile([1.0, 3.0], 99) == pytest.approx(2.98)
+        assert percentile([1.0, 3.0], 0) == 1.0
+        assert percentile([1.0, 3.0], 100) == 3.0
+
+    def test_matches_numpy(self):
+        vals = sorted([3.0, 1.0, 4.0, 1.5, 9.0, 2.6, 5.3])
+        for p in (0, 10, 50, 90, 99, 100):
+            assert percentile(vals, p) == pytest.approx(
+                float(np.percentile(vals, p)))
+
+    def test_engine_stats_none_before_traffic(self):
+        m = _build()
+        with InferenceEngine(m, ServeConfig(max_batch=8)) as eng:
+            st = eng.stats()
+        assert st["p50_ms"] is None
+        assert st["p99_ms"] is None
+
+
+# ---------------------------------------------------------------------
+# continuous (iteration-level) batching
+# ---------------------------------------------------------------------
+class TestContinuousBatching:
+    def test_lone_request_skips_flush_delay(self):
+        """Continuous admission: a single request on an idle engine goes
+        out immediately even under a huge max_delay — in flush mode the
+        same request waits the delay out (test_serve pins that side)."""
+        m = _build()
+        x = _rows(2)
+        with InferenceEngine(m, ServeConfig(
+                max_batch=64, max_delay_ms=2000.0)) as eng:
+            t0 = time.monotonic()
+            eng.predict(_slice(x, 0, 1), timeout=30)
+            waited = time.monotonic() - t0
+        assert waited < 1.0          # nowhere near the 2 s flush delay
+        assert eng.stats()["flushes"]["continuous"] >= 1
+        assert eng.stats()["flushes"]["deadline"] == 0
+        assert eng.stats()["continuous"] is True
+
+    def test_queue_coalesces_into_next_dispatch(self):
+        """Requests that arrive while a dispatch runs form the next
+        batch together instead of going out one by one."""
+        m = _build()
+        x = _rows(8)
+        with faults.active_plan(faults.FaultPlan(serve_delay_s=0.1)):
+            with InferenceEngine(m, ServeConfig(
+                    max_batch=8, max_delay_ms=2000.0,
+                    queue_capacity=64)) as eng:
+                futs = [eng.submit(_slice(x, 0, 1))]   # occupies batcher
+                time.sleep(0.03)                       # dispatch in flight
+                futs += [eng.submit(_slice(x, i, i + 1))
+                         for i in range(1, 7)]         # queue behind it
+                for f in futs:
+                    f.result(30)
+        st = eng.stats()
+        # 7 requests, 2 dispatches: 1 + the 6 that coalesced behind it
+        assert st["batches"] == 2
+        assert st["flushes"]["continuous"] == 2
+
+
+# ---------------------------------------------------------------------
+# fleet container
+# ---------------------------------------------------------------------
+class TestFleet:
+    def test_rejects_empty_and_duplicate_rids(self):
+        with pytest.raises(ValueError, match="at least one"):
+            Fleet([])
+        m = _build()
+        e1 = InferenceEngine(m, ServeConfig(max_batch=8), replica_id=1)
+        e2 = InferenceEngine(m, ServeConfig(max_batch=8), replica_id=1)
+        with pytest.raises(ValueError, match="duplicate"):
+            Fleet([e1, e2])
+
+    def test_stats_merge_latency_windows(self):
+        """Fleet p50/p99 cut the MERGED per-replica windows — never an
+        average of per-replica percentiles."""
+        m = _build()
+        engines = [InferenceEngine(m, ServeConfig(max_batch=8),
+                                   replica_id=i) for i in range(2)]
+        fleet = Fleet(engines)
+        engines[0]._lat_ms.extend([1.0, 2.0, 3.0])
+        engines[1]._lat_ms.extend([100.0])
+        st = fleet.stats()
+        merged = [1.0, 2.0, 3.0, 100.0]
+        assert st["p50_ms"] == pytest.approx(percentile(merged, 50))
+        assert st["p99_ms"] == pytest.approx(percentile(merged, 99))
+        assert st["size"] == 2
+        assert set(st["replicas"]) == {0, 1}
+
+    def test_healthy_excludes_shadow_and_ejected(self):
+        m = _build()
+        engines = [InferenceEngine(m, ServeConfig(max_batch=8),
+                                   replica_id=i) for i in range(3)]
+        fleet = Fleet(engines)
+        fleet.get(1).cohort = "shadow"
+        fleet.get(2).state = "ejected"
+        assert [r.rid for r in fleet.healthy()] == [0]
+
+    def test_replica_breaker_transitions(self):
+        m = _build()
+        rep = Replica(InferenceEngine(m, ServeConfig(max_batch=8),
+                                      replica_id=0), 0)
+        err = RuntimeError("boom")
+        assert rep.record_error(err, eject_after=3) is False
+        assert rep.record_error(err, eject_after=3) is False
+        assert rep.record_error(err, eject_after=3) is True
+        rep.eject("test")
+        assert rep.state == "ejected"
+        assert rep.ejections == 1
+        assert not rep.due_for_probe(cooldown_s=60.0)
+        rep.ejected_at -= 61.0
+        assert rep.due_for_probe(cooldown_s=60.0)
+        rep.begin_probe()
+        assert rep.state == "probing"
+        rep.probe_failed("still dead")
+        assert rep.state == "ejected"
+        rep.begin_probe()
+        rep.readmit()
+        assert rep.state == "healthy"
+        assert rep.consecutive_errors == 0
+        assert rep.readmissions == 1
+        # success resets the consecutive counter
+        rep.record_error(err, eject_after=3)
+        rep.record_success()
+        assert rep.consecutive_errors == 0
+
+
+# ---------------------------------------------------------------------
+# router: balancing, retry, chaos
+# ---------------------------------------------------------------------
+class TestRouter:
+    def test_roundtrip_bit_identity_and_balancing(self):
+        x = _rows(BS)
+        with _fleet(2) as router:
+            direct = np.asarray(
+                router.fleet.replicas[0].engine.model.forward_batch(x))
+            for i in range(16):
+                p = router.predict(_slice(x, i % BS, i % BS + 1),
+                                   timeout=30)
+                np.testing.assert_array_equal(
+                    p.scores, direct[i % BS:i % BS + 1])
+            st = router.stats()
+        assert st["responses"] == 16
+        assert st["failed"] == 0
+        # both replicas saw traffic (round-robin on equal queue depth)
+        per = st["fleet"]["replicas"]
+        assert all(per[rid]["engine"]["responses"] > 0 for rid in per)
+
+    def test_slow_replica_repels_traffic(self):
+        """Queue-depth balancing: the replica whose dispatches are
+        stretched accumulates queue and organically loses traffic."""
+        x = _rows(BS)
+        with faults.active_plan(faults.FaultPlan(
+                serve_delay_replica={0: 0.08})):
+            with _fleet(2, retries=1) as router:
+                stop = threading.Event()
+                failures, n_ok = [], [0]
+                direct = np.asarray(
+                    router.fleet.replicas[0].engine.model.forward_batch(x))
+                ts = _hammer(router, x, direct, stop, failures, n_ok)
+                time.sleep(1.5)
+                stop.set()
+                for t in ts:
+                    t.join()
+                st = router.stats()
+        assert not failures, failures[:3]
+        per = st["fleet"]["replicas"]
+        # the fast replica answered the overwhelming majority
+        assert (per[1]["engine"]["responses"]
+                > 3 * per[0]["engine"]["responses"])
+
+    def test_chaos_replica_down_zero_failures_then_readmit(self):
+        """The acceptance bar: kill one of 2 replicas mid-load — zero
+        non-retried-to-success failures, ejection + drain, and once the
+        fault clears a probe re-admits it."""
+        x = _rows(BS)
+        with _fleet(2) as router:
+            fleet = router.fleet
+            direct = np.asarray(
+                fleet.replicas[0].engine.model.forward_batch(x))
+            stop = threading.Event()
+            failures, n_ok = [], [0]
+            with faults.active_plan(faults.FaultPlan(
+                    replica_down={1: -1})):
+                ts = _hammer(router, x, direct, stop, failures, n_ok)
+                deadline = time.time() + 15
+                while (time.time() < deadline
+                       and fleet.get(1).state != "ejected"):
+                    time.sleep(0.02)
+                assert fleet.get(1).state == "ejected"
+                time.sleep(0.3)   # keep serving through the outage
+            # fault cleared: cooldown -> probe -> re-admission
+            deadline = time.time() + 15
+            while (time.time() < deadline
+                   and fleet.get(1).state != "healthy"):
+                time.sleep(0.02)
+            stop.set()
+            for t in ts:
+                t.join()
+            st = router.stats()
+            assert fleet.get(1).state == "healthy"
+        assert not failures, f"client-visible failures: {failures[:5]}"
+        assert n_ok[0] > 50
+        assert st["failed"] == 0
+        assert st["retries"] >= 1             # the outage cost retries…
+        assert st["responses"] == st["requests"]   # …never answers
+        rep = fleet.get(1)
+        assert rep.ejections >= 1
+        assert rep.readmissions >= 1
+        assert rep.probes >= 1
+
+    def test_finite_down_budget_recovers_via_probe(self):
+        """``rid:N`` form: N failed attempts, then the replica recovers
+        and the next PROBE (not client traffic) re-admits it."""
+        x = _rows(4)
+        with _fleet(2) as router:
+            fleet = router.fleet
+            router.predict(_slice(x, 0, 1), timeout=30)  # probe template
+            with faults.active_plan(faults.FaultPlan(
+                    replica_down={0: 4})):
+                deadline = time.time() + 20
+                while (time.time() < deadline
+                       and fleet.get(0).readmissions == 0):
+                    try:
+                        router.predict(_slice(x, 0, 1), timeout=30)
+                    except FleetUnavailable:
+                        pass
+                    time.sleep(0.02)
+            assert fleet.get(0).readmissions >= 1
+            assert fleet.get(0).state == "healthy"
+
+    def test_all_replicas_down_fleet_unavailable(self):
+        x = _rows(2)
+        with _fleet(2, retries=1, backoff_ms=1.0) as router:
+            with faults.active_plan(faults.FaultPlan(
+                    replica_down={0: -1, 1: -1})):
+                with pytest.raises((FleetUnavailable, ReplicaDown)):
+                    for _ in range(8):   # enough to trip both breakers
+                        router.predict(_slice(x, 0, 1), timeout=30)
+                # once both are ejected, failure is immediate + typed
+                deadline = time.time() + 10
+                while (time.time() < deadline and any(
+                        r.state != "ejected"
+                        for r in router.fleet.replicas)):
+                    try:
+                        router.predict(_slice(x, 0, 1), timeout=30)
+                    except (FleetUnavailable, ReplicaDown):
+                        pass
+                    time.sleep(0.02)
+                with pytest.raises(FleetUnavailable, match="no healthy"):
+                    router.predict(_slice(x, 0, 1), timeout=30)
+
+    def test_malformed_request_fails_fast_no_retry(self):
+        x = _rows(2)
+        with _fleet(2) as router:
+            with pytest.raises(ValueError, match="unknown input"):
+                router.predict({**_slice(x, 0, 1),
+                                "bogus": np.zeros(1)}, timeout=30)
+            st = router.stats()
+        assert st["retries"] == 0          # ValueError never retries
+
+    def test_overloaded_never_trips_the_breaker(self):
+        """Backpressure is load, not breakage: Overloaded steers the
+        retry elsewhere but must not count toward ejection."""
+        with _fleet(2) as router:
+            rep = router.fleet.get(0)
+            for _ in range(10):
+                router._attempt_failed(
+                    _req := __import__(
+                        "dlrm_flexflow_tpu.serve.router",
+                        fromlist=["_RouterReq"])._RouterReq(_rows(1)),
+                    rep, Overloaded(4, 4))
+            assert rep.consecutive_errors == 0
+            assert rep.state == "healthy"
+
+    def test_hedging_covers_a_slow_dispatch(self):
+        """With one stretched replica and hedging on, no client waits
+        out the slow dispatch: the hedge lands on the fast sibling."""
+        x = _rows(4)
+        with faults.active_plan(faults.FaultPlan(
+                serve_delay_replica={0: 0.4})):
+            with _fleet(2, hedge_ms=40.0) as router:
+                t0 = time.monotonic()
+                for i in range(6):
+                    router.predict(_slice(x, 0, 1), timeout=30)
+                elapsed = time.monotonic() - t0
+                st = router.stats()
+        # 6 sequential requests, roughly half landing on the slow
+        # replica: un-hedged that is >= 3 * 0.4 s of waiting
+        assert elapsed < 1.2
+        assert st["hedges"] >= 1
+        assert st["hedge_wins"] >= 1
+        assert st["failed"] == 0
+
+    def test_heartbeat_ejects_wedged_replica(self):
+        """A dispatch wedged far past the heartbeat deadline ejects the
+        replica even though no request ever errored."""
+        x = _rows(2)
+        with faults.active_plan(faults.FaultPlan(
+                serve_delay_replica={0: 3.0})):
+            with _fleet(2, heartbeat_deadline_s=0.4) as router:
+                fleet = router.fleet
+                # land one request on replica 0 to wedge its batcher
+                fleet.get(0).engine.submit(_slice(x, 0, 1))
+                deadline = time.time() + 10
+                while (time.time() < deadline
+                       and fleet.get(0).state != "ejected"):
+                    time.sleep(0.05)
+                assert fleet.get(0).state == "ejected"
+                assert "stale heartbeat" in fleet.get(0).last_error
+                # the fleet keeps answering via replica 1
+                p = router.predict(_slice(x, 0, 1), timeout=30)
+                assert p.scores is not None
+
+    def test_router_healthz_degrades_and_recovers(self):
+        with _fleet(2) as router:
+            hz = router.healthz()
+            assert hz["ok"] is True
+            assert hz["healthy"] == 2
+            router.fleet.get(0).eject("test")
+            hz = router.healthz()
+            assert hz["ok"] is True            # one survivor suffices
+            assert hz["healthy"] == 1
+            router.fleet.get(1).eject("test")
+            assert router.healthz()["ok"] is False
+        assert router.healthz()["ok"] is False  # closed == draining
+        assert router.healthz()["draining"] is True
+
+
+# ---------------------------------------------------------------------
+# canary / shadow deployments
+# ---------------------------------------------------------------------
+class TestCanaryShadow:
+    def test_canary_fraction_credit_pacing(self, tmp_path):
+        """Deterministic pacing: exactly fraction * N of fresh requests
+        choose the canary cohort — no sampling noise."""
+        snap = _snapshot(tmp_path)
+        x = _rows(BS)
+        with _fleet(2, canary_fraction=0.25) as router:
+            router.start_canary(snap)
+            cohorts = [router._choose_cohort() for _ in range(40)]
+        assert cohorts.count("canary") == 10
+
+    def test_poisoned_canary_rolls_back_zero_errors(self, tmp_path):
+        """The acceptance bar: a snapshot that loads clean but computes
+        garbage is auto-rolled-back by score divergence, with zero
+        client-visible errors, and the pre-deploy weights come back
+        bit-exactly."""
+        snap = _snapshot(tmp_path)
+        x = _rows(BS)
+        with _fleet(2, canary_fraction=0.5, canary_min_samples=16,
+                    canary_score_tol=0.1,
+                    canary_p99_ratio=1e9) as router:   # isolate trigger
+            fleet = router.fleet
+            direct = np.asarray(
+                fleet.replicas[0].engine.model.forward_batch(x))
+            with faults.active_plan(faults.FaultPlan(poison_reloads=1)):
+                ids = router.start_canary(snap)
+            assert fleet.get(ids[0]).cohort == "canary"
+            stop = threading.Event()
+            failures, n_ok = [], [0]
+
+            def worker(tid):
+                i = 0
+                while not stop.is_set():
+                    row = (tid + i) % BS
+                    try:
+                        router.predict(_slice(x, row, row + 1),
+                                       timeout=30)
+                    except Exception as e:   # noqa: BLE001
+                        failures.append(repr(e))
+                    i += 1
+
+            ts = [threading.Thread(target=worker, args=(t,))
+                  for t in range(4)]
+            for t in ts:
+                t.start()
+            deadline = time.time() + 20
+            while (time.time() < deadline
+                   and router.stats()["canary"]["active"]):
+                time.sleep(0.02)
+            stop.set()
+            for t in ts:
+                t.join()
+            st = router.stats()
+            assert not failures, failures[:3]
+            assert st["canary"]["rollbacks"] == 1
+            assert "score divergence" in st["canary"][
+                "last_rollback_reason"]
+            assert st["failed"] == 0
+            # rolled-back replica serves the ORIGINAL weights again
+            rep = fleet.get(ids[0])
+            assert rep.cohort == "stable"
+            p = rep.engine.predict(_slice(x, 0, 2), timeout=30)
+            np.testing.assert_array_equal(p.scores, direct[:2])
+
+    def test_slow_canary_rolls_back_on_p99(self, tmp_path):
+        """A GOOD snapshot on a replica that got slow still rolls back:
+        the p99-ratio trigger, not score divergence."""
+        snap = _snapshot(tmp_path)
+        x = _rows(BS)
+        with _fleet(2, canary_fraction=0.5, canary_min_samples=16,
+                    canary_p99_ratio=3.0, canary_score_tol=1e9) as router:
+            ids = router.start_canary(snap)
+            with faults.active_plan(faults.FaultPlan(
+                    serve_delay_replica={ids[0]: 0.05})):
+                deadline = time.time() + 20
+                while (time.time() < deadline
+                       and router.stats()["canary"]["active"]):
+                    router.predict(_slice(x, 0, 1), timeout=30)
+            st = router.stats()
+        assert st["canary"]["rollbacks"] == 1
+        assert "p99 regression" in st["canary"]["last_rollback_reason"]
+        assert st["failed"] == 0
+
+    def test_promote_installs_fleet_wide(self, tmp_path):
+        snap = _snapshot(tmp_path)
+        x = _rows(4)
+        with _fleet(3) as router:
+            fleet = router.fleet
+            ids = router.start_canary(snap, fraction=0.3)
+            expect = np.asarray(fleet.get(ids[0]).engine.model
+                                .forward_batch(x))
+            router.promote_canary()
+            st = router.stats()
+            assert st["canary"]["active"] is False
+            assert st["canary"]["promotions"] == 1
+            for rep in fleet:
+                assert rep.cohort == "stable"
+                assert rep.engine.version == fleet.get(ids[0]
+                                                       ).engine.version
+                p = rep.engine.predict(_slice(x, 0, 2), timeout=30)
+                np.testing.assert_array_equal(p.scores, expect[:2])
+
+    def test_canary_guard_rails(self, tmp_path):
+        snap = _snapshot(tmp_path)
+        with _fleet(2) as router:
+            router.fleet.get(1).eject("test")
+            with pytest.raises(RuntimeError, match=">= 2 healthy"):
+                router.start_canary(snap)
+            router.fleet.get(1).readmit()
+            router.start_canary(snap)
+            with pytest.raises(RuntimeError, match="already active"):
+                router.start_canary(snap)
+            router.rollback_canary("manual")
+            assert router.stats()["canary"]["active"] is False
+
+    def test_shadow_never_affects_clients(self, tmp_path):
+        """Shadow cohort: clients keep getting stable-cohort answers
+        bit-exactly; the candidate's diffs land only in the report —
+        even when the shadow replica starts failing."""
+        snap = _snapshot(tmp_path)
+        x = _rows(BS)
+        with _fleet(3) as router:
+            fleet = router.fleet
+            direct = np.asarray(
+                fleet.replicas[0].engine.model.forward_batch(x))
+            rid = router.start_shadow(snap)
+            assert fleet.get(rid).cohort == "shadow"
+            for i in range(30):
+                p = router.predict(_slice(x, i % BS, i % BS + 1),
+                                   timeout=30)
+                np.testing.assert_array_equal(
+                    p.scores, direct[i % BS:i % BS + 1])
+            deadline = time.time() + 10
+            while (time.time() < deadline
+                   and router.shadow_report()["n"] < 20):
+                time.sleep(0.02)
+            rep = router.shadow_report()
+            assert rep["n"] >= 20
+            assert rep["mean_abs_diff"] > 0    # one train step moved it
+            # kill the shadow: clients must not notice
+            with faults.active_plan(faults.FaultPlan(
+                    replica_down={rid: -1})):
+                for i in range(10):
+                    p = router.predict(_slice(x, 0, 1), timeout=30)
+                    np.testing.assert_array_equal(p.scores, direct[:1])
+            final = router.stop_shadow()
+            assert fleet.get(rid).cohort == "stable"
+            assert router.stats()["failed"] == 0
+            # pre-shadow weights restored
+            p = fleet.get(rid).engine.predict(_slice(x, 0, 2), timeout=30)
+            np.testing.assert_array_equal(p.scores, direct[:2])
+            assert final["n"] >= 20
+
+
+# ---------------------------------------------------------------------
+# cross-mesh snapshot reshard (fleet replicas follow a bigger trainer)
+# ---------------------------------------------------------------------
+class TestCrossMeshReload:
+    def test_reshard_opt_in_follows_multi_device_trainer(self, tmp_path):
+        """A per-device replica consuming a snapshot written by the
+        8-device trainer: rejected by default (PR 5 contract), loaded
+        with the global arrays resharded onto the replica's own mesh
+        when ``ServeConfig.reshard`` opts in."""
+        import os
+        x, y = synthetic_batch(DCFG, BS, seed=0)
+        trainer = _build()           # full default (8-device) mesh
+        xb = dict(x)
+        xb["label"] = y
+        trainer.train_batch(xb)
+        mgr = CheckpointManager(str(tmp_path), keep_last=3)
+        mgr.save(trainer, {})
+        expect = np.asarray(trainer.forward_batch(x))
+
+        from dlrm_flexflow_tpu.serve import SnapshotWatcher
+        replica = _build(dev=1)      # its own single-device mesh
+        eng = InferenceEngine(replica, ServeConfig(max_batch=8),
+                              replica_id=1)
+        eng.start()
+        try:
+            # default: reject-with-reason, keep serving version 0
+            w = SnapshotWatcher(eng, str(tmp_path), poll_s=0.02)
+            assert w.poll_once() is False
+            assert "8-device" in eng.stats()["last_reload_reject"]
+            assert eng.version == 0
+            # opt in: the same snapshot reshards onto this replica
+            w2 = SnapshotWatcher(eng, str(tmp_path), poll_s=0.02,
+                                 elastic=True)
+            assert w2.poll_once() is True
+            assert eng.version == 1
+            p = eng.predict(_slice(x, 0, 4), timeout=30)
+            np.testing.assert_array_equal(p.scores, expect[:4])
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------
+# fault plumbing for the fleet
+# ---------------------------------------------------------------------
+class TestFleetFaults:
+    def test_env_keys_parse(self, monkeypatch):
+        monkeypatch.setenv("FF_FAULT_REPLICA_DOWN", "1:8,2")
+        monkeypatch.setenv("FF_FAULT_SERVE_DELAY", "0.05,1:0.2")
+        monkeypatch.setenv("FF_FAULT_POISON_RELOAD", "1")
+        plan = faults.plan_from_env()
+        assert plan.replica_down == {1: 8, 2: -1}
+        assert plan.serve_delay_s == 0.05
+        assert plan.serve_delay_replica == {1: 0.2}
+        assert plan.poison_reloads == 1
+
+    def test_take_replica_down_budgets(self):
+        with faults.active_plan(faults.FaultPlan(
+                replica_down={0: 2, 1: -1})):
+            assert faults.take_replica_down(0) is True
+            assert faults.take_replica_down(0) is True
+            assert faults.take_replica_down(0) is False   # budget spent
+            for _ in range(5):
+                assert faults.take_replica_down(1) is True  # forever
+            assert faults.take_replica_down(2) is False
+            assert faults.take_replica_down(None) is False
+
+    def test_per_replica_delay_overrides_global(self):
+        with faults.active_plan(faults.FaultPlan(
+                serve_delay_s=0.01, serve_delay_replica={1: 0.05})):
+            t0 = time.perf_counter()
+            faults.maybe_serve_delay(0)
+            global_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            faults.maybe_serve_delay(1)
+            replica_s = time.perf_counter() - t0
+        assert global_s >= 0.01
+        assert replica_s >= 0.05
+
+    def test_poison_reload_scales_params_once(self):
+        m = _build()
+        state = {"params": m.params, "host_params": m.host_params,
+                 "op_state": m.op_state}
+        before = jax.tree.map(np.asarray, m.params)
+        with faults.active_plan(faults.FaultPlan(
+                poison_reloads=1, poison_reload_scale=2.0)):
+            poisoned = faults.maybe_poison_reload(state)
+            again = faults.maybe_poison_reload(state)   # budget spent
+        leaves_b = jax.tree.leaves(before)
+        leaves_p = jax.tree.leaves(
+            jax.tree.map(np.asarray, poisoned["params"]))
+        changed = sum(not np.array_equal(a, b)
+                      for a, b in zip(leaves_b, leaves_p))
+        assert changed > 0
+        np.testing.assert_array_equal(
+            leaves_p[0], 2.0 * leaves_b[0])
+        assert again is state                            # pass-through
+
+    def test_drain_pending_rescues_queued_futures(self):
+        """Ejection's drain: queued (undispatched) requests fail fast
+        with the typed ReplicaDown instead of rotting behind a dead
+        batcher."""
+        m = _build()
+        x = _rows(4)
+        with faults.active_plan(faults.FaultPlan(serve_delay_s=0.3)):
+            with InferenceEngine(m, ServeConfig(
+                    max_batch=8, queue_capacity=64,
+                    ), replica_id=7) as eng:
+                first = eng.submit(_slice(x, 0, 1))   # wedges batcher
+                time.sleep(0.05)
+                queued = [eng.submit(_slice(x, 0, 1)) for _ in range(3)]
+                n = eng.drain_pending()
+                assert n == 3
+                for f in queued:
+                    with pytest.raises(ReplicaDown, match="replica 7"):
+                        f.result(5)
+                first.result(30)   # in-flight request still answers

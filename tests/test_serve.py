@@ -184,9 +184,12 @@ class TestEngine:
     def test_size_flush_coalesces_one_batch(self):
         m = _build()
         x = _rows(BS)
-        # a long deadline: only the size trigger can flush promptly
+        # flush mode with a long deadline: only the size trigger can
+        # flush promptly (continuous mode would dispatch the first
+        # request the moment it lands)
         with InferenceEngine(m, ServeConfig(max_batch=8,
-                                            max_delay_ms=2000.0)) as eng:
+                                            max_delay_ms=2000.0,
+                                            continuous=False)) as eng:
             futs = [eng.submit(_slice(x, i, i + 1)) for i in range(8)]
             preds = [f.result(30) for f in futs]
         st = eng.stats()
@@ -199,8 +202,10 @@ class TestEngine:
     def test_deadline_flush_partial_batch(self):
         m = _build()
         x = _rows(4)
+        # flush mode: a partial batch waits out max_delay before going
         with InferenceEngine(m, ServeConfig(max_batch=64,
-                                            max_delay_ms=30.0)) as eng:
+                                            max_delay_ms=30.0,
+                                            continuous=False)) as eng:
             t0 = time.monotonic()
             f = eng.submit(_slice(x, 0, 1))
             p = f.result(30)
@@ -282,6 +287,32 @@ class TestEngine:
                 eng.submit(_rows(16))
         with pytest.raises(RuntimeError, match="closed"):
             eng.submit(_slice(x, 0, 1))
+
+    def test_submit_validates_per_sample_shapes(self):
+        """A wrong-shaped feature must die at submit() as a ValueError
+        (non-retryable), NOT at dispatch — there it would fail the whole
+        batch, burn the fleet router's retry budget, and trip the
+        circuit breaker: one malformed client ejecting every replica."""
+        m = _build()
+        x = _rows(4)
+        with InferenceEngine(m, ServeConfig(max_batch=8,
+                                            max_delay_ms=1.0)) as eng:
+            bad = dict(_slice(x, 0, 2))
+            bad["dense"] = np.zeros((2, 16), np.float32)   # expects (2,4)
+            with pytest.raises(ValueError, match="per-sample shape"):
+                eng.submit(bad)
+            # same element count, different layout (the HTTP-natural
+            # sparse (n, T) for the graph's (n, T, 1) bag input) is an
+            # unambiguous reshape: accepted, bit-identical
+            flat = dict(_slice(x, 0, 2))
+            nobag = flat["sparse"].reshape(2, -1)
+            assert nobag.shape != flat["sparse"].shape
+            flat["sparse"] = nobag
+            p2 = eng.predict(flat, timeout=30)
+            p3 = eng.predict(_slice(x, 0, 2), timeout=30)
+            np.testing.assert_array_equal(p2.scores, p3.scores)
+        # none of that tripped a dispatch error
+        assert eng.stats()["responses"] == 2
 
 
 # ---------------------------------------------------------------------
@@ -422,6 +453,68 @@ class TestHotReload:
                     time.sleep(0.02)
                 assert eng.version == 2
 
+    def test_transient_reload_io_retries_then_succeeds(self, tmp_path):
+        """ISSUE-6 satellite: a transient IOError mid-reload (NFS
+        hiccup) is absorbed by the shared read_with_retries backoff —
+        the reload SUCCEEDS on a later attempt instead of silently
+        skipping to the next poll, and nothing is recorded as a
+        failure."""
+        x, y = synthetic_batch(DCFG, BS, seed=0)
+        d = str(tmp_path)
+        trainer = _build()
+        mgr = CheckpointManager(d, keep_last=3)
+        _publish(trainer, mgr, x, y, steps=1)
+
+        server = _build()
+        eng = InferenceEngine(server, ServeConfig(max_batch=8,
+                                                  max_delay_ms=1.0))
+        eng.start()
+        try:
+            w = SnapshotWatcher(eng, d, poll_s=0.02)
+            with faults.active_plan(faults.FaultPlan(
+                    io_errors={"snapshot_reload": 2})) as plan:
+                assert w.poll_once() is True       # retried through both
+                assert plan.io_errors["snapshot_reload"] == 0
+            assert eng.version == 1
+            st = w.stats()
+            assert st["reload_failures"] == 0
+            assert st["last_reload_error"] == ""
+            assert eng.stats()["reload_rejects"] == 0
+        finally:
+            eng.close()
+
+    def test_watcher_stats_record_cumulative_failures(self, tmp_path):
+        """ISSUE-6 satellite: retries exhausted -> the watcher's own
+        stats() carry reload_failures + last_reload_error (the engine's
+        reject is once-per-snapshot; the watcher count is cumulative so
+        a never-reloading server is visible from /stats)."""
+        x, y = synthetic_batch(DCFG, BS, seed=0)
+        d = str(tmp_path)
+        trainer = _build()
+        mgr = CheckpointManager(d, keep_last=3)
+        _publish(trainer, mgr, x, y, steps=1)
+
+        server = _build()
+        eng = InferenceEngine(server, ServeConfig(max_batch=8,
+                                                  max_delay_ms=1.0))
+        eng.start()
+        try:
+            w = SnapshotWatcher(eng, d, poll_s=0.02)
+            with faults.active_plan(faults.FaultPlan(
+                    io_errors={"snapshot_reload": 64})):
+                assert w.poll_once() is False      # 3 retries exhausted
+                assert w.poll_once() is False      # fails again
+            st = w.stats()
+            assert st["reload_failures"] == 2      # cumulative
+            assert "failed to load" in st["last_reload_error"]
+            assert eng.stats()["reload_rejects"] == 1   # reject-once
+            assert eng.version == 0
+            # the fault cleared: the SAME snapshot now installs
+            assert w.poll_once() is True
+            assert eng.version == 1
+        finally:
+            eng.close()
+
     def test_fingerprint_mismatch_rejected_with_reason(self, tmp_path):
         d = str(tmp_path)
         other = ff.FFModel(ff.FFConfig(batch_size=BS, seed=0))
@@ -491,8 +584,6 @@ class TestEmbeddingCache:
         d = str(tmp_path)
         trainer = _build(host_resident_tables=True)
         mgr = CheckpointManager(d, keep_last=3)
-        _publish(trainer, mgr, x, y, steps=1)
-        expect = np.asarray(trainer.forward_batch(x))
 
         server = _build(host_resident_tables=True)
         eng = InferenceEngine(server, ServeConfig(
@@ -500,6 +591,9 @@ class TestEmbeddingCache:
             cache_rows=256), checkpoint_dir=d)
         with eng:
             p0 = eng.predict(_slice(x, 0, 4), timeout=30)   # fills cache
+            assert p0.version == 0      # published only after this
+            _publish(trainer, mgr, x, y, steps=1)
+            expect = np.asarray(trainer.forward_batch(x))
             deadline = time.time() + 20
             while eng.version < 1 and time.time() < deadline:
                 time.sleep(0.02)
@@ -510,6 +604,101 @@ class TestEmbeddingCache:
         np.testing.assert_array_equal(p1.scores, expect[:4])
         assert not np.array_equal(p0.scores, p1.scores)
         assert eng.stats()["embedding_cache"]["invalidations"] >= 1
+
+    def test_cache_invalidation_races_swap_under_traffic(self, tmp_path):
+        """ISSUE-6 satellite: the old-or-new-never-mixed invariant
+        extended to the embedding cache. Concurrent traffic hammers hot
+        (cacheable) index patterns while snapshots land; a request
+        admitted mid-reload must never combine OLD-version cached rows
+        with NEW-version params — every response's scores must equal its
+        OWN version's full model output for those rows. A cache
+        invalidated outside the swap lock (or keyed without regard to
+        the swap) would fail this with a blended score."""
+        import json
+        import shutil
+        x, y = synthetic_batch(DCFG, BS, seed=0)
+        # checkpoints are STAGED up front (computing the per-version
+        # expected outputs), then re-published into the live dir one at
+        # a time mid-traffic so every swap races hot cache entries
+        stage = str(tmp_path / "stage")
+        d = str(tmp_path / "live")
+        os.makedirs(d)
+        trainer = _build(host_resident_tables=True)
+        mgr = CheckpointManager(stage, keep_last=6)
+        mgr.save(trainer, {"epoch": 0, "batch": 0})
+        # training moves BOTH the tables (cached rows) and the dense
+        # params, so a stale cache row under new params is visible
+        expected = {0: np.asarray(trainer.forward_batch(x))}
+        for step in (1, 2, 3):
+            _publish(trainer, mgr, x, y, steps=1)
+            expected[step] = np.asarray(trainer.forward_batch(x))
+        with open(os.path.join(stage, "manifest.json")) as f:
+            staged = json.load(f)
+
+        def _republish(step):
+            """Atomically publish the staged snapshots up to `step`
+            into the live dir — what a trainer's rolling save does."""
+            for e in staged["entries"]:
+                if int(e.get("step", -1)) == step:
+                    shutil.copy(os.path.join(stage, e["file"]),
+                                os.path.join(d, e["file"]))
+            sub = dict(staged)
+            sub["entries"] = [e for e in staged["entries"]
+                              if int(e.get("step", -1)) <= step]
+            tmp = os.path.join(d, "manifest.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(sub, f)
+            os.replace(tmp, os.path.join(d, "manifest.json"))
+
+        _republish(0)
+        server = _build(host_resident_tables=True)
+        eng = InferenceEngine(server, ServeConfig(
+            max_batch=8, max_delay_ms=1.0, poll_s=0.005,
+            queue_capacity=512, cache_rows=256), checkpoint_dir=d)
+        failures = []
+        stop = threading.Event()
+
+        def hammer(tid):
+            i = 0
+            while not stop.is_set():
+                # a SMALL set of hot rows: repeats guarantee cache hits,
+                # so post-reload responses exercise refilled entries
+                row = (tid + i) % 8
+                try:
+                    p = eng.predict(_slice(x, row, row + 1), timeout=30)
+                except Overloaded:
+                    continue
+                want = expected.get(p.version)
+                if want is None or not np.array_equal(
+                        p.scores, want[row:row + 1]):
+                    failures.append((p.version, row))
+                i += 1
+
+        with faults.active_plan(faults.FaultPlan(serve_delay_s=0.002)):
+            with eng:
+                threads = [threading.Thread(target=hammer, args=(t,))
+                           for t in range(4)]
+                for t in threads:
+                    t.start()
+                # publish each version UNDER live traffic so every
+                # swap+invalidate races hot cache entries, then wait for
+                # it to land before publishing the next
+                deadline = time.time() + 60
+                for step in (1, 2, 3):
+                    _republish(step)
+                    while (eng.version < step
+                           and time.time() < deadline):
+                        time.sleep(0.01)
+                stop.set()
+                for t in threads:
+                    t.join()
+        assert eng.version == 3
+        assert not failures, (
+            f"cache/params version mix: {failures[:5]}")
+        st = eng.stats()
+        assert st["reloads"] == 3
+        assert st["embedding_cache"]["invalidations"] >= 3
+        assert st["embedding_cache"]["hits"] > 0   # the cache was live
 
 
 # ---------------------------------------------------------------------
