@@ -1,0 +1,39 @@
+"""flexcheck: concurrency + JAX-hazard analysis for dlrm_flexflow_tpu.
+
+Two halves:
+
+- **Static passes** (``python -m dlrm_flexflow_tpu.analysis`` or the
+  ``flexcheck`` console script): AST + call-graph rules over the package
+  — thread lifecycle, lock discipline (races, lock-order cycles,
+  blocking under dispatch/manifest locks), JAX hazards (import-time
+  dispatch, executable-cache keys, scan donation, traced branches) and
+  env-parsing hygiene. Findings print as ``file:line rule-id severity``
+  and gate CI via ``--fail-on high`` against the checked-in
+  ``analysis/baseline.json`` suppression file (every entry justified).
+- **Runtime sanitizer** (:mod:`.sanitizer`, opt-in via ``FF_SANITIZE=1``):
+  named-lock proxies that record the live lock-acquisition graph,
+  detect order cycles and held-too-long locks, and assert no JAX
+  dispatch happens under a no-dispatch lock — reporting through the
+  watchdog's :class:`~..utils.watchdog.StallReport` machinery.
+
+This ``__init__`` stays import-light: the production modules import
+:func:`make_lock` from :mod:`.sanitizer` on their hot paths, and pulling
+the AST passes (or argparse) in with it would tax every ``import
+dlrm_flexflow_tpu``.
+"""
+
+from __future__ import annotations
+
+__all__ = ["run_analysis", "main", "sanitizer"]
+
+from . import sanitizer  # noqa: E402  (import-light; hot-path dep)
+
+
+def run_analysis(root=None):
+    from .cli import run_analysis as _run
+    return _run(root)
+
+
+def main(argv=None) -> int:
+    from .cli import main as _main
+    return _main(argv)

@@ -1,0 +1,7 @@
+"""``python -m dlrm_flexflow_tpu.analysis`` entry point."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,76 @@
+"""Suppression baseline: known findings carried with a justification.
+
+The baseline is the analyzer's escape hatch for findings that are
+understood and deliberate (an abandoned-by-design probe thread, file IO
+that IS the critical section of a manifest lock). Every entry MUST carry
+a non-empty justification — an unjustified suppression is itself an
+error, so the file cannot silently rot into a mute button.
+
+Keys are line-number free (see ``findings.Finding.key``): a suppression
+survives unrelated edits but dies with the symbol it names, so a fixed
+finding leaves a stale entry behind that ``--prune-baseline`` removes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from .findings import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (bad JSON, missing justification)."""
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """key -> justification. Missing file = empty baseline."""
+    if not os.path.isfile(path):
+        return {}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        raise BaselineError(f"unreadable baseline {path!r}: {e}") from None
+    out: Dict[str, str] = {}
+    for i, entry in enumerate(doc.get("suppressions", [])):
+        key = entry.get("key")
+        just = (entry.get("justification") or "").strip()
+        if not key:
+            raise BaselineError(
+                f"{path}: suppression #{i} has no 'key'")
+        if not just:
+            raise BaselineError(
+                f"{path}: suppression {key!r} has no justification — "
+                f"every baselined finding must say WHY it is acceptable")
+        out[key] = just
+    return out
+
+
+def save_baseline(path: str, entries: Dict[str, str]) -> None:
+    doc = {"version": 1,
+           "suppressions": [{"key": k, "justification": v}
+                            for k, v in sorted(entries.items())]}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+
+
+def split_by_baseline(findings: List[Finding], baseline: Dict[str, str]
+                      ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """(fresh, suppressed, stale-keys): stale keys are baseline entries
+    matching nothing — fixed findings whose suppression should go."""
+    fresh, suppressed = [], []
+    seen = set()
+    for f in findings:
+        if f.key in baseline:
+            suppressed.append(f)
+            seen.add(f.key)
+        else:
+            fresh.append(f)
+    stale = [k for k in baseline if k not in seen]
+    return fresh, suppressed, stale

@@ -1,0 +1,119 @@
+"""flexcheck CLI: run the static passes, apply the baseline, exit coded.
+
+Usage::
+
+    python -m dlrm_flexflow_tpu.analysis [PATH ...] \
+        [--fail-on {high,medium,low,info,never}] [--baseline FILE]
+        [--show-baselined] [--write-baseline] [--prune-baseline]
+        [--list-rules]
+
+Findings print as ``file:line RULE severity [name] message``. Exit code
+1 when any non-baselined finding at or above ``--fail-on`` remains
+(default: high), 2 on usage/baseline errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .baseline import (DEFAULT_BASELINE, BaselineError, load_baseline,
+                       save_baseline, split_by_baseline)
+from .findings import RULES, Finding, severity_at_least, sort_findings
+from .index import build_index
+from .rules import ALL_PASSES
+
+_PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_analysis(root: Optional[str] = None) -> List[Finding]:
+    """All findings (baseline NOT applied) for a file or package tree.
+    Defaults to the installed ``dlrm_flexflow_tpu`` package itself."""
+    idx = build_index(root or _PACKAGE_ROOT)
+    findings: List[Finding] = []
+    for p in ALL_PASSES:
+        p(idx, findings)
+    return sort_findings(findings)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="flexcheck",
+        description="Concurrency + JAX-hazard static analyzer for "
+                    "dlrm_flexflow_tpu")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help=f"files/trees to scan (default: the installed "
+                         f"package at {_PACKAGE_ROOT})")
+    ap.add_argument("--fail-on", default="high",
+                    choices=["high", "medium", "low", "info", "never"],
+                    help="exit 1 when a non-baselined finding at or "
+                         "above this severity remains (default: high)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression file (default: the package's "
+                         "checked-in analysis/baseline.json)")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print suppressed findings with their "
+                         "justifications")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write every current finding into the baseline "
+                         "(justifications default to TODO — fill them "
+                         "in, an empty justification fails the load)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries that no longer match "
+                         "any finding")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule-id reference table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, (name, sev, doc) in sorted(RULES.items()):
+            print(f"{rid}  {name:<24} {sev:<7} {doc}")
+        return 0
+
+    findings: List[Finding] = []
+    for path in (args.paths or [_PACKAGE_ROOT]):
+        findings.extend(run_analysis(path))
+    findings = sort_findings(findings)
+
+    try:
+        baseline = load_baseline(args.baseline)
+    except BaselineError as e:
+        print(f"flexcheck: {e}", file=sys.stderr)
+        return 2
+    fresh, suppressed, stale = split_by_baseline(findings, baseline)
+
+    if args.write_baseline:
+        entries = dict(baseline) if not args.prune_baseline else {
+            k: v for k, v in baseline.items()
+            if k in {f.key for f in findings}}
+        for f in fresh:
+            entries.setdefault(f.key, "TODO: justify or fix")
+        save_baseline(args.baseline, entries)
+        print(f"flexcheck: wrote {len(entries)} suppression(s) to "
+              f"{args.baseline}")
+        return 0
+    if args.prune_baseline and stale:
+        save_baseline(args.baseline,
+                      {k: v for k, v in baseline.items()
+                       if k not in set(stale)})
+        print(f"flexcheck: pruned {len(stale)} stale suppression(s)")
+
+    for f in fresh:
+        print(f.render())
+    if args.show_baselined:
+        for f in suppressed:
+            print(f"{f.render()}  [baselined: {baseline[f.key]}]")
+    for k in stale:
+        print(f"flexcheck: stale baseline entry (fixed? prune it): {k}",
+              file=sys.stderr)
+
+    n_gate = [f for f in fresh
+              if args.fail_on != "never"
+              and severity_at_least(f.severity, args.fail_on)]
+    print(f"flexcheck: {len(fresh)} finding(s) "
+          f"({len(n_gate)} at/above --fail-on {args.fail_on}), "
+          f"{len(suppressed)} baselined, {len(stale)} stale "
+          f"suppression(s)")
+    return 1 if n_gate else 0

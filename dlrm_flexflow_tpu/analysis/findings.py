@@ -1,0 +1,102 @@
+"""Finding model shared by every flexcheck pass.
+
+A finding is one `file:line rule-id severity message` diagnostic. Its
+``key`` deliberately excludes the line number: suppression baselines must
+survive unrelated edits above the finding, so the key is built from the
+rule, the file, and the enclosing scope/symbol instead.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+# severity ladder (``--fail-on`` compares by index)
+SEVERITIES = ("info", "low", "medium", "high")
+
+
+def severity_at_least(sev: str, floor: str) -> bool:
+    return SEVERITIES.index(sev) >= SEVERITIES.index(floor)
+
+
+# rule-id registry: id -> (name, default severity, one-line doc). The
+# README's reference table and the CLI's --list-rules are generated from
+# this, so the code and the docs cannot drift apart.
+RULES = {
+    # --- thread lifecycle ---------------------------------------------
+    "FLX101": ("thread-unnamed", "high",
+               "threading.Thread without a name= starting with 'ff-' "
+               "(stall reports and stack dumps must name the worker)"),
+    "FLX102": ("thread-not-daemon", "high",
+               "threading.Thread without daemon=True (a wedged worker "
+               "must never block interpreter shutdown)"),
+    "FLX103": ("thread-unjoined", "high",
+               "thread is never joined/drained on any close()/shutdown() "
+               "path (leaked worker; racy teardown)"),
+    # --- lock discipline ----------------------------------------------
+    "FLX201": ("racy-attribute", "medium",
+               "attribute written both inside and outside `with <lock>` "
+               "scopes of the same class (torn read/lost update race)"),
+    "FLX202": ("lock-order-cycle", "high",
+               "cycle in the static lock-order graph (deadlock hazard: "
+               "two threads can acquire the cycle in opposite order)"),
+    "FLX203": ("blocking-under-lock", "high",
+               "blocking call (device_put/block_until_ready/file IO/"
+               "sleep/.result()/.join()) while holding a dispatch/"
+               "manifest/host-table lock"),
+    # --- JAX hazards ---------------------------------------------------
+    "FLX301": ("exec-cache-const-key", "high",
+               "compiled-executable cache stored under a constant key "
+               "(must key on the batch/shape signature)"),
+    "FLX302": ("import-time-jax", "high",
+               "jnp./jax dispatch at module import time (forces backend "
+               "init + device work on import)"),
+    "FLX303": ("scan-no-donate", "medium",
+               "lax.scan train body jitted without donate_argnums "
+               "(carries double-buffer; superstep memory doubles)"),
+    "FLX304": ("traced-python-branch", "medium",
+               "Python if/while on a traced value inside a scan/jit body "
+               "(TracerBoolConversionError or silent retrace)"),
+    # --- env parsing ---------------------------------------------------
+    "FLX401": ("env-parse-unchecked", "medium",
+               "int()/float() directly on an os.environ value without a "
+               "ValueError guard naming the variable"),
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str          # "FLX203"
+    severity: str      # info|low|medium|high
+    file: str          # path relative to the scanned root
+    line: int
+    message: str
+    scope: str = ""    # "Class.method", "function", or "<module>"
+    token: str = ""    # stable discriminator (lock/thread/attr name)
+
+    @property
+    def name(self) -> str:
+        return RULES[self.rule][0]
+
+    @property
+    def key(self) -> str:
+        """Line-number-free suppression key."""
+        return f"{self.rule}:{self.file}:{self.scope}:{self.token}"
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line} {self.rule} {self.severity} "
+                f"[{self.name}] {self.message}")
+
+
+def make_finding(rule: str, file: str, line: int, message: str,
+                 scope: str = "", token: str = "",
+                 severity: str = "") -> Finding:
+    return Finding(rule=rule, severity=severity or RULES[rule][1],
+                   file=file, line=line, message=message, scope=scope,
+                   token=token)
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings,
+                  key=lambda f: (-SEVERITIES.index(f.severity), f.file,
+                                 f.line, f.rule))

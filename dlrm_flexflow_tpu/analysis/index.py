@@ -1,0 +1,362 @@
+"""Package AST index: modules, classes, locks, threads, and a call graph.
+
+flexcheck's passes need cross-file context — which attribute is a lock,
+which class owns it, which function a call resolves to — so one indexing
+walk builds that here and the rule passes stay small. Resolution is
+deliberately conservative: an attribute or method name resolves across
+classes only when it is UNIQUE in the scanned package; anything
+ambiguous resolves to nothing rather than to a guess (a false deadlock
+report would teach people to ignore the analyzer).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+def dotted(node: ast.AST) -> str:
+    """'a.b.c' for nested Attribute/Name chains; '' when not a chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_lock_factory(call: ast.AST) -> Optional[str]:
+    """'lock'/'rlock'/'condition' when `call` constructs one, else None.
+    Recognizes threading.Lock()/RLock()/Condition(), bare Lock() from
+    `from threading import Lock`, and the sanitizer's make_lock(...)."""
+    if not isinstance(call, ast.Call):
+        return None
+    d = dotted(call.func)
+    leaf = d.rsplit(".", 1)[-1]
+    if leaf in LOCK_FACTORIES and (d == leaf or d.startswith("threading.")):
+        return leaf.lower()
+    if leaf == "make_lock":
+        return "lock"
+    return None
+
+
+@dataclass
+class LockDef:
+    lock_id: str          # "ClassName.attr" or "module.attr"
+    kind: str             # lock | rlock | condition
+    file: str
+    line: int
+
+
+@dataclass
+class ThreadSite:
+    file: str
+    line: int
+    scope: str            # "Class.method" or function name
+    cls: Optional[str]    # enclosing class name
+    func: Optional[ast.FunctionDef]
+    call: ast.Call
+    stored_attr: Optional[str] = None   # self.<attr> it is assigned to
+    stored_local: Optional[str] = None  # local var it is assigned to
+
+
+@dataclass
+class FuncInfo:
+    qualname: str         # "file.py:Class.method" or "file.py:func"
+    file: str
+    cls: Optional[str]
+    name: str
+    node: ast.FunctionDef
+
+
+@dataclass
+class PackageIndex:
+    root: str
+    modules: Dict[str, ast.Module] = field(default_factory=dict)
+    classes: Dict[str, Tuple[str, ast.ClassDef]] = field(
+        default_factory=dict)           # class name -> (file, node)
+    # (class, attr) -> LockDef, plus property aliases resolving to the
+    # same LockDef (model._host_lock -> FFModel._host_table_lock)
+    class_locks: Dict[Tuple[str, str], LockDef] = field(
+        default_factory=dict)
+    module_locks: Dict[Tuple[str, str], LockDef] = field(
+        default_factory=dict)           # (file, name) -> LockDef
+    # attr name -> [LockDef] across all classes (for unique resolution)
+    lock_attr_index: Dict[str, List[LockDef]] = field(default_factory=dict)
+    funcs: Dict[str, FuncInfo] = field(default_factory=dict)
+    # method name -> [FuncInfo] (for unique cross-class resolution)
+    method_index: Dict[str, List[FuncInfo]] = field(default_factory=dict)
+    threads: List[ThreadSite] = field(default_factory=list)
+    thread_subclasses: Set[str] = field(default_factory=set)
+    # Thread subclasses owning a join() somewhere (self-joining workers)
+    self_joining: Set[str] = field(default_factory=set)
+
+    # --- lock resolution ----------------------------------------------
+    def register_lock(self, cls: Optional[str], attr: str, kind: str,
+                      file: str, line: int,
+                      alias_of: Optional[LockDef] = None) -> None:
+        if alias_of is not None:
+            ld = alias_of
+        elif cls is None:
+            ld = self.module_locks.setdefault(
+                (file, attr), LockDef(f"{file}.{attr}", kind, file, line))
+        else:
+            ld = self.class_locks.setdefault(
+                (cls, attr), LockDef(f"{cls}.{attr}", kind, file, line))
+        if cls is not None:
+            self.class_locks.setdefault((cls, attr), ld)
+        self.lock_attr_index.setdefault(attr, [])
+        if ld not in self.lock_attr_index[attr]:
+            self.lock_attr_index[attr].append(ld)
+
+    def lock_for_attr(self, cls: Optional[str], attr: str
+                      ) -> Optional[LockDef]:
+        """Resolve `<obj>.<attr>` to a lock: exact class match first,
+        then unique-across-package attr name."""
+        if cls is not None and (cls, attr) in self.class_locks:
+            return self.class_locks[(cls, attr)]
+        cands = self.lock_attr_index.get(attr, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def resolve_method(self, name: str, cls: Optional[str]
+                       ) -> Optional[FuncInfo]:
+        """`self.name()` resolves within cls; `obj.name()` resolves only
+        when the method name is unique across the package."""
+        if cls is not None:
+            fi = self.funcs.get(f"{cls}.{name}")
+            if fi is not None:
+                return fi
+        cands = [f for f in self.method_index.get(name, [])
+                 if f.cls is not None]
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def resolve_call(self, call: ast.Call, cls: Optional[str],
+                     file: str) -> Optional[FuncInfo]:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                return self.resolve_method(f.attr, cls)
+            return self.resolve_method(f.attr, None)
+        if isinstance(f, ast.Name):
+            fi = self.funcs.get(f"{file}:{f.id}")
+            if fi is not None:
+                return fi
+            cands = [x for x in self.method_index.get(f.id, [])
+                     if x.cls is None]
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+
+def iter_py_files(root: str) -> List[str]:
+    out = []
+    if os.path.isfile(root):
+        return [root]
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def _scan_lock_assigns(idx: PackageIndex, file: str,
+                       cls: Optional[str], fn: ast.AST) -> None:
+    """Register `self.x = Lock()` / `x = Lock()` (incl. chained
+    `a = self.b = Lock()`) found anywhere under `fn`."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        kind = _is_lock_factory(node.value)
+        if kind is None:
+            continue
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self" and cls is not None):
+                idx.register_lock(cls, tgt.attr, kind, file, node.lineno)
+            elif isinstance(tgt, ast.Name) and cls is None:
+                idx.register_lock(None, tgt.id, kind, file, node.lineno)
+
+
+def _scan_lock_properties(idx: PackageIndex, file: str, cls: str,
+                          fn: ast.FunctionDef) -> None:
+    """A @property that creates-or-returns a lock attr aliases the
+    property name to that lock (FFModel._host_lock pattern)."""
+    is_prop = any(isinstance(d, ast.Name) and d.id == "property"
+                  for d in fn.decorator_list)
+    if not is_prop:
+        return
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            kind = _is_lock_factory(node.value)
+            if kind is None:
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    idx.register_lock(cls, tgt.attr, kind, file,
+                                      node.lineno)
+                    ld = idx.class_locks[(cls, tgt.attr)]
+                    idx.register_lock(cls, fn.name, kind, file,
+                                      fn.lineno, alias_of=ld)
+
+
+def _thread_bases(node: ast.ClassDef) -> bool:
+    for b in node.bases:
+        d = dotted(b)
+        if d in ("threading.Thread", "Thread"):
+            return True
+    return False
+
+
+def _scan_threads(idx: PackageIndex, file: str, cls: Optional[str],
+                  scope: str, fn: Optional[ast.FunctionDef],
+                  body_owner: ast.AST) -> None:
+    for node in ast.walk(body_owner):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) and node is not body_owner:
+            continue   # nested scopes scanned with their own scope name
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        is_thread = d in ("threading.Thread", "Thread")
+        is_super_init = (d == "super.__init__" or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "__init__"
+            and isinstance(node.func.value, ast.Call)
+            and dotted(node.func.value.func) == "super"))
+        if not is_thread and not (is_super_init and cls is not None
+                                  and cls in idx.thread_subclasses):
+            continue
+        site = ThreadSite(file=file, line=node.lineno, scope=scope,
+                          cls=cls, func=fn, call=node)
+        if is_super_init:
+            site.stored_attr = "<self>"   # the instance IS the thread
+        idx.threads.append(site)
+
+
+def build_index(root: str) -> PackageIndex:
+    root_abs = os.path.abspath(root)
+    # a single-file root (fixture snippets, `flexcheck some_file.py`)
+    # keys its module by basename
+    base = os.path.dirname(root_abs) if os.path.isfile(root_abs) \
+        else root_abs
+    idx = PackageIndex(root=base)
+    files = iter_py_files(root_abs)
+    trees: Dict[str, ast.Module] = {}
+    for path in files:
+        rel = os.path.relpath(path, base)
+        try:
+            with open(path, encoding="utf-8") as f:
+                trees[rel] = ast.parse(f.read(), filename=rel)
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue
+    idx.modules = trees
+
+    # pass 1: classes, Thread subclasses, functions
+    for rel, tree in trees.items():
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                idx.classes[node.name] = (rel, node)
+                if _thread_bases(node):
+                    idx.thread_subclasses.add(node.name)
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        fi = FuncInfo(f"{node.name}.{item.name}", rel,
+                                      node.name, item.name, item)
+                        idx.funcs[fi.qualname] = fi
+                        idx.method_index.setdefault(item.name,
+                                                    []).append(fi)
+            elif isinstance(node, ast.FunctionDef):
+                fi = FuncInfo(f"{rel}:{node.name}", rel, None,
+                              node.name, node)
+                idx.funcs[fi.qualname] = fi
+                idx.method_index.setdefault(node.name, []).append(fi)
+
+    # Thread subclasses that join themselves (a close()/stop() calling
+    # self.join) count as self-managing workers
+    for cname in idx.thread_subclasses:
+        _, cnode = idx.classes[cname]
+        for node in ast.walk(cnode):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                idx.self_joining.add(cname)
+
+    # pass 2: locks + thread construction sites
+    for rel, tree in trees.items():
+        _scan_lock_assigns(idx, rel, None, tree)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        _scan_lock_assigns(idx, rel, node.name, item)
+                        _scan_lock_properties(idx, rel, node.name, item)
+            elif isinstance(node, ast.FunctionDef):
+                _scan_lock_assigns(idx, rel, None, node)
+
+    # pass 3: thread sites (needs thread_subclasses from pass 1), with
+    # nested defs scanned under their own scope names
+    def scan_scope(rel: str, cls: Optional[str], scope: str,
+                   fn: Optional[ast.FunctionDef], owner: ast.AST) -> None:
+        _scan_threads(idx, rel, cls, scope, fn, owner)
+        for child in ast.iter_child_nodes(owner):
+            if isinstance(child, ast.FunctionDef) and child is not owner:
+                scan_scope(rel, cls, f"{scope}.{child.name}", child, child)
+            elif not isinstance(child, (ast.ClassDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.FunctionDef):
+                        scan_scope(rel, cls, f"{scope}.{sub.name}",
+                                   sub, sub)
+
+    for rel, tree in trees.items():
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        scan_scope(rel, node.name,
+                                   f"{node.name}.{item.name}", item, item)
+            elif isinstance(node, ast.FunctionDef):
+                scan_scope(rel, None, node.name, node, node)
+
+    # attach storage info to thread sites (self.attr = Thread(...) or
+    # t = Thread(...); optionally self.attr = t later in the same func)
+    for site in idx.threads:
+        if site.func is None or site.stored_attr:
+            continue
+        for node in ast.walk(site.func):
+            if isinstance(node, ast.Assign) and node.value is site.call:
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        site.stored_attr = tgt.attr
+                    elif isinstance(tgt, ast.Name):
+                        site.stored_local = tgt.id
+        if site.stored_local and not site.stored_attr:
+            for node in ast.walk(site.func):
+                if isinstance(node, ast.Assign):
+                    v = node.value
+                    if (isinstance(v, ast.Name)
+                            and v.id == site.stored_local):
+                        for tgt in node.targets:
+                            if (isinstance(tgt, ast.Attribute)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and tgt.value.id == "self"):
+                                site.stored_attr = tgt.attr
+    return idx

@@ -1,0 +1,699 @@
+"""flexcheck rule passes over the package AST index.
+
+Four families (see ``findings.RULES``): thread lifecycle (FLX1xx), lock
+discipline (FLX2xx), JAX hazards (FLX3xx), env parsing (FLX4xx). Every
+pass takes the shared :class:`~.index.PackageIndex` and appends
+:class:`~.findings.Finding`\\ s; none of them imports jax — the analyzer
+must run in a bare CI venv.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding, make_finding
+from .index import FuncInfo, PackageIndex, dotted
+
+# locks whose critical sections must never block: the serving dispatch
+# path, checkpoint manifests, host-table gathers, deployment swaps
+CRITICAL_LOCK_RE = re.compile(r"swap|dispatch|manifest|deploy|host")
+
+# calls considered blocking inside a critical section
+BLOCKING_ATTRS = {"block_until_ready", "result", "join", "sleep",
+                  "fsync", "replace", "unlink", "listdir", "device_put",
+                  "load", "save", "savez", "dump"}
+BLOCKING_DOTTED = {"time.sleep", "jax.device_put", "np.load", "numpy.load",
+                   "json.load", "json.dump", "os.fsync", "os.replace",
+                   "os.unlink", "os.listdir", "subprocess.run",
+                   "subprocess.check_call", "shutil.copy",
+                   "jax.block_until_ready"}
+BLOCKING_NAMES = {"open", "read_with_retries", "device_put"}
+
+# module-level jax calls that force backend init / device work on import
+IMPORT_TIME_JAX = {"jax.device_put", "jax.devices", "jax.local_devices",
+                   "jax.block_until_ready"}
+
+
+# ---------------------------------------------------------------------
+# shared walking helpers
+# ---------------------------------------------------------------------
+def _with_lock_ids(item: ast.withitem, idx: PackageIndex,
+                   cls: Optional[str], file: str,
+                   local_types: Dict[str, str]) -> Optional[str]:
+    """Lock id a `with X:` item acquires, or None when X is no known
+    lock. X may be self.attr, obj.attr, a bare name, or a local alias."""
+    expr = item.context_expr
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name):
+            owner = cls if base.id == "self" else local_types.get(base.id)
+            ld = idx.lock_for_attr(owner, expr.attr)
+            return ld.lock_id if ld else None
+    elif isinstance(expr, ast.Name):
+        ld = idx.module_locks.get((file, expr.id))
+        if ld is not None:
+            return ld.lock_id
+        # local alias: `lk = self._lock` style — resolved by the caller
+        # seeding local_types with "<lockid>" markers
+        alias = local_types.get("#lock:" + expr.id)
+        return alias
+    return None
+
+
+def _local_info(fn: ast.FunctionDef, idx: PackageIndex,
+                cls: Optional[str]) -> Dict[str, str]:
+    """Best-effort local var typing: `x = ClassName(...)` and lock
+    aliases `lk = self._lock` → "#lock:lk" marker entries."""
+    types: Dict[str, str] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        v = node.value
+        if isinstance(v, ast.Call):
+            d = dotted(v.func)
+            leaf = d.rsplit(".", 1)[-1]
+            if leaf in idx.classes:
+                types[tgt.id] = leaf
+        elif isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name):
+            owner = cls if v.value.id == "self" else types.get(v.value.id)
+            ld = idx.lock_for_attr(owner, v.attr)
+            if ld is not None:
+                types["#lock:" + tgt.id] = ld.lock_id
+    return types
+
+
+def _first_name_literal(node: ast.AST) -> Optional[str]:
+    """Leading literal text of a name expression (handles f-strings)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+    return None
+
+
+# ---------------------------------------------------------------------
+# FLX101/102/103 — thread lifecycle
+# ---------------------------------------------------------------------
+def check_threads(idx: PackageIndex, findings: List[Finding]) -> None:
+    for site in idx.threads:
+        kw = {k.arg: k.value for k in site.call.keywords if k.arg}
+        # name: required, and any literal prefix must be "ff-"
+        name = kw.get("name")
+        if name is None and site.stored_attr == "<self>":
+            # Thread subclass __init__ may take the name positionally
+            name = next(iter(site.call.args), None)
+        tok = site.stored_attr or site.stored_local or "thread"
+        if name is None:
+            findings.append(make_finding(
+                "FLX101", site.file, site.line,
+                "thread created without name=: stall reports and stack "
+                "dumps cannot identify this worker (name it 'ff-...')",
+                scope=site.scope, token=tok))
+        else:
+            lit = _first_name_literal(name)
+            if lit is not None and not lit.startswith("ff-"):
+                findings.append(make_finding(
+                    "FLX101", site.file, site.line,
+                    f"thread name {lit!r} does not follow the 'ff-*' "
+                    f"convention the watchdog troubleshooting table "
+                    f"keys on", scope=site.scope, token=tok))
+        daemon = kw.get("daemon")
+        if not (isinstance(daemon, ast.Constant) and daemon.value is True):
+            findings.append(make_finding(
+                "FLX102", site.file, site.line,
+                "thread not daemon=True: a wedged worker would block "
+                "interpreter shutdown (watchdogs abandon daemons safely)",
+                scope=site.scope, token=tok))
+        _check_join(idx, site, findings, tok)
+
+
+def _joins_attr(tree: ast.AST, attr: str) -> bool:
+    """True when the tree joins (or delegates close/stop to) self.attr,
+    directly or via a local alias `t = self.attr` / getattr(self, 'attr')."""
+    aliases = {None}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            v = node.value
+            src = None
+            if (isinstance(v, ast.Attribute)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == "self" and v.attr == attr):
+                src = True
+            elif (isinstance(v, ast.Call) and dotted(v.func) == "getattr"
+                  and len(v.args) >= 2
+                  and isinstance(v.args[0], ast.Name)
+                  and v.args[0].id == "self"
+                  and isinstance(v.args[1], ast.Constant)
+                  and v.args[1].value == attr):
+                src = True
+            if src:
+                aliases.add(node.targets[0].id)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr not in ("join", "close", "stop", "shutdown",
+                                  "wait"):
+            continue
+        v = node.func.value
+        if (isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name)
+                and v.value.id == "self" and v.attr == attr):
+            return True
+        if isinstance(v, ast.Name) and v.id in aliases:
+            return True
+    return False
+
+
+def _check_join(idx: PackageIndex, site, findings: List[Finding],
+                tok: str) -> None:
+    if site.stored_attr == "<self>":
+        if site.cls in idx.self_joining:
+            return
+        findings.append(make_finding(
+            "FLX103", site.file, site.line,
+            f"Thread subclass {site.cls} never joins itself (no "
+            f"close()/stop() calling self.join) — leaked worker",
+            scope=site.scope, token=tok))
+        return
+    if site.stored_attr and site.cls:
+        _, cnode = idx.classes[site.cls]
+        if _joins_attr(cnode, site.stored_attr):
+            return
+        findings.append(make_finding(
+            "FLX103", site.file, site.line,
+            f"thread stored on self.{site.stored_attr} is never joined "
+            f"on any close()/shutdown() path of {site.cls}",
+            scope=site.scope, token=tok))
+        return
+    # purely local thread: must be joined (or handed to a self-joining
+    # owner) inside the same function
+    fn = site.func
+    if fn is None:
+        findings.append(make_finding(
+            "FLX103", site.file, site.line,
+            "module-level thread is never joined", scope=site.scope,
+            token=tok))
+        return
+    var = site.stored_local
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and isinstance(node.func.value, ast.Name)
+                and (var is None or node.func.value.id == var)):
+            return
+    findings.append(make_finding(
+        "FLX103", site.file, site.line,
+        f"local thread {var or '<anonymous>'} is never joined in "
+        f"{site.scope} — the worker outlives the call that spawned it",
+        scope=site.scope, token=tok))
+
+
+# ---------------------------------------------------------------------
+# FLX201 — attribute written both inside and outside lock scopes
+# ---------------------------------------------------------------------
+_INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+
+def check_racy_attributes(idx: PackageIndex,
+                          findings: List[Finding]) -> None:
+    for cname, (file, cnode) in idx.classes.items():
+        locked: Dict[str, int] = {}
+        unlocked: Dict[str, Tuple[int, str]] = {}
+
+        def visit(node: ast.AST, held: bool, meth: str) -> None:
+            if isinstance(node, ast.With):
+                acquires = any(
+                    _with_lock_ids(item, idx, cname, file, {})
+                    for item in node.items)
+                for child in node.body:
+                    visit(child, held or acquires, meth)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for child in node.body:   # worker closures: same rules
+                    visit(child, False, meth)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = (node.targets if isinstance(node, ast.Assign)
+                        else [node.target])
+                for tgt in tgts:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        if held:
+                            locked.setdefault(tgt.attr, node.lineno)
+                        elif meth not in _INIT_METHODS:
+                            unlocked.setdefault(tgt.attr,
+                                                (node.lineno, meth))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held, meth)
+
+        for item in cnode.body:
+            if isinstance(item, ast.FunctionDef):
+                for child in item.body:
+                    visit(child, False, item.name)
+        for attr in sorted(set(locked) & set(unlocked)):
+            line, meth = unlocked[attr]
+            findings.append(make_finding(
+                "FLX201", file, line,
+                f"{cname}.{attr} is written under a lock (line "
+                f"{locked[attr]}) but also without one in {meth}() — "
+                f"racing writers can tear/lose updates",
+                scope=f"{cname}.{meth}", token=attr))
+
+
+# ---------------------------------------------------------------------
+# FLX202/203 — lock-order graph + blocking-under-lock
+# ---------------------------------------------------------------------
+def _direct_blocking_calls(fn: ast.FunctionDef
+                           ) -> List[Tuple[str, int]]:
+    out = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        leaf = d.rsplit(".", 1)[-1]
+        if (d in BLOCKING_DOTTED or d in BLOCKING_NAMES
+                or (isinstance(node.func, ast.Attribute)
+                    and leaf in BLOCKING_ATTRS)):
+            out.append((d or leaf, node.lineno))
+    return out
+
+
+class LockWalker:
+    """Per-function walk tracking the held-lock stack; feeds both the
+    lock-order graph and the blocking-under-lock rule."""
+
+    def __init__(self, idx: PackageIndex):
+        self.idx = idx
+        # lock-order edges: (lockA, lockB) -> (file, line, scope)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        self.blocking: List[Finding] = []
+        self._lockset_memo: Dict[str, Set[str]] = {}
+
+    # transitive set of locks a function may acquire
+    def lockset(self, fi: FuncInfo, stack: Tuple[str, ...] = ()
+                ) -> Set[str]:
+        if fi.qualname in self._lockset_memo:
+            return self._lockset_memo[fi.qualname]
+        if fi.qualname in stack:
+            return set()
+        out: Set[str] = set()
+        locals_ = _local_info(fi.node, self.idx, fi.cls)
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lid = _with_lock_ids(item, self.idx, fi.cls, fi.file,
+                                         locals_)
+                    if lid:
+                        out.add(lid)
+            elif isinstance(node, ast.Call):
+                callee = self.idx.resolve_call(node, fi.cls, fi.file)
+                if callee is not None and callee.qualname != fi.qualname:
+                    out |= self.lockset(callee,
+                                        stack + (fi.qualname,))
+        self._lockset_memo[fi.qualname] = out
+        return out
+
+    def walk_function(self, fi: FuncInfo) -> None:
+        locals_ = _local_info(fi.node, self.idx, fi.cls)
+        self._walk(fi, fi.node.body, (), locals_)
+
+    def _walk(self, fi: FuncInfo, body, held: Tuple[str, ...],
+              locals_: Dict[str, str]) -> None:
+        for node in body:
+            self._visit(fi, node, held, locals_)
+
+    def _visit(self, fi: FuncInfo, node: ast.AST,
+               held: Tuple[str, ...], locals_: Dict[str, str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def bodies run later, not under the current locks —
+            # walked separately with an empty stack
+            nested = FuncInfo(f"{fi.qualname}.{node.name}", fi.file,
+                              fi.cls, node.name, node)
+            self._walk(nested, node.body, (), locals_)
+            return
+        if isinstance(node, ast.With):
+            acquired = []
+            cond_objs = []
+            for item in node.items:
+                lid = _with_lock_ids(item, self.idx, fi.cls, fi.file,
+                                     locals_)
+                if lid:
+                    acquired.append((lid, item, node.lineno))
+                    cond_objs.append(dotted(item.context_expr))
+            for lid, _, line in acquired:
+                for h in held:
+                    if h != lid:
+                        self.edges.setdefault(
+                            (h, lid), (fi.file, line, fi.qualname))
+            new_held = held + tuple(lid for lid, _, _ in acquired)
+            for child in node.body:
+                self._visit(fi, child, new_held, locals_)
+            return
+        if isinstance(node, ast.Call):
+            self._check_call(fi, node, held, locals_)
+        for child in ast.iter_child_nodes(node):
+            self._visit(fi, child, held, locals_)
+
+    def _check_call(self, fi: FuncInfo, node: ast.Call,
+                    held: Tuple[str, ...],
+                    locals_: Dict[str, str]) -> None:
+        if not held:
+            return
+        d = dotted(node.func)
+        leaf = d.rsplit(".", 1)[-1]
+        # condition self-wait releases the lock — never blocking
+        if leaf == "wait":
+            owner = d.rsplit(".", 1)[0] if "." in d else ""
+            lid = None
+            if owner:
+                parts = owner.split(".")
+                if parts[0] == "self" and len(parts) == 2 and fi.cls:
+                    ld = self.idx.lock_for_attr(fi.cls, parts[1])
+                    lid = ld.lock_id if ld else None
+            if lid in held:
+                return
+        critical = [h for h in held
+                    if CRITICAL_LOCK_RE.search(h.rsplit(".", 1)[-1])]
+        if not critical:
+            # still propagate edges through callees for the order graph
+            callee = self.idx.resolve_call(node, fi.cls, fi.file)
+            if callee is not None:
+                for m in self.lockset(callee):
+                    for h in held:
+                        if h != m:
+                            self.edges.setdefault(
+                                (h, m), (fi.file, node.lineno,
+                                         fi.qualname))
+            return
+        blocking = (d in BLOCKING_DOTTED or d in BLOCKING_NAMES
+                    or (isinstance(node.func, ast.Attribute)
+                        and leaf in BLOCKING_ATTRS))
+        if blocking:
+            self.blocking.append(make_finding(
+                "FLX203", fi.file, node.lineno,
+                f"{d or leaf}() while holding {', '.join(critical)} — "
+                f"blocks every thread contending for the lock",
+                scope=fi.qualname, token=f"{critical[-1]}:{d or leaf}"))
+            return
+        callee = self.idx.resolve_call(node, fi.cls, fi.file)
+        if callee is not None:
+            for what, line in _direct_blocking_calls(callee.node):
+                self.blocking.append(make_finding(
+                    "FLX203", fi.file, node.lineno,
+                    f"call to {callee.qualname}() runs {what}() while "
+                    f"holding {', '.join(critical)}",
+                    scope=fi.qualname,
+                    token=f"{critical[-1]}:{callee.name}.{what}"))
+                break   # one finding per call site, not per io op
+            for m in self.lockset(callee):
+                for h in held:
+                    if h != m:
+                        self.edges.setdefault(
+                            (h, m), (fi.file, node.lineno, fi.qualname))
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], Tuple[str, int, str]]
+                 ) -> List[List[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: List[List[str]] = []
+    seen_keys: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            visited: Set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(path) > 1:
+                rot = min(range(len(path)),
+                          key=lambda i: path[i])
+                canon = tuple(path[rot:] + path[:rot])
+                if canon not in seen_keys:
+                    seen_keys.add(canon)
+                    cycles.append(list(canon))
+            elif nxt not in visited and nxt > start:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    # also catch 2-cycles A<->B (path len 2 handled above via len>1)
+    for a, b in edges:
+        if (b, a) in edges and (min(a, b), max(a, b)) not in seen_keys:
+            seen_keys.add((min(a, b), max(a, b)))
+            cycles.append([min(a, b), max(a, b)])
+    return cycles
+
+
+def check_locks(idx: PackageIndex, findings: List[Finding]) -> None:
+    walker = LockWalker(idx)
+    for fi in list(idx.funcs.values()):
+        walker.walk_function(fi)
+    findings.extend(walker.blocking)
+    for cyc in _find_cycles(walker.edges):
+        sites = []
+        for i, a in enumerate(cyc):
+            b = cyc[(i + 1) % len(cyc)]
+            site = walker.edges.get((a, b))
+            if site:
+                sites.append(f"{a}->{b} at {site[0]}:{site[1]}")
+        file, line, scope = next(
+            (walker.edges[(a, b)] for i, a in enumerate(cyc)
+             for b in [cyc[(i + 1) % len(cyc)]]
+             if (a, b) in walker.edges), ("<package>", 0, ""))
+        findings.append(make_finding(
+            "FLX202", file, line,
+            "lock-order cycle (deadlock hazard): "
+            + " ; ".join(sites), scope=scope,
+            token="|".join(cyc)))
+
+
+# ---------------------------------------------------------------------
+# FLX301/302/303/304 — JAX hazards
+# ---------------------------------------------------------------------
+def check_jax_hazards(idx: PackageIndex,
+                      findings: List[Finding]) -> None:
+    for rel, tree in idx.modules.items():
+        _check_import_time_jax(rel, tree, findings)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                _check_exec_cache_key(rel, node, findings)
+        _check_scan_rules(idx, rel, tree, findings)
+
+
+def _check_import_time_jax(rel: str, tree: ast.Module,
+                           findings: List[Finding]) -> None:
+    def scan(body, scope):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                scan(stmt.body, f"{scope or ''}{stmt.name}")
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    break
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if (d.startswith("jnp.") or d.startswith("jax.numpy.")
+                        or d in IMPORT_TIME_JAX):
+                    findings.append(make_finding(
+                        "FLX302", rel, node.lineno,
+                        f"{d}() runs at import time: forces JAX backend "
+                        f"init + device dispatch before main() configures "
+                        f"anything", scope=scope or "<module>", token=d))
+
+    scan(tree.body, "")
+
+
+def _check_exec_cache_key(rel: str, node: ast.Assign,
+                          findings: List[Finding]) -> None:
+    v = node.value
+    compiled = (isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "compile")
+    if not compiled:
+        return
+    for tgt in node.targets:
+        if not isinstance(tgt, ast.Subscript):
+            continue
+        base = dotted(tgt.value).rsplit(".", 1)[-1]
+        if not re.search(r"exec|cache", base, re.I):
+            continue
+        if isinstance(tgt.slice, ast.Constant):
+            findings.append(make_finding(
+                "FLX301", rel, node.lineno,
+                f"compiled executable stored in {base!r} under constant "
+                f"key {tgt.slice.value!r}: different batch shapes would "
+                f"silently reuse one executable — key on the shape "
+                f"signature", scope="", token=base))
+
+
+def _scan_call_bodies(tree: ast.Module) -> List[Tuple[ast.FunctionDef,
+                                                      ast.Call]]:
+    """(body_fn, scan_call) for lax.scan/fori/while calls whose body is
+    a locally-defined function."""
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs[node.name] = node
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if not d.endswith(("lax.scan", "lax.fori_loop", "lax.while_loop")):
+            continue
+        if node.args and isinstance(node.args[0], ast.Name):
+            body = defs.get(node.args[0].id)
+            if body is not None:
+                out.append((body, node))
+    return out
+
+
+def _check_scan_rules(idx: PackageIndex, rel: str, tree: ast.Module,
+                      findings: List[Finding]) -> None:
+    # FLX304: Python branches on traced params inside scan bodies
+    for body, call in _scan_call_bodies(tree):
+        params = {a.arg for a in body.args.args}
+        for node in ast.walk(body):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            names = {n.id for n in ast.walk(node.test)
+                     if isinstance(n, ast.Name)}
+            traced = names & params
+            if traced:
+                findings.append(make_finding(
+                    "FLX304", rel, node.lineno,
+                    f"Python {'if' if isinstance(node, ast.If) else 'while'}"
+                    f" on traced value(s) {sorted(traced)} inside scan "
+                    f"body {body.name}(): raises at trace time or "
+                    f"silently bakes one branch in",
+                    scope=body.name, token=",".join(sorted(traced))))
+    # FLX303: train-shaped functions containing lax.scan must be jitted
+    # with donated carries
+    scan_owners: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and any(
+                isinstance(c, ast.Call)
+                and dotted(c.func).endswith("lax.scan")
+                for c in ast.walk(node)):
+            if re.search(r"train|superstep|step", node.name):
+                scan_owners.add(node.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted(node.func) not in ("jax.jit", "jit"):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in scan_owners):
+            continue
+        scan_owners.discard(node.args[0].id)   # jitted: check kwargs
+        kws = {k.arg for k in node.keywords}
+        if "donate_argnums" not in kws and "donate_argnames" not in kws:
+            findings.append(make_finding(
+                "FLX303", rel, node.lineno,
+                f"jax.jit({node.args[0].id}) fuses a lax.scan train body "
+                f"without donate_argnums: the scanned carries "
+                f"double-buffer params+opt state every superstep",
+                scope="", token=node.args[0].id))
+
+
+# ---------------------------------------------------------------------
+# FLX401 — unchecked env parsing
+# ---------------------------------------------------------------------
+def _env_sourced_names(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            if _is_env_expr(node.value):
+                out.add(node.targets[0].id)
+    return out
+
+
+def _is_env_expr(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        d = dotted(sub) if isinstance(sub, (ast.Attribute, ast.Name)) \
+            else ""
+        if d.startswith("os.environ") or d == "os.getenv":
+            return True
+        if isinstance(sub, ast.Call) and dotted(sub.func) in (
+                "os.environ.get", "os.getenv"):
+            return True
+    return False
+
+
+def _guarded_by_valueerror(node: ast.AST,
+                           parents: Dict[ast.AST, ast.AST]) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Try):
+            for h in cur.handlers:
+                names = []
+                t = h.type
+                if isinstance(t, ast.Tuple):
+                    names = [dotted(e) for e in t.elts]
+                elif t is not None:
+                    names = [dotted(t)]
+                if any(n in ("ValueError", "Exception", "TypeError")
+                       for n in names):
+                    return True
+        cur = parents.get(cur)
+    return False
+
+
+def check_env_parsing(idx: PackageIndex,
+                      findings: List[Finding]) -> None:
+    for rel, tree in idx.modules.items():
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for fn in [n for n in ast.walk(tree)
+                   if isinstance(n, ast.FunctionDef)]:
+            if "env" in fn.name and fn.name.startswith("_env"):
+                continue   # the sanctioned parse helpers
+            env_vars = _env_sourced_names(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted(node.func) not in ("int", "float"):
+                    continue
+                if not node.args:
+                    continue
+                arg = node.args[0]
+                from_env = _is_env_expr(arg) or any(
+                    isinstance(n, ast.Name) and n.id in env_vars
+                    for n in ast.walk(arg))
+                if not from_env:
+                    continue
+                if _guarded_by_valueerror(node, parents):
+                    continue
+                findings.append(make_finding(
+                    "FLX401", rel, node.lineno,
+                    f"{dotted(node.func)}() on an os.environ value in "
+                    f"{fn.name}() without a ValueError guard: a typo'd "
+                    f"env var becomes an unhandled crash (or silent "
+                    f"mis-parse) with no variable name in the error",
+                    scope=fn.name, token=ast.unparse(arg)[:40]))
+
+
+ALL_PASSES = (check_threads, check_racy_attributes, check_locks,
+              check_jax_hazards, check_env_parsing)

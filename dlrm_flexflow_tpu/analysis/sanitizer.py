@@ -1,0 +1,268 @@
+"""Runtime lock-order sanitizer (lockdep-lite), opt-in via FF_SANITIZE=1.
+
+The static passes in this package reason about the code; this module
+watches the PROCESS. Every interesting lock in the framework is created
+through :func:`make_lock`:
+
+- ``FF_SANITIZE`` unset/0 (the default): :func:`make_lock` returns a
+  plain ``threading.Lock`` — literally the same object type as before,
+  zero proxy overhead on the hot path (tests pin this by type identity
+  and a micro-benchmark bound).
+- ``FF_SANITIZE=1``: the returned :class:`TrackedLock` records, per
+  thread, the stack of held locks and feeds a process-global
+  acquisition-order graph. Three checks run live:
+
+  1. **Lock-order cycles** (ThreadSanitizer's deadlock inference): if
+     lock B is ever acquired while holding A, the edge A→B is recorded;
+     a later acquisition establishing a path B→…→A reports a cycle —
+     BEFORE the interleaving that would actually deadlock ever runs.
+  2. **Held-too-long**: a lock held longer than
+     ``FF_SANITIZE_HOLD_S`` (default 1.0s) is reported on release —
+     the serving engine's p99 lives under these locks.
+  3. **Dispatch-under-lock**: locks created with ``no_dispatch=True``
+     (the engine's dispatch/swap lock, the model's host-table lock)
+     must never be held across a JAX dispatch; the model's dispatch
+     sites call :func:`note_jax_dispatch`, and a violation raises
+     :class:`DispatchUnderLock` (a
+     :class:`~..utils.watchdog.WorkerStalled`) carrying the structured
+     StallReport.
+
+Violations are recorded in a process-global list (:func:`violations`)
+and logged; only dispatch-under-lock raises (it is always a bug in THIS
+process's call stack). ``FF_SANITIZE=strict`` additionally raises on
+lock-order cycles — used by the fixtures that pin detection.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+_MODE = os.environ.get("FF_SANITIZE", "0").strip().lower()
+_ENABLED = _MODE not in ("", "0", "false", "off")
+_STRICT = _MODE == "strict"
+_HOLD_S = float(os.environ.get("FF_SANITIZE_HOLD_S", "1.0") or 0)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def override(on: bool, strict: bool = False, hold_s: Optional[float]
+             = None):
+    """Context manager flipping the sanitizer for tests. Only affects
+    locks CREATED inside the scope (existing plain locks stay plain)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _scope():
+        global _ENABLED, _STRICT, _HOLD_S
+        prev = (_ENABLED, _STRICT, _HOLD_S)
+        _ENABLED, _STRICT = bool(on), bool(strict)
+        if hold_s is not None:
+            _HOLD_S = float(hold_s)
+        try:
+            yield
+        finally:
+            _ENABLED, _STRICT, _HOLD_S = prev
+
+    return _scope()
+
+
+class LockOrderViolation(RuntimeError):
+    """A lock-acquisition order cycle was observed (deadlock hazard)."""
+
+    def __init__(self, report):
+        super().__init__(str(report))
+        self.report = report
+
+
+class DispatchUnderLock(RuntimeError):
+    """JAX dispatch attempted while holding a no-dispatch lock."""
+
+    def __init__(self, report):
+        super().__init__(str(report))
+        self.report = report
+
+
+class _State:
+    """Process-global sanitizer state: the acquisition graph + record of
+    violations. Its own plain (untracked) lock guards the graph."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # edge "A" -> set of "B" acquired while holding A, with one
+        # representative site per edge
+        self.graph: Dict[str, Set[str]] = {}
+        self.edge_site: Dict[Tuple[str, str], str] = {}
+        self.violations: List = []   # StallReport list
+        self.tls = threading.local()
+
+    def held(self) -> List["TrackedLock"]:
+        return getattr(self.tls, "stack", [])
+
+    def _path(self, a: str, b: str) -> Optional[List[str]]:
+        """Edge path a→…→b in the graph, or None."""
+        seen = {a}
+        stack = [(a, [a])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(self.graph.get(node, ())):
+                if nxt == b:
+                    return path + [b]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+
+_STATE = _State()
+
+
+def _stall_report(waiting_for: str, detail: str, waited_s: float = 0.0,
+                  deadline_s: float = 0.0):
+    from ..utils.watchdog import StallReport
+    return StallReport(worker=threading.current_thread().name,
+                       waiting_for=waiting_for, waited_s=waited_s,
+                       deadline_s=deadline_s, detail=detail)
+
+
+def _log():
+    from ..utils.logging import get_logger
+    return get_logger("sanitizer")
+
+
+class TrackedLock:
+    """Named ``threading.Lock`` proxy feeding the sanitizer. API-matches
+    the subset of Lock the framework uses (acquire/release/context
+    manager/locked)."""
+
+    __slots__ = ("name", "no_dispatch", "_lock", "_t_acquired")
+
+    def __init__(self, name: str, no_dispatch: bool = False):
+        self.name = name
+        self.no_dispatch = no_dispatch
+        self._lock = threading.Lock()
+        self._t_acquired = 0.0
+
+    # --- Lock protocol -------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            try:
+                self._note_acquired()
+            except BaseException:   # strict-mode cycle report: do not
+                self._lock.release()   # leave the lock held behind the
+                raise                  # raising __enter__
+        return got
+
+    def release(self) -> None:
+        self._note_released()
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock {self.name!r}>"
+
+    # --- sanitizer hooks ----------------------------------------------
+    def _note_acquired(self) -> None:
+        st = _STATE
+        stack = getattr(st.tls, "stack", None)
+        if stack is None:
+            stack = st.tls.stack = []
+        self._t_acquired = time.monotonic()
+        if stack:
+            with st.lock:
+                for held in stack:
+                    if held.name == self.name:
+                        continue
+                    back = st._path(self.name, held.name)
+                    fresh = self.name not in st.graph.get(held.name,
+                                                          ())
+                    st.graph.setdefault(held.name, set()).add(self.name)
+                    st.edge_site.setdefault(
+                        (held.name, self.name),
+                        threading.current_thread().name)
+                    if back is not None and fresh:
+                        cyc = [held.name] + back
+                        rep = _stall_report(
+                            f"lock {self.name!r}",
+                            f"lock-order cycle: {' -> '.join(cyc)} "
+                            f"(opposite acquisition orders observed)")
+                        st.violations.append(rep)
+                        _log().error("lock-order cycle detected: %s",
+                                     rep)
+                        if _STRICT:
+                            raise LockOrderViolation(rep)
+        stack.append(self)
+
+    def _note_released(self) -> None:
+        st = _STATE
+        stack = getattr(st.tls, "stack", None)
+        if stack and self in stack:
+            stack.remove(self)
+        held = time.monotonic() - self._t_acquired
+        if _HOLD_S > 0 and held > _HOLD_S:
+            rep = _stall_report(
+                f"release of lock {self.name!r}",
+                f"lock held {held:.3g}s (> {_HOLD_S:.3g}s budget) — "
+                f"every contending thread stalled that long",
+                waited_s=held, deadline_s=_HOLD_S)
+            st.violations.append(rep)
+            _log().warning("lock held too long: %s", rep)
+
+
+def make_lock(name: str, no_dispatch: bool = False):
+    """The framework's lock factory. Disabled (the default): a plain
+    ``threading.Lock`` — zero overhead, type-identical to before.
+    Enabled: a named :class:`TrackedLock` feeding the sanitizer."""
+    if not _ENABLED:
+        return threading.Lock()
+    return TrackedLock(name, no_dispatch=no_dispatch)
+
+
+def note_jax_dispatch(what: str = "dispatch") -> None:
+    """Called at the model's JAX dispatch sites (device_put, compiled
+    executable calls). No-op unless the sanitizer is on; raises
+    :class:`DispatchUnderLock` when a no-dispatch lock is held."""
+    if not _ENABLED:
+        return
+    for held in _STATE.held():
+        if held.no_dispatch:
+            rep = _stall_report(
+                f"JAX {what}",
+                f"JAX {what} while holding no-dispatch lock "
+                f"{held.name!r}: device work (or a compile) under this "
+                f"lock stalls every contending thread")
+            _STATE.violations.append(rep)
+            raise DispatchUnderLock(rep)
+
+
+def violations() -> List:
+    """StallReports recorded so far (cycles + held-too-long +
+    dispatch-under-lock)."""
+    with _STATE.lock:
+        return list(_STATE.violations)
+
+
+def lock_graph() -> Dict[str, Set[str]]:
+    with _STATE.lock:
+        return {k: set(v) for k, v in _STATE.graph.items()}
+
+
+def reset() -> None:
+    """Clear the graph + violations (test isolation)."""
+    with _STATE.lock:
+        _STATE.graph.clear()
+        _STATE.edge_site.clear()
+        _STATE.violations.clear()

@@ -333,7 +333,13 @@ class FFModel:
         self.strategies = dict(strategies or {})
         if not self.strategies and self.config.import_strategy_file:
             from ..parallel.strategy_io import load_strategies
-            self.strategies = load_strategies(self.config.import_strategy_file)
+            # load-time validation: degrees must factorize THIS mesh and
+            # every entry must reference an op of THIS model (or a
+            # reference-style generic key) — a malformed file fails here
+            # with file+op+reason, not as a downstream GSPMD error
+            self.strategies = load_strategies(
+                self.config.import_strategy_file, num_devices=ndev,
+                known_ops={op.name for op in self.ops})
         if self.config.search_budget > 0 and not self.strategies:
             try:
                 from ..search.mcmc import optimize
@@ -1211,6 +1217,8 @@ class FFModel:
 
     def _device_batch(self, batch: Dict[str, np.ndarray],
                       with_label: bool = True) -> Dict[str, Any]:
+        from ..analysis import sanitizer as _san
+        _san.note_jax_dispatch("batch staging device_put")
         out = {}
         puts: Dict[str, tuple] = {}   # name -> (host array, sharding)
         host_only = getattr(self, "_host_only_inputs", set())
@@ -1582,6 +1590,8 @@ class FFModel:
         # the batch signature so alternating shapes (e.g. a remainder
         # batch) each compile once.
         key = self._exec_key(device_batch)
+        from ..analysis import sanitizer as _san
+        _san.note_jax_dispatch("train executable")
         execs = getattr(self, "_train_step_execs", None)
         if execs is None:
             execs = self._train_step_execs = {}
@@ -1692,8 +1702,12 @@ class FFModel:
         pool's internal serialization."""
         lk = getattr(self, "_host_table_lock", None)
         if lk is None:
-            import threading
-            lk = self._host_table_lock = threading.Lock()
+            from ..analysis.sanitizer import make_lock
+            # no_dispatch: gathers copy rows OUT under the lock and
+            # device_put after release; a dispatch in the critical
+            # section would stall the scatter worker (FLX203)
+            lk = self._host_table_lock = make_lock(
+                "FFModel._host_table_lock", no_dispatch=True)
         return lk
 
     def _worker_deadline_s(self) -> float:
@@ -1788,15 +1802,23 @@ class FFModel:
     def _host_emb_forward(self, host_idx):
         """Host-side gather for host-resident tables: numpy lookup on the
         already-read-back indices, rows shipped to the device at the op's
-        output sharding."""
-        out = {}
+        output sharding.
+
+        Only the table READ holds ``_host_lock`` (``host_lookup`` returns
+        fresh arrays, never views into the table); the ``device_put`` H2D
+        transfer happens after release — flexcheck's blocking-under-lock
+        rule (FLX203) pins that a dispatch never stalls the async scatter
+        worker contending for the same lock."""
+        rows = {}
         with self._host_lock:
             for op in self._host_resident_list:
-                val = op.host_lookup(self.host_params[op.name],
-                                     host_idx[op.name])
-                out[op.name] = jax.device_put(
-                    val, self._out_sharding[op.outputs[0].guid])
-        return out
+                rows[op.name] = op.host_lookup(self.host_params[op.name],
+                                               host_idx[op.name])
+        from ..analysis import sanitizer as _san
+        _san.note_jax_dispatch("host-table row device_put")
+        return {op.name: jax.device_put(
+                    rows[op.name], self._out_sharding[op.outputs[0].guid])
+                for op in self._host_resident_list}
 
     def _host_emb_update(self, host_idx, cts, step):
         opt = self.optimizer
@@ -1957,6 +1979,8 @@ class FFModel:
         if host_emb is not None:
             args = args + (host_emb,)
             key = key + ("host_emb",) + self._exec_key(host_emb)
+        from ..analysis import sanitizer as _san
+        _san.note_jax_dispatch("eval executable")
         execs = getattr(self, "_eval_step_execs", None)
         if execs is None:
             execs = self._eval_step_execs = OrderedDict()

@@ -148,7 +148,8 @@ class SingleDataLoader:
         self._orders: Dict[int, np.ndarray] = {0: order}
         self._rng_states: Dict[int, tuple] = {0: self.rng.get_state()}
         self._max_epoch = 0
-        self._sched_lock = threading.Lock()
+        from ..analysis.sanitizer import make_lock
+        self._sched_lock = make_lock("SingleDataLoader._sched_lock")
         self._idx = 0      # batches CONSUMED (absolute ordinal)
         self._depth = _config_depth(model, depth)
         self._prefetch = bool(prefetch) and self._depth > 0
@@ -207,13 +208,19 @@ class SingleDataLoader:
     def reset(self):
         """reference: dataloader reset() task."""
         self._close_pipe()
-        order = self._orders[min(self._consumed_epoch(), self._max_epoch)]
-        if self.shuffle:
-            order = order.copy()
-            self.rng.shuffle(order)
-        self._orders = {0: order}
-        self._rng_states = {0: self.rng.get_state()}
-        self._max_epoch = 0
+        # the staging thread is joined by _close_pipe, but the schedule
+        # mutation still happens under the schedule lock: every writer
+        # of _orders/_max_epoch holds it, so the invariant is checkable
+        # locally (and by flexcheck FLX201) instead of by teardown order
+        with self._sched_lock:
+            order = self._orders[min(self._consumed_epoch(),
+                                     self._max_epoch)]
+            if self.shuffle:
+                order = order.copy()
+                self.rng.shuffle(order)
+            self._orders = {0: order}
+            self._rng_states = {0: self.rng.get_state()}
+            self._max_epoch = 0
         self._idx = 0
 
     def next_host_batch(self) -> Dict[str, np.ndarray]:
@@ -260,9 +267,10 @@ class SingleDataLoader:
         self.rng.set_state((r[0], np.asarray(r[1], dtype=np.uint32),
                             int(r[2]), int(r[3]), float(r[4])))
         ce = self._consumed_epoch()
-        self._orders = {ce: order}
-        self._rng_states = {ce: self.rng.get_state()}
-        self._max_epoch = ce
+        with self._sched_lock:   # every _orders/_max_epoch writer holds
+            self._orders = {ce: order}   # the schedule lock (FLX201)
+            self._rng_states = {ce: self.rng.get_state()}
+            self._max_epoch = ce
 
     def __iter__(self) -> Iterator[Dict]:
         self.reset()

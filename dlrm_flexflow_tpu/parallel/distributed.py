@@ -35,6 +35,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .mesh import _prime_factors
 from ..utils.logging import get_logger
 
+
+def _env_int(key: str) -> int:
+    """Strict env-var int: a malformed value names its variable instead
+    of raising a bare ValueError frames away (flexcheck FLX401)."""
+    raw = os.environ[key]
+    try:
+        return int(raw.strip())
+    except ValueError:
+        raise ValueError(
+            f"{key}={raw!r}: expected an integer") from None
+
 log_dist = get_logger("distributed")
 
 
@@ -82,7 +93,8 @@ class ParticipantRegistry:
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         self.deadline_s = float(deadline_s)
-        self._lock = threading.Lock()
+        from ..analysis.sanitizer import make_lock
+        self._lock = make_lock("ParticipantRegistry._lock")
         now = time.monotonic()
         self._last: Dict = {p: now for p in participants}
 
@@ -210,8 +222,7 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     assembly — executes on one machine."""
     if cpu_devices_per_process is None and \
             "FF_CPU_DEVICES_PER_PROCESS" in os.environ:
-        cpu_devices_per_process = int(
-            os.environ["FF_CPU_DEVICES_PER_PROCESS"])
+        cpu_devices_per_process = _env_int("FF_CPU_DEVICES_PER_PROCESS")
     # NB: must not touch any backend-initializing API (even
     # jax.process_count()) before jax.distributed.initialize
     try:
@@ -225,9 +236,9 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     coordinator_address = coordinator_address or os.environ.get(
         "COORDINATOR_ADDRESS")
     if num_processes is None and "NUM_PROCESSES" in os.environ:
-        num_processes = int(os.environ["NUM_PROCESSES"])
+        num_processes = _env_int("NUM_PROCESSES")
     if process_id is None and "PROCESS_ID" in os.environ:
-        process_id = int(os.environ["PROCESS_ID"])
+        process_id = _env_int("PROCESS_ID")
     if coordinator_address is None and num_processes is None:
         # single host, or TPU pod with full auto-detection
         try:

@@ -29,7 +29,8 @@ dims list on both load and save. JSON files are written sample-first.
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Tuple
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .pconfig import ParallelConfig, StrategyMap
 
@@ -163,6 +164,103 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
     return out
 
 
+# --- validation ------------------------------------------------------------
+
+# the reference's shared generic keys (dlrm_strategy.py /
+# dlrm_strategy_hetero.cc): "embedding{i}" per table plus one entry per
+# op TYPE — legal in a strategy file even when no op carries the name
+# verbatim (FFModel._resolve_generic_strategy_keys maps them)
+_GENERIC_KEY_RE = re.compile(r"^(embedding\d+|embedding|linear|concat|"
+                             r"mse_loss)$")
+
+_VALID_DEVICE_TYPES = ("TPU", "CPU")
+_VALID_MEMORY_TYPES = ("FBM", "ZCM")
+
+
+class StrategyValidationError(ValueError):
+    """A strategy file failed load-time validation. The message always
+    names the file, the op, and the reason — the alternative is a
+    downstream GSPMD/sharding error naming neither."""
+
+    def __init__(self, path: str, op: str, reason: str):
+        super().__init__(f"strategy file {path!r}, op {op!r}: {reason}")
+        self.path = path
+        self.op = op
+        self.reason = reason
+
+
+def validate_strategies(strategies: StrategyMap,
+                        num_devices: Optional[int] = None,
+                        axis_sizes: Optional[Sequence[int]] = None,
+                        known_ops: Optional[Set[str]] = None,
+                        path: str = "<memory>") -> StrategyMap:
+    """Structural + mesh validation of a loaded strategy map.
+
+    Always checked: op names are non-empty, degrees are a non-empty
+    tuple of positive ints (ParallelConfig enforces positivity at
+    construction), device/memory types are from the schema's vocabulary.
+    With ``num_devices``/``axis_sizes``: each op's degrees must be
+    jointly expressible over the factorized target mesh
+    (``parallel.sharding.assign_indices`` — the exact feasibility rule
+    compile() uses). With ``known_ops``: every op must name a model op
+    (or a reference-style generic key like ``embedding3``/``linear``).
+
+    Returns the map unchanged so call sites can chain it; raises
+    :class:`StrategyValidationError` (a ``ValueError``) with
+    file + op + reason otherwise.
+    """
+    if axis_sizes is None and num_devices is not None:
+        from .mesh import structural_axis_sizes
+        axis_sizes = structural_axis_sizes(int(num_devices))
+    for name, pc in strategies.items():
+        if not name or not isinstance(name, str):
+            raise StrategyValidationError(
+                path, repr(name), "empty/non-string op name")
+        if not pc.degrees:
+            raise StrategyValidationError(
+                path, name, "no partition degrees (empty dims)")
+        if len(pc.degrees) > 6:
+            raise StrategyValidationError(
+                path, name,
+                f"{len(pc.degrees)} partition dims — more than any "
+                f"supported tensor rank (corrupt dims field?)")
+        if pc.device_type not in _VALID_DEVICE_TYPES:
+            raise StrategyValidationError(
+                path, name,
+                f"device_type {pc.device_type!r} not in "
+                f"{_VALID_DEVICE_TYPES}")
+        for m in pc.memory_types:
+            if m not in _VALID_MEMORY_TYPES:
+                raise StrategyValidationError(
+                    path, name,
+                    f"memory_type {m!r} not in {_VALID_MEMORY_TYPES}")
+        if axis_sizes is not None:
+            from .sharding import assignable
+            ndev = 1
+            for a in axis_sizes:
+                ndev *= a
+            if pc.num_parts > ndev:
+                raise StrategyValidationError(
+                    path, name,
+                    f"degrees {pc.degrees} need {pc.num_parts} parts "
+                    f"but the target mesh has {ndev} device(s)")
+            if not assignable(pc.degrees, axis_sizes):
+                raise StrategyValidationError(
+                    path, name,
+                    f"degrees {pc.degrees} do not factorize the target "
+                    f"mesh axes {list(axis_sizes)} (no contiguous axis "
+                    f"assignment multiplies to each degree)")
+        if known_ops is not None and name not in known_ops \
+                and not _GENERIC_KEY_RE.match(name):
+            preview = sorted(known_ops)[:8]
+            raise StrategyValidationError(
+                path, name,
+                f"references no op of this model (known ops include "
+                f"{preview}...) and is not a generic key "
+                f"(embedding<i>/linear/concat/mse_loss)")
+    return strategies
+
+
 # --- public API ------------------------------------------------------------
 
 
@@ -181,16 +279,30 @@ def save_strategies(path: str, strategies: StrategyMap) -> None:
         json.dump(doc, f, indent=1)
 
 
-def load_strategies(path: str) -> StrategyMap:
+def load_strategies(path: str, num_devices: Optional[int] = None,
+                    known_ops: Optional[Set[str]] = None) -> StrategyMap:
+    """Load + validate a strategy file. Structural validation always
+    runs; pass ``num_devices`` to also require every op's degrees to
+    factorize the target mesh, and ``known_ops`` to require every entry
+    to reference a real (or generic-keyed) op — malformed files fail
+    HERE with file + op + reason instead of as a downstream GSPMD
+    error."""
     if path.endswith(".pb"):
-        return load_strategies_pb(path)
-    with open(path) as f:
-        doc = json.load(f)
-    out: StrategyMap = {}
-    for entry in doc["ops"]:
-        out[entry["name"]] = ParallelConfig(
-            tuple(entry["dims"]),
-            device_type=entry.get("device_type", "TPU"),
-            device_ids=tuple(entry.get("device_ids", ())),
-            memory_types=tuple(entry.get("memory_types", ())))
-    return out
+        out = load_strategies_pb(path)
+    else:
+        with open(path) as f:
+            doc = json.load(f)
+        out = {}
+        for entry in doc["ops"]:
+            try:
+                out[entry["name"]] = ParallelConfig(
+                    tuple(entry["dims"]),
+                    device_type=entry.get("device_type", "TPU"),
+                    device_ids=tuple(entry.get("device_ids", ())),
+                    memory_types=tuple(entry.get("memory_types", ())))
+            except (KeyError, TypeError, ValueError) as e:
+                raise StrategyValidationError(
+                    path, str(entry.get("name", "?")),
+                    f"malformed entry: {e}") from None
+    return validate_strategies(out, num_devices=num_devices,
+                               known_ops=known_ops, path=path)

@@ -20,11 +20,12 @@ finer-grained invalidation is needed.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Dict
 
 import numpy as np
+
+from ..analysis.sanitizer import make_lock
 
 
 class EmbeddingCache:
@@ -40,7 +41,7 @@ class EmbeddingCache:
             raise ValueError(f"cache capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self._d: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = make_lock("EmbeddingCache._lock", no_dispatch=True)
         self.hits = 0
         self.misses = 0
         self.invalidations = 0

@@ -31,11 +31,13 @@ Operational contracts:
   carrying the structured :class:`~..utils.watchdog.StallReport`) instead
   of occupying a batch slot.
 - **Zero-downtime reload**: :class:`~.watcher.SnapshotWatcher` polls a
-  ``CheckpointManager`` directory and installs new params via
-  ``FFModel.swap_params`` under the engine's dispatch lock — in-flight
-  batches finish on the old weights, the next dispatch sees the new
-  ones, and every response carries the version (checkpoint step) it was
-  computed with: old-or-new, never a mix.
+  ``CheckpointManager`` directory and stages new params via
+  ``install_snapshot``; the batcher thread applies the swap BETWEEN
+  dispatches (the swap lock only guards the reference hand-off — no
+  lock is ever held across the dispatch itself, which ``FF_SANITIZE=1``
+  asserts). In-flight batches finish on the old weights, the next
+  dispatch sees the new ones, and every response carries the version
+  (checkpoint step) it was computed with: old-or-new, never a mix.
 - **Observability**: ``stats()`` reports p50/p99 latency, batch-fill
   fraction, queue depth, embedding-cache hit rate, reload counts, and
   the eval-executable-cache occupancy/evictions.
@@ -52,6 +54,7 @@ from typing import Any, Dict, List, NamedTuple, Optional
 
 import numpy as np
 
+from ..analysis.sanitizer import make_lock
 from ..data.dataloader import coalesce_batches
 from ..utils import faults
 from ..utils.logging import get_logger
@@ -220,13 +223,24 @@ class InferenceEngine:
         self._closing = False
         self._started = False
         self._thread: Optional[threading.Thread] = None
-        # dispatch/swap critical section: params are read (dispatch) and
-        # swapped (hot reload) only under this lock
-        self._swap_lock = threading.Lock()
+        # swap staging: a hot reload PARKS the new state here under the
+        # lock; the batcher applies it between dispatches. The lock only
+        # ever guards reference hand-off — never file IO, device_puts,
+        # or the dispatch itself (the FF_SANITIZE no-dispatch assertion
+        # and flexcheck's FLX203 both pin that), so a slow reload can
+        # never stall the serving hot path behind the lock.
+        self._swap_lock = make_lock(
+            f"InferenceEngine._swap_lock[{replica_id}]",
+            no_dispatch=True)
+        self._pending_swap: Optional[tuple] = None
         self._version = int(getattr(model, "_step", 0))
+        # version of the params the batcher has actually applied; the
+        # response tag (== _version once the pending swap lands)
+        self._applied_version = self._version
         # stats (their own lock: stats() readers race the batcher's
         # appends — iterating a deque mid-append raises)
-        self._stats_lock = threading.Lock()
+        self._stats_lock = make_lock(
+            f"InferenceEngine._stats_lock[{replica_id}]")
         self._lat_ms: "deque[float]" = deque(maxlen=4096)
         self._n_requests = 0
         self._n_responses = 0
@@ -367,15 +381,22 @@ class InferenceEngine:
     # --- batcher -------------------------------------------------------
     def _batcher(self) -> None:
         while True:
+            # parked hot reloads apply HERE, on the dispatch thread,
+            # outside the condition lock — an idle engine picks a new
+            # snapshot up within one wakeup, a busy one between batches
+            self._apply_pending_swap()
             take: List[_Request] = []
             flush = "continuous"
             with self._cond:
                 self._heartbeat.beat()
-                while not self._q and not self._closing:
+                while (not self._q and not self._closing
+                        and self._pending_swap is None):
                     self._cond.wait(0.1)
                     self._heartbeat.beat()
                 if not self._q and self._closing:
                     return
+                if not self._q:   # woken only to apply a parked swap
+                    continue
                 if not self.config.continuous:
                     # flush-cycle mode: a batch is open from the moment
                     # its OLDEST request arrived; flush on size
@@ -425,14 +446,18 @@ class InferenceEngine:
 
         def gather(host_idx):
             import jax
-            out = {}
+            # rows come OUT under the table lock (lookup returns fresh
+            # arrays); the H2D device_put runs after release — same
+            # FLX203 discipline as FFModel._host_emb_forward
+            rows = {}
             with model._host_lock:
                 for op in model._host_resident_list:
-                    val = cache.lookup(op, model.host_params[op.name],
-                                       host_idx[op.name])
-                    out[op.name] = jax.device_put(
-                        val, model._out_sharding[op.outputs[0].guid])
-            return out
+                    rows[op] = cache.lookup(op,
+                                            model.host_params[op.name],
+                                            host_idx[op.name])
+            return {op.name: jax.device_put(
+                        rows[op], model._out_sharding[op.outputs[0].guid])
+                    for op in rows}
 
         return gather
 
@@ -461,15 +486,17 @@ class InferenceEngine:
         batch = coalesce_batches([r.features for r in live])
         n = sum(r.rows for r in live)
         bucket = next(b for b in self._buckets if b >= n)
-        # dispatch under the swap lock: the version tag and the params
-        # the executable reads are captured together, so a concurrent
-        # hot reload is either entirely before or entirely after this
-        # batch — never a mix
-        with self._swap_lock:
-            version = self._version
-            out = self._model.forward_bucket(
-                batch, bucket=bucket, host_gather=self._host_gather())
-        scores = np.asarray(out)          # device→host sync, outside lock
+        # apply any parked hot reload FIRST, then dispatch with NO lock
+        # held: the batcher thread is the only toucher of the model, so
+        # swap-then-dispatch on this thread gives the same atomicity the
+        # old dispatch-under-lock gave — a reload is entirely before or
+        # entirely after this batch, never a mix — without ever holding
+        # a lock across device work (the FF_SANITIZE=1 run asserts it)
+        self._apply_pending_swap()
+        version = self._applied_version
+        out = self._model.forward_bucket(
+            batch, bucket=bucket, host_gather=self._host_gather())
+        scores = np.asarray(out)          # device→host sync
         t_done = time.monotonic()
         off = 0
         for r in live:
@@ -488,18 +515,105 @@ class InferenceEngine:
     # --- hot reload (called by SnapshotWatcher) ------------------------
     def install_snapshot(self, state: Dict[str, Any], version: int,
                          source: str = "") -> None:
-        """Atomically swap in pre-loaded inference state (the output of
-        ``checkpoint.load_params_for_swap``) between dispatches."""
+        """Swap in pre-loaded inference state (the output of
+        ``checkpoint.load_params_for_swap``) between dispatches.
+
+        The caller's slow work (file read, CRC, device_put) already
+        happened outside any lock; this PARKS the new state under the
+        swap lock and the batcher thread applies it between dispatches —
+        the model is only ever touched by its dispatch thread, so
+        in-flight batches finish on the old weights and the next batch
+        sees the new ones (old-or-new, never a mix) WITHOUT any lock
+        being held across device work (the FF_SANITIZE no-dispatch
+        assertion pins that). The call returns once the swap has been
+        applied — callers (canary/rollback/watcher) observe the model
+        synchronously, exactly as when the swap ran under the dispatch
+        lock. On a batcher-less engine (not started / draining / called
+        from the batcher itself) the swap applies inline."""
+        params = state.get("params")
+        if params is not None:
+            import jax
+            old = jax.tree.structure(self._model.params)
+            new = jax.tree.structure(params)
+            if old != new:
+                raise ValueError(
+                    f"install_snapshot: params tree {new} does not match "
+                    f"the compiled model's {old} — a snapshot from a "
+                    f"differently-built model cannot hot-swap")
+        applied = threading.Event()
         with self._swap_lock:
+            superseded = self._pending_swap
+            self._pending_swap = (dict(state), int(version), source,
+                                  applied)
+            self._version = int(version)
+            self._reloads += 1
+            if superseded is not None:
+                # back-to-back installs: the engine moves straight past
+                # the superseded state — release its waiter
+                superseded[3].set()
+        t = self._thread
+        if (t is None or not t.is_alive()
+                or t is threading.current_thread()):
+            self._apply_pending_swap()
+            return
+        with self._cond:
+            self._cond.notify_all()   # wake an idle batcher to apply now
+        while not applied.wait(0.05):
+            t = self._thread
+            if t is None or not t.is_alive():   # batcher died mid-wait:
+                self._apply_pending_swap()      # no dispatch racer left
+                return
+
+    def _apply_pending_swap(self) -> None:
+        """Take the parked snapshot (if any) and swap it into the model.
+        Runs on the batcher thread between dispatches (or inline on a
+        batcher-less engine); the model mutation happens OUTSIDE the
+        swap lock — the lock only guards the reference hand-off."""
+        with self._swap_lock:
+            pending, self._pending_swap = self._pending_swap, None
+        if pending is None:
+            return
+        state, version, source, applied = pending
+        try:
             self._model.swap_params(params=state["params"],
                                     host_params=state.get("host_params"),
                                     op_state=state.get("op_state"))
-            self._version = int(version)
             if self._cache is not None:
                 self._cache.invalidate()
-            self._reloads += 1
-        log_serve.info("hot-reloaded weights to version %d%s", version,
-                       f" from {source}" if source else "")
+            self._applied_version = version
+            log_serve.info("hot-reloaded weights to version %d%s",
+                           version, f" from {source}" if source else "")
+        except BaseException as e:   # noqa: BLE001 — a failed apply must
+            # release the installer AND show up in stats, not kill the
+            # batcher (install_snapshot pre-validates the params tree,
+            # so this is a host-table/op-state shape surprise)
+            self.record_reload_reject(
+                f"staged snapshot (version {version}) failed to apply: "
+                f"{e}")
+        finally:
+            applied.set()
+
+    def state_snapshot(self) -> tuple:
+        """(state dict, version) of what this engine is serving — the
+        parked pending swap when one exists (it WILL be the next batch's
+        weights), else the model's current arrays. The fleet's rollback
+        capture and canary promotion read through this so they can never
+        grab a half-superseded view."""
+        with self._swap_lock:
+            pending = self._pending_swap
+            if pending is not None:
+                state, version = pending[0], pending[1]
+                m = self._model
+                return ({"params": state.get("params", m.params),
+                         "host_params": (state.get("host_params")
+                                         if state.get("host_params")
+                                         is not None else m.host_params),
+                         "op_state": (state.get("op_state")
+                                      if state.get("op_state") is not None
+                                      else m.op_state)}, version)
+        m = self._model
+        return ({"params": m.params, "host_params": m.host_params,
+                 "op_state": m.op_state}, self._applied_version)
 
     def record_reload_reject(self, reason: str) -> None:
         self._reload_rejects += 1

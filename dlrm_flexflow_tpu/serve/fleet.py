@@ -28,10 +28,10 @@ the router acts on, plus fleet-wide ``stats()`` aggregation.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..analysis.sanitizer import make_lock
 from ..utils.logging import get_logger
 from .engine import InferenceEngine, ReplicaDown, percentile
 
@@ -60,7 +60,7 @@ class Replica:
         # serves only duplicated traffic and never answers a client
         self.cohort = cohort
         self.state = HEALTHY
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"Replica._lock[{rid}]")
         self.consecutive_errors = 0
         self.ejected_at = 0.0
         self.last_error = ""
@@ -147,14 +147,12 @@ class Replica:
     # --- deployment helpers (used by the router's canary/shadow) -------
     def capture_rollback_state(self) -> None:
         """Snapshot the CURRENT inference state by reference before a
-        candidate snapshot is installed."""
-        m = self.engine.model
-        self.rollback_state = {
-            "params": m.params,
-            "host_params": m.host_params,
-            "op_state": m.op_state,
-        }
-        self.rollback_version = self.engine.version
+        candidate snapshot is installed. Reads through the engine's
+        ``state_snapshot`` so a reload parked-but-not-yet-applied is
+        captured (not the arrays it is about to supersede)."""
+        state, version = self.engine.state_snapshot()
+        self.rollback_state = state
+        self.rollback_version = version
 
     def restore_rollback_state(self) -> None:
         if self.rollback_state is None:

@@ -423,7 +423,8 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         self._thread: Optional[threading.Thread] = None
         self._thread_exc: Optional[BaseException] = None
-        self._manifest_lock = threading.Lock()
+        from ..analysis.sanitizer import make_lock
+        self._manifest_lock = make_lock("CheckpointManager._manifest_lock")
         self._sweep_orphan_tmps()
 
     # --- manifest ------------------------------------------------------

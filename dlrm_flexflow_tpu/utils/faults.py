@@ -96,7 +96,11 @@ subprocess kill-test needs):
 
 Unknown ``FF_FAULT_*`` keys are a WARNING, not a silent no-op: a typo'd
 key used to disable injection entirely, which made a passing resilience
-test meaningless.
+test meaningless. Malformed VALUES are harder errors still: a bad
+``rid:secs`` pair or non-integer count raises a ``ValueError`` naming
+the variable and the expected shape (``FF_FAULT_REPLICA_DOWN='1:x'``
+used to half-parse or blow up frames away from any mention of the env
+var that caused it).
 """
 
 from __future__ import annotations
@@ -165,7 +169,8 @@ class FaultPlan:
     fired: List[tuple] = field(default_factory=list)
 
     def __post_init__(self):
-        self._lock = threading.Lock()
+        from ..analysis.sanitizer import make_lock
+        self._lock = make_lock("FaultPlan._lock")
 
     def _record(self, hook: str, detail) -> None:
         self.fired.append((hook, detail))
@@ -182,6 +187,59 @@ _KNOWN_ENV_KEYS = ("FF_FAULT_NAN_STEPS", "FF_FAULT_TRUNCATE_CKPTS",
                    "FF_FAULT_STALL_COLLECTIVE", "FF_FAULT_SERVE_DELAY",
                    "FF_FAULT_CORRUPT_RELOAD", "FF_FAULT_REPLICA_DOWN",
                    "FF_FAULT_POISON_RELOAD")
+
+
+# --- strict env parsing ----------------------------------------------
+# A malformed value must fail LOUDLY with the variable named: a fault
+# schedule that half-parses (or ValueErrors three frames away from any
+# mention of FF_FAULT_*) leaves a resilience test silently exercising
+# nothing. flexcheck's FLX401 rule keeps all env parsing routed through
+# these helpers.
+def _env_int(key: str, raw: str) -> int:
+    try:
+        return int(raw.strip())
+    except ValueError:
+        raise ValueError(
+            f"{key}={raw!r}: expected an integer "
+            f"(e.g. {key}=2)") from None
+
+
+def _env_float(key: str, raw: str) -> float:
+    try:
+        return float(raw.strip())
+    except ValueError:
+        raise ValueError(
+            f"{key}={raw!r}: expected a number of seconds "
+            f"(e.g. {key}=0.5)") from None
+
+
+def _env_int_set(key: str, raw: str) -> Set[int]:
+    return {_env_int(key, s) for s in raw.split(",") if s.strip()}
+
+
+def _env_pairs(key: str, raw: str, val,
+               bare=None) -> list:
+    """Parse 'a:b,c:d' lists: each item is (int(a), val(b)); a bare item
+    (no colon) maps through `bare` (None = reject it)."""
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            head, tail = part.split(":", 1)
+            if ":" in tail:
+                raise ValueError(
+                    f"{key}={raw!r}: item {part!r} has more than one "
+                    f"':' — expected 'id:value'")
+            out.append((_env_int(key, head), val(key, tail)))
+        elif bare is None:
+            raise ValueError(
+                f"{key}={raw!r}: item {part!r} is missing its ':' "
+                f"(expected 'id:value')")
+        else:
+            out.append((None, bare(key, part)))
+    return out
 
 
 def plan_from_env() -> Optional[FaultPlan]:
@@ -216,50 +274,52 @@ def plan_from_env() -> Optional[FaultPlan]:
         return None
     plan = FaultPlan()
     if nan:
-        plan.nan_grad_steps = {int(s) for s in nan.split(",") if s.strip()}
+        plan.nan_grad_steps = _env_int_set("FF_FAULT_NAN_STEPS", nan)
     if trunc:
-        plan.truncate_checkpoints = int(trunc)
+        plan.truncate_checkpoints = _env_int("FF_FAULT_TRUNCATE_CKPTS",
+                                             trunc)
     if aborts:
-        plan.abort_writes = int(aborts)
+        plan.abort_writes = _env_int("FF_FAULT_ABORT_WRITES", aborts)
     if delay:
-        plan.write_delay_s = float(delay)
+        plan.write_delay_s = _env_float("FF_FAULT_WRITE_DELAY", delay)
     for part in ioerrs.split(","):
-        if ":" in part:
-            site, n = part.rsplit(":", 1)
-            plan.io_errors[site.strip()] = int(n)
-    for part in drop.split(","):
         part = part.strip()
         if not part:
             continue
-        if ":" in part:
-            step, cnt = part.split(":", 1)
-            plan.drop_device_steps[int(step)] = int(cnt)
-        else:
-            plan.drop_device_steps[int(part)] = 1
+        if ":" not in part:
+            raise ValueError(
+                f"FF_FAULT_IO_ERRORS={ioerrs!r}: item {part!r} is "
+                f"missing its ':' (expected 'site:count', e.g. "
+                f"ffbin_read:2)")
+        site, n = part.rsplit(":", 1)
+        plan.io_errors[site.strip()] = _env_int("FF_FAULT_IO_ERRORS", n)
+    for step, cnt in _env_pairs("FF_FAULT_DROP_DEVICE", drop, _env_int,
+                                bare=_env_int):
+        if step is None:                      # "=4" — one device, step 4
+            plan.drop_device_steps[cnt] = 1
+        else:                                 # "4:2" — 2 devices, step 4
+            plan.drop_device_steps[step] = cnt
     if stall_coll:
-        plan.stall_s["collective"] = float(stall_coll)
-    for part in serve_delay.split(","):
-        part = part.strip()
-        if not part:
-            continue
-        if ":" in part:                       # "rid:secs" — one replica
-            rid, secs = part.split(":", 1)
-            plan.serve_delay_replica[int(rid)] = float(secs)
-        else:                                 # bare seconds — everyone
-            plan.serve_delay_s = float(part)
-    for part in replica_down.split(","):
-        part = part.strip()
-        if not part:
-            continue
-        if ":" in part:                       # "rid:N" — N failures
-            rid, n = part.split(":", 1)
-            plan.replica_down[int(rid)] = int(n)
-        else:                                 # bare rid — dead forever
-            plan.replica_down[int(part)] = -1
+        plan.stall_s["collective"] = _env_float(
+            "FF_FAULT_STALL_COLLECTIVE", stall_coll)
+    for rid, secs in _env_pairs("FF_FAULT_SERVE_DELAY", serve_delay,
+                                _env_float, bare=_env_float):
+        if rid is None:                       # bare seconds — everyone
+            plan.serve_delay_s = secs
+        else:                                 # "rid:secs" — one replica
+            plan.serve_delay_replica[rid] = secs
+    for rid, n in _env_pairs("FF_FAULT_REPLICA_DOWN", replica_down,
+                             _env_int, bare=_env_int):
+        if rid is None:                       # bare rid — dead forever
+            plan.replica_down[n] = -1
+        else:                                 # "rid:N" — N failures
+            plan.replica_down[rid] = n
     if corrupt_reload:
-        plan.corrupt_reloads = int(corrupt_reload)
+        plan.corrupt_reloads = _env_int("FF_FAULT_CORRUPT_RELOAD",
+                                        corrupt_reload)
     if poison_reload:
-        plan.poison_reloads = int(poison_reload)
+        plan.poison_reloads = _env_int("FF_FAULT_POISON_RELOAD",
+                                       poison_reload)
     return plan
 
 

@@ -13,3 +13,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from dlrm_flexflow_tpu.utils.testing import ensure_cpu_devices  # noqa: E402
 
 ensure_cpu_devices(8)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """FF_SANITIZE=1 runs report (and fail on) any lock-order cycles /
+    held-too-long / dispatch-under-lock violations the suite provoked.
+    Tests that seed violations on purpose call ``sanitizer.reset()`` in
+    their teardown, so anything left here is a real finding."""
+    from dlrm_flexflow_tpu.analysis import sanitizer
+    if not sanitizer.enabled():
+        return
+    leftover = sanitizer.violations()
+    if leftover:
+        print("\nFF_SANITIZE: %d unexpected sanitizer violation(s):"
+              % len(leftover))
+        for rep in leftover:
+            print(f"  - {rep}")
+        session.exitstatus = 1
+    else:
+        print("\nFF_SANITIZE: no lock-order cycles / held-too-long / "
+              "dispatch-under-lock violations recorded")

@@ -1,0 +1,628 @@
+"""flexcheck tests: static passes (per-rule fixtures + the whole-package
+CI gate), the runtime lock-order sanitizer, and the strict FF_FAULT_*
+env parsing the analyzer's FLX401 rule keeps honest.
+
+The package gate is the PR's standing contract: `python -m
+dlrm_flexflow_tpu.analysis --fail-on high` must exit 0 on this tree —
+every high-severity finding is either fixed or carries a justified
+baseline entry.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from dlrm_flexflow_tpu.analysis import run_analysis, sanitizer
+from dlrm_flexflow_tpu.analysis.baseline import (DEFAULT_BASELINE,
+                                                 BaselineError,
+                                                 load_baseline,
+                                                 save_baseline,
+                                                 split_by_baseline)
+from dlrm_flexflow_tpu.analysis.findings import RULES
+from dlrm_flexflow_tpu.utils import faults
+
+
+def _findings(tmp_path, src, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return run_analysis(str(p))
+
+
+def _rules(found):
+    return sorted({f.rule for f in found})
+
+
+# =====================================================================
+# per-rule fixtures (positive + negative)
+# =====================================================================
+class TestThreadRules:
+    def test_unnamed_nondaemon_unjoined(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            def go():
+                t = threading.Thread(target=print)
+                t.start()
+        """)
+        assert _rules(found) == ["FLX101", "FLX102", "FLX103"]
+
+    def test_bad_prefix_flagged(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            def go():
+                t = threading.Thread(target=print, daemon=True,
+                                     name="worker-1")
+                t.start()
+                t.join()
+        """)
+        assert _rules(found) == ["FLX101"]
+        assert "'ff-'" in found[0].message or "ff-" in found[0].message
+
+    def test_compliant_thread_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            def go(i):
+                t = threading.Thread(target=print, daemon=True,
+                                     name=f"ff-worker-{i}")
+                t.start()
+                t.join()
+        """)
+        assert found == []
+
+    def test_self_stored_thread_joined_in_close(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=print, daemon=True,
+                                               name="ff-w")
+                    self._t.start()
+
+                def close(self):
+                    t = self._t
+                    t.join(5.0)
+        """)
+        assert found == []
+
+    def test_self_stored_thread_never_joined(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=print, daemon=True,
+                                               name="ff-w")
+                    self._t.start()
+        """)
+        assert _rules(found) == ["FLX103"]
+        assert "self._t" in found[0].message
+
+    def test_thread_subclass_self_joining(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            class Timer(threading.Thread):
+                def __init__(self, name):
+                    super().__init__(daemon=True, name=name)
+
+                def close(self):
+                    self.join(5.0)
+        """)
+        assert found == []
+
+
+class TestLockRules:
+    def test_racy_attribute(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def locked_inc(self):
+                    with self._lock:
+                        self.n += 1
+
+                def unlocked_inc(self):
+                    self.n += 1
+        """)
+        assert _rules(found) == ["FLX201"]
+        assert found[0].token == "n"
+
+    def test_consistent_locking_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def a(self):
+                    with self._lock:
+                        self.n += 1
+
+                def b(self):
+                    with self._lock:
+                        self.n = 0
+        """)
+        assert found == []
+
+    def test_lock_order_cycle(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            class A:
+                def __init__(self, b):
+                    self._alock = threading.Lock()
+                    self.b = b
+
+                def foo(self):
+                    with self._alock:
+                        self.b.into_b()
+
+                def a_leaf(self):
+                    with self._alock:
+                        pass
+
+            class B:
+                def __init__(self, a):
+                    self._block = threading.Lock()
+                    self.a = a
+
+                def into_b(self):
+                    with self._block:
+                        pass
+
+                def bar(self):
+                    with self._block:
+                        self.a.a_leaf()
+        """)
+        assert "FLX202" in _rules(found)
+        msg = next(f for f in found if f.rule == "FLX202").message
+        assert "A._alock" in msg and "B._block" in msg
+
+    def test_nested_same_class_no_cycle(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def ordered(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def also_ordered(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """)
+        assert found == []
+
+    def test_blocking_under_critical_lock(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+            import time
+
+            class Engine:
+                def __init__(self):
+                    self._swap_lock = threading.Lock()
+
+                def dispatch(self):
+                    with self._swap_lock:
+                        time.sleep(1)
+        """)
+        assert _rules(found) == ["FLX203"]
+        assert "time.sleep" in found[0].message
+
+    def test_blocking_outside_lock_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+            import time
+
+            class Engine:
+                def __init__(self):
+                    self._swap_lock = threading.Lock()
+
+                def dispatch(self):
+                    with self._swap_lock:
+                        v = 1
+                    time.sleep(v)
+        """)
+        assert found == []
+
+    def test_noncritical_lock_not_in_scope(self, tmp_path):
+        # stats locks may do slow-ish work; only dispatch/manifest/host/
+        # swap/deploy locks are in the FLX203 scope
+        found = _findings(tmp_path, """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._stats_lock = threading.Lock()
+
+                def f(self):
+                    with self._stats_lock:
+                        time.sleep(0.1)
+        """)
+        assert found == []
+
+    def test_blocking_via_callee(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            class M:
+                def __init__(self):
+                    self._manifest_lock = threading.Lock()
+
+                def write(self):
+                    with self._manifest_lock:
+                        self._io()
+
+                def _io(self):
+                    with open("/tmp/x", "w") as f:
+                        f.write("hi")
+        """)
+        assert _rules(found) == ["FLX203"]
+        assert "_io" in found[0].message
+
+
+class TestJaxRules:
+    def test_exec_cache_const_key(self, tmp_path):
+        found = _findings(tmp_path, """
+            class M:
+                def build(self, args):
+                    self._execs = {}
+                    self._execs["only"] = self._step.lower(*args).compile()
+        """)
+        assert _rules(found) == ["FLX301"]
+
+    def test_exec_cache_signature_key_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            class M:
+                def build(self, args):
+                    self._execs = {}
+                    key = self._exec_key(args)
+                    self._execs[key] = self._step.lower(*args).compile()
+        """)
+        assert found == []
+
+    def test_import_time_jnp(self, tmp_path):
+        found = _findings(tmp_path, """
+            import jax.numpy as jnp
+
+            SCALE = jnp.sqrt(2.0)
+
+            def fine():
+                return jnp.zeros(3)
+        """)
+        assert _rules(found) == ["FLX302"]
+        assert found[0].scope == "<module>"
+
+    def test_scan_without_donate(self, tmp_path):
+        found = _findings(tmp_path, """
+            import jax
+
+            def train_step(carry, xs):
+                return jax.lax.scan(lambda c, x: (c, x), carry, xs)
+
+            fn = jax.jit(train_step)
+        """)
+        assert _rules(found) == ["FLX303"]
+
+    def test_scan_with_donate_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            import jax
+
+            def train_step(carry, xs):
+                return jax.lax.scan(lambda c, x: (c, x), carry, xs)
+
+            fn = jax.jit(train_step, donate_argnums=(0,))
+        """)
+        assert found == []
+
+    def test_traced_python_branch(self, tmp_path):
+        found = _findings(tmp_path, """
+            import jax
+
+            def outer(xs):
+                def body(carry, x):
+                    if carry > 0:
+                        return carry, x
+                    return carry - 1, x
+                return jax.lax.scan(body, 0, xs)
+        """)
+        assert _rules(found) == ["FLX304"]
+        assert "carry" in found[0].message
+
+
+class TestEnvRule:
+    def test_unchecked_env_int(self, tmp_path):
+        found = _findings(tmp_path, """
+            import os
+
+            def parse():
+                raw = os.environ.get("FF_THING", "")
+                return int(raw)
+        """)
+        assert _rules(found) == ["FLX401"]
+
+    def test_guarded_env_int_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            import os
+
+            def parse():
+                raw = os.environ.get("FF_THING", "")
+                try:
+                    return int(raw)
+                except ValueError:
+                    raise ValueError(f"FF_THING={raw!r}: expected int")
+        """)
+        assert found == []
+
+
+# =====================================================================
+# baseline machinery
+# =====================================================================
+class TestBaseline:
+    def test_missing_justification_rejected(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text('{"suppressions": [{"key": "FLX101:x.py::t", '
+                     '"justification": "  "}]}')
+        with pytest.raises(BaselineError, match="justification"):
+            load_baseline(str(p))
+
+    def test_roundtrip_and_split(self, tmp_path):
+        p = tmp_path / "b.json"
+        save_baseline(str(p), {"k1": "because"})
+        assert load_baseline(str(p)) == {"k1": "because"}
+
+        class F:   # minimal finding stand-in
+            key = "k1"
+        fresh, supp, stale = split_by_baseline([F()], {"k1": "because",
+                                                       "dead": "x"})
+        assert not fresh and len(supp) == 1 and stale == ["dead"]
+
+    def test_suppression_key_is_line_insensitive(self, tmp_path):
+        src = """
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=print, daemon=True,
+                                               name="ff-w")
+                    self._t.start()
+        """
+        k1 = _findings(tmp_path, src, "a.py")[0].key
+        k2 = _findings(tmp_path, "\n\n# shifted\n" + textwrap.dedent(src),
+                       "a.py")[0].key
+        assert k1 == k2
+
+
+# =====================================================================
+# whole-package CI gate (the PR's standing acceptance bar)
+# =====================================================================
+class TestPackageGate:
+    def test_no_unbaselined_high_findings(self):
+        findings = run_analysis()   # the installed package tree
+        baseline = load_baseline(DEFAULT_BASELINE)
+        fresh, suppressed, stale = split_by_baseline(findings, baseline)
+        high = [f for f in fresh if f.severity == "high"]
+        assert not high, ("non-baselined high-severity findings:\n"
+                          + "\n".join(f.render() for f in high))
+        assert not stale, f"stale baseline entries (prune them): {stale}"
+
+    def test_every_baseline_entry_justified(self):
+        baseline = load_baseline(DEFAULT_BASELINE)
+        assert baseline, "expected a checked-in baseline"
+        for key, just in baseline.items():
+            assert len(just.strip()) > 20, (key, just)
+
+    def test_rule_table_complete(self):
+        for rid, (name, sev, doc) in RULES.items():
+            assert rid.startswith("FLX") and name and doc
+            assert sev in ("info", "low", "medium", "high")
+
+    @pytest.mark.slow
+    def test_cli_gate_subprocess(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "dlrm_flexflow_tpu.analysis",
+             "--fail-on", "high"],
+            cwd=_REPO, capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stdout + out.stderr
+
+
+# =====================================================================
+# runtime sanitizer
+# =====================================================================
+class TestSanitizer:
+    def test_disabled_is_plain_lock(self):
+        # FF_SANITIZE=0 must be a TRUE no-op: the factory hands back a
+        # bare threading.Lock, not a proxy
+        lk = sanitizer.make_lock("x")
+        assert type(lk) is type(threading.Lock())
+
+    def test_disabled_overhead_bound(self):
+        # micro-benchmark bound: 100k acquire/release through a
+        # make_lock product stays cheap (it IS threading.Lock), and the
+        # disabled dispatch hook is a constant-time flag check
+        lk = sanitizer.make_lock("bench")
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            with lk:
+                pass
+        lock_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            sanitizer.note_jax_dispatch()
+        note_s = time.perf_counter() - t0
+        assert lock_s < 2.0, f"plain-lock path slowed: {lock_s:.3f}s"
+        assert note_s < 1.0, f"disabled hook not O(1): {note_s:.3f}s"
+
+    def test_lock_order_inversion_detected_deterministically(self):
+        with sanitizer.override(True):
+            a = sanitizer.make_lock("fixture.A")
+            b = sanitizer.make_lock("fixture.B")
+            try:
+                def order_ab():
+                    with a:
+                        with b:
+                            pass
+
+                t = threading.Thread(target=order_ab, daemon=True,
+                                     name="ff-test-ab")
+                t.start()
+                t.join()
+                assert sanitizer.violations() == []
+                with b:        # opposite order on this thread:
+                    with a:    # edge B->A closes the A->B cycle
+                        pass
+                vios = sanitizer.violations()
+                assert len(vios) == 1
+                assert "cycle" in vios[0].detail
+                assert "fixture.A" in vios[0].detail
+                assert "fixture.B" in vios[0].detail
+            finally:
+                sanitizer.reset()
+
+    def test_strict_mode_raises_on_cycle(self):
+        with sanitizer.override(True, strict=True):
+            a = sanitizer.make_lock("strict.A")
+            b = sanitizer.make_lock("strict.B")
+            try:
+                def order_ab():
+                    with a:
+                        with b:
+                            pass
+                t = threading.Thread(target=order_ab, daemon=True,
+                                     name="ff-test-strict")
+                t.start()
+                t.join()
+                with pytest.raises(sanitizer.LockOrderViolation):
+                    with b:
+                        with a:
+                            pass
+                # the raising acquire must not leak the lock
+                assert not a._lock.locked()
+            finally:
+                sanitizer.reset()
+
+    def test_device_put_under_dispatch_lock_trips(self):
+        # the seeded hazard: device work while holding a no-dispatch
+        # (dispatch/swap) lock — exactly what the engine used to do
+        import numpy as np
+        import jax
+        with sanitizer.override(True):
+            swap = sanitizer.make_lock("fixture._swap_lock",
+                                       no_dispatch=True)
+            try:
+                with pytest.raises(sanitizer.DispatchUnderLock) as ei:
+                    with swap:
+                        jax.device_put(np.zeros(4))
+                        sanitizer.note_jax_dispatch("device_put")
+                assert "fixture._swap_lock" in str(ei.value)
+                assert ei.value.report.worker   # StallReport machinery
+            finally:
+                sanitizer.reset()
+
+    def test_dispatch_outside_lock_clean(self):
+        with sanitizer.override(True):
+            swap = sanitizer.make_lock("fixture2._swap_lock",
+                                       no_dispatch=True)
+            try:
+                with swap:
+                    pass
+                sanitizer.note_jax_dispatch("device_put")
+                assert sanitizer.violations() == []
+            finally:
+                sanitizer.reset()
+
+    def test_held_too_long_reported(self):
+        with sanitizer.override(True, hold_s=0.05):
+            lk = sanitizer.make_lock("slow.lock")
+            try:
+                with lk:
+                    time.sleep(0.12)
+                vios = sanitizer.violations()
+                assert len(vios) == 1
+                assert vios[0].waited_s > 0.05
+            finally:
+                sanitizer.reset()
+
+    def test_engine_locks_tracked_when_enabled(self):
+        # the engine's locks route through make_lock: under override the
+        # constructed engine carries TrackedLocks with the no-dispatch
+        # marker on the swap lock
+        from dlrm_flexflow_tpu.analysis.sanitizer import TrackedLock
+        from dlrm_flexflow_tpu.serve.cache import EmbeddingCache
+        with sanitizer.override(True):
+            c = EmbeddingCache(4)
+            assert isinstance(c._lock, TrackedLock)
+            assert c._lock.no_dispatch
+
+
+# =====================================================================
+# strict FF_FAULT_* env parsing (FLX401's runtime counterpart)
+# =====================================================================
+class TestFaultEnvParsing:
+    def _plan(self, monkeypatch, **env):
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        return faults.plan_from_env()
+
+    def test_valid_forms_parse(self, monkeypatch):
+        plan = self._plan(monkeypatch,
+                          FF_FAULT_NAN_STEPS="3,7",
+                          FF_FAULT_DROP_DEVICE="4:2,9",
+                          FF_FAULT_SERVE_DELAY="0.05,1:0.2",
+                          FF_FAULT_REPLICA_DOWN="1:8,2",
+                          FF_FAULT_IO_ERRORS="ffbin_read:2")
+        assert plan.nan_grad_steps == {3, 7}
+        assert plan.drop_device_steps == {4: 2, 9: 1}
+        assert plan.serve_delay_s == 0.05
+        assert plan.serve_delay_replica == {1: 0.2}
+        assert plan.replica_down == {1: 8, 2: -1}
+        assert plan.io_errors == {"ffbin_read": 2}
+
+    @pytest.mark.parametrize("key,val,frag", [
+        ("FF_FAULT_NAN_STEPS", "1,two", "FF_FAULT_NAN_STEPS"),
+        ("FF_FAULT_TRUNCATE_CKPTS", "one", "FF_FAULT_TRUNCATE_CKPTS"),
+        ("FF_FAULT_WRITE_DELAY", "fast", "FF_FAULT_WRITE_DELAY"),
+        ("FF_FAULT_SERVE_DELAY", "1:fast", "FF_FAULT_SERVE_DELAY"),
+        ("FF_FAULT_REPLICA_DOWN", "1:x", "FF_FAULT_REPLICA_DOWN"),
+        ("FF_FAULT_REPLICA_DOWN", "1:2:3", "more than one"),
+        ("FF_FAULT_DROP_DEVICE", "a:1", "FF_FAULT_DROP_DEVICE"),
+        ("FF_FAULT_IO_ERRORS", "nocolon", "missing its ':'"),
+        ("FF_FAULT_IO_ERRORS", "site:n", "FF_FAULT_IO_ERRORS"),
+        ("FF_FAULT_POISON_RELOAD", "yes", "FF_FAULT_POISON_RELOAD"),
+    ])
+    def test_malformed_values_name_the_variable(self, monkeypatch, key,
+                                                val, frag):
+        monkeypatch.setenv(key, val)
+        with pytest.raises(ValueError, match=frag):
+            faults.plan_from_env()
+
+    def test_malformed_value_is_not_silently_skipped(self, monkeypatch):
+        # the old parser dropped io_errors items without ':' on the
+        # floor — the injection silently never fired
+        monkeypatch.setenv("FF_FAULT_IO_ERRORS", "ffbin_read")
+        with pytest.raises(ValueError):
+            faults.plan_from_env()

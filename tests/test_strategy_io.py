@@ -297,3 +297,100 @@ class TestGenStrategyAndGenericKeys:
         for i in range(8):
             assert s[f"embedding{i}"].device_type == "CPU"
         assert s["linear"].device_type == "TPU"
+
+
+# ---------------------------------------------------------------------
+# load-time validation (flexcheck PR): malformed strategy files must
+# fail with file + op + reason, never as a downstream GSPMD error
+# ---------------------------------------------------------------------
+import glob
+import re
+
+from dlrm_flexflow_tpu.parallel.strategy_io import (StrategyValidationError,
+                                                    validate_strategies)
+
+
+def _devices_from_filename(name: str) -> int:
+    m = re.search(r"(\d+)dev", name)
+    if m:
+        return int(m.group(1))
+    m = re.search(r"(\d+)gpus", name)
+    if m:
+        return int(m.group(1))
+    if "1cpu_1gpu" in name:
+        return 2
+    raise AssertionError(f"cannot infer device count from {name}")
+
+
+class TestStrategyValidation:
+    def test_every_bundled_pb_validates(self):
+        """Each committed strategy file must load AND factorize the mesh
+        its filename targets — a corrupt or mis-generated .pb fails in
+        this test, not in someone's training run."""
+        pbs = sorted(glob.glob(os.path.join(_REPO, "strategies", "*.pb")))
+        assert pbs, "no bundled strategy files found"
+        for path in pbs:
+            n = _devices_from_filename(os.path.basename(path))
+            strategies = load_strategies(path, num_devices=n)
+            assert strategies, path
+
+    def test_degrees_must_factorize_mesh(self):
+        s = {"linear_0": ParallelConfig((3, 1))}
+        with pytest.raises(StrategyValidationError) as ei:
+            validate_strategies(s, num_devices=8, path="bad.pb")
+        msg = str(ei.value)
+        assert "bad.pb" in msg and "linear_0" in msg
+        assert "factorize" in msg
+
+    def test_degrees_exceeding_devices(self):
+        s = {"emb": ParallelConfig((16, 1))}
+        with pytest.raises(StrategyValidationError,
+                           match=r"16 parts.*4 device"):
+            validate_strategies(s, num_devices=4, path="big.pb")
+
+    def test_unknown_op_rejected_with_reason(self):
+        s = {"tyop_dense_0": ParallelConfig((2, 1))}
+        with pytest.raises(StrategyValidationError) as ei:
+            validate_strategies(s, num_devices=2,
+                                known_ops={"top_dense_0", "bot_dense_0"},
+                                path="typo.pb")
+        msg = str(ei.value)
+        assert "typo.pb" in msg and "tyop_dense_0" in msg
+        assert "references no op" in msg
+
+    def test_generic_keys_allowed_with_known_ops(self):
+        s = {"embedding3": ParallelConfig((1, 1)),
+             "linear": ParallelConfig((2, 1)),
+             "mse_loss": ParallelConfig((2, 1))}
+        validate_strategies(s, num_devices=2, known_ops={"dense_0"},
+                            path="generic.pb")
+
+    def test_bad_device_type_rejected(self):
+        s = {"op": ParallelConfig((1, 1), device_type="GPU")}
+        with pytest.raises(StrategyValidationError, match="device_type"):
+            validate_strategies(s, path="dt.pb")
+
+    def test_malformed_json_entry_names_file_and_op(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text('{"ops": [{"name": "dense_0", "dims": [0, 1]}]}')
+        with pytest.raises(StrategyValidationError) as ei:
+            load_strategies(str(p))
+        assert "dense_0" in str(ei.value)
+
+    def test_compile_rejects_unknown_op_in_imported_file(self, tmp_path):
+        """The model.compile() import path wires known_ops + mesh
+        factorization through, so --import-strategy-file fails loudly
+        at compile, naming the file and op."""
+        import dlrm_flexflow_tpu as ff
+        from dlrm_flexflow_tpu.parallel.strategy_io import save_strategies
+
+        path = str(tmp_path / "wrong.json")
+        save_strategies(path, {"no_such_op_9": ParallelConfig((1, 1))})
+        model = ff.FFModel(ff.FFConfig(batch_size=8, seed=0))
+        x = model.create_tensor((8, 4), name="x")
+        model.dense(x, 4, name="dense_0")
+        model.config.import_strategy_file = path
+        with pytest.raises(StrategyValidationError,
+                           match="no_such_op_9"):
+            model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                          ["mse"])
