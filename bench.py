@@ -228,6 +228,20 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             serve = {"error": str(exc)[:200]}
 
+    # opt-in embedding-sharding smoke (BENCH_SHARD=1): row-sharded
+    # all-to-all lookups vs replicated vs table-sharded steps/s,
+    # a2a bytes/step, and the simulated pod-topology sweep
+    shard = None
+    if os.environ.get("BENCH_SHARD"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_shard import measure as _shard_measure
+            shard = _shard_measure(
+                steps=int(os.environ.get("BENCH_SHARD_STEPS", "12")))
+        except Exception as exc:
+            shard = {"error": str(exc)[:200]}
+
     # opt-in serving-fleet smoke (BENCH_SERVE_FLEET=1): attained QPS at
     # a p99 SLO for 1/2/4 replicas under open-loop Poisson load, zero
     # failed requests with one replica killed mid-run, continuous vs
@@ -277,6 +291,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         out["serve"] = serve
     if serve_fleet is not None:
         out["serve_fleet"] = serve_fleet
+    if shard is not None:
+        out["shard"] = shard
     print(json.dumps(out))
     return 0
 

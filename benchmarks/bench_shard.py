@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Pod-scale embedding-sharding benchmark: row-sharded all-to-all
+lookups vs replicated tables vs table-dim sharding.
+
+Measures, on the attached mesh (CPU-virtual or real accelerator):
+
+- ``steps_per_s_{replicated,row_sharded,table_sharded}`` — steady-state
+  training rate of the same DLRM under the three table placements:
+  pure data-parallel (every device holds every table), PARAM-axis row
+  sharding (each device holds rows/N of every table, lookups routed by
+  explicit all-to-all — the ZionEX/DLRM-Terabyte shape), and classic
+  table-dim sharding (each device holds whole tables);
+- ``row_vs_replicated`` — the headline ratio (the paper's bar: >= 1.5x
+  pure DP on tables that fit no single device);
+- ``a2a_bytes_per_step`` — all-to-all bytes one device exchanges per
+  step under the balanced exchange model (ids out, rows back, gradient
+  rows out);
+- ``sim_pod_sweep`` — cost-model step times for replicated vs
+  row-sharded plans on simulated pod topologies (flat ICI 8, 2 slices
+  x 4 over DCN, 8 slices x 8 = v5e-64), where the replicated plan goes
+  INFEASIBLE once the tables exceed per-chip HBM.
+
+Prints ONE JSON line (the BENCH_*.json convention); `measure()` is also
+imported by bench.py when BENCH_SHARD=1.
+
+Usage: python benchmarks/bench_shard.py [--steps N]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+# big enough that the table working set dwarfs caches and the sparse
+# update dominates; small enough that N replicated copies fit host RAM
+ROWS = int(os.environ.get("BENCH_SHARD_ROWS", "131072"))
+TABLES = 8
+DIM = 64
+
+
+def _build(ndev, batch, mode):
+    import jax
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+    from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+    from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
+
+    dcfg = DLRMConfig(embedding_size=[ROWS] * TABLES,
+                      sparse_feature_size=DIM,
+                      mlp_bot=[DIM, 128, DIM],
+                      mlp_top=[DIM * (TABLES + 1), 128, 1])
+    model = ff.FFModel(ff.FFConfig(batch_size=batch, seed=0))
+    build_dlrm(model, dcfg)
+    strat = {}
+    for op in model.ops:
+        tn = type(op).__name__
+        nd = op.outputs[0].num_dims if op.outputs else 0
+        if tn == "EmbeddingBagStacked":
+            if mode == "row_sharded":
+                strat[op.name] = ParallelConfig((ndev, 1, 1),
+                                                param_degree=ndev)
+            elif mode == "table_sharded":
+                dt = next(d for d in range(min(ndev, TABLES), 0, -1)
+                          if TABLES % d == 0 and ndev % d == 0)
+                strat[op.name] = ParallelConfig((1, dt, 1))
+            else:
+                strat[op.name] = ParallelConfig.data_parallel(nd, ndev)
+        elif nd:
+            strat[op.name] = ParallelConfig.data_parallel(nd, ndev)
+    model.compile(ff.SGDOptimizer(lr=0.05), "mean_squared_error",
+                  ["mse"], mesh=make_mesh(devices=jax.devices()[:ndev]),
+                  strategies=strat)
+    model.init_layers()
+    return model, dcfg
+
+
+def _steps_per_s(model, batches, steps):
+    model.train_batch_device(batches[0])          # warm/compile
+    t0 = time.perf_counter()
+    mets = None
+    for s in range(steps):
+        mets = model.train_batch_device(batches[s % len(batches)])
+    float(mets["loss"])                           # true completion
+    return steps / (time.perf_counter() - t0)
+
+
+def _sim_pod_sweep(ndev):
+    """Cost-model pricing of replicated vs row-sharded plans across pod
+    topologies, with an HBM cap the replicated tables exceed."""
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+    from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
+    from dlrm_flexflow_tpu.search.cost_model import CostModel, TPUSpec
+    from dlrm_flexflow_tpu.search.mcmc import default_strategy
+    from dlrm_flexflow_tpu.search.simulator import Simulator
+
+    dcfg = DLRMConfig.random_benchmark()          # 8 x 1M x 64 (2 GB)
+    out = {}
+    for label, topo, n in [
+        ("ici8", [("ici", 8)], 8),
+        ("dcn2xici4", [("dcn", 2), ("ici", 4)], 8),
+        ("dcn8xici8_v5e64", [("dcn", 8), ("ici", 8)], 64),
+    ]:
+        model = ff.FFModel(ff.FFConfig(batch_size=256 * n))
+        build_dlrm(model, dcfg)
+        model.optimizer = ff.SGDOptimizer(lr=0.1)
+        emb = next(op for op in model.ops
+                   if type(op).__name__ == "EmbeddingBagStacked")
+        dp = default_strategy(model, n)
+        row = dict(dp)
+        row[emb.name] = ParallelConfig((n, 1, 1), param_degree=n)
+        # 1 GB "HBM": the 2 GB replicated tables cannot fit, the row
+        # shards can — the memory-feasibility half of the row-shard case
+        sim_cap = Simulator(model, CostModel(
+            spec=TPUSpec(hbm_capacity_bytes=1e9)), topology=topo)
+        sim = Simulator(model, CostModel(), topology=topo)
+        t_dp, t_row = sim.simulate(dp, n), sim.simulate(row, n)
+        out[label] = {
+            "sim_step_ms_replicated": round(1e3 * t_dp, 4),
+            "sim_step_ms_row_sharded": round(1e3 * t_row, 4),
+            "row_vs_replicated_sim": round(t_dp / t_row, 3),
+            "replicated_feasible_at_1gb_hbm":
+                sim_cap.simulate(dp, n) != float("inf"),
+            "row_sharded_feasible_at_1gb_hbm":
+                sim_cap.simulate(row, n) != float("inf"),
+        }
+    return out
+
+
+def measure(steps: int = 12):
+    import jax
+
+    from dlrm_flexflow_tpu.models.dlrm import synthetic_batch
+    from dlrm_flexflow_tpu.parallel.alltoall import \
+        exchange_bytes_per_step
+
+    ndev = len(jax.devices())
+    batch = 64 * ndev
+    out = {"ndev": ndev, "rows": ROWS, "tables": TABLES, "dim": DIM,
+           "batch": batch}
+
+    modes = ["replicated", "row_sharded"]
+    if ndev > 1 and TABLES % 2 == 0:
+        modes.append("table_sharded")
+    dcfg = None
+    for mode in modes:
+        model, dcfg = _build(ndev, batch, mode)
+        if mode == "row_sharded":
+            emb = next(op for op in model.ops
+                       if type(op).__name__ == "EmbeddingBagStacked")
+            plan = getattr(emb, "_row_plan", None)
+            out["row_plan_active"] = plan is not None
+            if plan is not None:
+                lookups = batch * TABLES * dcfg.embedding_bag_size
+                out["a2a_bytes_per_step"] = exchange_bytes_per_step(
+                    plan, lookups, DIM)
+        batches = []
+        for i in range(4):
+            x, y = synthetic_batch(dcfg, batch, seed=i)
+            x["label"] = y
+            batches.append(model._device_batch(x))
+        jax.block_until_ready(batches)
+        out[f"steps_per_s_{mode}"] = round(
+            _steps_per_s(model, batches, steps), 3)
+        del model, batches
+
+    if "steps_per_s_row_sharded" in out and \
+            out.get("steps_per_s_replicated"):
+        out["row_vs_replicated"] = round(
+            out["steps_per_s_row_sharded"]
+            / out["steps_per_s_replicated"], 3)
+
+    out["sim_pod_sweep"] = _sim_pod_sweep(ndev)
+    return out
+
+
+def main(argv):
+    steps = 12
+    if "--steps" in argv:
+        steps = int(argv[argv.index("--steps") + 1])
+    print(json.dumps({"metric": "embedding_sharding", **measure(steps)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

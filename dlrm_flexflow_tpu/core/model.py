@@ -449,6 +449,18 @@ class FFModel:
                         "the fused embedding %r stores ALL tables "
                         "host-resident (fusion constraint)",
                         sum(zcm), len(zcm), op.name)
+                # per-table PARAM-axis (row-shard) degrees fuse to the
+                # largest requested: rows of every table shard over that
+                # many devices with all-to-all lookup routing, output
+                # data-parallel over the whole mesh
+                pd = max((getattr(pc, "param_degree", 1) for pc in pcs),
+                         default=1)
+                if pd > 1 and not mem:
+                    batch = op.inputs[0].shape[0]
+                    ds = ndev if batch % max(ndev, 1) == 0 else 1
+                    strategies[op.name] = ParallelConfig(
+                        (ds, 1, 1), device_type=dtyp, param_degree=pd)
+                    continue
                 strategies[op.name] = ParallelConfig(
                     (1, degree, 1), device_type=dtyp, memory_types=mem)
                 # honor the per-table device assignment, not just its
@@ -591,6 +603,12 @@ class FFModel:
             pc = self._effective_pc(op)
             if pc.device_type == "CPU" and op.name not in hres:
                 self._host_offload_ops.add(op.name)
+            # row/PARAM-axis sharding for embedding tables (strategy
+            # param_degree > 1): resolve the all-to-all routing plan
+            # BEFORE output/param axes — both consult it
+            if hasattr(op, "_row_shard_geometry"):
+                from ..ops.embedding import configure_row_shard
+                configure_row_shard(op, self.strategies.get(op.name))
             try:
                 out_axes = op.output_axes(
                     pc, asn, raw_pc=self.strategies.get(op.name, pc))

@@ -129,6 +129,12 @@ class Op:
             if not all(d == 1 or shape[i] % d == 0
                        for i, d in enumerate(degs)):
                 continue
+            # the PARAM-axis (row-shard) degree must factorize the mesh
+            # on its own (it consumes axes independently of the output
+            # degrees — rows and batch may share axes)
+            pd = getattr(pc, "param_degree", 1)
+            if pd > 1 and not assignable((pd,), axis_sizes):
+                continue
             # per-dim degrees can each be expressible yet not jointly
             # assignable (they consume mesh axes in order)
             if assigner is not None:

@@ -229,16 +229,26 @@ def build_dlrm(model: FFModel, cfg: DLRMConfig,
 
 
 def dlrm_strategy(model: FFModel, cfg: DLRMConfig,
-                  num_devices: int) -> StrategyMap:
+                  num_devices: int,
+                  row_shard: bool = False) -> StrategyMap:
     """Hand-written DLRM strategy, the GSPMD analog of the reference
     generator (src/runtime/dlrm_strategy.cc:242-296): embedding tables
     table-parallel (stacked dim or width sharding), MLPs/bmm/concat
-    data-parallel over all chips."""
+    data-parallel over all chips. ``row_shard=True`` instead splits the
+    ROW space of every embedding table over the whole mesh (PARAM-axis
+    degree, explicit all-to-all lookup routing) — the pod-scale shape
+    for tables that fit no single device."""
     strat: StrategyMap = {}
+    batch = model.config.batch_size
     for op in model.ops:
         tname = type(op).__name__
         nd = op.outputs[0].num_dims if op.outputs else 0
-        if tname == "EmbeddingBagStacked":
+        if row_shard and batch % max(num_devices, 1) == 0 and tname in (
+                "EmbeddingBagStacked", "EmbeddingBagConcat", "Embedding"):
+            strat[op.name] = ParallelConfig(
+                (num_devices,) + (1,) * (nd - 1),
+                param_degree=num_devices)
+        elif tname == "EmbeddingBagStacked":
             # (batch, T, d): shard the table dim with the largest common
             # divisor of table count and device count
             dt = next(d for d in range(min(num_devices, op.num_tables), 0, -1)

@@ -43,6 +43,9 @@ import jax.numpy as jnp
 from ..core.initializers import GlorotUniform
 from ..core.op import Op, ParamDef
 from ..parallel.pconfig import DEVICE_CPU, ParallelConfig
+from ..utils.logging import get_logger
+
+log_emb = get_logger("embedding")
 
 AGGR_MODE_NONE = "none"
 AGGR_MODE_SUM = "sum"
@@ -499,6 +502,15 @@ def _sparse_opt_update(op, tbl, gidx, upd, opt, slabs, step, total_rows,
     optimizers are nonlinear in the gradient).
     Returns (new_kernel, new_slabs) in the stored layout."""
     d = op.out_dim
+    plan = _row_plan(op)
+    if plan is not None and gidx.shape[0] % plan.ndev == 0:
+        # row-sharded: gradient rows + their global positions route to
+        # the owning shard; weights AND state slabs update shard-locally
+        from ..parallel.alltoall import row_sharded_opt_update
+        owner, local = op._row_owner_local(gidx)
+        spec, _ = op._row_spec_block()
+        return row_sharded_opt_update(plan, tbl, slabs, spec, owner,
+                                      local, upd, opt, step, d)
     r = getattr(op, "_pack", 1)
     use_tiles = (r * d == 128
                  and _pallas_scatter_ok(op.model, 128, op.name)
@@ -576,6 +588,95 @@ def _row_shard_axes(op, d: int, packed_rows: int):
     return axes
 
 
+# ---- row/PARAM-axis sharding with explicit all-to-all routing ------------
+# The pod-scale mode (ParallelConfig.param_degree > 1): the table's ROW
+# space is block-sharded over mesh devices — no single device ever holds a
+# whole table — and lookups are routed to owners and back by the dense
+# all-to-all exchange in parallel/alltoall.py. Activated per op by
+# FFModel._build_shardings via configure_row_shard(); every routed path
+# below gates on `op._row_plan`.
+
+
+def configure_row_shard(op, raw_pc) -> None:
+    """Resolve (and validate) the row-shard plan for `op` from its RAW
+    strategy's param_degree. Sets ``op._row_plan`` (None = mode off).
+    Infeasible requests degrade loudly to replicated rows — a silent
+    fallback would OOM exactly the >HBM configs this mode exists for, so
+    the warning names the reason."""
+    from ..parallel.alltoall import plan_row_shard
+    op._row_plan = None
+    pd = getattr(raw_pc, "param_degree", 1) if raw_pc is not None else 1
+    if pd <= 1:
+        return
+    model = op.model
+    mesh = getattr(model, "mesh", None)
+    rows, pack, tables = op._row_shard_geometry()
+    batch = op.inputs[0].shape[0]
+    reason = None
+    if mesh is None or mesh.size <= 1:
+        reason = "needs a multi-device mesh"
+    elif (op.name in getattr(model, "_host_resident_ops", set())
+          or op.name in getattr(model, "_host_offload_ops", set())):
+        reason = "host-resident/offloaded tables cannot row-shard in HBM"
+    elif op.aggr not in (AGGR_MODE_SUM, AGGR_MODE_AVG):
+        reason = f"aggr={op.aggr!r} has no routed bag aggregation"
+    elif len(raw_pc.degrees) > 1 and any(d > 1 for d in raw_pc.degrees[1:]):
+        reason = (f"degrees {raw_pc.degrees} also request table/width "
+                  f"sharding — pick one axis for the table")
+    if reason is None:
+        plan = plan_row_shard(mesh, pd, rows, pack, tables)
+        if plan is None:
+            sizes = [int(mesh.shape[a]) for a in mesh.axis_names]
+            reason = (f"{pd} row shards must factorize mesh axes {sizes} "
+                      f"and divide the {rows} padded rows "
+                      f"(lane pack {pack})")
+        elif batch % plan.ndev != 0:
+            reason = (f"batch {batch} does not divide over the "
+                      f"{plan.ndev}-device mesh (lookups route from "
+                      f"batch shards)")
+        else:
+            op._row_plan = plan
+            return
+    log_emb.warning(
+        "row sharding (param_degree=%d) requested for %r but %s; "
+        "executing with replicated rows", pd, op.name, reason)
+
+
+def _row_plan(op):
+    return getattr(op, "_row_plan", None)
+
+
+def _a2a_payload_bytes(op, ndev: int, itemsize: int):
+    """Per-device all-to-all payloads for a row-sharded lookup under the
+    balanced (production/ragged) exchange, for the simulator: (request
+    ids, embedded rows back, gradient rows out). The (P−1)/P exchanged
+    fraction is applied by CostModel.alltoall_time_axes per axis."""
+    n_dev = _lookup_count(op) / max(ndev, 1)
+    d = op.out_dim
+    req = n_dev * 4.0                      # int32 row ids
+    rows = n_dev * d * float(itemsize)     # embedded rows, compute dtype
+    grad = n_dev * (4.0 + d * 4.0)         # fp32 grad rows + positions
+    return req, rows, grad
+
+
+def _row_shard_candidates(op, num_devices, feasible_degrees, nd):
+    """PARAM-axis candidates for the MCMC search: rows split over pp
+    shards, output data-parallel over the whole target mesh (the
+    pod-scale shape the cost model trades against pure DP)."""
+    rows, pack, _ = op._row_shard_geometry()
+    batch = op.inputs[0].shape[0]
+    if batch % num_devices != 0 or op.aggr not in (AGGR_MODE_SUM,
+                                                   AGGR_MODE_AVG):
+        return []
+    out = []
+    for pp in feasible_degrees:
+        if 1 < pp <= num_devices and rows % (pp * max(pack, 1)) == 0:
+            degs = [1] * nd
+            degs[0] = num_devices
+            out.append(ParallelConfig(tuple(degs), param_degree=pp))
+    return out
+
+
 def _pallas_scatter_ok(model, out_dim: int, op_name: str = "") -> bool:
     """Gate for the Pallas RMW scatter kernel: XLA's TPU scatter lowers to
     a serialized loop (~250 ms for 2k rows on an 8M-row table)."""
@@ -618,9 +719,45 @@ class Embedding(Op):
         return {"kernel": ParamDef((self.num_entries, self.out_dim),
                                    jnp.float32, self.kernel_initializer)}
 
+    # ---- row/PARAM-axis sharding hooks (see configure_row_shard) -------
+    _row_needs_2d_idx = True
+
+    def _row_shard_geometry(self):
+        return self.num_entries, getattr(self, "_pack", 1), 1
+
+    def _row_owner_local(self, g):
+        """Global (wrapped) row ids -> (owning shard, id in the owner's
+        flat local view). Shared with EmbeddingBagStacked: a flat id
+        t*rows + ix maps to shard ix // rows_local, local slot
+        t*rows_local + ix % rows_local (each shard owns the same row
+        block of EVERY table)."""
+        plan = self._row_plan
+        rows = self.num_entries
+        rl = plan.rows_local
+        ix = g % rows
+        t = g // rows
+        return ((ix // rl).astype(jnp.int32),
+                (t * rl + ix % rl).astype(jnp.int32))
+
+    def _row_spec_block(self):
+        from jax.sharding import PartitionSpec
+        plan = self._row_plan
+        return (PartitionSpec(plan.row_axes, None),
+                (self.num_entries // plan.nshards, self.out_dim))
+
     def apply(self, params, xs, *, training=False, rng=None):
         (idx,) = xs
         table = params["kernel"]
+        plan = _row_plan(self)
+        if (plan is not None and idx.ndim == 2
+                and idx.shape[0] % plan.ndev == 0):
+            from ..parallel.alltoall import row_sharded_bag_lookup
+            g = idx.astype(jnp.int32) % self.num_entries
+            owner, local = self._row_owner_local(g)
+            spec, block = self._row_spec_block()
+            return [row_sharded_bag_lookup(plan, table, spec, owner,
+                                           local, self.out_dim,
+                                           self.aggr, block)]
         if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG) and idx.ndim == 2
                 and _pallas_ok(self.model, self.out_dim, self.name)):
             from .pallas.embedding_kernel import embedding_bag
@@ -637,9 +774,10 @@ class Embedding(Op):
         return [rows]
 
     def candidate_parallel_configs(self, num_devices, feasible_degrees):
-        """Sample DP × width-sharded table. (Reference partitions only the
-        sample dim, embedding.cu:115-117; width sharding is the GSPMD
-        upgrade of whole-table placement.)"""
+        """Sample DP × width-sharded table, plus PARAM-axis row sharding
+        (DP output over the whole mesh, rows split over pp shards with
+        all-to-all lookup routing). (Reference partitions only the
+        sample dim, embedding.cu:115-117.)"""
         out = []
         nd = self.outputs[0].num_dims
         for ds in feasible_degrees:
@@ -649,11 +787,15 @@ class Embedding(Op):
                     degs[0] = ds
                     degs[-1] = dc
                     out.append(ParallelConfig(tuple(degs)))
+        out.extend(_row_shard_candidates(self, num_devices,
+                                         feasible_degrees, nd))
         out.append(_zcm_candidate(nd))
         return out
 
     def param_axes(self, pc: ParallelConfig, out_axes,
                    raw_pc=None):
+        if _row_plan(self) is not None:
+            return {"kernel": (self._row_plan.row_axes, ())}
         # width sharding follows the output channel axes; rows replicated
         ch = out_axes[-1] if len(out_axes) >= 2 else ()
         return {"kernel": ((), ch)}
@@ -663,6 +805,11 @@ class Embedding(Op):
         return float(bag * self.out_dim)  # bandwidth-bound; count adds
 
     def param_shard_shapes(self, pc: ParallelConfig, ndev=None):
+        pd = max(getattr(pc, "param_degree", 1), 1)
+        if pd > 1:
+            # row sharding: each shard holds rows/pd full-width rows
+            return {"kernel": (max(self.num_entries // pd, 1),
+                               self.out_dim)}
         # width sharding splits out_dim by the last degree
         dc = pc.degrees[-1] if len(pc.degrees) > 1 else 1
         return {"kernel": (self.num_entries, max(self.out_dim // dc, 1))}
@@ -674,6 +821,9 @@ class Embedding(Op):
 
     def update_random_hbm_rows(self, pc=None) -> float:
         return _embedding_update_rows(self, pc)
+
+    def alltoall_payload_bytes(self, ndev: int, itemsize: int):
+        return _a2a_payload_bytes(self, ndev, itemsize)
 
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
@@ -707,6 +857,7 @@ class Embedding(Op):
                 and getattr(self, "_pack", 1) == 1
                 and self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
                 and self.inputs[0].num_dims == 2
+                and _row_plan(self) is None
                 and not _pallas_ok(self.model, self.out_dim, self.name)
                 and _pallas_scatter_ok(self.model, 128, self.name)
                 and _row_shard_axes(self, self.out_dim, self.num_entries)
@@ -742,6 +893,16 @@ class Embedding(Op):
             # each row of the bag receives the bag-sum's cotangent
             upd = jnp.broadcast_to(ct[..., None, :],
                                    idx.shape + (d,)).reshape(-1, d)
+        plan = _row_plan(self)
+        if plan is not None and idx.size % plan.ndev == 0:
+            # row-sharded: gradient rows route to their owning shard
+            # (all-to-all) and apply there, in canonical global order
+            from ..parallel.alltoall import row_sharded_sgd_update
+            owner, local = self._row_owner_local(idx.reshape(-1))
+            spec, _ = self._row_spec_block()
+            new = row_sharded_sgd_update(plan, tbl, spec, owner, local,
+                                         upd, lr, d)
+            return {"kernel": new}
         if fwd is not None and self._fwd_residual_ok():
             # write-only path: the forward's gathered rows are the tiles,
             # so new rows land without the RMW read
@@ -929,6 +1090,21 @@ class EmbeddingBagStacked(Op):
         return logical.reshape(self.num_tables, self.num_entries // r,
                                self.out_dim * r)
 
+    # ---- row/PARAM-axis sharding hooks (see configure_row_shard) -------
+    def _row_shard_geometry(self):
+        return self.num_entries, self._pack, self.num_tables
+
+    _row_owner_local = Embedding._row_owner_local
+
+    def _row_spec_block(self):
+        from jax.sharding import PartitionSpec
+        plan = self._row_plan
+        r = self._pack
+        return (PartitionSpec(None, plan.row_axes, None),
+                (self.num_tables,
+                 self.num_entries // r // plan.nshards,
+                 self.out_dim * r))
+
     def apply(self, params, xs, *, training=False, rng=None):
         (idx,) = xs  # (batch, T, bag)
         table = params["kernel"]  # (T, rows/r, r*d)
@@ -936,6 +1112,22 @@ class EmbeddingBagStacked(Op):
         if self._table_order is not None:
             idx = jnp.take(idx, self._table_order, axis=1)
         r, d = self._pack, self.out_dim
+
+        plan = _row_plan(self)
+        if plan is not None and idx.shape[0] % plan.ndev == 0:
+            # row-sharded lookup: indices route to owning shards over
+            # the mesh's row axes, embedded rows route back
+            from ..parallel.alltoall import row_sharded_bag_lookup
+            rows = self.num_entries
+            offs = (jnp.arange(self.num_tables, dtype=jnp.int32)
+                    * rows)[None, :, None]
+            owner, local = self._row_owner_local(idx + offs)
+            spec, block = self._row_spec_block()
+            out = row_sharded_bag_lookup(plan, table, spec, owner,
+                                         local, d, self.aggr, block)
+            if self._table_inv is not None:
+                out = jnp.take(out, self._table_inv, axis=1)
+            return [out]
 
         if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG) and r == 1
                 and _pallas_ok(self.model, self.out_dim, self.name)):
@@ -961,17 +1153,24 @@ class EmbeddingBagStacked(Op):
         return [out]  # (batch, T, d) in LOGICAL table order
 
     def candidate_parallel_configs(self, num_devices, feasible_degrees):
-        # partition the table dim (dim 1 of the output) and/or sample dim
+        # partition the table dim (dim 1 of the output) and/or sample
+        # dim, plus PARAM-axis row sharding of every table
         out = []
         for ds in feasible_degrees:
             for dt in feasible_degrees:
                 if ds * dt <= num_devices and self.num_tables % max(dt, 1) == 0:
                     out.append(ParallelConfig((ds, dt, 1)))
+        out.extend(_row_shard_candidates(self, num_devices,
+                                         feasible_degrees, 3))
         out.append(_zcm_candidate(3))
         return out
 
     def param_axes(self, pc: ParallelConfig, out_axes,
                    raw_pc=None):
+        if _row_plan(self) is not None:
+            # rows of EVERY table block-shard over the row axes; the
+            # table dim stays whole on each shard
+            return {"kernel": ((), self._row_plan.row_axes, ())}
         # table dim of the param follows output dim 1's axes
         t_axes = out_axes[1] if len(out_axes) >= 2 else ()
         return {"kernel": (t_axes, (), ())}
@@ -989,9 +1188,15 @@ class EmbeddingBagStacked(Op):
         return [(max(batch // ds, 1), max(T // max(dt, 1), 1), bag)]
 
     def param_shard_shapes(self, pc: ParallelConfig, ndev=None):
+        r = self._pack
+        pd = max(getattr(pc, "param_degree", 1), 1)
+        if pd > 1:
+            # row sharding: all T tables present, rows/pd of each
+            return {"kernel": (self.num_tables,
+                               max(self.num_entries // r // pd, 1),
+                               self.out_dim * r)}
         # table-dim sharding by degrees[1]
         dt = pc.degrees[1] if len(pc.degrees) > 1 else 1
-        r = self._pack
         return {"kernel": (max(self.num_tables // dt, 1),
                            self.num_entries // r, self.out_dim * r)}
 
@@ -1002,6 +1207,9 @@ class EmbeddingBagStacked(Op):
 
     def update_random_hbm_rows(self, pc=None) -> float:
         return _embedding_update_rows(self, pc)
+
+    def alltoall_payload_bytes(self, ndev: int, itemsize: int):
+        return _a2a_payload_bytes(self, ndev, itemsize)
 
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
@@ -1020,6 +1228,7 @@ class EmbeddingBagStacked(Op):
         Pallas scatter available, XLA gather path in use)."""
         return (self._pack > 1
                 and self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
+                and _row_plan(self) is None
                 and not _pallas_ok(self.model, self.out_dim, self.name)
                 and _pallas_scatter_ok(self.model, 128, self.name)
                 and _row_shard_axes(
@@ -1066,6 +1275,18 @@ class EmbeddingBagStacked(Op):
             ct = ct / idx.shape[-1]
         r, d = self._pack, self.out_dim
         T, rows = self.num_tables, self.num_entries
+
+        plan = _row_plan(self)
+        if plan is not None and idx.size % plan.ndev == 0:
+            from ..parallel.alltoall import row_sharded_sgd_update
+            offs = (jnp.arange(T, dtype=jnp.int32) * rows)[None, :, None]
+            owner, local = self._row_owner_local((idx + offs).reshape(-1))
+            upd = jnp.broadcast_to(
+                ct[..., None, :], idx.shape + (d,)).reshape(-1, d)
+            spec, _ = self._row_spec_block()
+            new = row_sharded_sgd_update(plan, tbl, spec, owner, local,
+                                         upd, lr, d)
+            return {"kernel": new}
 
         if fwd is not None and self._fwd_residual_ok():
             # write-only path: fwd tiles + summed deltas -> pure scatter
@@ -1290,12 +1511,35 @@ class EmbeddingBagConcat(Op):
         offs = jnp.asarray(self._offsets, jnp.int32)[None, :, None]
         return idx.astype(jnp.int32) % sizes + offs       # (batch, T, bag)
 
+    # ---- row/PARAM-axis sharding hooks (see configure_row_shard) -------
+    def _row_shard_geometry(self):
+        return self.total_rows, self._pack, 1
+
+    def _row_owner_local(self, g):
+        plan = self._row_plan
+        rl = plan.rows_local
+        return (g // rl).astype(jnp.int32), (g % rl).astype(jnp.int32)
+
+    def _row_spec_block(self):
+        from jax.sharding import PartitionSpec
+        plan = self._row_plan
+        r = self._pack
+        return (PartitionSpec(plan.row_axes, None),
+                (self.total_rows // r // plan.nshards, self.out_dim * r))
+
     def apply(self, params, xs, *, training=False, rng=None):
         (idx,) = xs                        # (batch, T, bag)
         tbl = params["kernel"]             # (total_rows/r, r*d)
         g = self._global_indices(idx)
         batch, T, bag = g.shape
         r, d = self._pack, self.out_dim
+        plan = _row_plan(self)
+        if plan is not None and batch % plan.ndev == 0:
+            from ..parallel.alltoall import row_sharded_bag_lookup
+            owner, local = self._row_owner_local(g)
+            spec, block = self._row_spec_block()
+            return [row_sharded_bag_lookup(plan, tbl, spec, owner,
+                                           local, d, self.aggr, block)]
         if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG) and r == 1
                 and _pallas_ok(self.model, self.out_dim, self.name)):
             # one Pallas row-stream over the concatenated table; per-table
@@ -1321,6 +1565,8 @@ class EmbeddingBagConcat(Op):
             for dt in feasible_degrees:
                 if ds * dt <= num_devices and self.num_tables % max(dt, 1) == 0:
                     out.append(ParallelConfig((ds, dt, 1)))
+        out.extend(_row_shard_candidates(self, num_devices,
+                                         feasible_degrees, 3))
         out.append(_zcm_candidate(3))
         return out
 
@@ -1342,6 +1588,10 @@ class EmbeddingBagConcat(Op):
 
     def param_axes(self, pc: ParallelConfig, out_axes,
                    raw_pc=None):
+        # explicit PARAM-axis row sharding (all-to-all routed lookups)
+        # takes precedence over the implicit GSPMD row-block sharding
+        if _row_plan(self) is not None:
+            return {"kernel": (self._row_plan.row_axes, ())}
         # table parallelism = row-block sharding of the concatenated rows.
         # Keyed off the RAW (unclamped) strategy degrees: the output's
         # table dim often can't split evenly (26 tables on 8 chips), but
@@ -1361,9 +1611,14 @@ class EmbeddingBagConcat(Op):
 
     def param_shard_shapes(self, pc: ParallelConfig, ndev=None):
         # any table parallelism row-shards the concatenated table over the
-        # WHOLE mesh (param_axes), not just pc.num_parts
+        # WHOLE mesh (param_axes), not just pc.num_parts; an explicit
+        # PARAM-axis degree shards rows by exactly that many shards
+        pd = max(getattr(pc, "param_degree", 1), 1)
         full = ndev or (self.model.mesh.size if self.model.mesh else 1)
-        dt = full if (len(pc.degrees) > 1 and pc.degrees[1] > 1) else 1
+        if pd > 1:
+            dt = pd
+        else:
+            dt = full if (len(pc.degrees) > 1 and pc.degrees[1] > 1) else 1
         r = self._pack
         return {"kernel": (max(self.total_rows // r // max(dt, 1), 1),
                            self.out_dim * r)}
@@ -1375,6 +1630,9 @@ class EmbeddingBagConcat(Op):
 
     def update_random_hbm_rows(self, pc=None) -> float:
         return _embedding_update_rows(self, pc)
+
+    def alltoall_payload_bytes(self, ndev: int, itemsize: int):
+        return _a2a_payload_bytes(self, ndev, itemsize)
 
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
@@ -1391,6 +1649,7 @@ class EmbeddingBagConcat(Op):
         """See EmbeddingBagStacked._fwd_residual_ok."""
         return (self._pack > 1
                 and self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG)
+                and _row_plan(self) is None
                 and not _pallas_ok(self.model, self.out_dim, self.name)
                 and _pallas_scatter_ok(self.model, 128, self.name)
                 and _row_shard_axes(self, self.out_dim,
@@ -1421,6 +1680,14 @@ class EmbeddingBagConcat(Op):
         r, d = self._pack, self.out_dim
         upd = jnp.broadcast_to(ct[..., None, :], g.shape + (d,))
         upd = upd.reshape(-1, d)
+        plan = _row_plan(self)
+        if plan is not None and g.size % plan.ndev == 0:
+            from ..parallel.alltoall import row_sharded_sgd_update
+            owner, local = self._row_owner_local(g.reshape(-1))
+            spec, _ = self._row_spec_block()
+            new = row_sharded_sgd_update(plan, tbl, spec, owner, local,
+                                         upd, lr, d)
+            return {"kernel": new}
         if fwd is not None and self._fwd_residual_ok():
             from .pallas.embedding_kernel import scatter_write_rows_packed
             g_flat, tiles = fwd

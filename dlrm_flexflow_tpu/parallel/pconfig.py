@@ -39,12 +39,24 @@ class ParallelConfig:
     # framebuffer/HBM, ZCM = zero-copy host memory); round-tripped through
     # strategy files and consulted by the hetero host-offload path
     memory_types: Tuple[str, ...] = field(default=())
+    # PARAMETER-axis partition degree: how many row shards the op's
+    # parameter (an embedding table's row space) splits into, independent
+    # of the output degrees above. degrees describe the OUTPUT tensor and
+    # cannot express "rows of the table sharded, output data-parallel" —
+    # the pod-scale DLRM shape (Naumov 2019 / ZionEX 2022: row-sharded
+    # tables + all-to-all lookup exchange). 1 = replicated/whole rows
+    # (legacy behavior for every op that ignores it).
+    param_degree: int = 1
 
     def __post_init__(self):
         object.__setattr__(self, "degrees", tuple(int(d) for d in self.degrees))
         for d in self.degrees:
             if d < 1:
                 raise ValueError(f"invalid partition degree {d}")
+        object.__setattr__(self, "param_degree", int(self.param_degree))
+        if self.param_degree < 1:
+            raise ValueError(
+                f"invalid parameter-axis degree {self.param_degree}")
 
     @property
     def num_parts(self) -> int:

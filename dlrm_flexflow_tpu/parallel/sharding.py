@@ -92,6 +92,30 @@ def clamp_degrees(degrees: Sequence[int],
     return tuple(degs)
 
 
+def clamp_param_degree(param_degree: int,
+                       axis_sizes: Sequence[int]) -> int:
+    """Project a PARAM-axis (row-shard) degree onto a factorized mesh:
+    the largest feasible degree not exceeding the requested one. The
+    per-op core of elastic re-planning for row-sharded embedding tables
+    — a surviving 4-device mesh cannot hold 8 row shards, so the tables
+    reshard 4-way rather than silently replicating."""
+    if param_degree <= 1:
+        return 1
+    feas = feasible_degrees_for(axis_sizes)
+    return max((f for f in feas if f <= param_degree), default=1)
+
+
+def param_axis_indices(param_degree: int,
+                       axis_sizes: Sequence[int]
+                       ) -> Optional[Tuple[int, ...]]:
+    """Mesh-axis indices the PARAM (row-shard) degree consumes: the same
+    leading-run consumption as assign_indices for a single degree, so
+    the cost model prices the all-to-all on exactly the axes compile()
+    row-shards over. None when the degree does not factorize the mesh."""
+    idx = assign_indices((param_degree,), axis_sizes)
+    return idx[0] if idx is not None else None
+
+
 class AxisAssigner:
     """Maps partition degrees to tuples of mesh axes, consuming axes in mesh
     order so equal degrees on the same dim index always get the same axes."""

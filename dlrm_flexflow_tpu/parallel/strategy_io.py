@@ -67,7 +67,7 @@ def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
 
 def _encode_op(name: str, device_type: int, dims: List[int],
                device_ids: List[int],
-               memory_types: List[int]) -> bytes:
+               memory_types: List[int], param_dim: int = 1) -> bytes:
     msg = bytearray()
     nb = name.encode()
     msg += b"\x0a" + _varint(len(nb)) + nb          # 1: name (len-delim)
@@ -78,6 +78,11 @@ def _encode_op(name: str, device_type: int, dims: List[int],
         msg += b"\x20" + _varint(d)
     for m in memory_types:                          # 5: memory_types
         msg += b"\x28" + _varint(m)
+    if param_dim > 1:                               # 6: PARAM-axis degree
+        # extension field: the reference's proto2 parser skips unknown
+        # fields, so files stay readable by it; files without row
+        # sharding stay byte-identical to the legacy encoding
+        msg += b"\x30" + _varint(param_dim)
     return bytes(msg)
 
 
@@ -123,7 +128,8 @@ def save_strategies_pb(path: str, strategies: StrategyMap) -> None:
         dt = 1 if pc.device_type == "CPU" else 0
         mts = [1 if m == "ZCM" else 0 for m in pc.memory_types]
         op = _encode_op(name, dt, list(reversed(pc.degrees)),
-                        list(pc.device_ids), mts)
+                        list(pc.device_ids), mts,
+                        param_dim=getattr(pc, "param_degree", 1))
         body += b"\x0a" + _varint(len(op)) + op     # Strategy.ops = 1
     with open(path, "wb") as f:
         f.write(bytes(body))
@@ -145,7 +151,7 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
     for field, wt, v in _decode_message(buf):
         if field != 1 or wt != 2:
             continue
-        name, dt, dims, dev_ids, mts = "", 0, [], [], []
+        name, dt, dims, dev_ids, mts, pd = "", 0, [], [], [], 1
         for f2, wt2, v2 in _decode_message(v):
             if f2 == 1:
                 name = v2.decode()
@@ -157,10 +163,16 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
                 dev_ids += _unpack_varints(v2) if wt2 == 2 else [v2]
             elif f2 == 5:
                 mts += _unpack_varints(v2) if wt2 == 2 else [v2]
+            elif f2 == 6:
+                pd = v2                    # PARAM-axis (row-shard) degree
+        if pd < 1:
+            raise ValueError(
+                f"op {name!r}: parameter-axis degree {pd} < 1")
         out[name] = ParallelConfig(
             tuple(reversed(dims)), device_type="CPU" if dt == 1 else "TPU",
             device_ids=tuple(dev_ids),
-            memory_types=tuple("ZCM" if m == 1 else "FBM" for m in mts))
+            memory_types=tuple("ZCM" if m == 1 else "FBM" for m in mts),
+            param_degree=pd)
     return out
 
 
@@ -250,6 +262,20 @@ def validate_strategies(strategies: StrategyMap,
                     f"degrees {pc.degrees} do not factorize the target "
                     f"mesh axes {list(axis_sizes)} (no contiguous axis "
                     f"assignment multiplies to each degree)")
+            pd = getattr(pc, "param_degree", 1)
+            if pd > 1:
+                if pd > ndev:
+                    raise StrategyValidationError(
+                        path, name,
+                        f"parameter-axis degree {pd} (row shards) "
+                        f"exceeds the target mesh's {ndev} device(s)")
+                if not assignable((pd,), axis_sizes):
+                    raise StrategyValidationError(
+                        path, name,
+                        f"parameter-axis degree {pd} does not factorize "
+                        f"the target mesh axes {list(axis_sizes)} — row "
+                        f"shards need a contiguous axis run multiplying "
+                        f"to the degree")
         if known_ops is not None and name not in known_ops \
                 and not _GENERIC_KEY_RE.match(name):
             preview = sorted(known_ops)[:8]
@@ -268,13 +294,19 @@ def save_strategies(path: str, strategies: StrategyMap) -> None:
     if path.endswith(".pb"):
         save_strategies_pb(path, strategies)
         return
-    doc = {"ops": [
-        {"name": name,
-         "device_type": pc.device_type,
-         "dims": list(pc.degrees),
-         "device_ids": list(pc.device_ids),
-         "memory_types": list(pc.memory_types)}
-        for name, pc in sorted(strategies.items())]}
+    ops = []
+    for name, pc in sorted(strategies.items()):
+        entry = {"name": name,
+                 "device_type": pc.device_type,
+                 "dims": list(pc.degrees),
+                 "device_ids": list(pc.device_ids),
+                 "memory_types": list(pc.memory_types)}
+        if getattr(pc, "param_degree", 1) > 1:
+            # row/PARAM-axis shard degree (omitted when 1 so legacy
+            # files stay diff-identical)
+            entry["param_dim"] = int(pc.param_degree)
+        ops.append(entry)
+    doc = {"ops": ops}
     with open(path, "w") as f:
         json.dump(doc, f, indent=1)
 
@@ -299,7 +331,8 @@ def load_strategies(path: str, num_devices: Optional[int] = None,
                     tuple(entry["dims"]),
                     device_type=entry.get("device_type", "TPU"),
                     device_ids=tuple(entry.get("device_ids", ())),
-                    memory_types=tuple(entry.get("memory_types", ())))
+                    memory_types=tuple(entry.get("memory_types", ())),
+                    param_degree=int(entry.get("param_dim", 1)))
             except (KeyError, TypeError, ValueError) as e:
                 raise StrategyValidationError(
                     path, str(entry.get("name", "?")),

@@ -120,26 +120,50 @@ class TPUSpec:
                        hbm_bytes_per_s=1228e9, ici_bytes_per_s=50e9,
                        ici_links=6, hbm_capacity_bytes=32e9)
 
+    def apply_env_overrides(self) -> "TPUSpec":
+        """Honor FF_ICI_GBPS / FF_DCN_GBPS (GB/s, per link / per host):
+        pod-pricing knobs so a strategy search for a machine with a
+        different interconnect needs no code edit. Strict parsing (the
+        FLX401 contract): a malformed value raises naming the variable
+        instead of silently running with defaults."""
+        import os
+
+        from ..utils.faults import _env_float
+        for var, attr in (("FF_ICI_GBPS", "ici_bytes_per_s"),
+                          ("FF_DCN_GBPS", "dcn_bytes_per_s")):
+            raw = os.environ.get(var)
+            if raw is not None and raw != "":
+                val = _env_float(var, raw)
+                if val <= 0:
+                    raise ValueError(
+                        f"{var} must be a positive bandwidth in GB/s, "
+                        f"got {raw!r}")
+                setattr(self, attr, val * 1e9)
+        return self
+
     @staticmethod
     def detect() -> "TPUSpec":
         """Pick the spec matching the attached accelerator (falls back to
-        the v5e defaults off-TPU)."""
+        the v5e defaults off-TPU), then apply FF_ICI_GBPS/FF_DCN_GBPS
+        env overrides."""
         try:
             import jax
             kind = jax.devices()[0].device_kind.lower()
         except Exception:
-            return TPUSpec()
+            return TPUSpec().apply_env_overrides()
         if "v4" in kind:
-            return TPUSpec.v4()
+            return TPUSpec.v4().apply_env_overrides()
         if "v5p" in kind or "v5 p" in kind:
             return TPUSpec(name="v5p", mxu_flops=459e12, mxu_flops_f32=115e12,
                            hbm_bytes_per_s=2765e9, ici_bytes_per_s=100e9,
-                           ici_links=6, hbm_capacity_bytes=95e9)
+                           ici_links=6, hbm_capacity_bytes=95e9
+                           ).apply_env_overrides()
         if "v6" in kind:
             return TPUSpec(name="v6e", mxu_flops=918e12, mxu_flops_f32=230e12,
                            hbm_bytes_per_s=1640e9, ici_bytes_per_s=90e9,
-                           ici_links=4, hbm_capacity_bytes=32e9)
-        return TPUSpec()
+                           ici_links=4, hbm_capacity_bytes=32e9
+                           ).apply_env_overrides()
+        return TPUSpec().apply_env_overrides()
 
 
 class CostModel:
@@ -174,9 +198,10 @@ class CostModel:
         """Roofline time for one device's shard of `op` (seconds)."""
         # residency/device-type must key the cache: a ZCM config and an
         # HBM config with equal degrees have sharply different costs, and
-        # MCMC rewrite proposals compare exactly such pairs
-        key = (op.name, pc.degrees, pc.device_type, pc.memory_types,
-               backward)
+        # MCMC rewrite proposals compare exactly such pairs (the PARAM-
+        # axis row-shard degree likewise changes the update/comm shape)
+        key = (op.name, pc.degrees, getattr(pc, "param_degree", 1),
+               pc.device_type, pc.memory_types, backward)
         if key in self._cache:
             return self._cache[key]
 
@@ -365,6 +390,20 @@ class CostModel:
     def _ici_allreduce_bw(self) -> float:
         return self.axis_bw("ici")
 
+    def alltoall_time_axes(self, bytes_per_dev: float, axes) -> float:
+        """All-to-all over `axes` = [(kind, size), ...]: each device
+        exchanges (size−1)/size of its `bytes_per_dev` payload with its
+        peers along that axis at the axis's bandwidth — the lookup/row
+        exchange of row-sharded embedding tables. Hierarchical like
+        allreduce_time_axes: a multi-axis shard group pays each axis's
+        phase on that axis's channel."""
+        t, b = 0.0, float(bytes_per_dev)
+        for kind, size in axes:
+            if size <= 1:
+                continue
+            t += b * (size - 1) / size / self.axis_bw(kind)
+        return t
+
     def resharding_time(self, tensor_bytes: float, src_pc: ParallelConfig,
                         dst_pc: ParallelConfig,
                         kind: str = "ici") -> float:
@@ -372,13 +411,18 @@ class CostModel:
         consumer's (the reference gets this implicitly from Legion region
         intersections, simulator.cc:279-326; GSPMD emits collectives).
         `kind` picks the channel the move rides ("dcn" when the redistri-
-        bution crosses the slice axis)."""
-        if src_pc.degrees == dst_pc.degrees:
+        bution crosses the slice axis). PARAM-axis (row-shard) degrees
+        count as parts too: resharding a row-sharded table (elastic
+        recovery) is an all-to-all of the row blocks."""
+        pd_s = max(getattr(src_pc, "param_degree", 1), 1)
+        pd_d = max(getattr(dst_pc, "param_degree", 1), 1)
+        if src_pc.degrees == dst_pc.degrees and pd_s == pd_d:
             return 0.0
         # approximate: every device re-reads its destination shard from
         # peers — an all-to-all of the full tensor over the channel
-        moved = tensor_bytes * (1.0 - 1.0 / max(src_pc.num_parts,
-                                                dst_pc.num_parts, 1))
+        moved = tensor_bytes * (1.0 - 1.0 / max(src_pc.num_parts * pd_s,
+                                                dst_pc.num_parts * pd_d,
+                                                1))
         return moved / self.axis_bw(kind)
 
     def grad_sync_time(self, param_bytes: float, replicas: int,

@@ -30,7 +30,7 @@ from typing import Dict, Optional, Tuple
 from ..core.op import InputOp
 from ..parallel.mesh import structural_axis_sizes
 from ..parallel.pconfig import ParallelConfig, StrategyMap
-from ..parallel.sharding import clamp_degrees
+from ..parallel.sharding import clamp_degrees, clamp_param_degree
 from ..utils.logging import get_logger
 
 log_replan = get_logger("replan")
@@ -58,7 +58,12 @@ def clamp_strategies(model, strategies: Optional[StrategyMap],
         out[op.name] = ParallelConfig(
             clamp_degrees(pc.degrees, axis_sizes),
             device_type=pc.device_type,
-            memory_types=pc.memory_types)
+            memory_types=pc.memory_types,
+            # row-sharded tables RESHARD onto the survivors (the largest
+            # feasible shard count), they don't fall back to replication
+            # — replicating a >HBM table is exactly what cannot happen
+            param_degree=clamp_param_degree(
+                getattr(pc, "param_degree", 1), axis_sizes))
     return out
 
 

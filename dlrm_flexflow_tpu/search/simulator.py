@@ -32,6 +32,8 @@ import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax.numpy as jnp
+
 from ..core.op import InputOp, Op
 from ..parallel.pconfig import ParallelConfig, StrategyMap
 from .cost_model import CostModel
@@ -125,8 +127,13 @@ class Simulator:
     def _reshard_spec(self, src_pc: ParallelConfig, dst_pc: ParallelConfig,
                       topo) -> Optional[Tuple[str, int]]:
         """(kind, channel) the src→dst redistribution rides: the slowest
-        axis whose per-dim assignment changes. None = layouts agree."""
-        if src_pc.degrees == dst_pc.degrees:
+        axis whose per-dim assignment changes. None = layouts agree.
+        Configs that differ on the PARAM (row-shard) axis ride the axes
+        the larger row-shard degree occupies — an all-to-all of row
+        blocks, NOT the flat-ICI COMM_DEVICE fallback."""
+        pd_s = max(getattr(src_pc, "param_degree", 1), 1)
+        pd_d = max(getattr(dst_pc, "param_degree", 1), 1)
+        if src_pc.degrees == dst_pc.degrees and pd_s == pd_d:
             return None
         sa = self._assign(src_pc.degrees, topo)
         da = self._assign(dst_pc.degrees, topo)
@@ -138,6 +145,11 @@ class Simulator:
         involved = set()
         for s, d in zip(sa, da):
             involved |= set(s) ^ set(d)
+        if pd_s != pd_d:
+            from ..parallel.sharding import param_axis_indices
+            pidx = param_axis_indices(max(pd_s, pd_d),
+                                      [s for _, s in topo])
+            involved |= set(pidx or ())
         if not involved:
             return None
         dcn = [i for i in involved if _axis_kind(topo[i][0]) == "dcn"]
@@ -168,12 +180,49 @@ class Simulator:
                 return None
             return new_task(comm_t, chan, name)
 
+        def _a2a_axes(pd):
+            """[(axis_idx, kind, size)] the pd-way row shards occupy."""
+            from ..parallel.sharding import param_axis_indices
+            pidx = param_axis_indices(pd, [s for _, s in topo])
+            return [(i, _axis_kind(topo[i][0]), topo[i][1])
+                    for i in (pidx or ())]
+
+        def _a2a_chain(parents, bytes_per_dev, pd, label):
+            """Chain one all-to-all comm task per row axis (each on its
+            own channel, hierarchical like the allreduce chain) after
+            `parents`; returns the new frontier."""
+            for i, kind, size in _a2a_axes(pd):
+                t_ax = self.cost.alltoall_time_axes(bytes_per_dev,
+                                                    [(kind, size)])
+                if t_ax <= 0:
+                    continue
+                c = new_task(t_ax, self._channel(i),
+                             f"{label}[{topo[i][0]}]")
+                for p in parents:
+                    p.add_next(c)
+                parents = [c]
+            return parents
+
         # forward tasks per op per participating device
+        itemsize = jnp.dtype(self.cost.compute_dtype).itemsize
         for op in ops:
             pc = strategies[op.name]
             ct = self.cost.op_compute_time(op, pc, backward=False)
             fwd_of[op.name] = [new_task(ct, d, f"fwd:{op.name}")
                                for d in self._participants(pc, ndev, op)]
+            # row-sharded embedding lookups: explicit all-to-alls ride
+            # the row axes' channels — request ids to the owning shards
+            # before the local gather, embedded rows back after it
+            pd = max(getattr(pc, "param_degree", 1), 1)
+            if pd > 1 and hasattr(op, "alltoall_payload_bytes"):
+                req_b, rows_b, _ = op.alltoall_payload_bytes(ndev,
+                                                             itemsize)
+                req = _a2a_chain([], req_b, pd, f"a2a_idx:{op.name}")
+                for r in req:
+                    for ft in fwd_of[op.name]:
+                        r.add_next(ft)
+                fwd_of[op.name] = _a2a_chain(fwd_of[op.name], rows_b,
+                                             pd, f"a2a_rows:{op.name}")
             # dependency + resharding comm from producers
             for src in op.inputs:
                 if src.owner_op is None or isinstance(src.owner_op, InputOp):
@@ -243,7 +292,15 @@ class Simulator:
             # channel (phases over different axes of different ops overlap)
             asn = self._assign(pc.degrees, topo)
             parents: List[SimTask] = list(bwd_of[op.name])
-            if replicas > 1:
+            pd = max(getattr(pc, "param_degree", 1), 1)
+            if pd > 1 and hasattr(op, "alltoall_payload_bytes"):
+                # row-sharded table: gradient rows route to their owning
+                # shard (all-to-all over the row axes) instead of a DP
+                # all-reduce — optimizer state stays shard-local
+                _, _, grad_b = op.alltoall_payload_bytes(ndev, itemsize)
+                parents = _a2a_chain(parents, grad_b, pd,
+                                     f"a2a_grad:{op.name}")
+            elif replicas > 1:
                 if asn is not None and asn[0]:
                     b = float(dev_bytes)
                     for ax in asn[0]:
@@ -268,14 +325,23 @@ class Simulator:
             if self.cost._host_resident(op, pc):
                 upd_compute = self.cost.host_update_time(op, pc)
             else:
+                # the sparse scatter divides by how many shards the
+                # TABLE actually splits into (param_shard_shapes:
+                # row/table/width sharding), not by the output parts —
+                # a REPLICATED table applies the full update set on
+                # every replica (GSPMD gathers the updates), which is
+                # what makes pure DP lose to row sharding at scale
+                full_bytes = sum(
+                    math.prod(d.shape) * 4.0
+                    for d in op.param_defs().values())
+                tshards = max(full_bytes / max(shard_bytes, 1.0), 1.0)
                 upd_compute = max(
                     dev_bytes / self.cost._hbm_rate() * 3.0,  # r/w+momentum
                     # sparse touched-rows scatter is random-access
                     # latency bound (write-pipeline rate, slower than
                     # the gather's)
                     self.cost.scatter_rows_time(
-                        op.update_random_hbm_rows(pc)
-                        / max(pc.num_parts, 1)))
+                        op.update_random_hbm_rows(pc) / tshards))
             for d in self._participants(pc, ndev, op):
                 u = new_task(upd_compute, d, f"update:{op.name}")
                 for p in parents:
@@ -313,24 +379,33 @@ class Simulator:
         raw degrees — their table dim is intent, not an output
         partitioning."""
         from ..parallel.mesh import structural_axis_sizes
-        from ..parallel.sharding import feasible_degrees_for
+        from ..parallel.sharding import (clamp_param_degree,
+                                         feasible_degrees_for)
         if self.model.mesh is not None and self.model.mesh.size == ndev:
             from ..parallel.sharding import AxisAssigner
-            feas = AxisAssigner(self.model.mesh).feasible_degrees()
+            asn = AxisAssigner(self.model.mesh)
+            feas, axis_sizes = asn.feasible_degrees(), asn.axis_sizes
         else:
-            feas = feasible_degrees_for(structural_axis_sizes(ndev))
+            axis_sizes = structural_axis_sizes(ndev)
+            feas = feasible_degrees_for(axis_sizes)
         out = {}
         by_name = {op.name: op for op in self.model.ops}
         for name, pc in strategies.items():
             op = by_name.get(name)
+            pd = clamp_param_degree(getattr(pc, "param_degree", 1),
+                                    axis_sizes)
             if (op is None or not op.outputs
                     or getattr(op, "raw_degree_semantics", False)):
+                if pd != getattr(pc, "param_degree", 1):
+                    pc = ParallelConfig(pc.degrees, pc.device_type,
+                                        pc.device_ids, pc.memory_types,
+                                        param_degree=pd)
                 out[name] = pc
                 continue
             shape = op.outputs[0].shape
             degs = list(pc.degrees)[:len(shape)]
             degs += [1] * (len(shape) - len(degs))
-            changed = False
+            changed = pd != getattr(pc, "param_degree", 1)
             for i, d in enumerate(degs):
                 d = min(d, shape[i])
                 while d > 1 and (shape[i] % d != 0 or d not in feas):
@@ -339,7 +414,8 @@ class Simulator:
                     changed = True
                 degs[i] = max(d, 1)
             out[name] = (ParallelConfig(tuple(degs), pc.device_type,
-                                        pc.device_ids, pc.memory_types)
+                                        pc.device_ids, pc.memory_types,
+                                        param_degree=pd)
                          if changed else pc)
         return out
 

@@ -119,6 +119,13 @@ def mesh_meta(model) -> Dict[str, Any]:
     strategies = getattr(model, "strategies", None) or {}
     meta["degrees"] = {name: list(map(int, pc.degrees))
                        for name, pc in strategies.items()}
+    # PARAM-axis (row-shard) degrees, only where active — a reader can
+    # tell a row-sharded snapshot's layout without loading the model
+    pds = {name: int(getattr(pc, "param_degree", 1))
+           for name, pc in strategies.items()
+           if getattr(pc, "param_degree", 1) > 1}
+    if pds:
+        meta["param_degrees"] = pds
     return meta
 
 

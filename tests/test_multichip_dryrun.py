@@ -21,6 +21,8 @@ def test_dryrun_8dev_no_spmd_rematerialization():
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-4000:]
     assert "ok, loss=" in out
+    # the row-sharded (PARAM-axis, all-to-all routed) config trained
+    assert "rowshard ok" in out
     # the SOAP-searched InceptionV3 strategy (.pb) loaded and trained
     pb = os.path.join(REPO, "strategies", "inception_v3_8dev_ici_flat.pb")
     assert os.path.exists(pb), (
