@@ -242,6 +242,21 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             shard = {"error": str(exc)[:200]}
 
+    # opt-in lowered-HLO collective audit (BENCH_AUDIT=1): predicted-vs-
+    # lowered collective-bytes drift for the bench_shard row-sharded and
+    # replicated plans (shardcheck FLX51x over the real bench model)
+    audit = None
+    if os.environ.get("BENCH_AUDIT"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_audit import measure as _audit_measure
+            audit = _audit_measure(
+                tolerance=float(os.environ.get("BENCH_AUDIT_TOLERANCE",
+                                               "0.25")))
+        except Exception as exc:
+            audit = {"error": str(exc)[:200]}
+
     # opt-in serving-fleet smoke (BENCH_SERVE_FLEET=1): attained QPS at
     # a p99 SLO for 1/2/4 replicas under open-loop Poisson load, zero
     # failed requests with one replica killed mid-run, continuous vs
@@ -293,6 +308,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         out["serve_fleet"] = serve_fleet
     if shard is not None:
         out["shard"] = shard
+    if audit is not None:
+        out["audit"] = audit
     print(json.dumps(out))
     return 0
 

@@ -61,6 +61,39 @@ RULES = {
     "FLX401": ("env-parse-unchecked", "medium",
                "int()/float() directly on an os.environ value without a "
                "ValueError guard naming the variable"),
+    # --- SPMD plan verification (analysis/shardcheck.py) ----------------
+    "FLX501": ("implicit-reshard", "medium",
+               "producer/consumer sharding degrees disagree: GSPMD "
+               "legally inserts a resharding collective at this op "
+               "boundary (high when the moved tensor is table-scale)"),
+    "FLX502": ("replicated-table-update", "high",
+               "table-scale parameter replicated under data-parallel "
+               "updates: every step moves a table-scale gradient "
+               "collective (the bench_shard-measured 66x vs row-shard)"),
+    "FLX503": ("hbm-over-cap", "high",
+               "per-device residency (params + optimizer state + live "
+               "activations) exceeds the HBM capacity cap (--hbm-gb)"),
+    "FLX504": ("param-degree-misuse", "high",
+               "strategy requests param_degree row sharding the op "
+               "cannot execute (no configure_row_shard support, "
+               "non-factorizing degree, rows/batch indivisible) — "
+               "compile() silently falls back to replicated rows"),
+    "FLX505": ("elastic-clamp-hazard", "medium",
+               "plan cannot project onto the survivor mesh: "
+               "clamp_strategies would shed row shards into replication "
+               "or exceed the survivor's HBM"),
+    # --- lowered-HLO audit (analysis/hlo_audit.py) ----------------------
+    "FLX511": ("hlo-table-collective", "high",
+               "lowered HLO moves a table-scale buffer through an "
+               "all-gather/all-reduce/reduce-scatter (an implicit "
+               "reshard or replicated-table gradient sync)"),
+    "FLX512": ("hlo-missed-donation", "medium",
+               "large entry parameter is not input-output aliased "
+               "(missed donation: the buffer double-allocates)"),
+    "FLX513": ("hlo-collective-drift", "medium",
+               "measured collective bytes in the lowered HLO drift "
+               "beyond tolerance from the cost model's prediction "
+               "(the search is pricing a different program)"),
 }
 
 

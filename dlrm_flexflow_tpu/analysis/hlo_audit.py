@@ -1,0 +1,330 @@
+"""Lowered-HLO collective auditor (the FLX51x rules).
+
+The static plan verifier (:mod:`.shardcheck`) reasons about what GSPMD
+*will* do; this module checks what it *did*: AOT-lower the train step /
+serving forward through `FFModel.lowered_train_hlo` /
+`lowered_eval_hlo` (the post-SPMD-partitioning program, every inserted
+collective visible at concrete per-device shapes) and scan the text for
+hazards the type system cannot express:
+
+- FLX511 hlo-table-collective — an all-gather / all-reduce /
+  reduce-scatter moving a table-scale buffer. This is the lowered form
+  of the silent 66x failure: a replicated table under data-parallel
+  updates lowers to a full-table gradient collective every step.
+- FLX512 hlo-missed-donation — a large entry parameter with no
+  input-output alias: the buffer double-allocates (donate_argnums
+  regressions show up here before they show up as OOMs).
+- FLX513 hlo-collective-drift — measured collective bytes disagree with
+  the cost model's prediction beyond tolerance: the strategy search is
+  pricing a different program than the one that runs.
+
+Byte accounting convention: a collective "costs" its per-device buffer
+bytes (tuple results sum their elements) — the same quantity the
+predictions compute, so measured and predicted compare like for like.
+The drift report also carries the BALANCED (ragged/production) exchange
+bytes the cost model prices, so the dense-padding factor stays visible
+instead of being silently mixed into "drift".
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .findings import Finding, make_finding, sort_findings
+from .shardcheck import _fmt_bytes, table_scale_threshold
+
+# entry parameters at/above this size must be donated unless they are
+# step inputs (batches re-stage every step and cannot alias)
+DONATE_MIN_BYTES = 1 << 20
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"([a-z]+\d*)\[([\d,]*)\]")
+_COLLECTIVE_RE = re.compile(
+    r"%?([\w.-]+) = (\([^)]*\)|[a-z]+\d*\[[\d,]*\][^ ]*) "
+    r"(all-gather|all-reduce|all-to-all|reduce-scatter|"
+    r"collective-permute)(?:-start)?\(")
+
+
+def _shape_bytes(dtype: str, dims: str) -> float:
+    isz = _DTYPE_BYTES.get(dtype)
+    if isz is None:
+        return 0.0
+    n = 1
+    for d in dims.split(","):
+        if d.strip():
+            n *= int(d)
+    return float(n * isz)
+
+
+def _type_bytes(type_str: str) -> float:
+    """Bytes of an HLO result type: plain `f32[4,16384,32]{...}` or a
+    tuple `(s32[1,32]{1,0}, s32[1,32]{1,0}, ...)` (summed)."""
+    return sum(_shape_bytes(dt, dims)
+               for dt, dims in _SHAPE_RE.findall(type_str))
+
+
+class HloAudit:
+    """Parsed collective/donation facts of one lowered module."""
+
+    def __init__(self, text: str):
+        self.collectives: List[Tuple[str, str, float]] = []  # kind,name,B
+        for m in _COLLECTIVE_RE.finditer(text):
+            name, type_str, kind = m.group(1), m.group(2), m.group(3)
+            self.collectives.append((kind, name, _type_bytes(type_str)))
+        self.counts: Dict[str, int] = {}
+        self.bytes_by_kind: Dict[str, float] = {}
+        for kind, _name, b in self.collectives:
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+            self.bytes_by_kind[kind] = self.bytes_by_kind.get(kind,
+                                                             0.0) + b
+        self.entry_param_bytes = self._parse_entry_params(text)
+        self.aliased_params = self._parse_aliased(text)
+
+    @staticmethod
+    def _parse_entry_params(text: str) -> List[float]:
+        m = re.search(r"entry_computation_layout=\{\((.*?)\)->", text,
+                      re.S)
+        if not m:
+            return []
+        return [_shape_bytes(dt, dims)
+                for dt, dims in _SHAPE_RE.findall(m.group(1))]
+
+    @staticmethod
+    def _parse_aliased(text: str) -> set:
+        start = text.find("input_output_alias={")
+        if start < 0:
+            return set()
+        # brace-balanced scan: alias entries nest one level ({0}: (0,
+        # {}, may-alias)), so a lazy regex would cut at the first '}'
+        i = text.index("{", start)
+        depth, j = 0, i
+        while j < len(text):
+            if text[j] == "{":
+                depth += 1
+            elif text[j] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        return {int(p) for p in
+                re.findall(r":\s*\((\d+)", text[i:j + 1])}
+
+
+def predicted_collective_bytes(model) -> Dict[str, float]:
+    """Per-device collective bytes per train step the cost model's view
+    of the COMPILED strategies implies.
+
+    - ``all-to-all``: the dense padded row-shard exchange
+      (`parallel.alltoall.dense_exchange_hlo_bytes`) for every op with
+      an active `_row_plan` — the lowering is deterministic, so this is
+      exact, not approximate.
+    - ``all-to-all-balanced``: the balanced (ragged/production) exchange
+      the cost model actually prices (`exchange_bytes_per_step`), for
+      the drift report's context.
+    - ``all-reduce``: the data-parallel gradient sync the simulator
+      prices per parameter — min(shard bytes, touched bytes), fp32 —
+      for every replicated-updated op. A replicated table on the dense
+      path predicts its full table here; measured exceeding predicted
+      is exactly the cost-model drift FLX513 exists to surface.
+    """
+    from ..core.op import InputOp
+    from ..parallel.alltoall import (dense_exchange_hlo_bytes,
+                                     exchange_bytes_per_step)
+    host_res = set(getattr(model, "_host_resident_ops", set()) or set())
+    out = {"all-to-all": 0.0, "all-to-all-balanced": 0.0,
+           "all-reduce": 0.0}
+    ndev = int(model.mesh.size) if model.mesh is not None else 1
+    for op in model.ops:
+        if isinstance(op, InputOp) or op.name in host_res:
+            continue
+        plan = getattr(op, "_row_plan", None)
+        if plan is not None:
+            from ..ops.embedding import _lookup_count
+            lookups = int(_lookup_count(op))
+            d = op.out_dim
+            out["all-to-all"] += dense_exchange_hlo_bytes(plan, lookups,
+                                                          d)
+            out["all-to-all-balanced"] += exchange_bytes_per_step(
+                plan, lookups, d)
+            continue
+        if not op.param_defs():
+            continue
+        pc = model.strategies.get(op.name)
+        if pc is None or pc.device_type == "CPU":
+            continue
+        replicas = pc.degrees[0] if pc.degrees else 1
+        if replicas <= 1:
+            continue
+        shard_bytes = sum(
+            math.prod(shape) * 4.0
+            for shape in op.param_shard_shapes(pc, ndev).values())
+        touched = op.param_bytes_touched_per_step(max(pc.num_parts, 1))
+        out["all-reduce"] += min(shard_bytes, touched)
+    return out
+
+
+def audit_hlo_text(text: str, *, table_scale_bytes: Optional[float],
+                   nondonated_ok_bytes: float = 0.0,
+                   check_donation: bool = True,
+                   path: str = "<hlo>",
+                   scope: str = "train_step"
+                   ) -> Tuple[List[Finding], HloAudit]:
+    """Structure-only audit of one lowered module (FLX511/512). Pure
+    text analysis so tests can feed synthetic modules; byte thresholds
+    come from the caller."""
+    audit = HloAudit(text)
+    findings: List[Finding] = []
+    if table_scale_bytes is not None:
+        for kind, name, b in audit.collectives:
+            if kind in ("all-gather", "all-reduce", "reduce-scatter") \
+                    and b >= table_scale_bytes:
+                findings.append(make_finding(
+                    "FLX511", path, 0,
+                    f"{scope}: {kind} {name!r} moves {_fmt_bytes(b)} "
+                    f"(table-scale) every step — an implicit reshard or "
+                    f"replicated-table gradient sync; row-shard the "
+                    f"table (param_degree) or fix the producer/consumer "
+                    f"shardings", scope=scope, token=f"{kind}:{name}"))
+    if check_donation:
+        floor = max(float(DONATE_MIN_BYTES), float(nondonated_ok_bytes))
+        for i, b in enumerate(audit.entry_param_bytes):
+            if b > floor and i not in audit.aliased_params:
+                findings.append(make_finding(
+                    "FLX512", path, 0,
+                    f"{scope}: entry parameter {i} ({_fmt_bytes(b)}) is "
+                    f"not input-output aliased — the buffer double-"
+                    f"allocates (missed donate_argnums?)",
+                    scope=scope, token=f"param{i}"))
+    return findings, audit
+
+
+def audit_model(model, device_batch=None, *, tolerance: float = 0.25,
+                table_scale_bytes: Optional[float] = None,
+                include_eval: bool = False,
+                path: str = "<model>"
+                ) -> Tuple[List[Finding], Dict[str, object]]:
+    """Lower the model's train step (and optionally the serving forward)
+    and audit the partitioned HLO. Returns (findings, report); report
+    carries per-kind collective counts/bytes, the cost-model
+    predictions, and the relative drift per kind."""
+    tscale = table_scale_threshold(model, table_scale_bytes)
+    # batch inputs re-stage every step and legitimately aren't donated;
+    # anything bigger than the largest batch leaf must alias
+    ndev = int(model.mesh.size) if model.mesh is not None else 1
+    batch_leaf = 0.0
+    for t in model.input_tensors + ([model.label_tensor]
+                                    if model.label_tensor is not None
+                                    else []):
+        import numpy as np
+        import jax.numpy as jnp
+        b = float(math.prod(t.shape)) * jnp.dtype(t.dtype).itemsize
+        batch_leaf = max(batch_leaf, b / max(ndev, 1))
+    text = model.lowered_train_hlo(device_batch)
+    findings, audit = audit_hlo_text(
+        text, table_scale_bytes=tscale, nondonated_ok_bytes=batch_leaf,
+        path=path, scope="train_step")
+
+    predicted = predicted_collective_bytes(model)
+    measured = dict(audit.bytes_by_kind)
+    report: Dict[str, object] = {
+        "collective_counts": dict(audit.counts),
+        "measured_bytes": {k: round(v) for k, v in measured.items()},
+        "predicted_bytes": {k: round(v) for k, v in predicted.items()},
+        "tolerance": tolerance,
+    }
+    drift: Dict[str, float] = {}
+    # all-to-all: the dense exchange is deterministic — symmetric drift
+    pred_a2a = predicted.get("all-to-all", 0.0)
+    meas_a2a = measured.get("all-to-all", 0.0)
+    if pred_a2a > 0:
+        drift["all-to-all"] = abs(meas_a2a - pred_a2a) / pred_a2a
+        if drift["all-to-all"] > tolerance:
+            findings.append(make_finding(
+                "FLX513", path, 0,
+                f"all-to-all bytes drift: lowered HLO moves "
+                f"{_fmt_bytes(meas_a2a)}/device/step, the cost model "
+                f"prices {_fmt_bytes(pred_a2a)} "
+                f"({drift['all-to-all']:+.0%} vs tolerance "
+                f"{tolerance:.0%}) — the search is pricing a different "
+                f"exchange than the one that runs",
+                scope="train_step", token="a2a-drift"))
+    elif meas_a2a > 0:
+        drift["all-to-all"] = float("inf")
+    # all-reduce: scalar metric/loss reductions ride along, so only an
+    # EXCESS beyond tolerance (and at least 1 MiB) is drift — that is
+    # precisely the replicated-table gradient the model did not price
+    pred_ar = predicted.get("all-reduce", 0.0)
+    meas_ar = measured.get("all-reduce", 0.0)
+    if pred_ar > 0 or meas_ar > 0:
+        base = max(pred_ar, 1.0)
+        drift["all-reduce"] = (meas_ar - pred_ar) / base
+        if (meas_ar - pred_ar) > tolerance * base \
+                and (meas_ar - pred_ar) >= float(1 << 20):
+            findings.append(make_finding(
+                "FLX513", path, 0,
+                f"all-reduce bytes drift: lowered HLO moves "
+                f"{_fmt_bytes(meas_ar)}/device/step, the cost model "
+                f"prices {_fmt_bytes(pred_ar)} — GSPMD is syncing "
+                f"{_fmt_bytes(meas_ar - pred_ar)} the search never "
+                f"charged for (replicated-table gradient?)",
+                scope="train_step", token="ar-drift"))
+    report["drift"] = {k: (round(v, 4) if v != float("inf") else "inf")
+                       for k, v in drift.items()}
+
+    if include_eval:
+        eval_text = model.lowered_eval_hlo()
+        eval_findings, eval_audit = audit_hlo_text(
+            eval_text, table_scale_bytes=tscale, check_donation=False,
+            path=path, scope="eval_step")
+        findings.extend(eval_findings)
+        report["eval_collective_counts"] = dict(eval_audit.counts)
+    return sort_findings(findings), report
+
+
+def audit_file(path: str, model_name: Optional[str] = None,
+               ndev: Optional[int] = None, batch: Optional[int] = None,
+               tolerance: float = 0.25
+               ) -> Tuple[List[Finding], Dict[str, object]]:
+    """CLI entry: build + compile the strategy file's target model on
+    the attached devices and audit its lowered train step. Raises
+    RuntimeError when the local device count cannot host the plan's
+    mesh (the static verifier still covers those plans)."""
+    import os
+
+    import jax
+
+    from .shardcheck import build_target_model, infer_target
+    from ..parallel.mesh import make_mesh
+    from ..parallel.strategy_io import load_strategies
+    inferred = infer_target(path)
+    if model_name is None or ndev is None:
+        if inferred is None:
+            raise ValueError(
+                f"{path}: cannot infer target model/mesh — pass "
+                f"--model/--ndev")
+        model_name = model_name or inferred[0]
+        ndev = ndev or inferred[1]
+    devs = jax.devices()
+    if len(devs) < ndev:
+        raise RuntimeError(
+            f"audit needs {ndev} local devices, have {len(devs)} "
+            f"(JAX_PLATFORMS=cpu + XLA_FLAGS "
+            f"--xla_force_host_platform_device_count={ndev} to "
+            f"virtualize)")
+    model = build_target_model(model_name, ndev, batch=batch)
+    strategies = load_strategies(path, num_devices=ndev,
+                                 known_ops={op.name for op in model.ops})
+    from ..core.optimizers import SGDOptimizer
+    model.compile(SGDOptimizer(lr=0.05), "mean_squared_error", ["mse"],
+                  mesh=make_mesh(devices=devs[:ndev]),
+                  strategies=strategies)
+    model.init_layers()
+    return audit_model(model, tolerance=tolerance,
+                       path=os.path.basename(path))

@@ -1,0 +1,532 @@
+"""shardcheck: static SPMD plan verifier (the FLX5xx rules).
+
+flexcheck (PR 7) gave locks and threads static verification; this module
+gives the same treatment to SOAP strategy plans — the paper's thesis is
+that the plan IS the performance contract, and the worst failure mode of
+the pod-scale strategy space (PR 8) is *silent*: GSPMD legally inserts a
+full-table all-gather or resharding copy when producer/consumer
+shardings disagree, and the run is merely 66x slower (the exact gap
+bench_shard.py measured between replicated and row-sharded plans) or
+OOMs at scale instead of erroring.
+
+The verifier abstractly interprets a strategy map against a factorized
+mesh — propagating (shape, per-dim degrees, mesh-axis assignment, bytes)
+through the op graph with the SAME algorithms compile() uses
+(`parallel.sharding.assign_indices`, `Simulator._clamp_strategies`) — so
+what it flags is what GSPMD will do, not a parallel reimplementation's
+guess. No jax Mesh (and no devices) are needed: a 64-device terabyte
+plan verifies from a laptop.
+
+Rules (registered in findings.RULES; suppressible via the shared
+baseline machinery):
+
+- FLX501 implicit-reshard: producer/consumer degree mismatch at an op
+  boundary — GSPMD inserts a resharding collective there. High severity
+  when the moved tensor is table-scale.
+- FLX502 replicated-table-update: a table-scale parameter replicated
+  under data-parallel outputs — every step pays a table-scale gradient
+  collective (GSPMD gathers the update set per replica).
+- FLX503 hbm-over-cap: per-device residency over the ``--hbm-gb`` cap
+  (the accounting is `search.simulator.hbm_footprint_report`, shared
+  with the MCMC search's feasibility check).
+- FLX504 param-degree-misuse: the plan requests row sharding the op
+  cannot execute; compile() would degrade to replicated rows with only
+  a log warning (`ops.embedding.row_shard_structural_reason` is the
+  shared rule set).
+- FLX505 elastic-clamp-hazard: `search.replan.clamp_report` projects
+  the plan onto a survivor mesh and the projection sheds row shards
+  into replication (or cannot fit).
+
+The lowered-HLO half of the PR lives in :mod:`.hlo_audit` (FLX51x).
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .findings import Finding, make_finding, severity_at_least, sort_findings
+
+# plan-finding suppressions live in their own baseline file (same
+# machinery as flexcheck's analysis/baseline.json, separate namespace:
+# plan keys are keyed by strategy FILE, and flexcheck's stale-entry
+# nagging must not see them as dead AST suppressions)
+DEFAULT_PLAN_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "shardcheck_baseline.json")
+
+# a collective/reshard moving at least this many bytes per step is worth
+# a medium finding even when no table gives a relative scale
+RESHARD_WARN_BYTES = 1 << 20
+# absolute floor for "table-scale": tables below this never make a
+# collective high-severity (tiny test models reshard kilobytes legally)
+TABLE_SCALE_MIN_BYTES = 1 << 20
+# fraction of the largest table that counts as "table-scale" traffic
+TABLE_SCALE_FRAC = 0.25
+
+
+def table_scale_threshold(model,
+                          table_scale_bytes: Optional[float] = None
+                          ) -> Optional[float]:
+    """Bytes above which a moved buffer counts as table-scale: a quarter
+    of the model's largest embedding table (fp32), floored at 1 MiB.
+    None when the model has no tables and no explicit threshold —
+    table-scale rules stay silent then."""
+    if table_scale_bytes is not None:
+        return float(table_scale_bytes)
+    tables = [op.param_bytes() for op in model.ops
+              if hasattr(op, "host_lookup") and op.param_defs()]
+    if not tables:
+        return None
+    return max(float(TABLE_SCALE_MIN_BYTES),
+               TABLE_SCALE_FRAC * max(tables))
+
+
+def default_topology(model, ndev: int
+                     ) -> List[Tuple[str, int]]:
+    """[(kind, size), ...] for the target mesh: the compiled mesh's axis
+    names when one is attached and matches (axes named dcn* ride DCN),
+    else the structural factorization make_mesh would build — the same
+    fallback the simulator uses, so both price the same axes."""
+    mesh = getattr(model, "mesh", None)
+    if mesh is not None and mesh.size == ndev:
+        return [("dcn" if str(a).startswith("dcn") else "ici",
+                 int(mesh.shape[a])) for a in mesh.axis_names]
+    from ..parallel.mesh import structural_axis_sizes
+    return [("ici", s) for s in structural_axis_sizes(ndev)]
+
+
+def resolve_plan(model, strategies, ndev: int):
+    """Expand a loaded strategy map onto the model's ops exactly like
+    compile() does: reference-style generic keys (embedding{i}/linear/
+    concat/mse_loss) resolve onto real ops, everything unnamed gets its
+    default data-parallel config. Mutates ``model.strategies`` (verifier
+    models are throwaway graph builds)."""
+    from ..core.op import InputOp
+    model.strategies = dict(strategies or {})
+    model._resolve_generic_strategy_keys(ndev)
+    resolved = dict(model.strategies)
+    for op in model.ops:
+        if isinstance(op, InputOp):
+            continue
+        resolved.setdefault(op.name, op.default_parallel_config(ndev))
+    return resolved
+
+
+def _fmt_bytes(b: float) -> str:
+    if b >= 1e9:
+        return f"{b / 1e9:.2f} GB"
+    if b >= 1e6:
+        return f"{b / 1e6:.1f} MB"
+    return f"{b / 1e3:.0f} KB"
+
+
+def verify_plan(model, strategies, ndev: Optional[int] = None,
+                topology: Optional[Sequence[Tuple[str, int]]] = None,
+                *, hbm_bytes: Optional[float] = None,
+                survivor_ndev: Optional[int] = None,
+                table_scale_bytes: Optional[float] = None,
+                path: str = "<plan>",
+                resolve: bool = True) -> List[Finding]:
+    """Statically verify `strategies` for `model` on an `ndev` mesh.
+
+    Returns findings (baseline NOT applied); the caller gates them like
+    any other flexcheck pass. `model` needs only the built graph —
+    compile() must NOT have been called for verification to be honest
+    about what a fresh compile of this plan would do (a compiled model's
+    mesh is still consulted for axis kinds when it matches ndev).
+    """
+    from ..core.op import InputOp
+    from ..search.cost_model import CostModel
+    from ..search.simulator import Simulator, hbm_footprint_report
+    from ..parallel.sharding import assign_indices
+
+    if ndev is None:
+        mesh = getattr(model, "mesh", None)
+        ndev = int(mesh.size) if mesh is not None else 1
+    topo = list(topology) if topology is not None else \
+        default_topology(model, ndev)
+    axis_sizes = [s for _, s in topo]
+    cost = CostModel(compute_dtype=model.config.jnp_compute_dtype)
+    resolved = resolve_plan(model, strategies, ndev) if resolve \
+        else dict(strategies)
+    sim = Simulator(model, cost, topology=topo)
+    eff = sim._clamp_strategies(resolved, ndev)
+    tscale = table_scale_threshold(model, table_scale_bytes)
+    findings: List[Finding] = []
+    host_res = set(getattr(model, "_host_resident_ops", set()) or set())
+    for name, pc in resolved.items():
+        if pc.device_type == "CPU" or "ZCM" in pc.memory_types:
+            host_res.add(name)
+
+    ops = [op for op in model.ops if not isinstance(op, InputOp)]
+    by_name = {op.name: op for op in model.ops}
+
+    def _assign(degrees):
+        return assign_indices(list(degrees), axis_sizes)
+
+    # --- FLX501: implicit reshard boundaries ---------------------------
+    for op in ops:
+        if getattr(op, "raw_degree_semantics", False) \
+                or op.name in host_res:
+            continue
+        dst = eff.get(op.name)
+        if dst is None:
+            continue
+        da = _assign(dst.degrees)
+        for t in op.inputs:
+            src_op = t.owner_op
+            if src_op is None or isinstance(src_op, InputOp):
+                continue
+            if getattr(src_op, "raw_degree_semantics", False) \
+                    or src_op.name in host_res:
+                continue
+            src = eff.get(src_op.name)
+            if src is None:
+                continue
+            sa = _assign(src.degrees)
+            if sa is None or da is None:
+                continue
+            nd = max(len(sa), len(da))
+            sa_p = list(sa) + [()] * (nd - len(sa))
+            da_p = list(da) + [()] * (nd - len(da))
+            involved = set()
+            for s, d in zip(sa_p, da_p):
+                involved |= set(s) ^ set(d)
+            if not involved:
+                continue
+            parts = max(src.num_parts, dst.num_parts, 1)
+            moved = cost.tensor_bytes(t) * (1.0 - 1.0 / parts)
+            if moved <= 0:
+                continue
+            kinds = sorted({topo[i][0] for i in involved})
+            sev = "info"
+            if moved >= RESHARD_WARN_BYTES:
+                sev = "medium"
+            if tscale is not None and moved >= tscale:
+                sev = "high"
+            findings.append(make_finding(
+                "FLX501", path, 0,
+                f"implicit reshard between {src_op.name!r} "
+                f"(degrees {src.degrees}) and {op.name!r} "
+                f"(degrees {dst.degrees}): GSPMD moves "
+                f"~{_fmt_bytes(moved)} of {t.name!r} over "
+                f"{'/'.join(kinds)} every step",
+                scope=op.name, token=f"{src_op.name}->{op.name}",
+                severity=sev))
+
+    # --- FLX502: replicated table under data-parallel updates ----------
+    for op in ops:
+        if not (hasattr(op, "host_lookup") and op.param_defs()):
+            continue
+        if op.name in host_res:
+            continue
+        pc = eff.get(op.name)
+        if pc is None:
+            continue
+        pd = max(getattr(pc, "param_degree", 1), 1)
+        replicas = pc.degrees[0] if pc.degrees else 1
+        if pd > 1 or replicas <= 1:
+            continue
+        full = float(op.param_bytes())
+        shard = sum(math.prod(s) * 4.0 for s in
+                    op.param_shard_shapes(pc, ndev).values())
+        if shard < full:          # table/width sharding holds real shards
+            continue
+        if tscale is None or full < tscale:
+            continue
+        findings.append(make_finding(
+            "FLX502", path, 0,
+            f"{op.name!r} replicates a {_fmt_bytes(full)} table across "
+            f"{replicas} data-parallel replicas: every step moves a "
+            f"table-scale gradient collective (bench_shard measured "
+            f"66x vs row sharding) — set param_degree or shard the "
+            f"table dim", scope=op.name, token="replicated-table"))
+
+    # --- FLX503: per-device HBM footprint over the cap -----------------
+    if hbm_bytes is not None:
+        report = hbm_footprint_report(model, cost, eff, ndev)
+        total = sum(report.values())
+        if total > 0.9 * float(hbm_bytes):
+            top = sorted(report.items(), key=lambda kv: -kv[1])[:3]
+            tops = ", ".join(f"{k}={_fmt_bytes(v)}" for k, v in top)
+            findings.append(make_finding(
+                "FLX503", path, 0,
+                f"per-device residency {_fmt_bytes(total)} exceeds 90% "
+                f"of the {_fmt_bytes(float(hbm_bytes))} HBM cap on the "
+                f"{ndev}-device mesh (largest: {tops})",
+                scope="<plan>", token=f"hbm-{ndev}dev"))
+
+    # --- FLX504: param_degree the op cannot execute --------------------
+    from ..ops.embedding import row_shard_structural_reason
+    for name, pc in resolved.items():
+        pd = getattr(pc, "param_degree", 1)
+        if pd <= 1:
+            continue
+        op = by_name.get(name)
+        if op is None:
+            continue
+        if name in host_res:
+            reason = "host-resident/offloaded tables cannot row-shard " \
+                     "in HBM"
+        else:
+            reason = row_shard_structural_reason(op, pc, axis_sizes)
+        if reason is None:
+            continue
+        findings.append(make_finding(
+            "FLX504", path, 0,
+            f"{name!r} requests param_degree={pd} row sharding but "
+            f"{reason}; compile() silently replicates the table (a "
+            f">HBM table then OOMs, a smaller one trains 66x slower)",
+            scope=name, token=f"pd{pd}"))
+
+    # --- FLX505: elastic clamp hazards ---------------------------------
+    if survivor_ndev is not None and survivor_ndev >= 1 \
+            and survivor_ndev < ndev:
+        from ..search.replan import clamp_report
+        for op_name, reason, fatal in clamp_report(
+                model, resolved, survivor_ndev, hbm_bytes=hbm_bytes):
+            findings.append(make_finding(
+                "FLX505", path, 0,
+                f"elastic projection onto {survivor_ndev} survivor "
+                f"device(s): {op_name!r} {reason}",
+                scope=op_name, token=f"surv{survivor_ndev}",
+                severity="high" if fatal else "medium"))
+
+    return sort_findings(findings)
+
+
+# --------------------------------------------------------------------------
+# CLI: verify bundled/user strategy files against their target models
+# --------------------------------------------------------------------------
+
+_FNAME_PATTERNS = [
+    # bundled searched plans: dlrm_kaggle_8dev_dcn_2host_roofline.pb
+    (re.compile(r"dlrm_kaggle_(\d+)dev(_dcn_(\d+)host)?"), "dlrm_kaggle"),
+    (re.compile(r"dlrm_terabyte_(\d+)dev(_dcn(\d+)x\d+)?"),
+     "dlrm_terabyte"),
+    (re.compile(r"inception_v3_(\d+)dev(_dcn_(\d+)host)?"),
+     "inception_v3"),
+    # reference-style generated plans: dlrm_strategy_8embs_8gpus.pb
+    (re.compile(r"dlrm_strategy_(\d+)embs?_(\d+)gpus"), "dlrm_ref"),
+    (re.compile(r"dlrm_strategy_(\d+)nEmb_1cpu_1gpu"), "dlrm_ref_hetero"),
+]
+
+
+def infer_target(path: str
+                 ) -> Optional[Tuple[str, int, Optional[int]]]:
+    """(model_name, ndev, dcn_slices) from a strategy filename, or None
+    when the name matches no bundled convention."""
+    base = os.path.basename(path)
+    for pat, name in _FNAME_PATTERNS:
+        m = pat.search(base)
+        if not m:
+            continue
+        if name == "dlrm_ref":
+            return (f"dlrm_ref{m.group(1)}", int(m.group(2)), None)
+        if name == "dlrm_ref_hetero":
+            return (f"dlrm_ref{m.group(1)}", 2, None)
+        dcn = int(m.group(3)) if len(m.groups()) >= 3 and m.group(3) \
+            else None
+        return (name, int(m.group(1)), dcn)
+    return None
+
+
+def build_target_model(name: str, ndev: int,
+                       batch: Optional[int] = None):
+    """Build the (uncompiled) op graph a bundled strategy file targets.
+    Table sizes are the REAL workload's — byte thresholds must see the
+    true scale even though no array is ever allocated."""
+    from ..config import FFConfig
+    from ..core.model import FFModel
+    batch = batch if batch else 64 * max(ndev, 1)
+    if name.startswith("dlrm"):
+        from ..models.dlrm import DLRMConfig, build_dlrm
+        if name == "dlrm_kaggle":
+            dcfg = DLRMConfig.criteo_kaggle()
+        elif name == "dlrm_terabyte":
+            dcfg = DLRMConfig.terabyte()
+        elif name == "dlrm_random":
+            dcfg = DLRMConfig.random_benchmark()
+        elif name.startswith("dlrm_ref"):
+            # the reference's run_random shape generalized to N tables
+            # (its generated strategies key embedding{i}/linear/concat)
+            n = int(name[len("dlrm_ref"):] or 8)
+            dcfg = DLRMConfig(embedding_size=[1000000] * n,
+                              sparse_feature_size=64,
+                              mlp_bot=[64, 512, 512, 64],
+                              mlp_top=[64 * (n + 1), 1024, 1024, 1])
+        else:
+            raise ValueError(f"unknown model target {name!r}")
+        model = FFModel(FFConfig(batch_size=batch))
+        build_dlrm(model, dcfg)
+        return model
+    if name == "inception_v3":
+        from ..models.inception import build_inception_v3
+        model = FFModel(FFConfig(batch_size=batch))
+        build_inception_v3(model, num_classes=1000)
+        return model
+    raise ValueError(f"unknown model target {name!r}")
+
+
+def verify_file(path: str, model_name: Optional[str] = None,
+                ndev: Optional[int] = None,
+                batch: Optional[int] = None,
+                hbm_bytes: Optional[float] = None,
+                survivor_ndev: Optional[int] = None,
+                topology: Optional[Sequence[Tuple[str, int]]] = None
+                ) -> List[Finding]:
+    """Load + structurally validate a strategy file, build its target
+    model, and run the plan verifier. Load-time validation failures
+    (StrategyValidationError) become a single high FLX504 finding so one
+    corrupt file cannot crash a whole sweep."""
+    from ..parallel.strategy_io import (StrategyValidationError,
+                                        load_strategies)
+    inferred = infer_target(path)
+    if model_name is None or ndev is None:
+        if inferred is None:
+            raise ValueError(
+                f"{path}: cannot infer target model/mesh from the "
+                f"filename — pass --model and --ndev")
+        model_name = model_name or inferred[0]
+        ndev = ndev or inferred[1]
+        if topology is None and inferred[2]:
+            slices = inferred[2]
+            if ndev % slices == 0 and slices > 1:
+                from ..parallel.mesh import structural_axis_sizes
+                topology = ([("dcn", slices)]
+                            + [("ici", s) for s in
+                               structural_axis_sizes(ndev // slices)])
+    model = build_target_model(model_name, ndev, batch=batch)
+    rel = os.path.basename(path)
+    try:
+        strategies = load_strategies(
+            path, num_devices=ndev,
+            known_ops={op.name for op in model.ops})
+    except StrategyValidationError as e:
+        return [make_finding("FLX504", rel, 0,
+                             f"load-time validation failed: {e}",
+                             scope=e.op, token="load")]
+    return verify_plan(model, strategies, ndev, topology=topology,
+                       hbm_bytes=hbm_bytes, survivor_ndev=survivor_ndev,
+                       path=rel)
+
+
+def _parse_axes(spec: str) -> List[Tuple[str, int]]:
+    """--axes dcn:2,ici:4 -> [("dcn", 2), ("ici", 4)]."""
+    out = []
+    for part in spec.split(","):
+        kind, _, size = part.partition(":")
+        kind = kind.strip()
+        if kind not in ("ici", "dcn") or not size.strip().isdigit():
+            raise ValueError(
+                f"bad --axes entry {part!r} (want kind:size with kind "
+                f"ici|dcn, e.g. dcn:2,ici:4)")
+        out.append((kind, int(size)))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from .baseline import BaselineError, load_baseline, split_by_baseline
+    from .findings import RULES
+    ap = argparse.ArgumentParser(
+        prog="shardcheck",
+        description="Static SPMD plan verifier + lowered-HLO collective "
+                    "auditor for dlrm_flexflow_tpu strategy files")
+    ap.add_argument("paths", nargs="*",
+                    help="strategy files (.pb/.json) to verify; bundled "
+                         "filename conventions infer the target model "
+                         "and mesh")
+    ap.add_argument("--model", default=None,
+                    help="target graph (dlrm_kaggle|dlrm_random|"
+                         "dlrm_terabyte|dlrm_refN|inception_v3); "
+                         "default: inferred from each filename")
+    ap.add_argument("--ndev", type=int, default=None,
+                    help="target device count (default: inferred)")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="global batch size (default: 64 x ndev)")
+    ap.add_argument("--axes", default=None,
+                    help="mesh axes as kind:size[,kind:size...], e.g. "
+                         "dcn:2,ici:4 (default: inferred/flat ici)")
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="per-device HBM capacity cap in GB for the "
+                         "FLX503 footprint check (default: off)")
+    ap.add_argument("--survivor-ndev", type=int, default=None,
+                    help="also project the plan onto this many surviving "
+                         "devices and report elastic-clamp hazards "
+                         "(FLX505)")
+    ap.add_argument("--audit", action="store_true",
+                    help="additionally AOT-lower the train step on the "
+                         "attached devices and audit the compiled HLO "
+                         "(FLX511-513; needs >= ndev local devices)")
+    ap.add_argument("--audit-tolerance", type=float, default=0.25,
+                    help="relative drift tolerance for measured-vs-"
+                         "predicted collective bytes (default 0.25)")
+    ap.add_argument("--fail-on", default="high",
+                    choices=["high", "medium", "low", "info", "never"])
+    ap.add_argument("--baseline", default=DEFAULT_PLAN_BASELINE,
+                    help="plan-finding suppression file (default: the "
+                         "package's shardcheck_baseline.json)")
+    ap.add_argument("--show-baselined", action="store_true")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the FLX5xx rule reference and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, (name, sev, doc) in sorted(RULES.items()):
+            if rid.startswith("FLX5"):
+                print(f"{rid}  {name:<26} {sev:<7} {doc}")
+        return 0
+    if not args.paths:
+        ap.error("no strategy files given (or use --list-rules)")
+
+    topology = _parse_axes(args.axes) if args.axes else None
+    hbm = args.hbm_gb * 1e9 if args.hbm_gb else None
+    findings: List[Finding] = []
+    for path in args.paths:
+        try:
+            findings.extend(verify_file(
+                path, model_name=args.model, ndev=args.ndev,
+                batch=args.batch, hbm_bytes=hbm,
+                survivor_ndev=args.survivor_ndev, topology=topology))
+        except (ValueError, OSError) as e:
+            print(f"shardcheck: {e}", file=sys.stderr)
+            return 2
+        if args.audit:
+            from .hlo_audit import audit_file
+            try:
+                audit_findings, report = audit_file(
+                    path, model_name=args.model, ndev=args.ndev,
+                    batch=args.batch, tolerance=args.audit_tolerance)
+                findings.extend(audit_findings)
+                for k, v in sorted(report.items()):
+                    print(f"shardcheck: audit {os.path.basename(path)} "
+                          f"{k} = {v}")
+            except (ValueError, OSError, RuntimeError) as e:
+                print(f"shardcheck: audit skipped for {path}: {e}",
+                      file=sys.stderr)
+    findings = sort_findings(findings)
+
+    try:
+        baseline = load_baseline(args.baseline)
+    except BaselineError as e:
+        print(f"shardcheck: {e}", file=sys.stderr)
+        return 2
+    fresh, suppressed, _stale = split_by_baseline(findings, baseline)
+    for f in fresh:
+        print(f.render())
+    if args.show_baselined:
+        for f in suppressed:
+            print(f"{f.render()}  [baselined: {baseline[f.key]}]")
+    gate = [f for f in fresh if args.fail_on != "never"
+            and severity_at_least(f.severity, args.fail_on)]
+    print(f"shardcheck: {len(fresh)} finding(s) ({len(gate)} at/above "
+          f"--fail-on {args.fail_on}), {len(suppressed)} baselined")
+    return 1 if gate else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -49,6 +49,31 @@ from ..utils.logging import log_model
 from ..utils import faults
 
 
+def _sharding_mismatch(e: Exception) -> bool:
+    """True when a cached AOT executable rejected its inputs because
+    GSPMD propagated different shardings than it was compiled with (the
+    recompile-once fallback). The message wording changed across jax
+    releases ("...that disagree..." -> "...does not match...")."""
+    msg = str(e)
+    return "disagree" in msg or ("sharding" in msg
+                                 and "does not match" in msg)
+
+
+def _to_memory(v, space: str):
+    """Stage a traced value into host or device memory for the hetero
+    host-offload path. `jax.memory.Space` moved across jax releases; on
+    versions without it the transfer annotation is
+    `TransferToMemoryKind` with the corresponding memory-kind string."""
+    mem = getattr(jax, "memory", None)
+    if mem is not None and hasattr(mem, "Space"):
+        tgt = mem.Space.Host if space == "host" else mem.Space.Device
+    else:
+        from jax._src.sharding_impls import TransferToMemoryKind
+        tgt = TransferToMemoryKind(
+            "unpinned_host" if space == "host" else "device")
+    return jax.device_put(v, tgt)
+
+
 class AnomalyError(RuntimeError):
     """A train step produced a non-finite loss or gradient norm and the
     anomaly policy is "rollback" or "raise" (FFConfig.anomaly_policy).
@@ -771,32 +796,27 @@ class FFModel:
                 # (embedding.cu:280-283)
                 from jax.experimental.compute_on import compute_on
                 ctx = compute_on("device_host")
-                xs = [jax.device_put(x, jax.memory.Space.Host) for x in xs]
-                p = {pn: jax.device_put(v, jax.memory.Space.Host)
-                     for pn, v in p.items()}
+                xs = [_to_memory(x, "host") for x in xs]
+                p = {pn: _to_memory(v, "host") for pn, v in p.items()}
             else:
                 ctx = contextlib.nullcontext()
             if hasattr(op, "apply_with_state"):
                 st = op_state.get(op.name, {})
                 if host:
-                    st = jax.tree.map(
-                        lambda v: jax.device_put(v, jax.memory.Space.Host),
-                        st)
+                    st = jax.tree.map(lambda v: _to_memory(v, "host"), st)
                 with ctx:
                     outs, st2 = op.apply_with_state(p, st, xs,
                                                     training=training,
                                                     rng=rng)
                 if host:
-                    st2 = jax.tree.map(
-                        lambda v: jax.device_put(v, jax.memory.Space.Device),
-                        st2)
+                    st2 = jax.tree.map(lambda v: _to_memory(v, "device"),
+                                       st2)
                 new_state[op.name] = st2
             else:
                 with ctx:
                     outs = op.apply(p, xs, training=training, rng=rng)
             if host:
-                outs = [jax.device_put(o, jax.memory.Space.Device)
-                        for o in outs]
+                outs = [_to_memory(o, "device") for o in outs]
             for t, v in zip(op.outputs, outs):
                 sh = self._out_sharding.get(t.guid)
                 if sh is not None:
@@ -1547,7 +1567,7 @@ class FFModel:
             except ValueError as e:
                 # same GSPMD recompile-on-sharding-disagree fallback as
                 # the K=1 dispatch
-                if "disagree" not in str(e):
+                if not _sharding_mismatch(e):
                     raise
                 exec_ = execs[key] = self._superstep_fn.lower(
                     *args).compile()
@@ -1623,7 +1643,7 @@ class FFModel:
             # initial inputs; one recompile against the propagated
             # shardings reaches the fixed point (the sharding check runs
             # before execution, so donated buffers are still intact)
-            if "disagree" not in str(e):
+            if not _sharding_mismatch(e):
                 raise
             exec_ = execs[key] = self._train_step.lower(*args).compile()
             outs = exec_(*args)
@@ -1955,6 +1975,57 @@ class FFModel:
                 self.forward_batch(batch, host_gather=host_gather))
         return time.perf_counter() - t0
 
+    # --- lowering hooks (analysis/hlo_audit.py) -----------------------
+    def synthetic_device_batch(self) -> Dict:
+        """A zero-filled, fully-staged device batch at the compiled
+        shapes — the HLO auditor lowers against it (values never run;
+        only shapes/dtypes/shardings reach the compiler)."""
+        batch: Dict[str, np.ndarray] = {}
+        for t in self.input_tensors:
+            batch[t.name] = np.zeros(t.shape, dtype=np.dtype(t.dtype))
+        lt = self.label_tensor
+        if lt is not None:
+            batch["label"] = np.zeros(lt.shape, dtype=np.dtype(lt.dtype))
+        return self._device_batch(batch)
+
+    def lowered_train_hlo(self, device_batch: Optional[Dict] = None
+                          ) -> str:
+        """Post-SPMD-partitioning HLO text of the (K=1) train step —
+        the program GSPMD will actually run, with every inserted
+        collective visible at its concrete per-device shapes. The HLO
+        auditor (analysis/hlo_audit.py FLX511-513) scans this for
+        table-scale collectives, missed donation, and cost-model drift;
+        callers may also dump it for offline diffing. Requires
+        compile() + init_layers(); host-resident-table models are
+        rejected (their table traffic runs on the host, outside the
+        lowered program)."""
+        if getattr(self, "_host_resident_ops", None):
+            raise ValueError(
+                "host-resident-table models keep their table traffic on "
+                "the host — the lowered device HLO has nothing to audit "
+                "for them")
+        if self.params is None:
+            raise ValueError("call compile() + init_layers() first")
+        self._ensure_step_state()
+        db = device_batch if device_batch is not None \
+            else self.synthetic_device_batch()
+        args = (self.params, self.opt_state, self.op_state, self._msums,
+                db, self._step_dev)
+        return self._train_step.lower(*args).compile().as_text()
+
+    def lowered_eval_hlo(self, device_batch: Optional[Dict] = None
+                         ) -> str:
+        """Post-SPMD HLO of the eval/serving forward step (see
+        lowered_train_hlo); serving-bucket audits lower one batch per
+        bucket size."""
+        if self.params is None:
+            raise ValueError("call compile() + init_layers() first")
+        db = device_batch if device_batch is not None \
+            else self.synthetic_device_batch()
+        db = {k: v for k, v in db.items() if k != "label"}
+        args = (self.params, self.op_state, db)
+        return self._eval_step.lower(*args).compile().as_text()
+
     def swap_params(self, params=None, host_params=None, op_state=None):
         """Atomically install new inference state (the hot-reload hook).
 
@@ -2018,7 +2089,7 @@ class FFModel:
         try:
             return exec_(*args)
         except ValueError as e:
-            if "disagree" not in str(e):
+            if not _sharding_mismatch(e):
                 raise
             exec_ = execs[key] = self._eval_step.lower(*args).compile()
             return exec_(*args)

@@ -597,6 +597,44 @@ def _row_shard_axes(op, d: int, packed_rows: int):
 # below gates on `op._row_plan`.
 
 
+def row_shard_structural_reason(op, raw_pc, axis_sizes) -> Optional[str]:
+    """Mesh-free feasibility of `raw_pc.param_degree`-way row sharding
+    for `op` over a factorized mesh with `axis_sizes`, or None when the
+    request is executable. THE shared rule set: configure_row_shard
+    applies it against the live mesh at compile time, and the static
+    plan verifier (analysis/shardcheck.py) and elastic clamp
+    (search/replan.py) apply it to offline plans — all three must agree
+    on what "silently replicates" means."""
+    pd = getattr(raw_pc, "param_degree", 1) if raw_pc is not None else 1
+    if pd <= 1:
+        return None
+    if not hasattr(op, "_row_shard_geometry"):
+        return ("op has no row-shard support (no configure_row_shard "
+                "hook)")
+    rows, pack, _tables = op._row_shard_geometry()
+    batch = op.inputs[0].shape[0]
+    ndev = 1
+    for a in axis_sizes:
+        ndev *= int(a)
+    aggr = getattr(op, "aggr", AGGR_MODE_SUM)
+    if aggr not in (AGGR_MODE_SUM, AGGR_MODE_AVG):
+        return f"aggr={aggr!r} has no routed bag aggregation"
+    if len(raw_pc.degrees) > 1 and any(d > 1 for d in raw_pc.degrees[1:]):
+        return (f"degrees {raw_pc.degrees} also request table/width "
+                f"sharding — pick one axis for the table")
+    from ..parallel.sharding import assignable
+    if pd > ndev or not assignable((pd,), list(axis_sizes)):
+        return (f"{pd} row shards do not factorize mesh axes "
+                f"{[int(a) for a in axis_sizes]}")
+    if rows % (pd * max(pack, 1)) != 0:
+        return (f"{pd} row shards must divide the {rows} padded rows "
+                f"(lane pack {pack})")
+    if batch % ndev != 0:
+        return (f"batch {batch} does not divide over the {ndev}-device "
+                f"mesh (lookups route from batch shards)")
+    return None
+
+
 def configure_row_shard(op, raw_pc) -> None:
     """Resolve (and validate) the row-shard plan for `op` from its RAW
     strategy's param_degree. Sets ``op._row_plan`` (None = mode off).
@@ -611,18 +649,15 @@ def configure_row_shard(op, raw_pc) -> None:
     model = op.model
     mesh = getattr(model, "mesh", None)
     rows, pack, tables = op._row_shard_geometry()
-    batch = op.inputs[0].shape[0]
     reason = None
     if mesh is None or mesh.size <= 1:
         reason = "needs a multi-device mesh"
     elif (op.name in getattr(model, "_host_resident_ops", set())
           or op.name in getattr(model, "_host_offload_ops", set())):
         reason = "host-resident/offloaded tables cannot row-shard in HBM"
-    elif op.aggr not in (AGGR_MODE_SUM, AGGR_MODE_AVG):
-        reason = f"aggr={op.aggr!r} has no routed bag aggregation"
-    elif len(raw_pc.degrees) > 1 and any(d > 1 for d in raw_pc.degrees[1:]):
-        reason = (f"degrees {raw_pc.degrees} also request table/width "
-                  f"sharding — pick one axis for the table")
+    else:
+        reason = row_shard_structural_reason(
+            op, raw_pc, [int(mesh.shape[a]) for a in mesh.axis_names])
     if reason is None:
         plan = plan_row_shard(mesh, pd, rows, pack, tables)
         if plan is None:
@@ -630,10 +665,6 @@ def configure_row_shard(op, raw_pc) -> None:
             reason = (f"{pd} row shards must factorize mesh axes {sizes} "
                       f"and divide the {rows} padded rows "
                       f"(lane pack {pack})")
-        elif batch % plan.ndev != 0:
-            reason = (f"batch {batch} does not divide over the "
-                      f"{plan.ndev}-device mesh (lookups route from "
-                      f"batch shards)")
         else:
             op._row_plan = plan
             return

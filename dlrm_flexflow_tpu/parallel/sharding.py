@@ -93,16 +93,29 @@ def clamp_degrees(degrees: Sequence[int],
 
 
 def clamp_param_degree(param_degree: int,
-                       axis_sizes: Sequence[int]) -> int:
+                       axis_sizes: Sequence[int],
+                       rows: Optional[int] = None,
+                       pack: int = 1) -> int:
     """Project a PARAM-axis (row-shard) degree onto a factorized mesh:
     the largest feasible degree not exceeding the requested one. The
     per-op core of elastic re-planning for row-sharded embedding tables
     — a surviving 4-device mesh cannot hold 8 row shards, so the tables
-    reshard 4-way rather than silently replicating."""
+    reshard 4-way rather than silently replicating.
+
+    With ``rows``/``pack`` the result must also equal-block the table
+    (rows divisible by degree x lane pack) — the same constraint
+    configure_row_shard enforces at compile time, so the clamp can never
+    emit a degree that would silently replicate there. Returns 1 when
+    no degree > 1 survives; the CALLER decides whether replication is
+    acceptable (search/replan.clamp_strategies rejects with op+reason
+    when it is not)."""
     if param_degree <= 1:
         return 1
     feas = feasible_degrees_for(axis_sizes)
-    return max((f for f in feas if f <= param_degree), default=1)
+    return max((f for f in feas
+                if f <= param_degree
+                and (rows is None or rows % (f * max(pack, 1)) == 0)),
+               default=1)
 
 
 def param_axis_indices(param_degree: int,

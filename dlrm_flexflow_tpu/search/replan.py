@@ -15,6 +15,12 @@ Two layers:
   degree drops to the largest feasible degree on the new factorized mesh
   that divides into the old intent, and joint assignability is repaired
   per-op. This is the greedy fallback AND the warm start for the search.
+  A projection the plan verifier would flag INFEASIBLE on the survivors
+  (row shards forced into replicating a table the survivor mesh cannot
+  hold) is REJECTED with op + reason (:class:`ClampError`) instead of
+  shipped silently — dying with a named cause beats OOMing during
+  recovery with no cause at all. :func:`clamp_report` exposes the same
+  hazards non-fatally for the static verifier (shardcheck FLX505).
 - :func:`replan_strategies` — clamp, then (budget permitting) re-run the
   simulated-annealing search constrained to the surviving count, seeded
   from the clamped map so the walk starts from a feasible, near-optimal
@@ -25,7 +31,7 @@ Two layers:
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..core.op import InputOp
 from ..parallel.mesh import structural_axis_sizes
@@ -36,16 +42,111 @@ from ..utils.logging import get_logger
 log_replan = get_logger("replan")
 
 
+class ClampError(ValueError):
+    """A strategy projection onto a survivor mesh is infeasible. The
+    message always names the op and the reason — the silent alternative
+    is a plan that replicates a >HBM table and OOMs mid-recovery with
+    neither."""
+
+    def __init__(self, op: str, reason: str, ndev: int):
+        super().__init__(
+            f"cannot project strategy for op {op!r} onto {ndev} "
+            f"device(s): {reason}")
+        self.op = op
+        self.reason = reason
+        self.ndev = ndev
+
+
+def _survivor_hbm_bytes(hbm_bytes: Optional[float]) -> float:
+    if hbm_bytes is not None:
+        return float(hbm_bytes)
+    from .cost_model import TPUSpec
+    return float(TPUSpec.detect().hbm_capacity_bytes)
+
+
+def _project_op(op, pc: ParallelConfig, axis_sizes,
+                hbm_cap: float) -> Tuple[ParallelConfig,
+                                         Optional[Tuple[str, bool]]]:
+    """Clamp one op's config onto the survivor axes. Returns the
+    projected config plus an optional (reason, fatal) hazard: non-fatal
+    = row sharding was shed into replication but the table still fits;
+    fatal = the replicated fallback cannot fit the survivor's HBM."""
+    pd_old = max(getattr(pc, "param_degree", 1), 1)
+    rows = pack = None
+    if pd_old > 1 and hasattr(op, "_row_shard_geometry"):
+        rows, pack, _tables = op._row_shard_geometry()
+    new_pc = ParallelConfig(
+        clamp_degrees(pc.degrees, axis_sizes),
+        device_type=pc.device_type,
+        memory_types=pc.memory_types,
+        # row-sharded tables RESHARD onto the survivors (the largest
+        # feasible shard count that still equal-blocks the rows), they
+        # don't fall back to replication — replicating a >HBM table is
+        # exactly what cannot happen
+        param_degree=clamp_param_degree(pd_old, axis_sizes,
+                                        rows=rows, pack=pack))
+    hazard: Optional[Tuple[str, bool]] = None
+    if pd_old > 1 and new_pc.param_degree == 1:
+        table_bytes = float(op.param_bytes()) if op.param_defs() else 0.0
+        sizes = [int(a) for a in axis_sizes]
+        if table_bytes > 0.9 * hbm_cap:
+            hazard = (
+                f"row shards (param_degree={pd_old}) cannot reshard "
+                f"over survivor axes {sizes} (rows={rows}, lane pack "
+                f"{pack}) and the replicated fallback needs "
+                f"{table_bytes / 1e9:.2f} GB of the "
+                f"{hbm_cap / 1e9:.2f} GB per-device HBM", True)
+        else:
+            hazard = (
+                f"sheds row sharding (param_degree={pd_old} -> 1): no "
+                f"degree > 1 both factorizes survivor axes {sizes} and "
+                f"divides the {rows} rows — the table replicates",
+                False)
+    return new_pc, hazard
+
+
+def clamp_report(model, strategies: Optional[StrategyMap], ndev: int,
+                 hbm_bytes: Optional[float] = None
+                 ) -> List[Tuple[str, str, bool]]:
+    """Non-fatal projection analysis: [(op, reason, fatal)] hazards the
+    clamp of `strategies` onto `ndev` devices would incur. The static
+    plan verifier (shardcheck FLX505) reports these; fatal entries are
+    exactly the ones :func:`clamp_strategies` refuses to ship."""
+    axis_sizes = structural_axis_sizes(ndev)
+    cap = _survivor_hbm_bytes(hbm_bytes)
+    out: List[Tuple[str, str, bool]] = []
+    for op in model.ops:
+        if isinstance(op, InputOp):
+            continue
+        pc = (strategies or {}).get(op.name)
+        if pc is None:
+            continue
+        _, hazard = _project_op(op, pc, axis_sizes, cap)
+        if hazard is not None:
+            out.append((op.name, hazard[0], hazard[1]))
+    return out
+
+
 def clamp_strategies(model, strategies: Optional[StrategyMap],
-                     ndev: int) -> StrategyMap:
+                     ndev: int,
+                     hbm_bytes: Optional[float] = None) -> StrategyMap:
     """Project `strategies` onto an `ndev`-device target (greedy re-plan).
 
     Per op: `parallel.sharding.clamp_degrees` drops every dim's degree
     to the largest feasible one on the ndev factorized mesh and repairs
-    joint assignability. Ops missing from the old map (or with no map at
-    all) get their default data-parallel config for ndev.
+    joint assignability; row-shard degrees reshard via
+    `clamp_param_degree` (rows-divisibility aware). Ops missing from the
+    old map (or with no map at all) get their default data-parallel
+    config for ndev.
+
+    Raises :class:`ClampError` (op + reason) when the projection is
+    INFEASIBLE — a row-sharded table that can neither reshard onto the
+    survivors nor fit replicated in per-device HBM (`hbm_bytes`,
+    default: the detected chip's capacity). A merely-degraded projection
+    (row shards shed but the table fits) ships with a loud warning.
     """
     axis_sizes = structural_axis_sizes(ndev)
+    cap = _survivor_hbm_bytes(hbm_bytes)
     strategies = dict(strategies or {})
     out: StrategyMap = {}
     for op in model.ops:
@@ -55,15 +156,14 @@ def clamp_strategies(model, strategies: Optional[StrategyMap],
         if pc is None:
             out[op.name] = op.default_parallel_config(ndev)
             continue
-        out[op.name] = ParallelConfig(
-            clamp_degrees(pc.degrees, axis_sizes),
-            device_type=pc.device_type,
-            memory_types=pc.memory_types,
-            # row-sharded tables RESHARD onto the survivors (the largest
-            # feasible shard count), they don't fall back to replication
-            # — replicating a >HBM table is exactly what cannot happen
-            param_degree=clamp_param_degree(
-                getattr(pc, "param_degree", 1), axis_sizes))
+        new_pc, hazard = _project_op(op, pc, axis_sizes, cap)
+        if hazard is not None:
+            reason, fatal = hazard
+            if fatal:
+                raise ClampError(op.name, reason, ndev)
+            log_replan.warning("clamp to %d device(s): op %r %s",
+                               ndev, op.name, reason)
+        out[op.name] = new_pc
     return out
 
 
@@ -78,7 +178,10 @@ def replan_strategies(model, ndev: int,
     time), ``searched`` (whether the MCMC walk actually ran) and
     ``greedy_fallback`` (True when the search failed or the budget was
     exhausted and the clamped map shipped as-is). Deterministic for fixed
-    (model, ndev, old, budget, seed).
+    (model, ndev, old, budget, seed). An INFEASIBLE projection raises
+    :class:`ClampError` before any search — there is no survivable plan
+    to fall back to, and the caller's recovery must surface the named
+    op + reason rather than OOM blind.
     """
     t0 = time.perf_counter()
     old = old if old is not None else dict(model.strategies or {})

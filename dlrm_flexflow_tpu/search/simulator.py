@@ -42,6 +42,52 @@ COMM_DEVICE = -1  # flat-topology fallback channel (axis 0)
 HOST_DEVICE = -1000  # host CPU/DRAM: ONE shared resource for all ZCM ops
 
 
+def hbm_footprint_report(model, cost: CostModel, strategies: StrategyMap,
+                         ndev: int) -> Dict[str, float]:
+    """Per-op PEAK per-device HBM residency (bytes) a strategy implies:
+    parameters at each op's sharded shapes, optimizer state slabs, dense
+    gradients, and LIVE ACTIVATIONS (under reverse-mode autodiff every
+    op output is live from its forward until its backward, at its
+    sharded shape in compute dtype), plus model inputs under the
+    "inputs" key. Host-resident tables (CPU/ZCM strategies) live in host
+    RAM and don't count — the capability that lets DLRM-Terabyte run on
+    few chips (reference dlrm_strategy_hetero.cc:28-49).
+
+    Shared accounting: Simulator.fits_memory sums it for search
+    feasibility; the static plan verifier (analysis/shardcheck.py)
+    reports it per-op against an ``--hbm-gb`` cap."""
+    opt = getattr(model, "optimizer", None)
+    nslabs = len(opt.sparse_slab_names()) if opt is not None else 0
+    report: Dict[str, float] = {}
+    for op in model.ops:
+        pc = strategies.get(op.name)
+        if isinstance(op, InputOp):
+            # batch inputs are device-resident for the whole step;
+            # sharded along the sample dim under DP
+            report["inputs"] = (report.get("inputs", 0.0)
+                                + cost.tensor_bytes(op.outputs[0])
+                                / max(ndev, 1))
+            continue
+        if pc is None:
+            continue
+        parts = max(pc.num_parts, 1)
+        total = cost.tensor_bytes(op.outputs[0]) / parts
+        if op.param_defs() and not cost._host_resident(op, pc):
+            param_bytes = sum(math.prod(shape) * 4.0 for shape in
+                              op.param_shard_shapes(pc, ndev).values())
+            # momentum/Adam keep param-shaped state slabs (lazy sparse
+            # state is table-shaped too); a dense-updated param also
+            # materializes a param-shaped fp32 gradient before its
+            # update, while a touched-rows update's gradient is
+            # negligible next to the table
+            dense_grad = (op.param_bytes_touched_per_step(parts)
+                          >= op.param_bytes())
+            total += param_bytes * (1.0 + nslabs + (1.0 if dense_grad
+                                                    else 0.0))
+        report[op.name] = total
+    return report
+
+
 def _axis_kind(name: str) -> str:
     return "dcn" if str(name).startswith("dcn") else "ici"
 
@@ -420,52 +466,17 @@ class Simulator:
         return out
 
     def fits_memory(self, strategies: StrategyMap, ndev: int) -> bool:
-        """Per-device residency must fit the chip's HBM: parameters (at
-        each op's sharded shapes) + optimizer state slabs + dense
-        gradients + LIVE ACTIVATIONS, with 10% headroom for temps and
-        fragmentation. The reference allocates real FB scratch on-device
-        and fails oversized configs (reference simulator.cu:84-90); the
-        round-3 flat 25% headroom ignored activations entirely, so a
-        b256 conv strategy whose forward residuals alone exceed HBM
-        could be blessed by the search and OOM on the real chip.
-
-        Activation residency model: under reverse-mode autodiff every op
-        output (at its sharded shape, compute dtype) is live from its
-        forward until its backward — the peak is their sum, plus the
-        model inputs. Host-resident tables (CPU/ZCM strategies) live in
-        host RAM and don't count — the capability that lets
-        DLRM-Terabyte run on few chips (reference
-        dlrm_strategy_hetero.cc:28-49)."""
-        opt = getattr(self.model, "optimizer", None)
-        nslabs = len(opt.sparse_slab_names()) if opt is not None else 0
-        total = 0.0
-        for op in self.model.ops:
-            pc = strategies.get(op.name)
-            if isinstance(op, InputOp):
-                # batch inputs are device-resident for the whole step;
-                # sharded along the sample dim under DP
-                total += (self.cost.tensor_bytes(op.outputs[0])
-                          / max(ndev, 1))
-                continue
-            if pc is None:
-                continue
-            parts = max(pc.num_parts, 1)
-            total += self.cost.tensor_bytes(op.outputs[0]) / parts
-            if not op.param_defs():
-                continue
-            if self.cost._host_resident(op, pc):
-                continue
-            param_bytes = sum(math.prod(shape) * 4.0 for shape in
-                              op.param_shard_shapes(pc, ndev).values())
-            # momentum/Adam keep param-shaped state slabs (lazy sparse
-            # state is table-shaped too); a dense-updated param also
-            # materializes a param-shaped fp32 gradient before its
-            # update, while a touched-rows update's gradient is
-            # negligible next to the table
-            dense_grad = (op.param_bytes_touched_per_step(parts)
-                          >= op.param_bytes())
-            total += param_bytes * (1.0 + nslabs + (1.0 if dense_grad
-                                                    else 0.0))
+        """Per-device residency must fit the chip's HBM with 10%
+        headroom for temps and fragmentation. The reference allocates
+        real FB scratch on-device and fails oversized configs
+        (reference simulator.cu:84-90); the round-3 flat 25% headroom
+        ignored activations entirely, so a b256 conv strategy whose
+        forward residuals alone exceed HBM could be blessed by the
+        search and OOM on the real chip. The accounting itself lives in
+        :func:`hbm_footprint_report`, shared with the static plan
+        verifier (analysis/shardcheck.py FLX503)."""
+        total = sum(hbm_footprint_report(self.model, self.cost,
+                                         strategies, ndev).values())
         return total <= 0.9 * self.cost.spec.hbm_capacity_bytes
 
     def simulate(self, strategies: StrategyMap,

@@ -450,6 +450,43 @@ class TestPackageGate:
         assert out.returncode == 0, out.stdout + out.stderr
 
 
+class TestShardcheckGate:
+    """The plan-verifier half of the package gate (shardcheck PR): the
+    bundled strategy files must pass `shardcheck --fail-on high` with
+    the checked-in plan baseline, and the FLX5xx rules ride the same
+    findings/baseline/CLI machinery as the AST passes."""
+
+    def test_bundled_plans_gate_clean(self):
+        import glob
+
+        from dlrm_flexflow_tpu.analysis.shardcheck import main as sc_main
+        files = sorted(glob.glob(os.path.join(_REPO, "strategies", "*")))
+        assert files
+        assert sc_main(files + ["--fail-on", "high"]) == 0
+
+    def test_every_plan_baseline_entry_justified(self):
+        from dlrm_flexflow_tpu.analysis.shardcheck import \
+            DEFAULT_PLAN_BASELINE
+        baseline = load_baseline(DEFAULT_PLAN_BASELINE)
+        assert baseline, "expected a checked-in plan baseline"
+        for key, just in baseline.items():
+            assert key.startswith("FLX5"), key
+            assert len(just.strip()) > 20, (key, just)
+
+    def test_flx5_rules_in_shared_registry(self):
+        # flexcheck --list-rules and the README table generate from the
+        # same RULES dict, so the FLX5xx entries must live there
+        for rid in ("FLX501", "FLX502", "FLX503", "FLX504", "FLX505",
+                    "FLX511", "FLX512", "FLX513"):
+            assert rid in RULES
+
+    def test_console_script_registered(self):
+        with open(os.path.join(_REPO, "pyproject.toml")) as f:
+            toml = f.read()
+        assert 'shardcheck = "dlrm_flexflow_tpu.analysis.shardcheck:main"' \
+            in toml
+
+
 # =====================================================================
 # runtime sanitizer
 # =====================================================================

@@ -334,6 +334,32 @@ class TestStrategyValidation:
             strategies = load_strategies(path, num_devices=n)
             assert strategies, path
 
+    def test_every_bundled_plan_passes_shardcheck(self):
+        """Satellite contract of the shardcheck PR: every committed
+        strategy file verifies against its target model/mesh with ZERO
+        unbaselined high-severity plan findings — a plan that would
+        silently all-gather a table or replicate row shards fails HERE,
+        not as a 66x-slower production run. Known-historical findings
+        carry justifications in analysis/shardcheck_baseline.json; a
+        fixed plan leaves a stale suppression, which also fails."""
+        from dlrm_flexflow_tpu.analysis.baseline import (load_baseline,
+                                                         split_by_baseline)
+        from dlrm_flexflow_tpu.analysis.shardcheck import (
+            DEFAULT_PLAN_BASELINE, verify_file)
+        files = sorted(glob.glob(os.path.join(_REPO, "strategies", "*")))
+        assert files, "no bundled strategy files found"
+        findings = []
+        for path in files:
+            findings.extend(verify_file(path))
+        baseline = load_baseline(DEFAULT_PLAN_BASELINE)
+        fresh, _suppressed, stale = split_by_baseline(findings, baseline)
+        high = [f for f in fresh if f.severity == "high"]
+        assert not high, ("bundled plans with non-baselined "
+                          "high-severity findings:\n"
+                          + "\n".join(f.render() for f in high))
+        assert not stale, (f"stale plan-baseline entries (fixed plans? "
+                           f"prune them): {stale}")
+
     def test_degrees_must_factorize_mesh(self):
         s = {"linear_0": ParallelConfig((3, 1))}
         with pytest.raises(StrategyValidationError) as ei:
