@@ -275,6 +275,21 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             serve_fleet = {"error": str(exc)[:200]}
 
+    # opt-in continual-learning freshness smoke (BENCH_FRESHNESS=1):
+    # train-step → servable p50/p99 for delta-chain publication vs
+    # full-checkpoint reloads on a tables-dominated DLRM
+    freshness = None
+    if os.environ.get("BENCH_FRESHNESS"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_freshness import measure as _fresh_measure
+            freshness = _fresh_measure(
+                publishes=int(os.environ.get("BENCH_FRESHNESS_PUBLISHES",
+                                             "12")))
+        except Exception as exc:
+            freshness = {"error": str(exc)[:200]}
+
     vs = 1.0
     base_file = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE")
     if os.path.exists(base_file):
@@ -310,6 +325,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         out["shard"] = shard
     if audit is not None:
         out["audit"] = audit
+    if freshness is not None:
+        out["freshness"] = freshness
     print(json.dumps(out))
     return 0
 

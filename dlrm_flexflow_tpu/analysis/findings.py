@@ -44,6 +44,10 @@ RULES = {
                "blocking call (device_put/block_until_ready/file IO/"
                "sleep/.result()/.join()) while holding a dispatch/"
                "manifest/host-table lock"),
+    "FLX204": ("manifest-write-not-atomic", "high",
+               "manifest/delta file opened for writing directly (bare "
+               "open(path, 'w')): a crash mid-write publishes a torn "
+               "file — write a temp file and os.replace() it"),
     # --- JAX hazards ---------------------------------------------------
     "FLX301": ("exec-cache-const-key", "high",
                "compiled-executable cache stored under a constant key "

@@ -483,6 +483,60 @@ def check_locks(idx: PackageIndex, findings: List[Finding]) -> None:
 
 
 # ---------------------------------------------------------------------
+# FLX204 — manifest/delta files written without temp + os.replace
+# ---------------------------------------------------------------------
+_MANIFEST_PATH_RE = re.compile(r"manifest|delta", re.IGNORECASE)
+_TEMP_PATH_RE = re.compile(r"\btmp\b|\.tmp|temp", re.IGNORECASE)
+_WRITE_MODES = {"w", "wt", "wb", "w+", "wb+", "w+b"}
+
+
+def check_manifest_atomicity(idx: PackageIndex,
+                             findings: List[Finding]) -> None:
+    """Chain manifests and delta snapshots are the crash-consistency
+    spine of the continual train->serve loop: a bare ``open(path, "w")``
+    on one of them publishes a torn file to any concurrent reader when
+    the writer dies mid-write. Every such write must go through a temp
+    file in the same directory + ``os.replace`` (the checkpoint
+    module's ``_write_manifest``/``_write_npz_atomic`` discipline —
+    their ``open(tmp, ...)`` is exactly the sanctioned pattern and is
+    not flagged)."""
+    for rel, tree in idx.modules.items():
+        for fn in [n for n in ast.walk(tree)
+                   if isinstance(n, ast.FunctionDef)]:
+            for node in ast.walk(fn):
+                if (not isinstance(node, ast.Call)
+                        or dotted(node.func) != "open"
+                        or not node.args):
+                    continue
+                mode = None
+                if len(node.args) >= 2 and isinstance(node.args[1],
+                                                      ast.Constant):
+                    mode = node.args[1].value
+                for kw in node.keywords:
+                    if kw.arg == "mode" and isinstance(kw.value,
+                                                       ast.Constant):
+                        mode = kw.value.value
+                if not isinstance(mode, str) \
+                        or mode not in _WRITE_MODES:
+                    continue
+                try:
+                    target = ast.unparse(node.args[0])
+                except Exception:   # pragma: no cover - unparse safety
+                    continue
+                if not _MANIFEST_PATH_RE.search(target):
+                    continue
+                if _TEMP_PATH_RE.search(target):
+                    continue   # the sanctioned temp-file half
+                findings.append(make_finding(
+                    "FLX204", rel, node.lineno,
+                    f"open({target}, {mode!r}) writes a manifest/delta "
+                    f"path in place: a crash mid-write publishes a torn "
+                    f"file to concurrent readers — write a .tmp-<pid> "
+                    f"sibling and os.replace() it",
+                    scope=fn.name, token=target[:40]))
+
+
+# ---------------------------------------------------------------------
 # FLX301/302/303/304 — JAX hazards
 # ---------------------------------------------------------------------
 def check_jax_hazards(idx: PackageIndex,
@@ -696,4 +750,5 @@ def check_env_parsing(idx: PackageIndex,
 
 
 ALL_PASSES = (check_threads, check_racy_attributes, check_locks,
-              check_jax_hazards, check_env_parsing)
+              check_manifest_atomicity, check_jax_hazards,
+              check_env_parsing)

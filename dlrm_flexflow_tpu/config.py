@@ -143,6 +143,18 @@ class FFConfig:
     checkpoint_dir: str = ""
     save_every: int = 0
     keep_last: int = 3
+    # ---- continual learning (FFModel.fit_stream + utils/delta.py) -----
+    # optimizer steps between delta-snapshot publishes in fit_stream;
+    # 0 = no periodic publication. Set with --publish-every N.
+    publish_every: int = 0
+    # compaction trigger: when the live delta chain's accumulated bytes
+    # exceed this fraction of its base checkpoint's size, the next
+    # publish is a fresh full checkpoint. Set with --delta-compact-frac.
+    delta_compact_frac: float = 0.5
+    # optional hard cadence: a full checkpoint every N delta publishes
+    # regardless of size (0 = compaction-only). Set with
+    # --delta-full-every N.
+    delta_full_every: int = 0
     # elastic-mesh recovery (parallel/elastic.py): what fit() does when
     # the mesh degrades (device loss via MeshDegraded, or a background
     # worker missing its liveness deadline via WorkerStalled).
@@ -311,6 +323,12 @@ class FFConfig:
                 cfg.save_every = int(take())
             elif a == "--keep-last":
                 cfg.keep_last = int(take())
+            elif a == "--publish-every":
+                cfg.publish_every = int(take())
+            elif a == "--delta-compact-frac":
+                cfg.delta_compact_frac = float(take())
+            elif a == "--delta-full-every":
+                cfg.delta_full_every = int(take())
             elif a == "--elastic":
                 v = take()
                 if v not in ("off", "resume", "inplace"):

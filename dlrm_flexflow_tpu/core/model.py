@@ -2054,6 +2054,125 @@ class FFModel:
         if op_state is not None:
             self.op_state = op_state
 
+    def apply_delta(self, delta: Dict):
+        """Incrementally install a delta snapshot (the continual-learning
+        hot path; see ``utils/delta.py``).
+
+        ``delta`` is a ``load_delta_file`` payload: ``rows[flat_key] =
+        (idx, vals)`` replaces the given flattened-2D stored rows of a
+        params/hostparams array, ``full[flat_key]`` replaces whole
+        (dense/op-state) arrays, ``step`` becomes the new version. The
+        serving engine calls this between dispatches exactly like
+        ``swap_params`` — the caller already staged the device-param row
+        payloads with ``stage_delta_rows`` OUTSIDE any dispatch lock, so
+        the only device work here is the row scatter itself. Device
+        params are updated functionally (in-flight executions keep their
+        old arrays); host tables are updated in place under
+        ``_host_lock`` (between dispatches nothing reads them).
+
+        Everything is validated BEFORE anything is installed: an unknown
+        key, an out-of-range row index, or a width mismatch raises with
+        the key named and the model untouched — the engine turns that
+        into a reject-with-reason and the watcher falls back to a full
+        reload."""
+        step = int(delta["step"])
+        rows = delta.get("rows") or {}
+        full = delta.get("full") or {}
+
+        def _leaf(tree, key, what):
+            parts = key.split("/")
+            node = tree
+            for p in parts[1:]:
+                if not isinstance(node, dict) or p not in node:
+                    raise ValueError(
+                        f"delta {what} {key!r} does not exist in this "
+                        f"model (differently-built model?)")
+                node = node[p]
+            return parts[1:], node
+
+        sections = {"params": self.params, "state": self.op_state,
+                    "hostparams": self.host_params}
+        # ---- validate first, install second ----------------------------
+        plan = []
+        for key, (idx, vals) in rows.items():
+            sec = key.split("/", 1)[0]
+            tree = sections.get(sec)
+            if tree is None or sec == "state":
+                raise ValueError(
+                    f"delta row update targets unsupported section "
+                    f"{key!r}")
+            path, cur = _leaf(tree, key, "row update")
+            shape = tuple(np.asarray(cur).shape) if sec == "hostparams" \
+                else tuple(cur.shape)
+            if len(shape) < 2 or (np.asarray(vals).shape[-1]
+                                  != shape[-1]):
+                raise ValueError(
+                    f"delta rows for {key!r} have width "
+                    f"{np.asarray(vals).shape[-1:]} but the stored array "
+                    f"is {shape}")
+            nrows = int(np.prod(shape[:-1]))
+            idx_np = np.asarray(idx)
+            if idx_np.size and (int(idx_np.max()) >= nrows
+                                or int(idx_np.min()) < 0):
+                raise ValueError(
+                    f"delta rows for {key!r} index up to "
+                    f"{int(idx_np.max())} but the stored array has only "
+                    f"{nrows} rows")
+            plan.append((sec, key, path, idx, vals))
+        for key in full:
+            sec = key.split("/", 1)[0]
+            tree = sections.get(sec)
+            if tree is None:
+                raise ValueError(
+                    f"delta full update targets unknown section {key!r}")
+            _leaf(tree, key, "full update")
+        # ---- install ---------------------------------------------------
+        self._host_drain()
+        self._host_prefetch_invalidate()
+        new_params = {op: dict(d) for op, d in self.params.items()}
+        new_state = {op: (dict(d) if isinstance(d, dict) else d)
+                     for op, d in self.op_state.items()}
+        for sec, key, path, idx, vals in plan:
+            if sec == "params":
+                opname, pname = path[0], path[-1]
+                cur = new_params[opname][pname]
+                w = cur.shape[-1]
+                new2d = jnp.reshape(cur, (-1, w)).at[
+                    jnp.asarray(idx)].set(
+                        jnp.asarray(vals, dtype=cur.dtype))
+                new = jnp.reshape(new2d, cur.shape)
+                shard = self._param_sharding.get(opname, {}).get(pname)
+                if shard is not None:
+                    new = jax.device_put(new, shard)
+                new_params[opname][pname] = new
+            else:   # hostparams: in-place row writes under the table lock
+                opname, pname = path[0], path[-1]
+                with self._host_lock:
+                    tbl = self.host_params[opname][pname]
+                    mi = np.unravel_index(np.asarray(idx),
+                                          tbl.shape[:-1])
+                    tbl[mi] = np.asarray(vals, dtype=tbl.dtype)
+        for key, v in full.items():
+            sec = key.split("/", 1)[0]
+            parts = key.split("/")
+            opname, pname = parts[1], parts[-1]
+            if sec == "params":
+                shard = self._param_sharding.get(opname, {}).get(pname)
+                new_params[opname][pname] = (
+                    jax.device_put(v, shard) if shard is not None
+                    else jax.device_put(v))
+            elif sec == "state":
+                new_state[opname][pname] = jax.device_put(v)
+            else:
+                with self._host_lock:
+                    self.host_params[opname][pname] = np.array(v)
+        self.params = new_params
+        self.op_state = new_state
+        self._step = step
+        self._step_dev = None
+        self._msums = None
+        return self
+
     def _eval_dispatch(self, db: Dict, host_emb=None):
         """Eval through the same AOT executable cache as the train path:
         calling the pjit wrapper re-validates the whole param pytree in
@@ -2767,3 +2886,128 @@ class FFModel:
                 "num_samples": num_samples, "rollbacks": rollbacks,
                 "recoveries": recoveries,
                 "metrics": self.perf.report()}
+
+    # ------------------------------------------------------------------
+    # streaming fit: the continual train->serve loop (utils/delta.py)
+    # ------------------------------------------------------------------
+    def fit_stream(self, source, steps: Optional[int] = None,
+                   publisher=None, publish_every: Optional[int] = None,
+                   verbose: bool = True,
+                   callbacks: Optional[List[Callable]] = None,
+                   resume: bool = False):
+        """Train indefinitely off a streaming source, publishing delta
+        snapshots for the serving fleet.
+
+        ``source`` is a callable ``source(i) -> batch`` returning the
+        i-th host batch as a feature dict INCLUDING ``"label"``
+        (:class:`~..data.stream.ArrayStream` wraps in-memory arrays;
+        any deterministic callable works). Returning ``None`` or
+        raising ``StopIteration``/``IndexError`` ends the stream;
+        ``steps`` bounds it explicitly (None = until the source ends).
+
+        Batches ride the SAME depth-K prefetch ring as ``fit()`` — the
+        staging thread slices + device_puts batch N+1 while the device
+        trains batch N — and every batch is shown to the publisher's
+        :class:`~..utils.delta.TouchedRowTracker` BEFORE staging, so at
+        publish time the per-table touched-row candidates cover every
+        trained step. Every ``publish_every`` optimizer steps the
+        publisher emits a delta snapshot (or a full checkpoint when the
+        chain compacts), inline on the training thread — the gather
+        must see a quiesced step anyway.
+
+        ``resume=True`` restores the newest valid full checkpoint from
+        the publisher's directory first and continues the stream at the
+        recorded position (``loader_state["stream_step"]``). The
+        restarted publisher always re-anchors on a fresh full base —
+        a dead trainer's delta chain is unextendable by design.
+
+        Anomaly policy ``rollback`` is not supported here (there is no
+        epoch to re-wind); use ``skip_step`` or ``raise``.
+        """
+        if getattr(self, "_anomaly_policy", "none") == "rollback":
+            raise ValueError(
+                'anomaly_policy="rollback" is not supported by '
+                "fit_stream (no epoch position to re-wind); use "
+                '"skip_step" or "raise"')
+        if publish_every is None:
+            publish_every = int(getattr(self.config, "publish_every", 0))
+        if publisher is not None and publish_every < 1:
+            raise ValueError(
+                "fit_stream(publisher=...) needs publish_every >= 1 "
+                "(--publish-every N)")
+        if self.params is None:
+            self.init_layers()
+        start = 0
+        if resume and publisher is not None:
+            entry = publisher.mgr.restore_latest(self)
+            if entry is not None:
+                start = int((entry.get("loader_state") or {})
+                            .get("stream_step", 0))
+                if verbose:
+                    print(f"resumed stream from checkpoint step "
+                          f"{entry['step']} (stream position {start})")
+
+        from ..data.prefetch import PrefetchPipeline
+
+        def produce(i):
+            try:
+                batch = source(start + i)
+            except (StopIteration, IndexError):
+                raise IndexError("stream exhausted") from None
+            if batch is None:
+                raise IndexError("stream exhausted")
+            if publisher is not None:
+                publisher.observe_batch(batch)
+            return self._stage_step(batch)
+
+        depth = max(int(getattr(self.config, "prefetch_depth", 2) or 0),
+                    1)
+        pipe = PrefetchPipeline(
+            produce, depth=depth, num_items=steps, name="fit_stream",
+            deadline_s=self._worker_deadline_s() or None)
+        throttle = 1 if jax.default_backend() == "cpu" else 32
+        from collections import deque as _deque
+        inflight = _deque()
+        trained = 0
+        publishes = 0
+        mets = None
+        t0 = time.time()
+        try:
+            while steps is None or trained < steps:
+                try:
+                    staged = pipe.get()
+                except IndexError:
+                    break
+                mets = self.train_batch_staged(staged)
+                inflight.append(mets["loss"])
+                if len(inflight) > throttle:
+                    jax.block_until_ready(inflight.popleft())
+                trained += 1
+                if (publisher is not None and publish_every
+                        and trained % publish_every == 0):
+                    publisher.publish(
+                        {"stream_step": start + trained})
+                    publishes += 1
+                if callbacks and mets is not None:
+                    for cb in callbacks:
+                        cb(self, trained, mets)
+        finally:
+            pipe.close()
+        self._host_drain()
+        if publisher is not None and trained and (
+                not publish_every or trained % publish_every):
+            # final partial interval: the fleet should not miss the tail
+            publisher.publish({"stream_step": start + trained})
+            publishes += 1
+        elapsed = time.time() - t0
+        bs = int(self.config.batch_size)
+        if verbose and mets is not None:
+            print(f"fit_stream: {trained} steps, "
+                  f"loss={float(mets['loss']):.6f}, "
+                  f"{trained * bs / max(elapsed, 1e-9):.2f} samples/s, "
+                  f"{publishes} publish(es)")
+        return {"steps": trained, "elapsed": elapsed,
+                "throughput": trained * bs / max(elapsed, 1e-9),
+                "publishes": publishes,
+                "publisher": (publisher.stats()
+                              if publisher is not None else None)}

@@ -2,8 +2,9 @@ from .dataloader import (FFBinDataLoader, ImgDataLoader2D, ImgDataLoader4D,
                          SingleDataLoader, coalesce_batches, load_dlrm_hdf5,
                          pad_batch_rows, write_ffbin, write_img_ffbin)
 from .prefetch import PrefetchPipeline
+from .stream import ArrayStream
 
 __all__ = ["SingleDataLoader", "FFBinDataLoader", "write_ffbin",
            "ImgDataLoader4D", "ImgDataLoader2D", "write_img_ffbin",
            "load_dlrm_hdf5", "PrefetchPipeline", "coalesce_batches",
-           "pad_batch_rows"]
+           "pad_batch_rows", "ArrayStream"]

@@ -974,7 +974,20 @@ class Embedding(Op):
                                           self.num_entries, fwd_tiles)
         return {"kernel": new_k}, new_s
 
+    # ---- delta publication (utils/delta.py) ----------------------------
+    # A batch's lookup indices mapped to the rows of the STORED kernel
+    # (flattened to 2-D over all-but-the-last axis) that a touched-rows
+    # update can change. The continual-learning publisher restricts its
+    # publish-time diff to these candidates; serving's EmbeddingCache
+    # uses the host variant to invalidate only dirtied samples.
+    def delta_touched_rows(self, idx_np) -> "np.ndarray":
+        import numpy as np
+        g = np.asarray(idx_np).astype(np.int64).reshape(-1) \
+            % self.num_entries
+        return np.unique(g)
 
+    # host table is (num_entries, out_dim) — same natural layout
+    host_delta_touched_rows = delta_touched_rows
 
     # ---- host-resident table form (reference embedding_avx2.cc) --------
     def host_init(self, seed: int):
@@ -1396,7 +1409,28 @@ class EmbeddingBagStacked(Op):
                                           step, T * rows, fwd_tiles)
         return {"kernel": new_k}, new_s
 
+    # ---- delta publication (utils/delta.py; see Embedding) -------------
+    def delta_touched_rows(self, idx_np) -> "np.ndarray":
+        # stored kernel (T, rows/r, r*d) flattens to (T*rows/r, r*d);
+        # logical table t lives at stored slot _table_inv[t], logical row
+        # ix at packed row ix // r of that slot
+        import numpy as np
+        r, rows = self._pack, self.num_entries
+        g = np.asarray(idx_np).astype(np.int64) % rows    # (batch, T, bag)
+        slot = np.arange(self.num_tables, dtype=np.int64)
+        if self._table_inv is not None:
+            slot = np.asarray(self._table_inv, dtype=np.int64)
+        flat = slot[None, :, None] * (rows // r) + g // r
+        return np.unique(flat.reshape(-1))
 
+    def host_delta_touched_rows(self, idx_np) -> "np.ndarray":
+        # host table is (T, rows, d) in LOGICAL table order, unpacked
+        import numpy as np
+        rows = self.num_entries
+        g = np.asarray(idx_np).astype(np.int64) % rows
+        offs = (np.arange(self.num_tables, dtype=np.int64)
+                * rows)[None, :, None]
+        return np.unique((g + offs).reshape(-1))
 
     # ---- host-resident table form (reference embedding_avx2.cc) --------
     def host_init(self, seed: int):
@@ -1795,4 +1829,17 @@ class EmbeddingBagConcat(Op):
         _host_stateful_update(host_params["kernel"],
                               self._host_global_indices(idx_np), ct_np,
                               opt, slabs, step, self.aggr)
+
+    # ---- delta publication (utils/delta.py; see Embedding) -------------
+    def delta_touched_rows(self, idx_np) -> "np.ndarray":
+        # stored kernel is (total_rows/r, r*d): concatenated global rows,
+        # r logical rows per packed row
+        import numpy as np
+        g = self._host_global_indices(idx_np)
+        return np.unique(g.reshape(-1) // self._pack)
+
+    def host_delta_touched_rows(self, idx_np) -> "np.ndarray":
+        # host table is the unpacked (total_rows, d) concatenation
+        import numpy as np
+        return np.unique(self._host_global_indices(idx_np).reshape(-1))
 

@@ -12,16 +12,24 @@ Keying is per (op, per-sample index row): the cached value is exactly
 bit-identical to the uncached path (the lookup is row-wise across the
 batch — each sample's bag gather/reduce never sees its neighbors).
 
-The cache is dropped wholesale on every hot reload (`invalidate`): new
-tables mean every cached row is stale. During serving the tables are
-otherwise immutable (training scatters never run in the engine), so no
-finer-grained invalidation is needed.
+Invalidation has two granularities:
+
+- a FULL hot reload (`invalidate`) drops everything — new tables mean
+  every cached row is stale;
+- an incremental DELTA reload (`invalidate_rows`) drops only the
+  samples whose bag touched a dirtied table row: each entry records the
+  host-table rows its value was gathered from
+  (``op.host_delta_touched_rows``), so the hot working set survives a
+  delta that rewrote a few thousand cold rows.
+
+During serving the tables are otherwise immutable (training scatters
+never run in the engine), so no finer-grained tracking is needed.
 """
 
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Dict
+from typing import Dict, Iterable, Tuple
 
 import numpy as np
 
@@ -40,11 +48,14 @@ class EmbeddingCache:
         if capacity < 1:
             raise ValueError(f"cache capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
-        self._d: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+        # key -> (value, dependency host-table rows | None)
+        self._d: "OrderedDict[tuple, Tuple[np.ndarray, object]]" = \
+            OrderedDict()
         self._lock = make_lock("EmbeddingCache._lock", no_dispatch=True)
         self.hits = 0
         self.misses = 0
         self.invalidations = 0
+        self.row_invalidations = 0
 
     def lookup(self, op, table_params, idx_np: np.ndarray) -> np.ndarray:
         """Per-sample-cached equivalent of
@@ -57,23 +68,31 @@ class EmbeddingCache:
         with self._lock:
             for i in range(rows):
                 key = (op.name, idx_np[i].tobytes())
-                v = self._d.get(key)
-                if v is None:
+                hit = self._d.get(key)
+                if hit is None:
                     miss.append(i)
                 else:
                     self._d.move_to_end(key)
-                    vals[i] = v
+                    vals[i] = hit[0]
             self.hits += rows - len(miss)
             self.misses += len(miss)
         if miss:
             sub = op.host_lookup(table_params, idx_np[np.asarray(miss)])
             sub = np.asarray(sub)
+            # which host-table rows each missed sample's bag gathered —
+            # recorded so a delta reload can invalidate ONLY the samples
+            # a dirtied row feeds (None = unknown -> conservative drop)
+            deps = {}
+            if hasattr(op, "host_delta_touched_rows"):
+                for i in miss:
+                    deps[i] = op.host_delta_touched_rows(idx_np[i:i + 1])
             with self._lock:
                 for j, i in enumerate(miss):
                     v = np.ascontiguousarray(sub[j])
                     vals[i] = v
-                    self._d[(op.name, idx_np[i].tobytes())] = v
-                    self._d.move_to_end((op.name, idx_np[i].tobytes()))
+                    key = (op.name, idx_np[i].tobytes())
+                    self._d[key] = (v, deps.get(i))
+                    self._d.move_to_end(key)
                 while len(self._d) > self.capacity:
                     self._d.popitem(last=False)
         return np.stack(vals, axis=0)
@@ -83,6 +102,33 @@ class EmbeddingCache:
         with self._lock:
             self._d.clear()
             self.invalidations += 1
+
+    def invalidate_rows(self, op_name: str,
+                        dirty_rows: Iterable[int]) -> int:
+        """Drop only the entries of ``op_name`` whose gathered bag
+        intersects ``dirty_rows`` (host-table flat row ids — the same
+        ids a delta's ``hostparams`` row update carries). Entries with
+        no recorded dependencies are dropped conservatively. Returns
+        how many entries were evicted."""
+        dirty = np.unique(np.asarray(list(dirty_rows)
+                                     if not isinstance(dirty_rows,
+                                                       np.ndarray)
+                                     else dirty_rows).reshape(-1))
+        if dirty.size == 0:
+            return 0
+        with self._lock:
+            doomed = []
+            for key, (_, deps) in self._d.items():
+                if key[0] != op_name:
+                    continue
+                if deps is None or np.intersect1d(
+                        np.asarray(deps), dirty,
+                        assume_unique=False).size:
+                    doomed.append(key)
+            for key in doomed:
+                del self._d[key]
+            self.row_invalidations += len(doomed)
+            return len(doomed)
 
     def __len__(self) -> int:
         return len(self._d)
@@ -96,4 +142,5 @@ class EmbeddingCache:
             "misses": self.misses,
             "hit_rate": (self.hits / total) if total else 0.0,
             "invalidations": self.invalidations,
+            "row_invalidations": self.row_invalidations,
         }

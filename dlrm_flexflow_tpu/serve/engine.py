@@ -232,11 +232,22 @@ class InferenceEngine:
         self._swap_lock = make_lock(
             f"InferenceEngine._swap_lock[{replica_id}]",
             no_dispatch=True)
-        self._pending_swap: Optional[tuple] = None
+        # ordered queue of parked installs: ("full", state, ...) entries
+        # replace everything queued before them; ("delta", payload, ...)
+        # entries are INCREMENTAL and append — the batcher drains the
+        # queue in order between dispatches
+        self._pending: List[tuple] = []
         self._version = int(getattr(model, "_step", 0))
         # version of the params the batcher has actually applied; the
         # response tag (== _version once the pending swap lands)
         self._applied_version = self._version
+        # whether ANY snapshot install has been applied: until then the
+        # engine serves the model's own (constructor-time) state, whose
+        # version number can numerically coincide with a published
+        # step without being that state — the watcher must not treat
+        # it as a delta-chain node (it would patch rows onto the wrong
+        # base params)
+        self._applied_any = False
         # stats (their own lock: stats() readers race the batcher's
         # appends — iterating a deque mid-append raises)
         self._stats_lock = make_lock(
@@ -250,6 +261,7 @@ class InferenceEngine:
         self._rows_served = 0
         self._rows_padded = 0
         self._reloads = 0
+        self._delta_reloads = 0
         self._reload_rejects = 0
         self._last_reject = ""
         self._warmup_s = 0.0
@@ -390,7 +402,7 @@ class InferenceEngine:
             with self._cond:
                 self._heartbeat.beat()
                 while (not self._q and not self._closing
-                        and self._pending_swap is None):
+                        and not self._pending):
                     self._cond.wait(0.1)
                     self._heartbeat.beat()
                 if not self._q and self._closing:
@@ -542,15 +554,39 @@ class InferenceEngine:
                     f"differently-built model cannot hot-swap")
         applied = threading.Event()
         with self._swap_lock:
-            superseded = self._pending_swap
-            self._pending_swap = (dict(state), int(version), source,
-                                  applied)
+            # a FULL install replaces the whole state: everything queued
+            # before it (older fulls, incremental deltas) is superseded —
+            # release their waiters, the engine moves straight past them
+            superseded = self._pending
+            self._pending = [("full", dict(state), int(version), source,
+                              applied)]
             self._version = int(version)
             self._reloads += 1
-            if superseded is not None:
-                # back-to-back installs: the engine moves straight past
-                # the superseded state — release its waiter
-                superseded[3].set()
+            for entry in superseded:
+                entry[4].set()
+        self._await_applied(applied)
+
+    def install_delta(self, payload: Dict[str, Any], version: int,
+                      source: str = "") -> None:
+        """Park an INCREMENTAL delta (a ``load_delta_file`` payload whose
+        device rows were already staged via ``stage_delta_rows`` — the
+        H2D happened on the watcher thread, outside any lock). The
+        batcher applies it between dispatches via ``FFModel.apply_delta``
+        exactly like a full swap: in-flight batches finish on the old
+        rows, the next dispatch sees the new ones, old-or-new never a
+        mix. Deltas APPEND to the install queue (they are increments,
+        not replacements — dropping one would corrupt the chain) and the
+        call returns once applied."""
+        applied = threading.Event()
+        with self._swap_lock:
+            self._pending.append(("delta", dict(payload), int(version),
+                                  source, applied))
+            self._version = int(version)
+            self._reloads += 1
+            self._delta_reloads += 1
+        self._await_applied(applied)
+
+    def _await_applied(self, applied: threading.Event) -> None:
         t = self._thread
         if (t is None or not t.is_alive()
                 or t is threading.current_thread()):
@@ -565,44 +601,73 @@ class InferenceEngine:
                 return
 
     def _apply_pending_swap(self) -> None:
-        """Take the parked snapshot (if any) and swap it into the model.
-        Runs on the batcher thread between dispatches (or inline on a
-        batcher-less engine); the model mutation happens OUTSIDE the
-        swap lock — the lock only guards the reference hand-off."""
+        """Drain the parked install queue in order and swap/apply each
+        into the model. Runs on the batcher thread between dispatches
+        (or inline on a batcher-less engine); the model mutation happens
+        OUTSIDE the swap lock — the lock only guards the queue
+        hand-off."""
         with self._swap_lock:
-            pending, self._pending_swap = self._pending_swap, None
-        if pending is None:
+            pending, self._pending = self._pending, []
+        for kind, state, version, source, applied in pending:
+            try:
+                if kind == "full":
+                    self._model.swap_params(
+                        params=state["params"],
+                        host_params=state.get("host_params"),
+                        op_state=state.get("op_state"))
+                    if self._cache is not None:
+                        self._cache.invalidate()
+                else:
+                    self._model.apply_delta(state)
+                    self._invalidate_cache_rows(state)
+                self._applied_version = version
+                self._applied_any = True
+                log_serve.info("hot-%s weights to version %d%s",
+                               "reloaded" if kind == "full"
+                               else "delta-patched", version,
+                               f" from {source}" if source else "")
+            except BaseException as e:   # noqa: BLE001 — a failed apply
+                # must release the installer AND show up in stats, not
+                # kill the batcher. Roll _version back to what is
+                # actually applied so the watcher retries (with backoff)
+                # or falls back instead of believing the reload landed.
+                with self._swap_lock:
+                    if not self._pending:
+                        self._version = self._applied_version
+                self.record_reload_reject(
+                    f"staged {kind} (version {version}) failed to "
+                    f"apply: {e}")
+            finally:
+                applied.set()
+
+    def _invalidate_cache_rows(self, payload: Dict[str, Any]) -> None:
+        """Delta reload: invalidate only the cached samples a dirtied
+        host-table row feeds (a full-array host replacement still drops
+        everything for safety)."""
+        if self._cache is None:
             return
-        state, version, source, applied = pending
-        try:
-            self._model.swap_params(params=state["params"],
-                                    host_params=state.get("host_params"),
-                                    op_state=state.get("op_state"))
-            if self._cache is not None:
-                self._cache.invalidate()
-            self._applied_version = version
-            log_serve.info("hot-reloaded weights to version %d%s",
-                           version, f" from {source}" if source else "")
-        except BaseException as e:   # noqa: BLE001 — a failed apply must
-            # release the installer AND show up in stats, not kill the
-            # batcher (install_snapshot pre-validates the params tree,
-            # so this is a host-table/op-state shape surprise)
-            self.record_reload_reject(
-                f"staged snapshot (version {version}) failed to apply: "
-                f"{e}")
-        finally:
-            applied.set()
+        if any(k.startswith("hostparams/")
+               for k in (payload.get("full") or {})):
+            self._cache.invalidate()
+            return
+        for key, (idx, _vals) in (payload.get("rows") or {}).items():
+            if key.startswith("hostparams/"):
+                self._cache.invalidate_rows(key.split("/")[1],
+                                            np.asarray(idx))
 
     def state_snapshot(self) -> tuple:
         """(state dict, version) of what this engine is serving — the
-        parked pending swap when one exists (it WILL be the next batch's
-        weights), else the model's current arrays. The fleet's rollback
-        capture and canary promotion read through this so they can never
-        grab a half-superseded view."""
+        newest parked FULL install when one exists (it WILL be the next
+        batch's weights), else the model's current arrays. The fleet's
+        rollback capture and canary promotion read through this so they
+        can never grab a half-superseded view. A parked DELTA cannot be
+        represented without applying it; installs are synchronous, so
+        the window where one is pending is the installer's own call —
+        the model's current arrays are the honest answer then."""
         with self._swap_lock:
-            pending = self._pending_swap
-            if pending is not None:
-                state, version = pending[0], pending[1]
+            pending = self._pending
+            if pending and pending[-1][0] == "full":
+                _, state, version, _, _ = pending[-1]
                 m = self._model
                 return ({"params": state.get("params", m.params),
                          "host_params": (state.get("host_params")
@@ -624,6 +689,13 @@ class InferenceEngine:
     @property
     def version(self) -> int:
         return self._version
+
+    @property
+    def has_applied_snapshot(self) -> bool:
+        """True once any install (full or delta) has been applied —
+        before that, ``version`` describes the model's constructor-time
+        state, not a published snapshot."""
+        return self._applied_any
 
     @property
     def model(self):
@@ -719,6 +791,7 @@ class InferenceEngine:
             "p99_ms": pct(99),
             "version": self._version,
             "reloads": self._reloads,
+            "delta_reloads": self._delta_reloads,
             "reload_rejects": self._reload_rejects,
             "last_reload_reject": self._last_reject,
             "buckets": list(self._buckets),

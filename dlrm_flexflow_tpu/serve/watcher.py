@@ -1,38 +1,46 @@
 """Zero-downtime snapshot hot reload for the serving engine.
 
-A trainer publishes rolling snapshots through ``CheckpointManager``
-(atomic npz + manifest with step/fingerprint/CRC-32). The
+A trainer publishes rolling snapshots through ``CheckpointManager`` —
+and, in the continual-learning loop, **delta snapshots** chained off
+them through :class:`~..utils.delta.DeltaPublisher`. The
 :class:`SnapshotWatcher` polls that directory READ-ONLY from the serving
 process — it deliberately does not construct a ``CheckpointManager``
-(whose init sweeps ``*.tmp-*`` orphans, which would race a live trainer's
-in-flight atomic write) — validates the newest manifest entry exactly
-like ``CheckpointManager._entry_valid`` (file present, fingerprint
-matches THIS model's build, CRC-32 clean), loads the params with the
-``params_only`` fast path into FRESH arrays outside any lock, and then
-swaps them into the engine between dispatches.
+(whose init sweeps ``*.tmp-*`` orphans, which would race a live
+trainer's in-flight atomic write).
 
-Failure is always non-fatal, and is handled in two tiers:
+Reload strategy, freshest-first:
 
-- **Transient IO** (an NFS hiccup mid-``np.load``, a manifest read
-  racing a writer) is absorbed by the shared
-  :func:`~..data.dataloader.read_with_retries` backoff — the same
-  retry discipline the training dataloaders use — before it ever counts
-  as a failure.
-- **Real failures** (retries exhausted, a torn manifest, a fingerprint
-  from a differently-built model, a CRC mismatch, or a snapshot
-  corrupted between validation and load — the
-  ``FF_FAULT_CORRUPT_RELOAD`` injection) are recorded: the engine gets
-  a reject-with-reason, and the watcher's own ``stats()`` carries the
-  cumulative ``reload_failures`` count plus ``last_reload_error`` so a
-  silently-never-reloading server is visible from /stats instead of
-  just skipping to the next poll. Either way the engine keeps serving
-  the current version — zero failed requests.
+1. **Delta chain**: when the manifest lists a chain whose tip is newer
+   than the served version, the WHOLE chain is validated up front
+   (:func:`~..utils.delta.resolve_chain`: prev links contiguous, every
+   file present + CRC-32 clean, fingerprints match this model's build,
+   base identity unchanged). If the engine is already AT a chain node,
+   only the deltas past it are loaded — touched-rows-sized, not
+   checkpoint-sized; a cold engine loads the base (full) plus the chain.
+   Row payloads are ``device_put`` on this thread, OUTSIDE any dispatch
+   lock, then applied between dispatches via ``FFModel.apply_delta`` —
+   the same old-or-new-never-mixed discipline as ``swap_params``.
+2. **Graceful degradation**: ANY chain problem — a gap from a lost
+   manifest entry, a torn/missing delta, a replaced base, a foreign
+   fingerprint, a load or apply failure — is a reject-with-reason, and
+   the watcher falls back to the newest valid FULL snapshot (possibly
+   the chain's own base: older but consistent). Never a failed request.
+
+Failure handling keeps the two existing tiers — transient IO retried by
+the shared ``read_with_retries`` backoff; real failures recorded in
+``stats()`` (cumulative ``reload_failures`` + ``last_reload_error``) and
+reject-with-reason'd to the engine once per cause — plus **exponential
+backoff with jitter** on consecutive failures: a permanently-bad
+manifest is re-polled at up to ``backoff_max_s`` instead of hammered at
+the poll interval, and ``stats()["next_poll_s"]`` shows the current
+pace. Any successful poll resets the backoff.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 from typing import Any, Dict, Optional
 
@@ -40,22 +48,27 @@ from ..data.dataloader import read_with_retries
 from ..utils import faults
 from ..utils.checkpoint import (_file_crc32, config_fingerprint,
                                 load_params_for_swap)
+from ..utils.delta import (ChainError, load_delta_file, resolve_chain,
+                           stage_delta_rows)
 
 
 class SnapshotWatcher:
-    """Background poller installing newer valid snapshots into an
-    :class:`~.engine.InferenceEngine`."""
+    """Background poller installing newer valid snapshots (full or
+    delta-chained) into an :class:`~.engine.InferenceEngine`."""
 
     MANIFEST = "manifest.json"
 
     def __init__(self, engine, directory: str, poll_s: float = 0.5,
-                 elastic: bool = False):
+                 elastic: bool = False, allow_deltas: bool = True,
+                 backoff_max_s: float = 30.0):
         self._engine = engine
         self.directory = os.path.abspath(directory)
         self.poll_s = max(float(poll_s), 0.01)
         # cross-mesh reshard on load: a per-device fleet replica follows
         # a multi-device trainer's snapshots (ServeConfig.reshard)
         self.elastic = bool(elastic)
+        self.allow_deltas = bool(allow_deltas)
+        self.backoff_max_s = max(float(backoff_max_s), self.poll_s)
         self._fingerprint = config_fingerprint(engine.model)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -69,6 +82,13 @@ class SnapshotWatcher:
         # to reload must be visible in stats(), not silent
         self._reload_failures = 0
         self._last_reload_error = ""
+        # exponential backoff on consecutive failing polls
+        self._consecutive_failures = 0
+        self._next_poll_s = self.poll_s
+        self._jitter = random.Random(os.getpid() ^ id(self))
+        # chain accounting for stats()
+        self._delta_installs = 0
+        self._chain_fallbacks = 0
 
     def _record_failure(self, reason: str) -> None:
         self._reload_failures += 1
@@ -99,6 +119,7 @@ class SnapshotWatcher:
 
     def _run(self) -> None:
         while not self._stop.is_set():
+            before = self._reload_failures
             try:
                 self.poll_once()
             except Exception as e:   # noqa: BLE001 — the watcher must
@@ -106,13 +127,30 @@ class SnapshotWatcher:
                 self._record_failure(f"watcher poll error: {e}")
                 self._engine.record_reload_reject(
                     f"watcher poll error: {e}")
-            self._stop.wait(self.poll_s)
+            if self._reload_failures > before:
+                self._consecutive_failures += 1
+            else:
+                self._consecutive_failures = 0
+            self._next_poll_s = self._backoff_interval()
+            self._stop.wait(self._next_poll_s)
 
-    # --- one poll ------------------------------------------------------
-    def _read_entries(self) -> list:
+    def _backoff_interval(self) -> float:
+        """Next poll delay: the base interval normally; exponential in
+        the consecutive-failure count, jittered (x0.5–1.0 so a fleet of
+        watchers hitting the same bad manifest desynchronizes), capped
+        at ``backoff_max_s``."""
+        if self._consecutive_failures == 0:
+            return self.poll_s
+        k = min(self._consecutive_failures, 10)
+        base = min(self.poll_s * (2.0 ** k), self.backoff_max_s)
+        return max(base * (0.5 + 0.5 * self._jitter.random()),
+                   self.poll_s)
+
+    # --- manifest read -------------------------------------------------
+    def _read_manifest(self) -> Optional[Dict[str, Any]]:
         path = os.path.join(self.directory, self.MANIFEST)
         if not os.path.isfile(path):
-            return []   # normal pre-publish state, not a failure
+            return None   # normal pre-publish state, not a failure
 
         def _load():
             with open(path) as f:
@@ -123,18 +161,25 @@ class SnapshotWatcher:
             # atomic manifest replace) gets the shared retry/backoff
             m = read_with_retries(_load, site="snapshot_manifest")
         except FileNotFoundError:
-            return []   # swept between the isfile check and the open
+            return None   # swept between the isfile check and the open
         except (json.JSONDecodeError, OSError) as e:
             self._record_failure(f"manifest unreadable: {e}")
-            return []
-        entries = m.get("entries") if isinstance(m, dict) else None
+            return None
+        return m if isinstance(m, dict) else None
+
+    def _read_entries(self) -> list:
+        m = self._read_manifest() or {}
+        entries = m.get("entries")
         return entries if isinstance(entries, list) else []
 
-    def _latest_valid(self) -> Optional[Dict[str, Any]]:
+    def _latest_valid(self, entries: Optional[list] = None
+                      ) -> Optional[Dict[str, Any]]:
         """Newest manifest entry that exists on disk, matches this
         model's fingerprint, and checksums clean — the same discipline
         as ``CheckpointManager.latest_valid``, read-only."""
-        for entry in sorted(self._read_entries(),
+        if entries is None:
+            entries = self._read_entries()
+        for entry in sorted(entries,
                             key=lambda e: e.get("step", -1), reverse=True):
             path = os.path.join(self.directory, entry.get("file", ""))
             if not os.path.isfile(path):
@@ -157,11 +202,119 @@ class SnapshotWatcher:
             return entry
         return None
 
+    # --- one poll ------------------------------------------------------
     def poll_once(self) -> bool:
-        """Check for a newer valid snapshot; install it if found.
-        Returns True when a reload happened."""
+        """Check for newer servable state; install it if found. The
+        delta chain is tried first (freshest, cheapest); any chain
+        problem degrades to the newest valid full snapshot. Returns True
+        when a reload happened."""
         self._polls += 1
-        entry = self._latest_valid()
+        manifest = self._read_manifest()
+        if manifest is None:
+            return False
+        if self.allow_deltas and self._try_delta_chain(manifest):
+            return True
+        return self._try_full(manifest)
+
+    # --- delta chain path ---------------------------------------------
+    def _try_delta_chain(self, manifest: Dict[str, Any]) -> bool:
+        deltas = manifest.get("deltas")
+        if not isinstance(deltas, list) or not deltas:
+            return False
+        tip_step = max(int(e.get("step", -1)) for e in deltas)
+        if tip_step <= self._engine.version:
+            return False
+        key = ("chain", tip_step)
+        if key in self._rejected:
+            return False   # already degraded for this tip
+        try:
+            base_entry, chain = resolve_chain(manifest,
+                                              self._fingerprint,
+                                              self.directory)
+        except ChainError as e:
+            self._chain_fallbacks += 1
+            self._reject_once(
+                key, f"delta chain rejected: {e} — falling back to "
+                     f"full reload")
+            return False
+        base_step = int(base_entry.get("step", -1))
+        applied = self._engine.version
+        on_chain = {base_step} | {int(e.get("step", -1)) for e in chain}
+        # the engine's version only names a chain node once something
+        # was actually INSTALLED from this directory — a fresh engine's
+        # constructor-time version can coincide with a published step
+        # without being that state, and patching delta rows onto it
+        # would silently mix lineages
+        if self._engine.has_applied_snapshot and applied in on_chain:
+            need_base = False
+            pending = [e for e in chain
+                       if int(e.get("step", -1)) > applied]
+        elif (not self._engine.has_applied_snapshot
+                or applied < base_step):
+            need_base = True      # cold engine: base + whole chain
+            pending = chain
+        else:
+            # the served version is between base and tip but NOT a
+            # chain node (e.g. a snapshot from a retired chain):
+            # applying these deltas could mix lineages — degrade
+            self._chain_fallbacks += 1
+            self._reject_once(
+                key, f"delta chain rejected: served version {applied} "
+                     f"is not on the chain (base {base_step}, tip "
+                     f"{tip_step}) — falling back to full reload")
+            return False
+        if not pending:
+            return False
+        try:
+            # slow half on THIS thread, outside any dispatch lock: file
+            # reads, validation, and the row payloads' device_put
+            payloads = []
+            for e in pending:
+                path = os.path.join(self.directory, e["file"])
+                payload = read_with_retries(
+                    lambda p=path: load_delta_file(p),
+                    site="delta_reload")
+                payloads.append(stage_delta_rows(self._engine.model,
+                                                 payload))
+            if need_base:
+                base_path = os.path.join(self.directory,
+                                         base_entry["file"])
+                faults.maybe_corrupt_reload(base_path)
+                state = read_with_retries(
+                    lambda: load_params_for_swap(self._engine.model,
+                                                 base_path,
+                                                 elastic=self.elastic),
+                    site="snapshot_reload")
+                state = faults.maybe_poison_reload(state)
+                self._engine.install_snapshot(state, base_step,
+                                              source=base_entry["file"])
+            for e, payload in zip(pending, payloads):
+                self._engine.install_delta(payload,
+                                           int(e.get("step", -1)),
+                                           source=e["file"])
+            self._delta_installs += len(pending)
+        except Exception as e:   # noqa: BLE001
+            self._chain_fallbacks += 1
+            self._reject_once(
+                key, f"delta chain failed to load/apply: {e} — falling "
+                     f"back to full reload")
+            return False
+        if self._engine.version != tip_step:
+            # an apply failed between dispatches (engine rolled its
+            # version back and recorded the reject) — degrade
+            self._chain_fallbacks += 1
+            self._record_failure(
+                f"delta chain applied partially (at version "
+                f"{self._engine.version}, tip {tip_step})")
+            self._rejected.add(key)
+            return False
+        return True
+
+    # --- full-snapshot path ---------------------------------------------
+    def _try_full(self, manifest: Dict[str, Any]) -> bool:
+        entries = manifest.get("entries")
+        entry = self._latest_valid(entries
+                                   if isinstance(entries, list) else [])
         if entry is None:
             return False
         step = int(entry.get("step", -1))
@@ -197,5 +350,9 @@ class SnapshotWatcher:
     def stats(self) -> Dict[str, Any]:
         return {"directory": self.directory, "polls": self._polls,
                 "poll_s": self.poll_s,
+                "next_poll_s": self._next_poll_s,
+                "consecutive_failures": self._consecutive_failures,
+                "delta_installs": self._delta_installs,
+                "chain_fallbacks": self._chain_fallbacks,
                 "reload_failures": self._reload_failures,
                 "last_reload_error": self._last_reload_error}

@@ -518,7 +518,8 @@ class CheckpointManager:
 
     def _write_snapshot(self, flat, step: int, fingerprint: str,
                         loader_state: Dict[str, Any],
-                        mesh: Optional[Dict[str, Any]] = None) -> None:
+                        mesh: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Any]:
         fname = f"ckpt-{step:08d}.npz"
         path = os.path.join(self.directory, fname)
         t0 = time.time()
@@ -540,21 +541,69 @@ class CheckpointManager:
             self._write_manifest(manifest)
         log_ckpt.info("saved checkpoint %s (step %d, %.0f ms)",
                       fname, step, 1e3 * (time.time() - t0))
+        return entry
 
     def _gc(self, manifest: Dict[str, Any]) -> None:
         """Keep the newest `keep_last` entries; delete the rest's files.
         Called under the manifest lock, BEFORE the manifest write — a
         crash between unlink and manifest write only loses already-
-        superseded snapshots (the entry scan skips missing files)."""
+        superseded snapshots (the entry scan skips missing files).
+
+        A snapshot a LIVE delta chain still references as its base is
+        retained beyond keep_last — deleting it would strand every
+        watcher that has not caught up past the base (the chain's
+        incremental loads and its full-reload fallback both die with
+        it). It falls out of the manifest on the next chain reset."""
         entries = manifest["entries"]
         entries.sort(key=lambda e: e.get("step", -1))
         drop, keep = entries[:-self.keep_last], entries[-self.keep_last:]
+        chained = {d.get("base_file") for d in manifest.get("deltas", [])}
+        chained.discard(None)
+        spared = [e for e in drop if e.get("file") in chained]
+        drop = [e for e in drop if e.get("file") not in chained]
         for e in drop:
             try:
                 os.unlink(os.path.join(self.directory, e["file"]))
             except OSError:
                 pass
-        manifest["entries"] = keep
+        manifest["entries"] = sorted(spared + keep,
+                                     key=lambda e: e.get("step", -1))
+
+    # --- delta chain (utils/delta.py DeltaPublisher) -------------------
+    def delta_entries(self) -> List[Dict[str, Any]]:
+        with self._manifest_lock:
+            return list(self._read_manifest().get("deltas", []))
+
+    def append_delta_entry(self, entry: Dict[str, Any]) -> None:
+        """Append one delta entry to the chain manifest (atomic
+        read-modify-replace under the manifest lock). The delta FILE
+        must already be on disk — a crash between the two leaves an
+        unlisted file, never a listed-but-missing one."""
+        with self._manifest_lock:
+            manifest = self._read_manifest()
+            deltas = manifest.setdefault("deltas", [])
+            manifest["deltas"] = [e for e in deltas
+                                  if e.get("file") != entry.get("file")] \
+                + [entry]
+            self._write_manifest(manifest)
+
+    def reset_deltas(self) -> int:
+        """Retire the delta chain: drop every delta entry from the
+        manifest, then delete the files (in that order — a crash in
+        between leaves harmless orphan files, never dangling entries).
+        Returns how many entries were retired."""
+        with self._manifest_lock:
+            manifest = self._read_manifest()
+            retired = list(manifest.get("deltas", []))
+            if retired:
+                manifest["deltas"] = []
+                self._write_manifest(manifest)
+        for e in retired:
+            try:
+                os.unlink(os.path.join(self.directory, e.get("file", "")))
+            except OSError:
+                pass
+        return len(retired)
 
     # --- restore -------------------------------------------------------
     def entries(self) -> List[Dict[str, Any]]:

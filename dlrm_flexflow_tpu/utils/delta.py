@@ -1,0 +1,552 @@
+"""Crash-safe delta publication for the continual train→serve loop.
+
+Production recsys retrains forever, and a full-checkpoint publish makes
+the serving fleet's freshness (train-step → servable) checkpoint-sized.
+The bulk of a DLRM snapshot is embedding rows, and one publish interval
+touches only the rows its batches gathered — so the trainer publishes
+**delta snapshots**: the touched table rows plus the (small) dense
+params, chained off a rolling full checkpoint. The serving
+:class:`~..serve.watcher.SnapshotWatcher` applies deltas incrementally
+via ``FFModel.apply_delta`` instead of a full-param reload.
+
+Crash-consistency discipline (the CheckFreq-style rules):
+
+- every delta file is written **atomically** (same temp + fsync +
+  ``os.replace`` as checkpoints) — a trainer SIGKILLed mid-publish never
+  leaves a torn file at a published path;
+- the chain lives in the SAME ``manifest.json`` the rolling checkpoints
+  use, under a separate ``"deltas"`` list, each entry carrying the base
+  snapshot's identity (step + CRC-32), its own CRC-32, the previous
+  chain step, and per-array touched-row counts — a watcher can validate
+  the whole chain read-only, and ANY inconsistency (gap, torn file,
+  replaced base, foreign fingerprint) is detectable before a single row
+  is applied;
+- the file is written BEFORE its manifest entry: a crash between the
+  two leaves an unlisted (harmless) file, never a listed-but-missing
+  one;
+- when the accumulated chain outgrows ``compact_frac`` of its base (or
+  ``max_chain`` links), the next publish is a **compaction**: a fresh
+  full checkpoint becomes the new base and the old chain is retired.
+
+Touched-row tracking: the streaming ``fit_stream`` loop shows every
+batch to :class:`TouchedRowTracker` before staging it; embedding ops map
+the lookup indices to stored-kernel rows (``delta_touched_rows``). The
+publisher diffs only those candidate rows against the last published
+state — and falls back to a full-array row diff whenever candidates are
+unavailable or provably incomplete (a dense-update table, a batch it
+never saw), so the delta is ALWAYS exact; tracking is an optimization,
+never a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import faults
+from .checkpoint import (CheckpointManager, _file_crc32, _model_flat,
+                         _write_npz_atomic, config_fingerprint, mesh_meta)
+from .logging import get_logger
+
+log_delta = get_logger("delta")
+
+# arrays below this element count are cheaper to ship whole than to
+# row-diff + index; only params/hostparams arrays at or above it (and
+# with >= 2 dims) get the touched-rows treatment
+ROW_DELTA_MIN_ELEMS = 16384
+
+_SERVING_SECTIONS = ("params", "state", "hostparams")
+
+
+class ChainError(ValueError):
+    """A delta chain failed validation (gap, torn file, replaced or
+    missing base, foreign fingerprint). The watcher treats this as
+    reject-with-reason and degrades to a full-param reload."""
+
+
+def serving_flat(model) -> Dict[str, np.ndarray]:
+    """The serving-relevant slice of a model's flattened state: params,
+    op state, host tables — what ``load_params_for_swap`` reads —
+    keyed exactly like the checkpoint npz. Host tables are deep-copied
+    (the trainer keeps scattering into them in place)."""
+    flat = _model_flat(model, copy_host=True)
+    return {k: v for k, v in flat.items()
+            if k.split("/", 1)[0] in _SERVING_SECTIONS}
+
+
+def _row_view(arr: np.ndarray) -> np.ndarray:
+    """Stored array -> 2-D (rows, width) view over all-but-last axes."""
+    return arr.reshape(-1, arr.shape[-1])
+
+
+def _row_eligible(arr: np.ndarray, min_elems: int) -> bool:
+    return arr.ndim >= 2 and arr.size >= min_elems and arr.shape[-1] > 0
+
+
+class TouchedRowTracker:
+    """Accumulates, per flat state key, the stored-kernel rows the
+    training batches since the last publish MAY have updated.
+
+    ``observe(batch)`` runs on the staging thread (cheap numpy); the
+    publisher ``snapshot()``\\ s on the training thread. Accumulation is
+    CUMULATIVE over the tracker's life: the prefetch ring stages (and
+    observes) batches ahead of training, so per-interval bookkeeping
+    could never tell which observations were actually trained — a
+    cumulative set is always a superset of the rows updated since any
+    publish, which is exactly the safe direction for restricting the
+    publish-time diff (a candidate that did not change is never
+    shipped; a changed row is never missed). Over a long stream the set
+    converges on the table's hot working set — still far smaller than
+    the table. Keys are only tracked when the op's update is provably
+    row-local (sparse device update active, or a host-resident table);
+    everything else diffs all rows at publish.
+    """
+
+    def __init__(self, model):
+        self.model = model
+        from ..analysis.sanitizer import make_lock
+        self._lock = make_lock("TouchedRowTracker._lock")
+        self._merged: Dict[str, np.ndarray] = {}
+        self._pending: Dict[str, List[np.ndarray]] = {}
+        self._batches = 0
+        # (op, input name, flat key, host?) tuples resolved once
+        self._tracked = self._resolve_tracked()
+
+    def _resolve_tracked(self) -> List[Tuple[Any, str, str, bool]]:
+        from ..ops.embedding import _sparse_update_active
+        out = []
+        hres = getattr(self.model, "_host_resident_ops", set())
+        for op in getattr(self.model, "ops", []):
+            if not op.inputs or not hasattr(op, "delta_touched_rows"):
+                continue
+            in_name = op.inputs[0].name
+            if op.name in hres:
+                # host updates are always touched-rows-only
+                out.append((op, in_name,
+                            f"hostparams/{op.name}/kernel", True))
+            elif _sparse_update_active(op):
+                out.append((op, in_name,
+                            f"params/{op.name}/kernel", False))
+            # dense-update device tables: every row may change
+            # (e.g. dense Adam moments) — leave untracked, diff-all
+        return out
+
+    def observe(self, batch: Dict[str, np.ndarray]) -> None:
+        """Record one (about to be trained) host batch's candidates."""
+        adds = []
+        for op, in_name, key, host in self._tracked:
+            idx = batch.get(in_name)
+            if idx is None:
+                continue
+            rows = (op.host_delta_touched_rows(idx) if host
+                    else op.delta_touched_rows(idx))
+            adds.append((key, rows))
+        with self._lock:
+            self._batches += 1
+            for key, rows in adds:
+                self._pending.setdefault(key, []).append(rows)
+
+    def snapshot(self) -> Tuple[Dict[str, np.ndarray], int]:
+        """Merge pending observations and return (a copy of) the
+        cumulative candidate sets plus the total batches observed.
+        Nothing is cleared — a failed publish needs the same candidates
+        again, and the next publish's interval is covered regardless."""
+        with self._lock:
+            pending, self._pending = self._pending, {}
+            batches = self._batches
+        for k, v in pending.items():
+            prev = self._merged.get(k)
+            parts = ([prev] if prev is not None else []) + v
+            self._merged[k] = np.unique(np.concatenate(parts))
+        return dict(self._merged), batches
+
+
+def _diff_flat(prev: Dict[str, np.ndarray], cur: Dict[str, np.ndarray],
+               candidates: Optional[Dict[str, np.ndarray]],
+               min_elems: int):
+    """Exact diff of two serving_flat states.
+
+    Returns (rows, full, counts): ``rows[key] = (idx, vals)`` for
+    row-eligible arrays (idx into the flattened-2D stored layout),
+    ``full[key]`` for everything else that changed, ``counts`` for the
+    manifest. Restricting to ``candidates[key]`` is only an optimization
+    — the equality compare is still performed on the candidate rows, so
+    a candidate that did NOT change is never shipped."""
+    rows: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    full: Dict[str, np.ndarray] = {}
+    counts: Dict[str, int] = {}
+    for key, cv in cur.items():
+        pv = prev.get(key)
+        if pv is None or pv.shape != cv.shape or pv.dtype != cv.dtype:
+            full[key] = cv           # new/reshaped array: ship whole
+            continue
+        if _row_eligible(cv, min_elems):
+            p2, c2 = _row_view(pv), _row_view(cv)
+            cand = candidates.get(key) if candidates else None
+            if cand is not None:
+                cand = cand[(cand >= 0) & (cand < c2.shape[0])]
+                sub = np.any(p2[cand] != c2[cand], axis=1)
+                idx = cand[sub]
+            else:
+                idx = np.flatnonzero(np.any(p2 != c2, axis=1))
+            if idx.size:
+                rows[key] = (idx.astype(np.int64),
+                             np.ascontiguousarray(c2[idx]))
+                counts[key] = int(idx.size)
+        elif not np.array_equal(pv, cv):
+            full[key] = cv
+    return rows, full, counts
+
+
+# ---------------------------------------------------------------------
+# delta file round trip
+# ---------------------------------------------------------------------
+def write_delta_file(path: str, step: int, prev_step: int, base_step: int,
+                     rows, full) -> int:
+    """Atomically write one delta npz; returns its CRC-32. The
+    publish-abort injection fires inside the atomic writer (before the
+    rename — exactly the mid-publish crash window), the torn-delta
+    injection truncates AFTER the rename (bit rot on a published
+    file)."""
+    flat: Dict[str, np.ndarray] = {
+        "meta/step": np.asarray(step, np.int64),
+        "meta/prev_step": np.asarray(prev_step, np.int64),
+        "meta/base_step": np.asarray(base_step, np.int64),
+    }
+    for key, (idx, vals) in rows.items():
+        flat[f"idx/{key}"] = idx
+        flat[f"rows/{key}"] = vals
+    for key, v in full.items():
+        flat[f"full/{key}"] = v
+    faults.maybe_abort_publish(path)
+    crc = _write_npz_atomic(path, flat)
+    if faults.maybe_torn_delta(path):
+        pass                      # published file torn post-rename
+    return crc
+
+
+def load_delta_file(path: str) -> Dict[str, Any]:
+    """Read a delta npz into an apply_delta payload (host arrays; the
+    caller device_puts the row payloads outside any dispatch lock)."""
+    data = np.load(path)
+    rows: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    full: Dict[str, np.ndarray] = {}
+    for k in data.files:
+        if k.startswith("idx/"):
+            key = k[len("idx/"):]
+            rows[key] = (data[k], data[f"rows/{key}"])
+        elif k.startswith("full/"):
+            full[k[len("full/"):]] = data[k]
+    return {"step": int(data["meta/step"]),
+            "prev_step": int(data["meta/prev_step"]),
+            "base_step": int(data["meta/base_step"]),
+            "rows": rows, "full": full}
+
+
+def stage_delta_rows(model, payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Device_put a loaded delta's device-param row payloads — the slow
+    H2D half of an incremental reload, run on the watcher thread OUTSIDE
+    any dispatch lock (host-table rows stay numpy; they are applied on
+    the host). Returns a new payload; the input is not modified."""
+    import jax
+    staged = dict(payload)
+    staged["rows"] = dict(payload["rows"])
+    for key, (idx, vals) in payload["rows"].items():
+        if key.startswith("params/"):
+            staged["rows"][key] = (jax.device_put(idx),
+                                   jax.device_put(vals))
+    return staged
+
+
+# ---------------------------------------------------------------------
+# chain validation (shared: publisher sanity + watcher read-only path)
+# ---------------------------------------------------------------------
+def resolve_chain(manifest: Dict[str, Any], fingerprint: Optional[str],
+                  directory: str,
+                  check_files: bool = True
+                  ) -> Optional[Tuple[Dict[str, Any], List[Dict[str, Any]]]]:
+    """Validate the manifest's delta chain newest-tip-first.
+
+    Returns ``(base_entry, ordered_delta_entries)`` for the newest tip,
+    or None when no deltas are listed. Raises :class:`ChainError` with
+    the reason on ANY inconsistency: a gap in the prev links, a delta or
+    base written by a differently-built model, a base snapshot that was
+    replaced (CRC identity mismatch) or is missing from the manifest, a
+    listed delta file that is missing or fails its CRC-32.
+    """
+    deltas = manifest.get("deltas") or []
+    if not deltas:
+        return None
+    entries = manifest.get("entries") or []
+    tip = max(deltas, key=lambda e: e.get("step", -1))
+    base_step = tip.get("base_step")
+    chain = sorted((e for e in deltas
+                    if e.get("base_step") == base_step),
+                   key=lambda e: e.get("step", -1))
+    if len(chain) != len(deltas):
+        strays = [e.get("file") for e in deltas if e not in chain]
+        raise ChainError(
+            f"delta chain mixes bases: {strays} do not chain off base "
+            f"step {base_step} (stale chain from a previous run)")
+    base_entry = next((e for e in entries
+                       if e.get("step") == base_step), None)
+    if base_entry is None:
+        raise ChainError(
+            f"chain base snapshot (step {base_step}) is not in the "
+            f"manifest (pruned or never published)")
+    if (fingerprint is not None and base_entry.get("fingerprint")
+            not in (None, fingerprint)):
+        raise ChainError(
+            f"chain base {base_entry.get('file')} fingerprint "
+            f"{base_entry.get('fingerprint')} != this model's "
+            f"{fingerprint} (differently-built model)")
+    prev = base_step
+    for e in chain:
+        if e.get("prev_step") != prev:
+            raise ChainError(
+                f"chain gap: delta {e.get('file')} links to step "
+                f"{e.get('prev_step')} but the chain is at step {prev} "
+                f"(lost manifest entry / partial publish)")
+        if (fingerprint is not None
+                and e.get("fingerprint") not in (None, fingerprint)):
+            raise ChainError(
+                f"delta {e.get('file')} fingerprint "
+                f"{e.get('fingerprint')} != this model's {fingerprint}")
+        if (e.get("base_crc32") is not None
+                and base_entry.get("crc32") is not None
+                and e.get("base_crc32") != base_entry.get("crc32")):
+            raise ChainError(
+                f"delta {e.get('file')} was published against base "
+                f"step {base_step} crc {e.get('base_crc32')}, but the "
+                f"manifest's base {base_entry.get('file')} has crc "
+                f"{base_entry.get('crc32')} (base was replaced)")
+        if check_files:
+            path = os.path.join(directory, e.get("file", ""))
+            if not os.path.isfile(path):
+                raise ChainError(
+                    f"delta {e.get('file')} is listed in the manifest "
+                    f"but missing on disk")
+            crc = e.get("crc32")
+            if crc is not None and _file_crc32(path) != crc:
+                raise ChainError(
+                    f"delta {e.get('file')} fails its CRC-32 (torn "
+                    f"write / corruption)")
+        prev = e.get("step")
+    return base_entry, chain
+
+
+# ---------------------------------------------------------------------
+# publisher
+# ---------------------------------------------------------------------
+class DeltaPublisher:
+    """Interleaves delta snapshots with rolling full checkpoints.
+
+    Owns (or adopts) a :class:`CheckpointManager` on `directory`. The
+    first publish is always a FULL checkpoint (the chain base — also
+    what crash-resume restores from); subsequent publishes are deltas
+    until compaction triggers: accumulated delta bytes exceeding
+    ``compact_frac`` of the base file, ``max_chain`` links, or an
+    explicit ``full_every`` cadence. Construction retires any chain a
+    previous (crashed) trainer left behind — its in-memory base state is
+    gone, so the chain can never be extended; the watcher degrades to
+    the full snapshots until the new chain starts.
+
+    A failed delta publish (IO error, injected abort) is non-fatal: the
+    chain is untouched (the file write is atomic and the manifest entry
+    never happened), the cumulative tracker still holds the interval's
+    candidate rows, and the next interval publishes the union.
+    ``stats()`` counts it.
+    """
+
+    def __init__(self, model, directory: str, keep_last: int = 3,
+                 compact_frac: float = 0.5, full_every: int = 0,
+                 max_chain: int = 64,
+                 row_delta_min_elems: int = ROW_DELTA_MIN_ELEMS,
+                 manager: Optional[CheckpointManager] = None):
+        if compact_frac <= 0:
+            raise ValueError(
+                f"compact_frac must be > 0, got {compact_frac}")
+        self.model = model
+        self.mgr = manager or CheckpointManager(directory,
+                                                keep_last=keep_last)
+        self.compact_frac = float(compact_frac)
+        self.full_every = int(full_every)
+        self.max_chain = int(max_chain)
+        self.row_delta_min_elems = int(row_delta_min_elems)
+        self.tracker = TouchedRowTracker(model)
+        # candidates are trustworthy only if the tracker saw every batch
+        # trained after this point (fit_stream observes at staging time)
+        self._track_origin = int(getattr(model, "_step", 0) or 0)
+        self._fingerprint = config_fingerprint(model)
+        # a previous run's chain is unextendable — retire it
+        removed = self.mgr.reset_deltas()
+        if removed:
+            log_delta.info("retired %d stale delta(s) from a previous "
+                           "run in %s", removed, self.mgr.directory)
+        self._last_flat: Optional[Dict[str, np.ndarray]] = None
+        self._last_step = -1
+        self._base_step = -1
+        self._base_file = ""
+        self._base_crc: Optional[int] = None
+        self._base_bytes = 0
+        self._chain_bytes = 0
+        self._chain_len = 0
+        self._deltas_since_full = 0
+        self.publishes = 0
+        self.full_publishes = 0
+        self.delta_publishes = 0
+        self.compactions = 0
+        self.publish_errors = 0
+        self.last_publish_error = ""
+        self._untracked_warned = False
+
+    # --- tracking ------------------------------------------------------
+    def observe_batch(self, batch: Dict[str, np.ndarray]) -> None:
+        """Show the tracker a host batch about to be staged/trained."""
+        self.tracker.observe(batch)
+
+    # --- publish decision ----------------------------------------------
+    def _compaction_due(self) -> Optional[str]:
+        if self._last_flat is None:
+            return "no base yet"
+        if self.full_every and self._deltas_since_full >= self.full_every:
+            return f"full_every={self.full_every} cadence"
+        if self._chain_len >= self.max_chain:
+            return f"chain length {self._chain_len} >= {self.max_chain}"
+        if (self._base_bytes
+                and self._chain_bytes > self.compact_frac
+                * self._base_bytes):
+            return (f"chain bytes {self._chain_bytes} > "
+                    f"{self.compact_frac:g} x base {self._base_bytes}")
+        return None
+
+    def publish(self, loader_state: Optional[Dict[str, Any]] = None
+                ) -> Optional[Dict[str, Any]]:
+        """Publish the model's current state: a delta when a live chain
+        can absorb it, a full checkpoint otherwise (first publish /
+        compaction). Returns the manifest entry, or None when a delta
+        publish failed non-fatally (retried next interval)."""
+        reason = self._compaction_due()
+        if reason is None:
+            return self.publish_delta(loader_state)
+        if self._last_flat is not None:
+            self.compactions += 1
+            log_delta.info("compacting delta chain -> full checkpoint "
+                           "(%s)", reason)
+        return self.publish_full(loader_state)
+
+    # --- full (chain base) publish --------------------------------------
+    def publish_full(self, loader_state: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+        """Blocking full checkpoint; becomes the new chain base."""
+        self.mgr.wait()
+        model = self.model
+        step = int(model._step)
+        flat = _model_flat(model, copy_host=True)
+        entry = self.mgr._write_snapshot(
+            flat, step, self._fingerprint, dict(loader_state or {}),
+            mesh_meta(model))
+        removed = self.mgr.reset_deltas()
+        if removed:
+            log_delta.info("retired %d delta(s) of the previous chain",
+                           removed)
+        self._last_flat = {
+            k: v for k, v in flat.items()
+            if k.split("/", 1)[0] in _SERVING_SECTIONS}
+        self._last_step = step
+        self._base_step = step
+        self._base_file = entry["file"]
+        self._base_crc = entry.get("crc32")
+        try:
+            self._base_bytes = os.path.getsize(
+                os.path.join(self.mgr.directory, entry["file"]))
+        except OSError:
+            self._base_bytes = 0
+        self._chain_bytes = 0
+        self._chain_len = 0
+        self._deltas_since_full = 0
+        self.publishes += 1
+        self.full_publishes += 1
+        return entry
+
+    # --- delta publish ---------------------------------------------------
+    def publish_delta(self, loader_state: Optional[Dict[str, Any]] = None
+                      ) -> Optional[Dict[str, Any]]:
+        model = self.model
+        step = int(model._step)
+        if self._last_flat is None:
+            return self.publish_full(loader_state)
+        if step <= self._last_step:
+            return None           # nothing trained since the last publish
+        cur = serving_flat(model)
+        cand, batches = self.tracker.snapshot()
+        # candidates are only trustworthy when the tracker saw at least
+        # every batch trained since it started watching (fit_stream
+        # observes at staging time, which runs AHEAD of training; ad-hoc
+        # train_batch calls in between break the invariant)
+        if batches < step - self._track_origin:
+            if cand and not self._untracked_warned:
+                self._untracked_warned = True
+                log_delta.warning(
+                    "tracker observed %d batch(es) for %d trained "
+                    "step(s); falling back to full-array row diffs",
+                    batches, step - self._track_origin)
+            cand = None
+        try:
+            rows, full, counts = _diff_flat(self._last_flat, cur, cand,
+                                            self.row_delta_min_elems)
+            fname = f"delta-{step:08d}.npz"
+            path = os.path.join(self.mgr.directory, fname)
+            crc = write_delta_file(path, step, self._last_step,
+                                   self._base_step, rows, full)
+        except (IOError, OSError) as e:
+            # non-fatal: the atomic writer left no torn file and the
+            # manifest never saw an entry; the cumulative tracker still
+            # holds the candidates, so the NEXT delta covers this
+            # interval's rows too.
+            self.publish_errors += 1
+            self.last_publish_error = str(e)
+            log_delta.warning("delta publish at step %d failed (%s); "
+                              "will retry next interval", step, e)
+            return None
+        entry = {
+            "file": fname, "kind": "delta", "step": step,
+            "prev_step": self._last_step, "base_step": self._base_step,
+            "base_file": self._base_file, "base_crc32": self._base_crc,
+            "fingerprint": self._fingerprint, "crc32": crc,
+            "bytes": os.path.getsize(path),
+            "touched_rows": counts, "full_arrays": sorted(full),
+            "loader_state": dict(loader_state or {}),
+            "time": time.time(),
+        }
+        if faults.take_delta_gap():
+            log_delta.warning("injected delta gap: %s published without "
+                              "a manifest entry", fname)
+        else:
+            self.mgr.append_delta_entry(entry)
+        self._last_flat = cur
+        self._last_step = step
+        self._chain_bytes += entry["bytes"]
+        self._chain_len += 1
+        self._deltas_since_full += 1
+        self.publishes += 1
+        self.delta_publishes += 1
+        return entry
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "publishes": self.publishes,
+            "full_publishes": self.full_publishes,
+            "delta_publishes": self.delta_publishes,
+            "compactions": self.compactions,
+            "publish_errors": self.publish_errors,
+            "last_publish_error": self.last_publish_error,
+            "base_step": self._base_step,
+            "last_step": self._last_step,
+            "chain_len": self._chain_len,
+            "chain_bytes": self._chain_bytes,
+            "base_bytes": self._base_bytes,
+        }

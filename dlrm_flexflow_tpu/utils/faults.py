@@ -90,6 +90,13 @@ subprocess kill-test needs):
 - ``FF_FAULT_REPLICA_DOWN=1``      serving replica 1 is dead (every
   dispatch/probe raises); ``1:8`` fails its next 8 attempts then
   recovers, so the probe/re-admit path runs
+- ``FF_FAULT_DELTA_TORN=1``        truncate the next 1 published delta
+                                   snapshot after its rename (torn chain)
+- ``FF_FAULT_PUBLISH_ABORT=2``     abort the next 2 delta publishes
+                                   before the rename (mid-publish crash)
+- ``FF_FAULT_DELTA_GAP=1``         drop the next 1 delta's manifest
+                                   entry (chain gap the watcher must
+                                   reject)
 - ``FF_FAULT_POISON_RELOAD=1``     scale the params of the next 1
   snapshot the hot-reload loads (valid file, garbage weights — the
   canary auto-rollback trigger)
@@ -165,6 +172,20 @@ class FaultPlan:
     corrupt_reloads: int = 0
     # bytes to leave when corrupting a reload snapshot
     corrupt_reload_bytes: int = 64
+    # number of future DELTA snapshot files to truncate right after their
+    # atomic rename (a torn delta left on disk — the watcher's chain CRC
+    # validation must reject it and fall back to a full reload)
+    torn_deltas: int = 0
+    torn_delta_bytes: int = 64
+    # number of future delta PUBLISHES to abort before the rename (the
+    # trainer crashing mid-publish: no torn file may ever be visible at
+    # the final path, and the chain manifest must not list the victim)
+    publish_aborts: int = 0
+    # number of future delta publishes whose manifest entry is silently
+    # dropped AFTER the file lands (simulated lost manifest update: the
+    # next delta still chains to the unlisted step, so the watcher sees
+    # a chain GAP and must degrade to a full reload)
+    delta_gaps: int = 0
     # record of (hook, detail) actually fired, for test assertions
     fired: List[tuple] = field(default_factory=list)
 
@@ -186,7 +207,8 @@ _KNOWN_ENV_KEYS = ("FF_FAULT_NAN_STEPS", "FF_FAULT_TRUNCATE_CKPTS",
                    "FF_FAULT_IO_ERRORS", "FF_FAULT_DROP_DEVICE",
                    "FF_FAULT_STALL_COLLECTIVE", "FF_FAULT_SERVE_DELAY",
                    "FF_FAULT_CORRUPT_RELOAD", "FF_FAULT_REPLICA_DOWN",
-                   "FF_FAULT_POISON_RELOAD")
+                   "FF_FAULT_POISON_RELOAD", "FF_FAULT_DELTA_TORN",
+                   "FF_FAULT_PUBLISH_ABORT", "FF_FAULT_DELTA_GAP")
 
 
 # --- strict env parsing ----------------------------------------------
@@ -268,9 +290,12 @@ def plan_from_env() -> Optional[FaultPlan]:
     corrupt_reload = os.environ.get("FF_FAULT_CORRUPT_RELOAD", "")
     replica_down = os.environ.get("FF_FAULT_REPLICA_DOWN", "")
     poison_reload = os.environ.get("FF_FAULT_POISON_RELOAD", "")
+    delta_torn = os.environ.get("FF_FAULT_DELTA_TORN", "")
+    publish_abort = os.environ.get("FF_FAULT_PUBLISH_ABORT", "")
+    delta_gap = os.environ.get("FF_FAULT_DELTA_GAP", "")
     if not any((nan, trunc, aborts, delay, ioerrs, drop, stall_coll,
                 serve_delay, corrupt_reload, replica_down,
-                poison_reload)):
+                poison_reload, delta_torn, publish_abort, delta_gap)):
         return None
     plan = FaultPlan()
     if nan:
@@ -320,6 +345,13 @@ def plan_from_env() -> Optional[FaultPlan]:
     if poison_reload:
         plan.poison_reloads = _env_int("FF_FAULT_POISON_RELOAD",
                                        poison_reload)
+    if delta_torn:
+        plan.torn_deltas = _env_int("FF_FAULT_DELTA_TORN", delta_torn)
+    if publish_abort:
+        plan.publish_aborts = _env_int("FF_FAULT_PUBLISH_ABORT",
+                                       publish_abort)
+    if delta_gap:
+        plan.delta_gaps = _env_int("FF_FAULT_DELTA_GAP", delta_gap)
     return plan
 
 
@@ -523,6 +555,54 @@ def maybe_poison_reload(state: dict) -> dict:
     if out.get("host_params") is not None:
         out["host_params"] = jax.tree.map(_scale, out["host_params"])
     return out
+
+
+def maybe_abort_publish(path: str) -> None:
+    """Raise IOError before a delta snapshot's atomic rename (the
+    trainer crashing mid-publish). The temp file is cleaned up by the
+    writer; no torn file may ever be visible at the final path and the
+    chain manifest must not gain the victim's entry."""
+    plan = active()
+    if plan is None:
+        return
+    with plan._lock:
+        if plan.publish_aborts > 0:
+            plan.publish_aborts -= 1
+            plan._record("publish_abort", path)
+            raise IOError(f"injected delta publish abort: {path}")
+
+
+def maybe_torn_delta(path: str) -> bool:
+    """Truncate a just-published delta file (torn write / bit rot after
+    the rename). The watcher's chain CRC validation must reject the
+    whole chain and degrade to a full reload."""
+    plan = active()
+    if plan is None:
+        return False
+    with plan._lock:
+        if plan.torn_deltas <= 0:
+            return False
+        plan.torn_deltas -= 1
+        plan._record("torn_delta", path)
+    with open(path, "r+b") as f:
+        f.truncate(plan.torn_delta_bytes)
+    return True
+
+
+def take_delta_gap() -> bool:
+    """True once per budgeted gap: the publisher drops this delta's
+    manifest entry after the file lands, so the NEXT delta's prev link
+    points at an unlisted step — the watcher must detect the chain gap
+    and degrade to a full reload."""
+    plan = active()
+    if plan is None:
+        return False
+    with plan._lock:
+        if plan.delta_gaps <= 0:
+            return False
+        plan.delta_gaps -= 1
+        plan._record("delta_gap", None)
+    return True
 
 
 def maybe_corrupt_reload(path: str) -> bool:

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Subprocess target for the SIGKILL-mid-delta-publish chaos test.
+
+Streams a tiny DLRM forever with a DeltaPublisher (delta snapshot every
+PUBLISH_EVERY steps, periodic compaction fulls); the parent test sets
+FF_FAULT_WRITE_DELAY to stretch the temp-write→rename window and
+SIGKILLs this process while a publish is in flight, then asserts the
+serving watcher never applies a torn chain. Pass ``--resume`` to
+continue a killed run from its newest full checkpoint (the restarted
+publisher re-anchors on a fresh base — a dead trainer's chain is
+unextendable by design).
+
+Run directly (never under pytest):
+    python _continual_worker.py <dir> [--resume]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlrm_flexflow_tpu.utils.testing import ensure_cpu_devices  # noqa: E402
+
+ensure_cpu_devices(2)
+
+import dlrm_flexflow_tpu as ff  # noqa: E402
+from dlrm_flexflow_tpu.data.stream import ArrayStream  # noqa: E402
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,  # noqa: E402
+                                           synthetic_batch)
+from dlrm_flexflow_tpu.utils.delta import DeltaPublisher  # noqa: E402
+
+DCFG = DLRMConfig(embedding_size=[64] * 4, sparse_feature_size=8,
+                  mlp_bot=[4, 16, 8], mlp_top=[40, 16, 1])
+BS = 16
+PUBLISH_EVERY = 2
+# tiny-model deltas are about base-sized, so this compacts (publishes a
+# fresh full base) every ~4 deltas — the recovery path a torn chain needs
+COMPACT_FRAC = 4.0
+
+
+def build_model(seed=3):
+    m = ff.FFModel(ff.FFConfig(batch_size=BS, seed=seed))
+    build_dlrm(m, DCFG)
+    m.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"])
+    m.init_layers()
+    return m
+
+
+def dataset():
+    return synthetic_batch(DCFG, 64, seed=0)
+
+
+if __name__ == "__main__":
+    out_dir = sys.argv[1]
+    resume = "--resume" in sys.argv[2:]
+    model = build_model()
+    x, y = dataset()
+    pub = DeltaPublisher(model, out_dir, keep_last=4,
+                         compact_frac=COMPACT_FRAC,
+                         row_delta_min_elems=0)
+    # effectively-endless stream; the parent kills us mid-publish
+    model.fit_stream(ArrayStream(x, y, BS, seed=1), steps=None,
+                     publisher=pub, publish_every=PUBLISH_EVERY,
+                     verbose=False, resume=resume)

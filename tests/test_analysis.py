@@ -285,6 +285,59 @@ class TestLockRules:
         assert "_io" in found[0].message
 
 
+class TestManifestAtomicityRule:
+    def test_bare_manifest_write_flagged(self, tmp_path):
+        found = _findings(tmp_path, """
+            import json
+
+            def publish(manifest_path, manifest):
+                with open(manifest_path, "w") as f:
+                    json.dump(manifest, f)
+        """)
+        assert "FLX204" in _rules(found)
+        assert "os.replace" in [f for f in found
+                                if f.rule == "FLX204"][0].message
+
+    def test_delta_path_write_flagged(self, tmp_path):
+        found = _findings(tmp_path, """
+            def publish(delta_file, blob):
+                with open(delta_file, "wb") as f:
+                    f.write(blob)
+        """)
+        assert _rules(found) == ["FLX204"]
+
+    def test_temp_then_replace_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            import json
+            import os
+
+            def publish(manifest_path, manifest):
+                tmp = f"{manifest_path}.tmp-{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(manifest, f)
+                os.replace(tmp, manifest_path)
+        """)
+        assert "FLX204" not in _rules(found)
+
+    def test_manifest_read_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            import json
+
+            def load(manifest_path):
+                with open(manifest_path) as f:
+                    return json.load(f)
+        """)
+        assert "FLX204" not in _rules(found)
+
+    def test_unrelated_write_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            def dump(log_path, text):
+                with open(log_path, "w") as f:
+                    f.write(text)
+        """)
+        assert "FLX204" not in _rules(found)
+
+
 class TestJaxRules:
     def test_exec_cache_const_key(self, tmp_path):
         found = _findings(tmp_path, """

@@ -1,0 +1,815 @@
+"""Continual train→serve loop (ISSUE 10): crash-safe delta publication
+with graceful degradation to full reload.
+
+Pinned contracts (the ISSUE-10 acceptance criteria):
+
+- a delta chain replayed on top of its base reproduces the trainer's
+  serving state BITWISE (device tables, host tables, dense params, op
+  state), whether the diff was restricted to tracked touched rows or
+  computed over all rows;
+- every publish is atomic: an aborted publish leaves no torn file and
+  no manifest entry, and the skipped interval folds into the next
+  delta;
+- the watcher validates the WHOLE chain before applying a single row;
+  a torn delta, a chain gap, a replaced base, or a foreign fingerprint
+  degrades to a full-param reload with a reject-with-reason — never a
+  failed request;
+- an engine already on the chain loads only the deltas past its
+  version (touched-rows-sized freshness); a cold engine loads base +
+  chain;
+- keep-last-K pruning never deletes a base snapshot a live chain still
+  references;
+- the embedding cache invalidates only the samples a dirtied host row
+  feeds;
+- consecutive reload failures back off exponentially (with jitter)
+  instead of hammering a bad manifest; ``stats()["next_poll_s"]``
+  surfaces the pace;
+- chaos (torn delta + publish abort under concurrent traffic): zero
+  failed requests, zero mixed-version responses, convergence to the
+  newest published version.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.data.stream import ArrayStream
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                           synthetic_batch)
+from dlrm_flexflow_tpu.serve import InferenceEngine, Overloaded, ServeConfig
+from dlrm_flexflow_tpu.serve.watcher import SnapshotWatcher
+from dlrm_flexflow_tpu.utils import faults
+from dlrm_flexflow_tpu.utils.checkpoint import (CheckpointManager,
+                                                config_fingerprint,
+                                                restore_checkpoint)
+from dlrm_flexflow_tpu.utils.delta import (ChainError, DeltaPublisher,
+                                           load_delta_file, resolve_chain,
+                                           serving_flat)
+
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+DCFG = DLRMConfig(embedding_size=[64] * 4, sparse_feature_size=8,
+                  mlp_bot=[4, 16, 8], mlp_top=[40, 16, 1])
+BS = 16
+X, Y = synthetic_batch(DCFG, 64, seed=0)
+
+
+def _build(seed=2, ndev=None, **cfg_kw):
+    import jax
+
+    from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+    model = ff.FFModel(ff.FFConfig(batch_size=BS, seed=seed, **cfg_kw))
+    build_dlrm(model, DCFG)
+    # tests that train WHILE an engine dispatches pin ndev=1: a trainer's
+    # 8-virtual-device CPU collectives and the engine's dispatches can
+    # starve XLA-CPU's shared threadpool (same contention fit() throttles)
+    mesh = make_mesh(devices=jax.devices()[:ndev]) if ndev else None
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+                  mesh=mesh)
+    model.init_layers()
+    return model
+
+
+def _slice(x, a, b):
+    return {k: v[a:b] for k, v in x.items()}
+
+
+def _manifest(d):
+    with open(os.path.join(d, "manifest.json")) as f:
+        return json.load(f)
+
+
+def _replay(d, build=None, upto=None):
+    """Reference reconstruction: restore the chain base params_only,
+    apply every (valid-prefix) delta <= upto; returns the model."""
+    man = _manifest(d)
+    ref = (build or _build)(seed=11)
+    fulls = {e["step"]: e for e in man.get("entries", [])}
+    deltas = sorted(man.get("deltas", []), key=lambda e: e["step"])
+    if upto is not None:
+        deltas = [e for e in deltas if e["step"] <= upto]
+    if deltas:
+        base = fulls[deltas[0]["base_step"]]
+    else:
+        assert fulls, "nothing published"
+        base = fulls[max(fulls)] if upto is None else fulls[upto]
+    restore_checkpoint(ref, os.path.join(d, base["file"]),
+                       params_only=True)
+    for e in deltas:
+        ref.apply_delta(load_delta_file(os.path.join(d, e["file"])))
+    return ref
+
+
+def _state_equal(a, b):
+    fa, fb = serving_flat(a), serving_flat(b)
+    if set(fa) != set(fb):
+        return False
+    return all(np.array_equal(fa[k], fb[k]) for k in fa)
+
+
+# ---------------------------------------------------------------------
+# touched-row mappings: candidates must cover every changed stored row
+# ---------------------------------------------------------------------
+class TestTouchedRowMapping:
+    def _changed_rows(self, before, after):
+        b2 = np.asarray(before).reshape(-1, np.asarray(before).shape[-1])
+        a2 = np.asarray(after).reshape(-1, np.asarray(after).shape[-1])
+        return set(np.flatnonzero(np.any(b2 != a2, axis=1)).tolist())
+
+    def _assert_covers(self, model, op_name, idx_key="sparse"):
+        op = next(o for o in model.ops if o.name == op_name)
+        before = np.array(np.asarray(model.params[op_name]["kernel"]))
+        xb = dict(X)
+        xb = {k: v[:BS] for k, v in xb.items()}
+        xb["label"] = Y[:BS]
+        model.train_batch(xb)
+        after = np.asarray(model.params[op_name]["kernel"])
+        changed = self._changed_rows(before, after)
+        cand = set(op.delta_touched_rows(X[idx_key][:BS]).tolist())
+        assert changed, "train step changed no table rows?"
+        assert changed <= cand, sorted(changed - cand)[:10]
+
+    def test_stacked_device_mapping(self):
+        self._assert_covers(_build(), "emb_stack")
+
+    def test_concat_device_mapping(self):
+        cfg = DLRMConfig(embedding_size=[64, 32, 48, 64],
+                         sparse_feature_size=8, mlp_bot=[4, 16, 8],
+                         mlp_top=[40, 16, 1])
+        model = ff.FFModel(ff.FFConfig(batch_size=BS, seed=2))
+        build_dlrm(model, cfg)
+        model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                      ["mse"])
+        model.init_layers()
+        op = next(o for o in model.ops if o.name == "emb_concat")
+        x, y = synthetic_batch(cfg, BS, seed=0)
+        before = np.array(np.asarray(model.params["emb_concat"]["kernel"]))
+        xb = dict(x)
+        xb["label"] = y
+        model.train_batch(xb)
+        after = np.asarray(model.params["emb_concat"]["kernel"])
+        changed = self._changed_rows(before, after)
+        cand = set(op.delta_touched_rows(x["sparse"]).tolist())
+        assert changed and changed <= cand
+
+    def test_host_stacked_mapping(self):
+        model = _build(host_resident_tables=True, host_tables_async=False)
+        op = next(o for o in model.ops if o.name == "emb_stack")
+        before = np.array(model.host_params["emb_stack"]["kernel"])
+        xb = {k: v[:BS] for k, v in X.items()}
+        xb["label"] = Y[:BS]
+        model.train_batch(xb)
+        model._host_drain()
+        after = model.host_params["emb_stack"]["kernel"]
+        changed = self._changed_rows(before, after)
+        cand = set(op.host_delta_touched_rows(X["sparse"][:BS]).tolist())
+        assert changed and changed <= cand
+
+
+# ---------------------------------------------------------------------
+# publisher + chain format
+# ---------------------------------------------------------------------
+class TestDeltaPublisher:
+    def _stream_publish(self, tmp_path, steps=12, every=4, **pub_kw):
+        trainer = _build()
+        d = str(tmp_path)
+        kw = dict(row_delta_min_elems=0, compact_frac=100.0)
+        kw.update(pub_kw)
+        pub = DeltaPublisher(trainer, d, **kw)
+        trainer.fit_stream(ArrayStream(X, Y, BS, seed=1), steps=steps,
+                           publisher=pub, publish_every=every,
+                           verbose=False)
+        return trainer, pub, d
+
+    def test_chain_manifest_shape(self, tmp_path):
+        trainer, pub, d = self._stream_publish(tmp_path)
+        man = _manifest(d)
+        deltas = man["deltas"]
+        assert [e["step"] for e in deltas] == [8, 12]
+        base = man["entries"][0]
+        assert base["step"] == 4
+        for e in deltas:
+            assert e["kind"] == "delta"
+            assert e["base_step"] == 4
+            assert e["base_file"] == base["file"]
+            assert e["base_crc32"] == base["crc32"]
+            assert e["crc32"] is not None
+            assert e["touched_rows"]["params/emb_stack/kernel"] > 0
+            assert e["bytes"] > 0
+        assert deltas[0]["prev_step"] == 4
+        assert deltas[1]["prev_step"] == 8
+        # chain validates clean
+        resolve_chain(man, config_fingerprint(trainer), d)
+
+    def test_chain_replays_bitwise(self, tmp_path):
+        trainer, pub, d = self._stream_publish(tmp_path)
+        ref = _replay(d)
+        assert _state_equal(trainer, ref)
+        # forward outputs identical too
+        a = np.asarray(trainer.forward_batch(X))
+        b = np.asarray(ref.forward_batch(X))
+        np.testing.assert_array_equal(a, b)
+
+    def test_host_tables_chain_replays_bitwise(self, tmp_path):
+        trainer = _build(host_resident_tables=True,
+                         host_tables_async=False)
+        d = str(tmp_path)
+        pub = DeltaPublisher(trainer, d, row_delta_min_elems=0,
+                             compact_frac=100.0)
+        trainer.fit_stream(ArrayStream(X, Y, BS, seed=1), steps=12,
+                           publisher=pub, publish_every=4, verbose=False)
+        man = _manifest(d)
+        assert any("hostparams/emb_stack/kernel" in e["touched_rows"]
+                   for e in man["deltas"])
+        ref = _replay(d, build=lambda seed: _build(
+            seed=seed, host_resident_tables=True,
+            host_tables_async=False))
+        assert _state_equal(trainer, ref)
+
+    def test_publish_abort_nonfatal_and_folds_in(self, tmp_path):
+        with faults.active_plan(faults.FaultPlan(publish_aborts=1)) as p:
+            trainer, pub, d = self._stream_publish(tmp_path)
+            assert ("publish_abort" in [f[0] for f in p.fired])
+        st = pub.stats()
+        assert st["publish_errors"] == 1
+        assert "abort" in st["last_publish_error"]
+        # the aborted interval (step 8) folded into the next delta
+        man = _manifest(d)
+        assert [e["step"] for e in man["deltas"]] == [12]
+        assert man["deltas"][0]["prev_step"] == 4
+        # the skipped interval's rows ride the next delta: the chain
+        # still replays the trainer's state bitwise
+        assert _state_equal(trainer, _replay(d))
+
+    def test_delta_gap_detected(self, tmp_path):
+        with faults.active_plan(faults.FaultPlan(delta_gaps=1)):
+            trainer, pub, d = self._stream_publish(tmp_path)
+        with pytest.raises(ChainError, match="chain gap"):
+            resolve_chain(_manifest(d), config_fingerprint(trainer), d)
+
+    def test_torn_delta_detected(self, tmp_path):
+        with faults.active_plan(faults.FaultPlan(torn_deltas=1)):
+            trainer, pub, d = self._stream_publish(tmp_path)
+        with pytest.raises(ChainError, match="CRC-32"):
+            resolve_chain(_manifest(d), config_fingerprint(trainer), d)
+
+    def test_compaction_resets_chain(self, tmp_path):
+        # tiny model: one delta outweighs compact_frac=0.1 x base
+        trainer, pub, d = self._stream_publish(tmp_path, steps=12,
+                                               every=4, compact_frac=0.1)
+        st = pub.stats()
+        assert st["compactions"] >= 1
+        man = _manifest(d)
+        # after a compaction the chain re-anchors (or is empty)
+        for e in man.get("deltas", []):
+            assert e["base_step"] == st["base_step"]
+        assert not [f for f in os.listdir(d)
+                    if f.startswith("delta-")
+                    and f not in [e["file"]
+                                  for e in man.get("deltas", [])]]
+
+    def test_stale_chain_retired_on_restart(self, tmp_path):
+        trainer, pub, d = self._stream_publish(tmp_path)
+        assert _manifest(d)["deltas"]
+        # a new publisher (crash-restarted trainer) retires the chain
+        t2 = _build(seed=5)
+        DeltaPublisher(t2, d, row_delta_min_elems=0)
+        man = _manifest(d)
+        assert man.get("deltas", []) == []
+        assert not [f for f in os.listdir(d) if f.startswith("delta-")]
+
+    def test_publish_without_new_steps_is_noop(self, tmp_path):
+        trainer = _build()
+        pub = DeltaPublisher(trainer, str(tmp_path),
+                             row_delta_min_elems=0, compact_frac=100.0)
+        pub.publish_full()
+        assert pub.publish() is None
+        assert pub.stats()["publishes"] == 1
+
+
+class TestCheckpointGCBaseRetention:
+    def test_gc_spares_chain_base(self, tmp_path):
+        """keep-last-K pruning must retain a base snapshot a live delta
+        chain still references (the satellite fix: GC used to delete
+        the base out from under the watcher)."""
+        d = str(tmp_path)
+        trainer = _build()
+        pub = DeltaPublisher(trainer, d, keep_last=1,
+                             row_delta_min_elems=0, compact_frac=100.0)
+        trainer.fit_stream(ArrayStream(X, Y, BS, seed=1), steps=8,
+                           publisher=pub, publish_every=4, verbose=False)
+        man = _manifest(d)
+        base_file = man["deltas"][0]["base_file"]
+        # push keep_last=1 full snapshots past the base
+        xb = {k: v[:BS] for k, v in X.items()}
+        xb["label"] = Y[:BS]
+        for _ in range(3):
+            trainer.train_batch(xb)
+            pub.mgr.save(trainer, {})
+        man = _manifest(d)
+        files = [e["file"] for e in man["entries"]]
+        assert base_file in files, "GC deleted the live chain's base"
+        assert os.path.isfile(os.path.join(d, base_file))
+        # the chain still validates against the retained base
+        resolve_chain(man, config_fingerprint(trainer), d)
+        # once the chain is retired, the base becomes collectible
+        pub.mgr.reset_deltas()
+        trainer.train_batch(xb)
+        pub.mgr.save(trainer, {})
+        man = _manifest(d)
+        assert base_file not in [e["file"] for e in man["entries"]]
+
+
+# ---------------------------------------------------------------------
+# FFModel.apply_delta validation
+# ---------------------------------------------------------------------
+class TestApplyDeltaValidation:
+    def _payload(self, **kw):
+        p = {"step": 99, "rows": {}, "full": {}}
+        p.update(kw)
+        return p
+
+    def test_unknown_key_rejected_untouched(self):
+        m = _build()
+        before = serving_flat(m)
+        with pytest.raises(ValueError, match="does not exist"):
+            m.apply_delta(self._payload(rows={
+                "params/nope/kernel": (np.array([0]),
+                                       np.zeros((1, 8), np.float32))}))
+        assert _state_equal(m, m) and m._step != 99
+        after = serving_flat(m)
+        assert all(np.array_equal(before[k], after[k]) for k in before)
+
+    def test_bad_width_rejected(self):
+        m = _build()
+        with pytest.raises(ValueError, match="width"):
+            m.apply_delta(self._payload(rows={
+                "params/emb_stack/kernel": (np.array([0]),
+                                            np.zeros((1, 3),
+                                                     np.float32))}))
+
+    def test_out_of_range_row_rejected(self):
+        m = _build()
+        w = np.asarray(m.params["emb_stack"]["kernel"]).shape[-1]
+        with pytest.raises(ValueError, match="rows"):
+            m.apply_delta(self._payload(rows={
+                "params/emb_stack/kernel": (np.array([10 ** 9]),
+                                            np.zeros((1, w),
+                                                     np.float32))}))
+
+
+# ---------------------------------------------------------------------
+# serving: chain-aware watcher
+# ---------------------------------------------------------------------
+class TestServeDelta:
+    def _publish_stream(self, trainer, pub, steps, every=4):
+        trainer.fit_stream(ArrayStream(X, Y, BS, seed=1), steps=steps,
+                           publisher=pub, publish_every=every,
+                           verbose=False)
+
+    def _wait_version(self, eng, v, timeout=30):
+        # wait for the APPLIED version: install_* bumps `version` when
+        # the swap is parked, the batcher applies it moments later
+        deadline = time.time() + timeout
+        while eng._applied_version < v and time.time() < deadline:
+            time.sleep(0.02)
+        return eng._applied_version
+
+    def test_incremental_delta_reloads_bit_identical(self, tmp_path):
+        d = str(tmp_path)
+        trainer = _build(ndev=1)
+        pub = DeltaPublisher(trainer, d, row_delta_min_elems=0,
+                             compact_frac=100.0)
+        pub.publish_full({})
+        server = _build(seed=7, ndev=1)
+        eng = InferenceEngine(server, ServeConfig(max_batch=BS,
+                                                  poll_s=0.02),
+                              checkpoint_dir=d)
+        with eng:
+            p0 = eng.predict(_slice(X, 0, 2), timeout=30)
+            assert p0.version == 0
+            self._publish_stream(trainer, pub, steps=12)
+            assert self._wait_version(eng, 12) == 12
+            p1 = eng.predict(_slice(X, 0, 2), timeout=30)
+        st = eng.stats()
+        assert st["delta_reloads"] >= 2       # steps 8, 12 incremental
+        assert st["reload_rejects"] == 0
+        assert st["watcher"]["delta_installs"] >= 2
+        assert st["watcher"]["chain_fallbacks"] == 0
+        expect = np.asarray(trainer.forward_bucket(_slice(X, 0, 2)))
+        np.testing.assert_array_equal(p1.scores, expect)
+
+    def test_cold_engine_catches_up_base_plus_chain(self, tmp_path):
+        d = str(tmp_path)
+        trainer = _build(ndev=1)
+        pub = DeltaPublisher(trainer, d, row_delta_min_elems=0,
+                             compact_frac=100.0)
+        self._publish_stream(trainer, pub, steps=12)   # base 4 + 8, 12
+        server = _build(seed=7, ndev=1)
+        eng = InferenceEngine(server, ServeConfig(max_batch=BS,
+                                                  poll_s=0.02),
+                              checkpoint_dir=d)
+        with eng:
+            assert self._wait_version(eng, 12) == 12
+            p = eng.predict(_slice(X, 0, 2), timeout=30)
+        assert p.version == 12
+        st = eng.stats()
+        assert st["delta_reloads"] >= 2
+        expect = np.asarray(trainer.forward_bucket(_slice(X, 0, 2)))
+        np.testing.assert_array_equal(p.scores, expect)
+
+    def test_torn_delta_degrades_then_recovers(self, tmp_path):
+        d = str(tmp_path)
+        trainer = _build(ndev=1)
+        pub = DeltaPublisher(trainer, d, row_delta_min_elems=0,
+                             compact_frac=100.0)
+        pub.publish_full({})
+        server = _build(seed=7, ndev=1)
+        eng = InferenceEngine(server, ServeConfig(max_batch=8,
+                                                  poll_s=0.02,
+                                                  queue_capacity=512),
+                              checkpoint_dir=d)
+        with eng:
+            self._publish_stream(trainer, pub, steps=4)
+            assert self._wait_version(eng, 4) == 4
+            with faults.active_plan(faults.FaultPlan(torn_deltas=1)) as p:
+                self._publish_stream(trainer, pub, steps=4)  # delta torn
+                assert [f[0] for f in p.fired] == ["torn_delta"]
+                deadline = time.time() + 20
+                while (eng.stats()["watcher"]["chain_fallbacks"] == 0
+                       and time.time() < deadline):
+                    eng.predict(_slice(X, 0, 1), timeout=30)
+                    time.sleep(0.01)
+            st = eng.stats()
+            assert st["watcher"]["chain_fallbacks"] >= 1
+            assert "falling back" in st["last_reload_reject"]
+            # pinned at the pre-tear version; every request still answers
+            p1 = eng.predict(_slice(X, 0, 1), timeout=30)
+            assert p1.version == 4
+            # recovery: a compaction full re-anchors the fleet
+            pub.publish_full({})
+            assert self._wait_version(eng, 8) == 8
+            p2 = eng.predict(_slice(X, 0, 2), timeout=30)
+        expect = np.asarray(trainer.forward_bucket(_slice(X, 0, 2)))
+        np.testing.assert_array_equal(p2.scores, expect)
+
+    def test_chain_gap_degrades_with_reason(self, tmp_path):
+        d = str(tmp_path)
+        trainer = _build(ndev=1)
+        pub = DeltaPublisher(trainer, d, row_delta_min_elems=0,
+                             compact_frac=100.0)
+        pub.publish_full({})
+        server = _build(seed=7, ndev=1)
+        eng = InferenceEngine(server, ServeConfig(max_batch=BS,
+                                                  poll_s=0.02),
+                              checkpoint_dir=d)
+        with eng:
+            with faults.active_plan(faults.FaultPlan(delta_gaps=1)):
+                self._publish_stream(trainer, pub, steps=8)  # 4=gap, 8 ok
+            deadline = time.time() + 20
+            while (eng.stats()["reload_rejects"] == 0
+                   and time.time() < deadline):
+                time.sleep(0.02)
+            st = eng.stats()
+            assert st["watcher"]["chain_fallbacks"] >= 1
+            assert "chain gap" in st["last_reload_reject"]
+            assert eng.version == 0          # never applied a torn chain
+
+    def test_row_level_cache_invalidation(self, tmp_path):
+        d = str(tmp_path)
+        trainer = _build(ndev=1, host_resident_tables=True,
+                         host_tables_async=False)
+        pub = DeltaPublisher(trainer, d, row_delta_min_elems=0,
+                             compact_frac=100.0)
+        xb = {k: v[:BS] for k, v in X.items()}
+        xb["label"] = Y[:BS]
+        trainer.train_batch(xb)              # base step 1 > 0
+        base = pub.publish_full({})
+        server = _build(seed=7, ndev=1, host_resident_tables=True,
+                        host_tables_async=False)
+        eng = InferenceEngine(server, ServeConfig(max_batch=BS,
+                                                  poll_s=0.02,
+                                                  cache_rows=128),
+                              checkpoint_dir=d)
+        with eng:
+            # the base full-install drops the whole cache (correct: new
+            # tables); warm AFTER it so the delta's row-level path is
+            # what the assertions below see
+            assert self._wait_version(eng, base["step"]) == base["step"]
+            full_invalidations = eng.stats()["embedding_cache"][
+                "invalidations"]
+            for i in range(0, BS, 2):        # warm the cache
+                eng.predict(_slice(X, i, i + 2), timeout=30)
+            assert eng.stats()["embedding_cache"]["size"] > 0
+            self._publish_stream(trainer, pub, steps=4)
+            assert self._wait_version(eng, 5) == 5
+            st = eng.stats()["embedding_cache"]
+            # delta reload invalidated by ROW, not wholesale
+            assert st["row_invalidations"] > 0
+            assert st["invalidations"] == full_invalidations
+            p = eng.predict(_slice(X, 0, 2), timeout=30)
+        expect = np.asarray(trainer.forward_bucket(_slice(X, 0, 2)))
+        np.testing.assert_array_equal(p.scores, expect)
+
+    def test_backoff_on_consecutive_failures(self, tmp_path):
+        d = str(tmp_path)
+        # a permanently-unreadable manifest: every poll fails
+        with open(os.path.join(d, "manifest.json"), "w") as f:
+            f.write("{ torn json")
+        server = _build(seed=7, ndev=1)
+        eng = InferenceEngine(server, ServeConfig(max_batch=BS))
+        eng.start()
+        w = SnapshotWatcher(eng, d, poll_s=0.01)
+        try:
+            w.start()
+            deadline = time.time() + 10
+            while (w.stats()["consecutive_failures"] < 3
+                   and time.time() < deadline):
+                time.sleep(0.02)
+            st = w.stats()
+            assert st["consecutive_failures"] >= 3
+            assert st["next_poll_s"] > w.poll_s
+            # recovery resets the backoff
+            os.unlink(os.path.join(d, "manifest.json"))
+            deadline = time.time() + 10
+            while (w.stats()["consecutive_failures"] > 0
+                   and time.time() < deadline):
+                time.sleep(0.02)
+            st = w.stats()
+            assert st["consecutive_failures"] == 0
+            assert st["next_poll_s"] == w.poll_s
+        finally:
+            w.stop()
+            eng.close()
+
+
+# ---------------------------------------------------------------------
+# chaos: torn delta + publish abort under concurrent traffic
+# ---------------------------------------------------------------------
+class TestChaosContinual:
+    def test_chaos_zero_failures_zero_mixed_versions(self, tmp_path):
+        """The ISSUE-10 acceptance run: stream-train with a torn delta
+        AND a publish abort injected while requests hammer the engine.
+        Zero failed requests; every response's scores equal its OWN
+        version's model output; the engine converges to the newest
+        published version once compaction re-anchors the chain."""
+        d = str(tmp_path)
+        trainer = _build(ndev=1)
+        pub = DeltaPublisher(trainer, d, row_delta_min_elems=0,
+                             compact_frac=100.0, full_every=6)
+        expected = {}
+
+        server = _build(seed=7, ndev=1)
+        eng = InferenceEngine(server, ServeConfig(max_batch=8,
+                                                  poll_s=0.005,
+                                                  queue_capacity=512),
+                              checkpoint_dir=d)
+        failures = []
+        request_errors = []
+        stop = threading.Event()
+
+        def hammer(tid):
+            i = 0
+            last_v = -1
+            while not stop.is_set():
+                row = (tid + i) % BS
+                try:
+                    p = eng.predict(_slice(X, row, row + 1), timeout=30)
+                except Overloaded:
+                    continue
+                except Exception as e:   # noqa: BLE001
+                    request_errors.append(repr(e))
+                    continue
+                if p.version < last_v:
+                    failures.append(("version went backwards",
+                                     last_v, p.version))
+                last_v = p.version
+                want = expected.get(p.version)
+                # tolerance 1e-6: a row's position inside a coalesced
+                # bucket can shift its score by ~1 ulp on CPU gemm;
+                # inter-VERSION score gaps are asserted >> this below,
+                # so a mixed/blended response still fails loudly
+                if want is None or not np.allclose(
+                        p.scores, want[row:row + 1], rtol=0, atol=1e-6):
+                    failures.append(("mixed/unknown version",
+                                     p.version, row))
+                i += 1
+
+        xb = {k: v[:BS] for k, v in X.items()}
+        xb["label"] = Y[:BS]
+        plan = faults.FaultPlan(torn_deltas=1, publish_aborts=1,
+                                serve_delay_s=0.002)
+        with faults.active_plan(plan):
+            with eng:
+                # until the first install lands, the engine serves ITS
+                # OWN init state tagged version 0 — that is the honest
+                # expectation for tag 0, not the trainer's. References
+                # are computed at batch BS (like test_serve's
+                # old-or-new test): the bucketed-dispatch bit-identity
+                # contract is pinned against that shape
+                probe = _slice(X, 0, BS)
+                expected[0] = np.asarray(server.forward_batch(probe))
+                trainer.train_batch(xb)         # base step 1 (> 0)
+                expected[1] = np.asarray(trainer.forward_batch(probe))
+                base = pub.publish_full({})
+                assert base["step"] == 1
+                threads = [threading.Thread(target=hammer, args=(t,))
+                           for t in range(4)]
+                for t in threads:
+                    t.start()
+                last_entry_step = base["step"]
+                saw_fallback = False
+                for step in range(2, 32):
+                    trainer.train_batch(xb)
+                    if step % 2 == 0:
+                        expected[trainer._step] = np.asarray(
+                            trainer.forward_batch(probe))
+                        entry = pub.publish({})
+                        if entry is not None:
+                            last_entry_step = entry["step"]
+                    if (not saw_fallback and "torn_delta"
+                            in [f[0] for f in plan.fired]):
+                        # hold publication until the watcher has SEEN
+                        # the torn chain and degraded — otherwise a
+                        # fast compaction could retire it unobserved
+                        # (traffic keeps hammering meanwhile)
+                        dl = time.time() + 20
+                        while (eng.stats()["watcher"]["chain_fallbacks"]
+                               == 0 and time.time() < dl):
+                            time.sleep(0.01)
+                        saw_fallback = True
+                deadline = time.time() + 30
+                while (eng.version < last_entry_step
+                       and time.time() < deadline):
+                    time.sleep(0.02)
+                stop.set()
+                for t in threads:
+                    t.join()
+        assert not request_errors, request_errors[:5]
+        assert not failures, failures[:5]
+        # the tolerance above must be far below what separates
+        # versions, or the mixed-version check would be vacuous
+        steps_pub = sorted(expected)
+        for a, b in zip(steps_pub[1:], steps_pub[2:]):
+            gap = float(np.abs(expected[a] - expected[b]).max())
+            assert gap > 1e-4, (a, b, gap)
+        assert ("torn_delta" in [f[0] for f in plan.fired])
+        assert ("publish_abort" in [f[0] for f in plan.fired])
+        # the torn chain forced at least one graceful degradation...
+        assert eng.stats()["watcher"]["chain_fallbacks"] >= 1
+        # ...and compaction re-anchored the fleet on the newest state
+        assert eng.version == last_entry_step
+        p = eng.stats()
+        assert p["responses"] > 0 and p["timeouts"] == 0
+
+
+# ---------------------------------------------------------------------
+# the real thing: SIGKILL the trainer mid-delta-publish
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+def test_sigkill_trainer_mid_delta_publish(tmp_path):
+    import _continual_worker as worker
+
+    d = str(tmp_path / "pub")
+    os.makedirs(d, exist_ok=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # stretch every temp-write→rename window so the SIGKILL lands inside
+    # a publish deterministically
+    env["FF_FAULT_WRITE_DELAY"] = "0.25"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_TESTS_DIR, "_continual_worker.py"),
+         d],
+        env=env, cwd=_TESTS_DIR,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    # the worker trains on a 2-device mesh (its own process); the
+    # serving model must match it for non-elastic snapshot loads
+    def _server_model(seed):
+        import jax
+
+        from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+        m = ff.FFModel(ff.FFConfig(batch_size=worker.BS, seed=seed))
+        build_dlrm(m, worker.DCFG)
+        m.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+                  mesh=make_mesh(devices=jax.devices()[:2]))
+        m.init_layers()
+        return m
+
+    server = _server_model(seed=8)
+    x, _y = worker.dataset()
+    eng = InferenceEngine(server, ServeConfig(max_batch=8, poll_s=0.02,
+                                              queue_capacity=512))
+    request_errors = []
+    versions = []
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            row = i % worker.BS
+            try:
+                p = eng.predict({k: v[row:row + 1] for k, v in x.items()},
+                                timeout=60)
+                versions.append(p.version)
+            except Overloaded:
+                pass
+            except Exception as e:   # noqa: BLE001
+                request_errors.append(repr(e))
+            i += 1
+            time.sleep(0.002)
+
+    killed = False
+    try:
+        eng.start()
+        w = SnapshotWatcher(eng, d, poll_s=0.02)
+        w.start()
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    out = proc.stdout.read().decode(errors="replace")
+                    pytest.fail(f"worker died on its own:\n{out[-3000:]}")
+                # kill once the engine has applied at least one DELTA
+                # and a publish write is in flight (tmp file present)
+                tmp_inflight = any(".tmp-" in f for f in os.listdir(d))
+                if eng.stats()["delta_reloads"] >= 1 and tmp_inflight:
+                    os.kill(proc.pid, signal.SIGKILL)
+                    killed = True
+                    break
+                time.sleep(0.005)
+            assert killed, "never caught a delta publish in flight"
+            proc.wait(timeout=30)
+            # keep serving through the crash; give the watcher a few
+            # polls against the (possibly torn) post-crash directory
+            time.sleep(1.0)
+            assert not request_errors, request_errors[:5]
+            v_final = eng.version
+            assert v_final > 0
+            # the served version must be a VALID chain node (or full
+            # snapshot): reconstruct it from disk and compare bitwise —
+            # a torn chain was never applied
+            ref = _server_model(seed=12)
+            man = json.load(open(os.path.join(d, "manifest.json")))
+            fulls = {e["step"]: e for e in man.get("entries", [])}
+            deltas = sorted(
+                [e for e in man.get("deltas", [])
+                 if e["step"] <= v_final],
+                key=lambda e: e["step"])
+            if v_final in fulls and not deltas:
+                restore_checkpoint(
+                    ref, os.path.join(d, fulls[v_final]["file"]),
+                    params_only=True)
+            else:
+                assert deltas and deltas[-1]["step"] == v_final, (
+                    f"served version {v_final} is not a published "
+                    f"chain node")
+                base = fulls[deltas[0]["base_step"]]
+                restore_checkpoint(ref, os.path.join(d, base["file"]),
+                                   params_only=True)
+                for e in deltas:
+                    ref.apply_delta(
+                        load_delta_file(os.path.join(d, e["file"])))
+            got = np.asarray(eng.model.forward_bucket(
+                {k: v[:4] for k, v in x.items()}))
+            want = np.asarray(ref.forward_bucket(
+                {k: v[:4] for k, v in x.items()}))
+            np.testing.assert_array_equal(got, want)
+            # versions observed by traffic only ever move forward
+            assert versions == sorted(versions)
+            # restart the trainer with --resume: it re-anchors a fresh
+            # chain and the engine eventually advances past the crash
+            proc = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(_TESTS_DIR, "_continual_worker.py"), d,
+                 "--resume"],
+                env={**env, "FF_FAULT_WRITE_DELAY": "0"}, cwd=_TESTS_DIR,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            deadline = time.time() + 180
+            while eng.version <= v_final and time.time() < deadline:
+                if proc.poll() is not None:
+                    out = proc.stdout.read().decode(errors="replace")
+                    pytest.fail(f"resumed worker died:\n{out[-3000:]}")
+                time.sleep(0.05)
+            assert eng.version > v_final, (
+                "engine never advanced past the crash after resume")
+            assert not request_errors, request_errors[:5]
+        finally:
+            stop.set()
+            t.join(timeout=30)
+            w.stop()
+            eng.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
