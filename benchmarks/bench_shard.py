@@ -41,7 +41,7 @@ TABLES = 8
 DIM = 64
 
 
-def _build(ndev, batch, mode):
+def _build(ndev, batch, mode, bag=1):
     import jax
 
     import dlrm_flexflow_tpu as ff
@@ -51,18 +51,25 @@ def _build(ndev, batch, mode):
 
     dcfg = DLRMConfig(embedding_size=[ROWS] * TABLES,
                       sparse_feature_size=DIM,
+                      embedding_bag_size=bag,
                       mlp_bot=[DIM, 128, DIM],
                       mlp_top=[DIM * (TABLES + 1), 128, 1])
     model = ff.FFModel(ff.FFConfig(batch_size=batch, seed=0))
     build_dlrm(model, dcfg)
     strat = {}
+    row_kw = {
+        "row_sharded": {},
+        "dedup": {"exchange": "dedup"},
+        "hybrid": {"exchange": "dedup", "hot_fraction": 1.0 / 64},
+    }
     for op in model.ops:
         tn = type(op).__name__
         nd = op.outputs[0].num_dims if op.outputs else 0
         if tn == "EmbeddingBagStacked":
-            if mode == "row_sharded":
+            if mode in row_kw:
                 strat[op.name] = ParallelConfig((ndev, 1, 1),
-                                                param_degree=ndev)
+                                                param_degree=ndev,
+                                                **row_kw[mode])
             elif mode == "table_sharded":
                 dt = next(d for d in range(min(ndev, TABLES), 0, -1)
                           if TABLES % d == 0 and ndev % d == 0)
@@ -131,6 +138,123 @@ def _sim_pod_sweep(ndev):
     return out
 
 
+def _skew_sweep(ndev, steps):
+    """Skew sweep (ISSUE 11): alpha in {0 (uniform), 0.8, 1.0, 1.2}
+    comparing the dense vs dedup'd vs hybrid exchange on the CPU mesh —
+    steps/s plus the MEASURED balanced exchange bytes, computed from
+    the actual per-device DISTINCT id counts of the benchmark batches
+    (the dedup'd exchange's valid traffic scales with these, not with
+    batch size; the hybrid's cold stream excludes hot hits on top)."""
+    import jax
+    import numpy as np
+
+    from dlrm_flexflow_tpu.models.dlrm import synthetic_batch
+    from dlrm_flexflow_tpu.parallel.alltoall import \
+        exchange_bytes_per_step
+
+    batch = 64 * ndev
+    bag = 4           # multi-hot bags are where duplicates concentrate
+    out = {}
+    for alpha in (0.0, 0.8, 1.0, 1.2):
+        entry = {}
+        batches_np = []
+        for i in range(4):
+            x, y = synthetic_batch(
+                _bench_dcfg(bag), batch, seed=i, zipf_alpha=alpha)
+            x["label"] = y
+            batches_np.append(x)
+        for mode in ("row_sharded", "dedup", "hybrid"):
+            model, dcfg = _build(ndev, batch, mode, bag=bag)
+            emb = next(op for op in model.ops
+                       if type(op).__name__ == "EmbeddingBagStacked")
+            plan = emb._row_plan
+            if mode == "dedup":
+                # measured distinct cold ids per device per step
+                per_dev = batch // ndev
+                dcounts = []
+                for x in batches_np:
+                    flat = emb.flat_lookup_ids(x["sparse"]).reshape(
+                        batch, -1)
+                    for d in range(ndev):
+                        dcounts.append(len(np.unique(
+                            flat[d * per_dev:(d + 1) * per_dev])))
+                entry["measured_distinct_per_dev"] = round(
+                    float(np.mean(dcounts)), 1)
+                entry["a2a_bytes_dedup"] = exchange_bytes_per_step(
+                    plan, batch * TABLES * bag, DIM,
+                    distinct_per_device=float(np.mean(dcounts)))
+                entry["a2a_bytes_dense"] = exchange_bytes_per_step(
+                    plan, batch * TABLES * bag, DIM)
+            staged = [model._device_batch(dict(x)) for x in batches_np]
+            jax.block_until_ready(staged)
+            entry[f"steps_per_s_{mode}"] = round(
+                _steps_per_s(model, staged, steps), 3)
+            del model, staged
+        out[f"alpha_{alpha:g}"] = entry
+    return out
+
+
+def _bench_dcfg(bag):
+    from dlrm_flexflow_tpu.models.dlrm import DLRMConfig
+    return DLRMConfig(embedding_size=[ROWS] * TABLES,
+                      sparse_feature_size=DIM, embedding_bag_size=bag,
+                      mlp_bot=[DIM, 128, DIM],
+                      mlp_top=[DIM * (TABLES + 1), 128, 1])
+
+
+def _sim_skew_dcn():
+    """The ISSUE 11 perf bar: >= 2x simulated step time vs the dense
+    exchange at zipf(1.0) on the DCN topology — a production-scale
+    step (multi-hot bag 32, 2048 samples/device, fused supersteps)
+    where the exchange + touched-rows scatter dominate, priced from an
+    observed zipf(1.0) histogram."""
+    import numpy as np
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.data.dataloader import zipf_indices
+    from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+    from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
+    from dlrm_flexflow_tpu.search.cost_model import CostModel
+    from dlrm_flexflow_tpu.search.mcmc import default_strategy
+    from dlrm_flexflow_tpu.search.simulator import Simulator
+    from dlrm_flexflow_tpu.utils.histogram import IdFrequencySketch
+
+    n = 8
+    dcfg = DLRMConfig(embedding_size=[1000000] * 8,
+                      embedding_bag_size=32, sparse_feature_size=64,
+                      mlp_bot=[64, 512, 512, 64],
+                      mlp_top=[576, 1024, 1024, 1024, 1])
+    model = ff.FFModel(ff.FFConfig(batch_size=2048 * n, superstep=8))
+    build_dlrm(model, dcfg)
+    model.optimizer = ff.SGDOptimizer(lr=0.1)
+    emb = next(op for op in model.ops
+               if type(op).__name__ == "EmbeddingBagStacked")
+    rng = np.random.RandomState(0)
+    sk = IdFrequencySketch(8 * 1000000)
+    for t in range(8):
+        sk.observe(zipf_indices(rng, 1000000, 400000, 1.0)
+                   + t * 1000000)
+    model.attach_id_histograms({emb.name: sk})
+    dp = default_strategy(model, n)
+
+    def plan(**kw):
+        s = dict(dp)
+        s[emb.name] = ParallelConfig((n, 1, 1), param_degree=n, **kw)
+        return s
+
+    sim = Simulator(model, CostModel(), topology=[("dcn", 8)])
+    t_dense = sim.simulate(plan(), n)
+    t_dedup = sim.simulate(plan(exchange="dedup"), n)
+    t_hyb = sim.simulate(plan(exchange="dedup", hot_fraction=1 / 64), n)
+    return {
+        "sim_step_ms_dense": round(1e3 * t_dense, 3),
+        "sim_step_ms_dedup": round(1e3 * t_dedup, 3),
+        "sim_step_ms_hybrid": round(1e3 * t_hyb, 3),
+        "dedup_vs_dense_sim": round(t_dense / t_dedup, 3),
+        "hybrid_vs_dense_sim": round(t_dense / t_hyb, 3),
+    }
+
+
 def measure(steps: int = 12):
     import jax
 
@@ -175,6 +299,8 @@ def measure(steps: int = 12):
             / out["steps_per_s_replicated"], 3)
 
     out["sim_pod_sweep"] = _sim_pod_sweep(ndev)
+    out["skew_sweep"] = _skew_sweep(ndev, steps)
+    out["sim_skew_dcn"] = _sim_skew_dcn()
     return out
 
 

@@ -221,6 +221,36 @@ def measure_dispatch_floor(steps=200, ks=(1, 2, 4, 8, 16)):
     return floor_ms, t_dev_ms, per_k
 
 
+def measure_skew_distinct(alphas=(0.0, 0.8, 1.0, 1.2),
+                          rows=1_000_000, draws=65536, trials=3):
+    """Calibrate the cost model's SKEW TERM: the analytic
+    expected-distinct estimate (IdFrequencySketch.expected_distinct —
+    what prices the dedup'd exchange) against the EMPIRICAL distinct-id
+    count of fresh zipf draws from the same observed histogram. Written
+    to benchmarks/skew_calibration.json; the prediction error is the
+    honesty bound on every dedup'd-exchange price the search sees."""
+    import numpy as np
+
+    from dlrm_flexflow_tpu.data.dataloader import zipf_indices
+    from dlrm_flexflow_tpu.utils.histogram import IdFrequencySketch
+    out = {}
+    for alpha in alphas:
+        rng = np.random.RandomState(7)
+        sk = IdFrequencySketch(rows)
+        sk.observe(zipf_indices(rng, rows, 4 * draws, alpha))
+        pred = sk.expected_distinct(draws)
+        emp = float(np.mean([
+            len(np.unique(zipf_indices(rng, rows, draws, alpha)))
+            for _ in range(trials)]))
+        out[f"alpha_{alpha:g}"] = {
+            "predicted_distinct": round(pred, 1),
+            "empirical_distinct": round(emp, 1),
+            "err": round(pred / emp - 1.0, 4) if emp else None,
+            "draws": draws, "rows": rows,
+        }
+    return out
+
+
 def main():
     from dlrm_flexflow_tpu.search.cost_model import CostModel
     from dlrm_flexflow_tpu.search.mcmc import default_strategy
@@ -333,6 +363,20 @@ def main():
         print(f"dispatch floor: measured {floor_ms:.3f} ms vs pinned "
               f"{pinned_ms:.3f} ms (x{rec['drift_vs_pinned']}) -> "
               f"{floor_out}")
+
+        # skew-term calibration: expected-distinct vs empirical (the
+        # dedup'd exchange's pricing input, ISSUE 11)
+        skew = measure_skew_distinct()
+        skew_out = os.path.join(os.path.dirname(out),
+                                "skew_calibration.json")
+        tmp = skew_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(skew, f, indent=1)
+        os.replace(tmp, skew_out)
+        worst_skew = max(abs(v["err"]) for v in skew.values()
+                         if v["err"] is not None)
+        print(f"skew expected-distinct worst |err|: {worst_skew:.1%} "
+              f"-> {skew_out}")
 
     if not rows:
         print("no calibration points matched (CAL_ONLY filter?)")

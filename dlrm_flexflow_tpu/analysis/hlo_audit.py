@@ -135,7 +135,8 @@ def predicted_collective_bytes(model) -> Dict[str, float]:
       is exactly the cost-model drift FLX513 exists to surface.
     """
     from ..core.op import InputOp
-    from ..parallel.alltoall import (dense_exchange_hlo_bytes,
+    from ..parallel.alltoall import (dedup_exchange_hlo_bytes,
+                                     dense_exchange_hlo_bytes,
                                      exchange_bytes_per_step)
     host_res = set(getattr(model, "_host_resident_ops", set()) or set())
     out = {"all-to-all": 0.0, "all-to-all-balanced": 0.0,
@@ -146,13 +147,28 @@ def predicted_collective_bytes(model) -> Dict[str, float]:
             continue
         plan = getattr(op, "_row_plan", None)
         if plan is not None:
-            from ..ops.embedding import _lookup_count
+            from ..ops.embedding import (_lookup_count,
+                                         expected_routed_lookups)
             lookups = int(_lookup_count(op))
             d = op.out_dim
-            out["all-to-all"] += dense_exchange_hlo_bytes(plan, lookups,
-                                                          d)
+            # the padded exchange the lowering actually emits: dense
+            # capacity n_local, or min(n_local, flat cold rows) under
+            # dedup — both deterministic, so drift pins exactly
+            fn = (dedup_exchange_hlo_bytes if plan.dedup
+                  else dense_exchange_hlo_bytes)
+            out["all-to-all"] += fn(plan, lookups, d)
+            # the balanced/ragged bytes the cost model prices — with
+            # the skew term (expected distinct / cold-only routed ids)
+            # when the strategy carries a skew policy
+            pc = (getattr(model, "strategies", None) or {}).get(op.name)
+            distinct = None
+            if pc is not None and (
+                    getattr(pc, "exchange", "dense") == "dedup"
+                    or getattr(pc, "hot_fraction", 0.0) > 0):
+                distinct = expected_routed_lookups(
+                    op, pc, lookups / max(ndev, 1))
             out["all-to-all-balanced"] += exchange_bytes_per_step(
-                plan, lookups, d)
+                plan, lookups, d, distinct_per_device=distinct)
             continue
         if not op.param_defs():
             continue

@@ -199,6 +199,13 @@ class FFConfig:
     # host gather. Capacity in cached samples; 0 disables. Invalidated
     # on every hot reload. Set with --serve-cache-rows N.
     serve_cache_rows: int = 0
+    # pre-warm the embedding-row cache at engine start from a published
+    # id-frequency histogram (the id_histogram.npz a DeltaPublisher
+    # writes next to its snapshots, or the checkpoint dir holding one):
+    # zipfian traffic concentrates on few index tuples, so a fresh
+    # replica starts with the hot working set already cached. Set with
+    # --serve-cache-warm PATH.
+    serve_cache_warm: str = ""
     # snapshot-watcher poll interval for zero-downtime hot reload of a
     # CheckpointManager directory. Set with --serve-poll SECONDS.
     serve_poll_s: float = 0.5
@@ -375,6 +382,8 @@ class FFConfig:
                 cfg.serve_deadline_ms = float(take())
             elif a == "--serve-cache-rows":
                 cfg.serve_cache_rows = int(take())
+            elif a == "--serve-cache-warm":
+                cfg.serve_cache_warm = take()
             elif a == "--serve-poll":
                 cfg.serve_poll_s = float(take())
             elif a == "--serve-batching":

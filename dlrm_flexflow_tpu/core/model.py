@@ -364,7 +364,9 @@ class FFModel:
             # with file+op+reason, not as a downstream GSPMD error
             self.strategies = load_strategies(
                 self.config.import_strategy_file, num_devices=ndev,
-                known_ops={op.name for op in self.ops})
+                known_ops={op.name for op in self.ops},
+                row_shard_ops={op.name for op in self.ops
+                               if hasattr(op, "_row_shard_geometry")})
         if self.config.search_budget > 0 and not self.strategies:
             try:
                 from ..search.mcmc import optimize
@@ -483,8 +485,16 @@ class FFModel:
                 if pd > 1 and not mem:
                     batch = op.inputs[0].shape[0]
                     ds = ndev if batch % max(ndev, 1) == 0 else 1
+                    # skew policies fuse like the degree: dedup if any
+                    # table asked for it, the largest hot fraction wins
+                    exch = ("dedup" if any(
+                        getattr(pc, "exchange", "dense") == "dedup"
+                        for pc in pcs) else "dense")
+                    frac = max((getattr(pc, "hot_fraction", 0.0)
+                                for pc in pcs), default=0.0)
                     strategies[op.name] = ParallelConfig(
-                        (ds, 1, 1), device_type=dtyp, param_degree=pd)
+                        (ds, 1, 1), device_type=dtyp, param_degree=pd,
+                        exchange=exch, hot_fraction=frac)
                     continue
                 strategies[op.name] = ParallelConfig(
                     (1, degree, 1), device_type=dtyp, memory_types=mem)
@@ -1024,7 +1034,10 @@ class FFModel:
                 for op in sparse_ops:
                     xs = [anc_env[t.guid] for t in op.inputs]
                     if stateful:
-                        slabs = {k: sparse_state[k][op.name]["kernel"]
+                        # the whole per-param slab dict goes in (the
+                        # hybrid placement splits an embedding into
+                        # kernel + hot_kernel, each with its own state)
+                        slabs = {k: dict(sparse_state[k][op.name])
                                  for k in slab_names}
                         new_k, new_slabs = op.sparse_opt_update(
                             params[op.name], xs, gev[op.name],
@@ -1032,7 +1045,10 @@ class FFModel:
                             fwd=emb_fwd.get(op.name))
                         new_params[op.name] = new_k
                         for k in slab_names:
-                            new_opt[k][op.name] = {"kernel": new_slabs[k]}
+                            ns = new_slabs[k]
+                            new_opt[k][op.name] = (
+                                ns if isinstance(ns, dict)
+                                else {"kernel": ns})
                     else:
                         new_params[op.name] = op.sparse_sgd_update(
                             params[op.name], xs, gev[op.name],
@@ -2886,6 +2902,19 @@ class FFModel:
                 "num_samples": num_samples, "rollbacks": rollbacks,
                 "recoveries": recoveries,
                 "metrics": self.perf.report()}
+
+    # ------------------------------------------------------------------
+    # skew statistics (utils/histogram.py)
+    # ------------------------------------------------------------------
+    def attach_id_histograms(self, sketches) -> None:
+        """Attach per-op id-frequency sketches ({op name ->
+        IdFrequencySketch}, e.g. loaded from a published
+        ``id_histogram.npz``) so the strategy search can price the
+        skew-aware exchanges (dedup-before-exchange, hot/cold hybrid —
+        ops/embedding.expected_routed_lookups). Without an attached
+        histogram the cost model assumes uniform ids, under which
+        neither mode looks attractive."""
+        self._id_histograms = dict(sketches or {})
 
     # ------------------------------------------------------------------
     # streaming fit: the continual train->serve loop (utils/delta.py)

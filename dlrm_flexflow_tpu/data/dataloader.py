@@ -102,6 +102,39 @@ def pad_batch_rows(batch, rows: int):
     return out
 
 
+# ---- skewed synthetic ids (zipf) -----------------------------------------
+# Real recommendation traffic is zipfian — a few hot ids dominate lookups
+# (FAE / Neo measure it; the skew-aware exchange in parallel/alltoall.py
+# exploits it). The synthetic loaders can reproduce that so skewed
+# workloads are testable and benchable: p(k) ∝ 1 / (k+1)^alpha over
+# [0, rows) — id 0 is the hottest, matching the frequency-ordered
+# renumbering real preprocessed datasets use. alpha = 0 is EXACTLY the
+# legacy uniform path (same rng.randint draws, bit-compatible seeds).
+
+_ZIPF_CDF_CACHE: Dict[tuple, np.ndarray] = {}
+
+
+def zipf_indices(rng: np.random.RandomState, rows: int, size,
+                 alpha: float) -> np.ndarray:
+    """Draw ids in [0, rows) with zipf(alpha) probabilities via inverse
+    CDF (cached per (rows, alpha) — O(rows) setup once, O(log rows) per
+    draw). alpha <= 0 falls back to the legacy uniform randint so
+    existing seeded datasets stay byte-identical."""
+    if alpha <= 0.0:
+        return rng.randint(0, rows, size=size)
+    key = (int(rows), float(alpha))
+    cdf = _ZIPF_CDF_CACHE.get(key)
+    if cdf is None:
+        p = 1.0 / np.power(np.arange(1, rows + 1, dtype=np.float64),
+                           float(alpha))
+        cdf = np.cumsum(p)
+        cdf /= cdf[-1]
+        _ZIPF_CDF_CACHE[key] = cdf
+    n = int(np.prod(size))
+    draws = np.searchsorted(cdf, rng.random_sample(n), side="right")
+    return draws.reshape(size).astype(np.int64)
+
+
 def _config_depth(model, depth: Optional[int]) -> int:
     if depth is not None:
         return max(int(depth), 0)

@@ -49,6 +49,11 @@ class DLRMConfig:
     mlp_top: List[int] = field(default_factory=lambda: [8, 2])
     arch_interaction_op: str = "cat"     # "cat" | "dot"
     loss_threshold: float = 0.0
+    # synthetic-data skew: zipf exponent for the categorical ids drawn
+    # by synthetic_batch (0 = the legacy uniform draws, bit-compatible
+    # seeds). Real traffic is zipfian; --zipf-alpha makes skewed
+    # workloads reproducible in tests and benches.
+    zipf_alpha: float = 0.0
     # convenience run configs
     @staticmethod
     def random_benchmark() -> "DLRMConfig":
@@ -121,6 +126,12 @@ class DLRMConfig:
                 cfg.arch_interaction_op = take()
             elif a == "--loss-threshold":
                 cfg.loss_threshold = float(take())
+            elif a == "--zipf-alpha":
+                cfg.zipf_alpha = float(take())
+                if cfg.zipf_alpha < 0:
+                    raise ValueError(
+                        f"--zipf-alpha expects a >= 0 exponent, got "
+                        f"{cfg.zipf_alpha}")
             i += 1
         return cfg
 
@@ -269,14 +280,20 @@ def dlrm_strategy(model: FFModel, cfg: DLRMConfig,
     return strat
 
 
-def synthetic_batch(cfg: DLRMConfig, batch: int, seed: int = 0):
+def synthetic_batch(cfg: DLRMConfig, batch: int, seed: int = 0,
+                    zipf_alpha: Optional[float] = None):
     """Random data generator (reference dlrm.cc data_loader with
-    --dataset '' generates random ints/floats, dlrm.cc:384-484)."""
+    --dataset '' generates random ints/floats, dlrm.cc:384-484).
+    `zipf_alpha` (default: cfg.zipf_alpha) skews the categorical ids
+    zipf(alpha)-style — id 0 hottest — so skewed workloads are
+    reproducible; 0 keeps the legacy uniform draws bit-compatible."""
+    from ..data.dataloader import zipf_indices
     rng = np.random.RandomState(seed)
     T = len(cfg.embedding_size)
+    alpha = cfg.zipf_alpha if zipf_alpha is None else float(zipf_alpha)
     dense = rng.rand(batch, cfg.mlp_bot[0]).astype(np.float32)
     sparse = np.stack(
-        [rng.randint(0, rows, size=(batch, cfg.embedding_bag_size))
+        [zipf_indices(rng, rows, (batch, cfg.embedding_bag_size), alpha)
          for rows in cfg.embedding_size], axis=1).astype(np.int32)
     labels = rng.randint(0, 2, size=(batch, 1)).astype(np.float32)
     return {"dense": dense, "sparse": sparse}, labels

@@ -491,8 +491,38 @@ def _stateful_update_tiles_packed(view, gidx, upd, d, opt, slab_views,
     return new_view, new_slabs
 
 
+def _norm_slabs(slabs):
+    """Accept {slab: arr} (legacy — the kernel's slab rows) or
+    {slab: {param: arr}} (the model passes every param's slabs; the
+    hybrid placement has two params). Returns
+    (kernel_slabs, hot_slabs | None, was_nested)."""
+    nested = any(isinstance(v, dict) for v in slabs.values())
+    if not nested:
+        return dict(slabs), None, False
+    k = {n: v["kernel"] for n, v in slabs.items()}
+    hot = None
+    if any("hot_kernel" in v for v in slabs.values()):
+        hot = {n: v["hot_kernel"] for n, v in slabs.items()}
+    return k, hot, True
+
+
+def _finish_opt_update(out, nested):
+    """Normalize a stateful-update result back to the caller's slab
+    form: hybrid results (4-tuple) always nest (two params); legacy
+    flat callers get flat kernel slabs back."""
+    if len(out) == 4:
+        new_k, new_s, new_h, new_hs = out
+        return ({"kernel": new_k, "hot_kernel": new_h},
+                {k: {"kernel": new_s[k], "hot_kernel": new_hs[k]}
+                 for k in new_s})
+    new_k, new_s = out
+    if nested:
+        new_s = {k: {"kernel": v} for k, v in new_s.items()}
+    return {"kernel": new_k}, new_s
+
+
 def _sparse_opt_update(op, tbl, gidx, upd, opt, slabs, step, total_rows,
-                       fwd_tiles=None):
+                       fwd_tiles=None, hot_tbl=None, hot_slabs=None):
     """Shared stateful-update router for the embedding ops: lane-packed
     Pallas tile path on TPU, logical-row XLA path elsewhere.
 
@@ -500,17 +530,24 @@ def _sparse_opt_update(op, tbl, gidx, upd, opt, slabs, step, total_rows,
     slabs {name: same-layout state}; gidx (n,) UNPACKED global rows;
     upd (n, d) RAW gradient rows (not pre-scaled by -lr — stateful
     optimizers are nonlinear in the gradient).
-    Returns (new_kernel, new_slabs) in the stored layout."""
+    Returns (new_kernel, new_slabs) in the stored layout — plus
+    (new_hot, new_hot_slabs) under the hybrid placement."""
     d = op.out_dim
     plan = _row_plan(op)
     if plan is not None and gidx.shape[0] % plan.ndev == 0:
         # row-sharded: gradient rows + their global positions route to
         # the owning shard; weights AND state slabs update shard-locally
+        # (hybrid hot rows apply in lockstep from an all-gather)
         from ..parallel.alltoall import row_sharded_opt_update
-        owner, local = op._row_owner_local(gidx)
+        owner, local, gid, hot_id = op._row_route(gidx)
         spec, _ = op._row_spec_block()
+        if hot_id is not None:
+            return row_sharded_opt_update(
+                plan, tbl, slabs, spec, owner, local, upd, opt, step,
+                d, gid=gid, hot_table=hot_tbl, hot_slabs=hot_slabs,
+                hot_id=hot_id)
         return row_sharded_opt_update(plan, tbl, slabs, spec, owner,
-                                      local, upd, opt, step, d)
+                                      local, upd, opt, step, d, gid=gid)
     r = getattr(op, "_pack", 1)
     use_tiles = (r * d == 128
                  and _pallas_scatter_ok(op.model, 128, op.name)
@@ -597,6 +634,34 @@ def _row_shard_axes(op, d: int, packed_rows: int):
 # below gates on `op._row_plan`.
 
 
+# hot-row quantum, in lane-pack units: the hybrid hot count rounds to a
+# multiple of HOT_QUANTUM_PACKS x pack so the SAME hot split works for
+# every row-shard degree dividing 8 — an elastic clamp 8 -> 4 -> 2 can
+# reshard the cold tail without changing the hot block's shape (and the
+# checkpoint stays restorable across the clamp)
+HOT_QUANTUM_PACKS = 8
+
+
+def resolve_hot_rows(rows: int, pack: int, param_degree: int,
+                     hot_fraction: float) -> int:
+    """Per-table replicated hot-row count H for the hybrid placement:
+    `hot_fraction` of `rows`, rounded to the hot quantum, such that the
+    cold tail (rows - H) still equal-blocks `param_degree` row shards at
+    the lane packing. 0 = no hybrid (infeasible requests resolve to 0
+    and the caller degrades loudly to plain row sharding)."""
+    if hot_fraction <= 0.0 or param_degree <= 1 or rows <= 0:
+        return 0
+    q = HOT_QUANTUM_PACKS * max(pack, 1)
+    if q >= rows:
+        return 0
+    h = int(round(hot_fraction * rows / q)) * q
+    h = max(h, q)
+    h = min(h, rows - q)
+    if (rows - h) % (param_degree * max(pack, 1)) != 0:
+        return 0
+    return h
+
+
 def row_shard_structural_reason(op, raw_pc, axis_sizes) -> Optional[str]:
     """Mesh-free feasibility of `raw_pc.param_degree`-way row sharding
     for `op` over a factorized mesh with `axis_sizes`, or None when the
@@ -632,23 +697,33 @@ def row_shard_structural_reason(op, raw_pc, axis_sizes) -> Optional[str]:
     if batch % ndev != 0:
         return (f"batch {batch} does not divide over the {ndev}-device "
                 f"mesh (lookups route from batch shards)")
+    frac = getattr(raw_pc, "hot_fraction", 0.0)
+    if frac > 0 and not getattr(op, "_hot_split_ok", False):
+        return (f"hot_fraction={frac:g} requested but this op has no "
+                f"per-table hot/cold split (concatenated non-uniform "
+                f"tables keep every row routed)")
     return None
 
 
 def configure_row_shard(op, raw_pc) -> None:
     """Resolve (and validate) the row-shard plan for `op` from its RAW
-    strategy's param_degree. Sets ``op._row_plan`` (None = mode off).
+    strategy's param_degree (+ the skew refinements: exchange mode and
+    hot_fraction). Sets ``op._row_plan`` (None = mode off) and
+    ``op._hot_rows`` (per-table replicated hot rows; 0 = no hybrid).
     Infeasible requests degrade loudly to replicated rows — a silent
     fallback would OOM exactly the >HBM configs this mode exists for, so
     the warning names the reason."""
     from ..parallel.alltoall import plan_row_shard
     op._row_plan = None
+    op._hot_rows = 0
     pd = getattr(raw_pc, "param_degree", 1) if raw_pc is not None else 1
     if pd <= 1:
         return
     model = op.model
     mesh = getattr(model, "mesh", None)
     rows, pack, tables = op._row_shard_geometry()
+    dedup = getattr(raw_pc, "exchange", "dense") == "dedup"
+    frac = getattr(raw_pc, "hot_fraction", 0.0)
     reason = None
     if mesh is None or mesh.size <= 1:
         reason = "needs a multi-device mesh"
@@ -658,8 +733,18 @@ def configure_row_shard(op, raw_pc) -> None:
     else:
         reason = row_shard_structural_reason(
             op, raw_pc, [int(mesh.shape[a]) for a in mesh.axis_names])
+    hot = 0
+    if reason is None and frac > 0:
+        hot = resolve_hot_rows(rows, pack, pd, frac)
+        if hot <= 0:
+            log_emb.warning(
+                "hot_fraction=%g for %r resolves to no replicable hot "
+                "block (rows=%d, lane pack %d, %d shards, quantum %d "
+                "rows); executing plain row sharding", frac, op.name,
+                rows, pack, pd, HOT_QUANTUM_PACKS * max(pack, 1))
     if reason is None:
-        plan = plan_row_shard(mesh, pd, rows, pack, tables)
+        plan = plan_row_shard(mesh, pd, rows - hot, pack, tables,
+                              dedup=dedup, hot_rows=hot)
         if plan is None:
             sizes = [int(mesh.shape[a]) for a in mesh.axis_names]
             reason = (f"{pd} row shards must factorize mesh axes {sizes} "
@@ -667,6 +752,7 @@ def configure_row_shard(op, raw_pc) -> None:
                       f"(lane pack {pack})")
         else:
             op._row_plan = plan
+            op._hot_rows = hot
             return
     log_emb.warning(
         "row sharding (param_degree=%d) requested for %r but %s; "
@@ -677,12 +763,62 @@ def _row_plan(op):
     return getattr(op, "_row_plan", None)
 
 
-def _a2a_payload_bytes(op, ndev: int, itemsize: int):
+def _id_histogram(op):
+    """The op's observed id-frequency sketch (utils/histogram.py),
+    attached by FFModel.attach_id_histograms / fit_stream collection, or
+    a uniform default — under which dedup ~= dense and the hybrid
+    placement never looks attractive, exactly right for unknown
+    traffic."""
+    from ..utils.histogram import IdFrequencySketch
+    hist = getattr(op.model, "_id_histograms", {}).get(op.name)
+    if hist is not None:
+        return hist
+    rows, _pack, tables = op._row_shard_geometry() \
+        if hasattr(op, "_row_shard_geometry") else (op.num_entries, 1, 1)
+    return IdFrequencySketch(rows * tables)
+
+
+def expected_routed_lookups(op, pc, per_device_lookups: float) -> float:
+    """THE skew term: how many lookup slots one device actually routes
+    through the exchange per step under `pc`'s exchange/hot policy,
+    from the op's observed id histogram.
+
+    - hybrid (hot_fraction > 0): hot hits are served locally, so only
+      the cold fraction routes;
+    - dedup: duplicates collapse, so the routed count is the EXPECTED
+      DISTINCT (cold) ids among the device's draws — the quantity
+      ``IdFrequencySketch.expected_distinct`` computes.
+
+    Uniform (no histogram) traffic makes dedup ~= dense on big tables
+    and prices the hot set at its row fraction — so the search only
+    reaches for these modes when the observed distribution rewards
+    them."""
+    rows, pack, tables = op._row_shard_geometry()
+    pd = max(getattr(pc, "param_degree", 1), 1)
+    hot = resolve_hot_rows(rows, pack, pd,
+                           getattr(pc, "hot_fraction", 0.0)) \
+        if getattr(op, "_hot_split_ok", False) else 0
+    hist = _id_histogram(op)
+    if getattr(pc, "exchange", "dense") == "dedup":
+        return hist.expected_distinct(per_device_lookups,
+                                      hot_rows_per_table=hot,
+                                      rows_per_table=rows)
+    if hot > 0:
+        return per_device_lookups * (1.0 - hist.hot_mass(hot, rows,
+                                                         tables))
+    return per_device_lookups
+
+
+def _a2a_payload_bytes(op, ndev: int, itemsize: int, pc=None):
     """Per-device all-to-all payloads for a row-sharded lookup under the
     balanced (production/ragged) exchange, for the simulator: (request
     ids, embedded rows back, gradient rows out). The (P−1)/P exchanged
-    fraction is applied by CostModel.alltoall_time_axes per axis."""
+    fraction is applied by CostModel.alltoall_time_axes per axis. With
+    `pc`, the skew-aware exchange policies shrink the routed count
+    (expected distinct / cold-only ids from the observed histogram)."""
     n_dev = _lookup_count(op) / max(ndev, 1)
+    if pc is not None:
+        n_dev = expected_routed_lookups(op, pc, n_dev)
     d = op.out_dim
     req = n_dev * 4.0                      # int32 row ids
     rows = n_dev * d * float(itemsize)     # embedded rows, compute dtype
@@ -690,21 +826,78 @@ def _a2a_payload_bytes(op, ndev: int, itemsize: int):
     return req, rows, grad
 
 
+def expected_hot_distinct(op, pc, per_device_lookups: float) -> float:
+    """Expected DISTINCT hot ids one device touches per step under
+    `pc`'s hybrid placement — the hot update stream is pre-combined per
+    hot id before the all-gather (parallel/alltoall._hot_combine), so
+    this, not the raw hot-hit count, is what moves and what every
+    replica scatters."""
+    rows, pack, tables = op._row_shard_geometry()
+    pd = max(getattr(pc, "param_degree", 1), 1)
+    hot = resolve_hot_rows(rows, pack, pd,
+                           getattr(pc, "hot_fraction", 0.0)) \
+        if getattr(op, "_hot_split_ok", False) else 0
+    if hot <= 0:
+        return 0.0
+    hist = _id_histogram(op)
+    all_d = hist.expected_distinct(per_device_lookups)
+    cold_d = hist.expected_distinct(per_device_lookups,
+                                    hot_rows_per_table=hot,
+                                    rows_per_table=rows)
+    return min(max(all_d - cold_d, 0.0), float(hot * tables))
+
+
+def hot_update_bytes(op, pc, ndev: int) -> float:
+    """Per-device bytes of the hybrid placement's HOT update stream:
+    the all-gathered fp32 per-hot-id partial sums (+ id/position) every
+    replica applies in lockstep — priced like the replicated-table
+    allreduce the simulator already knows, but only over the DISTINCT
+    hot ids actually touched."""
+    n_dev = _lookup_count(op) / max(ndev, 1)
+    hot_d = expected_hot_distinct(op, pc, n_dev)
+    return hot_d * (8.0 + op.out_dim * 4.0)
+
+
+# hot fractions the search samples for the hybrid placement (resolved
+# against each table's geometry; unresolvable ones are skipped)
+_HOT_FRACTIONS = (1.0 / 64, 1.0 / 16)
+
+
 def _row_shard_candidates(op, num_devices, feasible_degrees, nd):
     """PARAM-axis candidates for the MCMC search: rows split over pp
     shards, output data-parallel over the whole target mesh (the
-    pod-scale shape the cost model trades against pure DP)."""
+    pod-scale shape the cost model trades against pure DP) — in the
+    dense exchange, the dedup'd (unique-ids) exchange, and, for ops
+    with a per-table hot split, the hot/cold hybrid placement. The
+    skew term (expected_routed_lookups) is what lets the walk tell
+    them apart: on uniform ids dense wins (dedup pays its sort for
+    nothing), on zipfian ids dedup/hybrid win."""
     rows, pack, _ = op._row_shard_geometry()
     batch = op.inputs[0].shape[0]
     if batch % num_devices != 0 or op.aggr not in (AGGR_MODE_SUM,
                                                    AGGR_MODE_AVG):
         return []
+    # the skew variants enter the walk ONLY when an observed histogram
+    # is attached: without one the cost model assumes uniform ids,
+    # under which dedup/hybrid price at best ~dense (minus the sort
+    # overhead) — offering them would just dilute the walk
+    skewed = op.name in getattr(op.model, "_id_histograms", {})
     out = []
     for pp in feasible_degrees:
         if 1 < pp <= num_devices and rows % (pp * max(pack, 1)) == 0:
             degs = [1] * nd
             degs[0] = num_devices
             out.append(ParallelConfig(tuple(degs), param_degree=pp))
+            if not skewed:
+                continue
+            out.append(ParallelConfig(tuple(degs), param_degree=pp,
+                                      exchange="dedup"))
+            if getattr(op, "_hot_split_ok", False):
+                for frac in _HOT_FRACTIONS:
+                    if resolve_hot_rows(rows, pack, pp, frac) > 0:
+                        out.append(ParallelConfig(
+                            tuple(degs), param_degree=pp,
+                            exchange="dedup", hot_fraction=frac))
     return out
 
 
@@ -747,34 +940,80 @@ class Embedding(Op):
         self.outputs = [self._make_output(out_shape)]
 
     def param_defs(self) -> Dict[str, ParamDef]:
+        H = getattr(self, "_hot_rows", 0)
+        if H > 0:
+            # hybrid placement (configure_row_shard resolved a hot
+            # split): cold tail row-sharded, hot head replicated
+            return {"kernel": ParamDef(
+                        (self.num_entries - H, self.out_dim),
+                        jnp.float32, self.kernel_initializer),
+                    "hot_kernel": ParamDef(
+                        (H, self.out_dim), jnp.float32,
+                        self.kernel_initializer)}
         return {"kernel": ParamDef((self.num_entries, self.out_dim),
                                    jnp.float32, self.kernel_initializer)}
 
+    def init_params(self, key):
+        H = getattr(self, "_hot_rows", 0)
+        if H <= 0:
+            return super().init_params(key)
+        # draw at the FULL logical shape with the same key the
+        # non-hybrid build would use, then split — the hybrid table's
+        # initial values are bitwise the baseline's
+        keys = jax.random.split(key, 1)
+        logical = self.kernel_initializer(
+            keys[0], (self.num_entries, self.out_dim), jnp.float32)
+        return {"kernel": logical[H:], "hot_kernel": logical[:H]}
+
     # ---- row/PARAM-axis sharding hooks (see configure_row_shard) -------
     _row_needs_2d_idx = True
+    _hot_split_ok = True    # per-table hot/cold hybrid supported
 
     def _row_shard_geometry(self):
         return self.num_entries, getattr(self, "_pack", 1), 1
 
-    def _row_owner_local(self, g):
-        """Global (wrapped) row ids -> (owning shard, id in the owner's
-        flat local view). Shared with EmbeddingBagStacked: a flat id
-        t*rows + ix maps to shard ix // rows_local, local slot
-        t*rows_local + ix % rows_local (each shard owns the same row
-        block of EVERY table)."""
+    def _row_route(self, g):
+        """Flat global (wrapped) ids t*rows + ix -> the routed-lookup
+        arrays (owner, local, gid, hot_id). Shared with
+        EmbeddingBagStacked: each shard owns the same COLD row block of
+        EVERY table; under the hybrid placement the per-table head
+        (ix < hot rows) is served from the replicated hot block — those
+        slots carry owner == nshards (excluded from the exchange), a
+        gid in a disjoint key range (so the dedup machinery never
+        merges them into a cold id's partial sum), and their flat
+        hot-block row in hot_id (sentinel on cold slots)."""
         plan = self._row_plan
         rows = self.num_entries
+        H = getattr(self, "_hot_rows", 0)
         rl = plan.rows_local
         ix = g % rows
         t = g // rows
-        return ((ix // rl).astype(jnp.int32),
-                (t * rl + ix % rl).astype(jnp.int32))
+        if H <= 0:
+            return ((ix // rl).astype(jnp.int32),
+                    (t * rl + ix % rl).astype(jnp.int32),
+                    g.astype(jnp.int32), None)
+        rc = rows - H
+        is_hot = ix < H
+        cix = jnp.maximum(ix - H, 0)
+        owner = jnp.where(is_hot, plan.nshards,
+                          cix // rl).astype(jnp.int32)
+        local = jnp.where(is_hot, plan.flat_rows_local,
+                          t * rl + cix % rl).astype(jnp.int32)
+        hid = (t * H + ix).astype(jnp.int32)
+        gid = jnp.where(is_hot, plan.tables * rc + hid,
+                        t * rc + cix).astype(jnp.int32)
+        hot_id = jnp.where(is_hot, hid,
+                           plan.hot_rows_flat).astype(jnp.int32)
+        return owner, local, gid, hot_id
 
     def _row_spec_block(self):
         from jax.sharding import PartitionSpec
         plan = self._row_plan
         return (PartitionSpec(plan.row_axes, None),
-                (self.num_entries // plan.nshards, self.out_dim))
+                (plan.rows_local, self.out_dim))
+
+    def _hot_block_shape(self):
+        return (getattr(self, "_hot_rows", 0), self.out_dim)
 
     def apply(self, params, xs, *, training=False, rng=None):
         (idx,) = xs
@@ -784,11 +1023,13 @@ class Embedding(Op):
                 and idx.shape[0] % plan.ndev == 0):
             from ..parallel.alltoall import row_sharded_bag_lookup
             g = idx.astype(jnp.int32) % self.num_entries
-            owner, local = self._row_owner_local(g)
+            owner, local, gid, hot_id = self._row_route(g)
             spec, block = self._row_spec_block()
-            return [row_sharded_bag_lookup(plan, table, spec, owner,
-                                           local, self.out_dim,
-                                           self.aggr, block)]
+            return [row_sharded_bag_lookup(
+                plan, table, spec, owner, local, self.out_dim,
+                self.aggr, block, gid=gid,
+                hot_table=params.get("hot_kernel"), hot_id=hot_id,
+                hot_block_shape=self._hot_block_shape())]
         if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG) and idx.ndim == 2
                 and _pallas_ok(self.model, self.out_dim, self.name)):
             from .pallas.embedding_kernel import embedding_bag
@@ -826,7 +1067,10 @@ class Embedding(Op):
     def param_axes(self, pc: ParallelConfig, out_axes,
                    raw_pc=None):
         if _row_plan(self) is not None:
-            return {"kernel": (self._row_plan.row_axes, ())}
+            axes = {"kernel": (self._row_plan.row_axes, ())}
+            if getattr(self, "_hot_rows", 0) > 0:
+                axes["hot_kernel"] = ((), ())   # replicated hot head
+            return axes
         # width sharding follows the output channel axes; rows replicated
         ch = out_axes[-1] if len(out_axes) >= 2 else ()
         return {"kernel": ((), ch)}
@@ -838,9 +1082,16 @@ class Embedding(Op):
     def param_shard_shapes(self, pc: ParallelConfig, ndev=None):
         pd = max(getattr(pc, "param_degree", 1), 1)
         if pd > 1:
-            # row sharding: each shard holds rows/pd full-width rows
-            return {"kernel": (max(self.num_entries // pd, 1),
-                               self.out_dim)}
+            # row sharding: each shard holds cold_rows/pd full-width
+            # rows (+ the whole replicated hot head under the hybrid)
+            H = resolve_hot_rows(self.num_entries,
+                                 getattr(self, "_pack", 1), pd,
+                                 getattr(pc, "hot_fraction", 0.0))
+            out = {"kernel": (max((self.num_entries - H) // pd, 1),
+                              self.out_dim)}
+            if H > 0:
+                out["hot_kernel"] = (H, self.out_dim)
+            return out
         # width sharding splits out_dim by the last degree
         dc = pc.degrees[-1] if len(pc.degrees) > 1 else 1
         return {"kernel": (self.num_entries, max(self.out_dim // dc, 1))}
@@ -853,8 +1104,8 @@ class Embedding(Op):
     def update_random_hbm_rows(self, pc=None) -> float:
         return _embedding_update_rows(self, pc)
 
-    def alltoall_payload_bytes(self, ndev: int, itemsize: int):
-        return _a2a_payload_bytes(self, ndev, itemsize)
+    def alltoall_payload_bytes(self, ndev: int, itemsize: int, pc=None):
+        return _a2a_payload_bytes(self, ndev, itemsize, pc=pc)
 
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
@@ -927,13 +1178,18 @@ class Embedding(Op):
         plan = _row_plan(self)
         if plan is not None and idx.size % plan.ndev == 0:
             # row-sharded: gradient rows route to their owning shard
-            # (all-to-all) and apply there, in canonical global order
+            # (all-to-all) and apply there, in canonical order; hybrid
+            # hot rows apply in lockstep from an all-gather
             from ..parallel.alltoall import row_sharded_sgd_update
-            owner, local = self._row_owner_local(idx.reshape(-1))
+            owner, local, gid, hot_id = self._row_route(idx.reshape(-1))
             spec, _ = self._row_spec_block()
-            new = row_sharded_sgd_update(plan, tbl, spec, owner, local,
-                                         upd, lr, d)
-            return {"kernel": new}
+            out = row_sharded_sgd_update(
+                plan, tbl, spec, owner, local, upd, lr, d, gid=gid,
+                hot_table=params.get("hot_kernel"), hot_id=hot_id)
+            if hot_id is None:
+                return {"kernel": out}
+            new, new_hot = out
+            return {"kernel": new, "hot_kernel": new_hot}
         if fwd is not None and self._fwd_residual_ok():
             # write-only path: the forward's gathered rows are the tiles,
             # so new rows land without the RMW read
@@ -969,10 +1225,13 @@ class Embedding(Op):
                                    idx.shape + (d,)).reshape(-1, d)
         fwd_tiles = (fwd[1] if fwd is not None and self._fwd_residual_ok()
                      else None)
-        new_k, new_s = _sparse_opt_update(self, tbl, idx.reshape(-1), upd,
-                                          opt, slabs, step,
-                                          self.num_entries, fwd_tiles)
-        return {"kernel": new_k}, new_s
+        kslabs, hslabs, nested = _norm_slabs(slabs)
+        out = _sparse_opt_update(self, tbl, idx.reshape(-1), upd,
+                                 opt, kslabs, step,
+                                 self.num_entries, fwd_tiles,
+                                 hot_tbl=params.get("hot_kernel"),
+                                 hot_slabs=hslabs)
+        return _finish_opt_update(out, nested)
 
     # ---- delta publication (utils/delta.py) ----------------------------
     # A batch's lookup indices mapped to the rows of the STORED kernel
@@ -984,10 +1243,28 @@ class Embedding(Op):
         import numpy as np
         g = np.asarray(idx_np).astype(np.int64).reshape(-1) \
             % self.num_entries
+        H = getattr(self, "_hot_rows", 0)
+        if H > 0:
+            # "kernel" stores only the cold tail under the hybrid
+            # placement; the (small) replicated hot block stays
+            # untracked — the publisher diffs it whole
+            g = g[g >= H] - H
         return np.unique(g)
 
-    # host table is (num_entries, out_dim) — same natural layout
-    host_delta_touched_rows = delta_touched_rows
+    def host_delta_touched_rows(self, idx_np) -> "np.ndarray":
+        # host table is (num_entries, out_dim) — same natural layout
+        # (host-resident tables never row-shard, so never hybrid)
+        import numpy as np
+        g = np.asarray(idx_np).astype(np.int64).reshape(-1) \
+            % self.num_entries
+        return np.unique(g)
+
+    def flat_lookup_ids(self, idx_np) -> "np.ndarray":
+        """Batch indices -> flat lookup-id space, for the id-frequency
+        sketch (utils/histogram.py) collected at staging."""
+        import numpy as np
+        return (np.asarray(idx_np).astype(np.int64).reshape(-1)
+                % self.num_entries)
 
     # ---- host-resident table form (reference embedding_avx2.cc) --------
     def host_init(self, seed: int):
@@ -1104,6 +1381,15 @@ class EmbeddingBagStacked(Op):
 
     def param_defs(self):
         r = self._pack
+        H = getattr(self, "_hot_rows", 0)
+        if H > 0:
+            return {"kernel": ParamDef(
+                        (self.num_tables, (self.num_entries - H) // r,
+                         self.out_dim * r),
+                        jnp.float32, self.kernel_initializer),
+                    "hot_kernel": ParamDef(
+                        (self.num_tables, H // r, self.out_dim * r),
+                        jnp.float32, self.kernel_initializer)}
         return {"kernel": ParamDef(
             (self.num_tables, self.num_entries // r, self.out_dim * r),
             jnp.float32, self.kernel_initializer)}
@@ -1117,7 +1403,18 @@ class EmbeddingBagStacked(Op):
             self.kernel_initializer(
                 k, (self.num_entries, self.out_dim), jnp.float32)
             for k in keys])
-        return {"kernel": self.pack_kernel(tables)}
+        H = getattr(self, "_hot_rows", 0)
+        if H <= 0:
+            return {"kernel": self.pack_kernel(tables)}
+        # hybrid: the SAME draws split into the replicated hot head and
+        # the row-sharded cold tail — bitwise the baseline's values
+        r, d = self._pack, self.out_dim
+        if self._table_order is not None:
+            tables = jnp.take(tables, self._table_order, axis=0)
+        return {"kernel": tables[:, H:].reshape(
+                    self.num_tables, (self.num_entries - H) // r, r * d),
+                "hot_kernel": tables[:, :H].reshape(
+                    self.num_tables, H // r, r * d)}
 
     def unpack_kernel(self, kernel):
         """(T, rows/r, r*d) stored form -> logical (T, rows, d)."""
@@ -1135,19 +1432,25 @@ class EmbeddingBagStacked(Op):
                                self.out_dim * r)
 
     # ---- row/PARAM-axis sharding hooks (see configure_row_shard) -------
+    _hot_split_ok = True    # uniform tables: per-table hot/cold split
+
     def _row_shard_geometry(self):
         return self.num_entries, self._pack, self.num_tables
 
-    _row_owner_local = Embedding._row_owner_local
+    _row_route = Embedding._row_route
 
     def _row_spec_block(self):
         from jax.sharding import PartitionSpec
         plan = self._row_plan
         r = self._pack
         return (PartitionSpec(None, plan.row_axes, None),
-                (self.num_tables,
-                 self.num_entries // r // plan.nshards,
+                (self.num_tables, plan.rows_local // r,
                  self.out_dim * r))
+
+    def _hot_block_shape(self):
+        r = self._pack
+        return (self.num_tables, getattr(self, "_hot_rows", 0) // r,
+                self.out_dim * r)
 
     def apply(self, params, xs, *, training=False, rng=None):
         (idx,) = xs  # (batch, T, bag)
@@ -1165,10 +1468,12 @@ class EmbeddingBagStacked(Op):
             rows = self.num_entries
             offs = (jnp.arange(self.num_tables, dtype=jnp.int32)
                     * rows)[None, :, None]
-            owner, local = self._row_owner_local(idx + offs)
+            owner, local, gid, hot_id = self._row_route(idx + offs)
             spec, block = self._row_spec_block()
-            out = row_sharded_bag_lookup(plan, table, spec, owner,
-                                         local, d, self.aggr, block)
+            out = row_sharded_bag_lookup(
+                plan, table, spec, owner, local, d, self.aggr, block,
+                gid=gid, hot_table=params.get("hot_kernel"),
+                hot_id=hot_id, hot_block_shape=self._hot_block_shape())
             if self._table_inv is not None:
                 out = jnp.take(out, self._table_inv, axis=1)
             return [out]
@@ -1213,8 +1518,12 @@ class EmbeddingBagStacked(Op):
                    raw_pc=None):
         if _row_plan(self) is not None:
             # rows of EVERY table block-shard over the row axes; the
-            # table dim stays whole on each shard
-            return {"kernel": ((), self._row_plan.row_axes, ())}
+            # table dim stays whole on each shard (the hybrid hot head
+            # is replicated everywhere)
+            axes = {"kernel": ((), self._row_plan.row_axes, ())}
+            if getattr(self, "_hot_rows", 0) > 0:
+                axes["hot_kernel"] = ((), (), ())
+            return axes
         # table dim of the param follows output dim 1's axes
         t_axes = out_axes[1] if len(out_axes) >= 2 else ()
         return {"kernel": (t_axes, (), ())}
@@ -1235,10 +1544,17 @@ class EmbeddingBagStacked(Op):
         r = self._pack
         pd = max(getattr(pc, "param_degree", 1), 1)
         if pd > 1:
-            # row sharding: all T tables present, rows/pd of each
-            return {"kernel": (self.num_tables,
-                               max(self.num_entries // r // pd, 1),
-                               self.out_dim * r)}
+            # row sharding: all T tables present, cold_rows/pd of each
+            # (+ the whole replicated hot head under the hybrid)
+            H = resolve_hot_rows(self.num_entries, r, pd,
+                                 getattr(pc, "hot_fraction", 0.0))
+            out = {"kernel": (self.num_tables,
+                              max((self.num_entries - H) // r // pd, 1),
+                              self.out_dim * r)}
+            if H > 0:
+                out["hot_kernel"] = (self.num_tables, H // r,
+                                     self.out_dim * r)
+            return out
         # table-dim sharding by degrees[1]
         dt = pc.degrees[1] if len(pc.degrees) > 1 else 1
         return {"kernel": (max(self.num_tables // dt, 1),
@@ -1252,8 +1568,8 @@ class EmbeddingBagStacked(Op):
     def update_random_hbm_rows(self, pc=None) -> float:
         return _embedding_update_rows(self, pc)
 
-    def alltoall_payload_bytes(self, ndev: int, itemsize: int):
-        return _a2a_payload_bytes(self, ndev, itemsize)
+    def alltoall_payload_bytes(self, ndev: int, itemsize: int, pc=None):
+        return _a2a_payload_bytes(self, ndev, itemsize, pc=pc)
 
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
@@ -1324,13 +1640,18 @@ class EmbeddingBagStacked(Op):
         if plan is not None and idx.size % plan.ndev == 0:
             from ..parallel.alltoall import row_sharded_sgd_update
             offs = (jnp.arange(T, dtype=jnp.int32) * rows)[None, :, None]
-            owner, local = self._row_owner_local((idx + offs).reshape(-1))
+            owner, local, gid, hot_id = self._row_route(
+                (idx + offs).reshape(-1))
             upd = jnp.broadcast_to(
                 ct[..., None, :], idx.shape + (d,)).reshape(-1, d)
             spec, _ = self._row_spec_block()
-            new = row_sharded_sgd_update(plan, tbl, spec, owner, local,
-                                         upd, lr, d)
-            return {"kernel": new}
+            out = row_sharded_sgd_update(
+                plan, tbl, spec, owner, local, upd, lr, d, gid=gid,
+                hot_table=params.get("hot_kernel"), hot_id=hot_id)
+            if hot_id is None:
+                return {"kernel": out}
+            new, new_hot = out
+            return {"kernel": new, "hot_kernel": new_hot}
 
         if fwd is not None and self._fwd_residual_ok():
             # write-only path: fwd tiles + summed deltas -> pure scatter
@@ -1405,9 +1726,12 @@ class EmbeddingBagStacked(Op):
         upd = jnp.broadcast_to(ct[..., None, :],
                                idx.shape + (d,)).reshape(-1, d)
         fwd_tiles = fwd[1] if fwd is not None else None
-        new_k, new_s = _sparse_opt_update(self, tbl, g, upd, opt, slabs,
-                                          step, T * rows, fwd_tiles)
-        return {"kernel": new_k}, new_s
+        kslabs, hslabs, nested = _norm_slabs(slabs)
+        out = _sparse_opt_update(self, tbl, g, upd, opt, kslabs,
+                                 step, T * rows, fwd_tiles,
+                                 hot_tbl=params.get("hot_kernel"),
+                                 hot_slabs=hslabs)
+        return _finish_opt_update(out, nested)
 
     # ---- delta publication (utils/delta.py; see Embedding) -------------
     def delta_touched_rows(self, idx_np) -> "np.ndarray":
@@ -1420,8 +1744,24 @@ class EmbeddingBagStacked(Op):
         slot = np.arange(self.num_tables, dtype=np.int64)
         if self._table_inv is not None:
             slot = np.asarray(self._table_inv, dtype=np.int64)
+        H = getattr(self, "_hot_rows", 0)
+        if H > 0:
+            # hybrid: "kernel" stores only the cold tail; the (small)
+            # replicated hot block stays untracked — diffed whole
+            flat = slot[None, :, None] * ((rows - H) // r) + (g - H) // r
+            return np.unique(flat.reshape(-1)[g.reshape(-1) >= H])
         flat = slot[None, :, None] * (rows // r) + g // r
         return np.unique(flat.reshape(-1))
+
+    def flat_lookup_ids(self, idx_np) -> "np.ndarray":
+        """Batch indices -> flat t*rows + ix lookup ids, for the
+        id-frequency sketch collected at staging."""
+        import numpy as np
+        rows = self.num_entries
+        g = np.asarray(idx_np).astype(np.int64) % rows
+        offs = (np.arange(self.num_tables, dtype=np.int64)
+                * rows)[None, :, None]
+        return (g + offs).reshape(-1)
 
     def host_delta_touched_rows(self, idx_np) -> "np.ndarray":
         # host table is (T, rows, d) in LOGICAL table order, unpacked
@@ -1580,10 +1920,16 @@ class EmbeddingBagConcat(Op):
     def _row_shard_geometry(self):
         return self.total_rows, self._pack, 1
 
-    def _row_owner_local(self, g):
+    def _row_route(self, g):
+        """Concatenated global rows -> (owner, local, gid, hot_id).
+        The dedup'd exchange keys on the concatenated row id; the
+        hot/cold hybrid does NOT apply here (non-uniform tables have no
+        per-table hot split — row_shard_structural_reason says so)."""
         plan = self._row_plan
         rl = plan.rows_local
-        return (g // rl).astype(jnp.int32), (g % rl).astype(jnp.int32)
+        return ((g // rl).astype(jnp.int32),
+                (g % rl).astype(jnp.int32),
+                g.astype(jnp.int32), None)
 
     def _row_spec_block(self):
         from jax.sharding import PartitionSpec
@@ -1601,10 +1947,11 @@ class EmbeddingBagConcat(Op):
         plan = _row_plan(self)
         if plan is not None and batch % plan.ndev == 0:
             from ..parallel.alltoall import row_sharded_bag_lookup
-            owner, local = self._row_owner_local(g)
+            owner, local, gid, _hot = self._row_route(g)
             spec, block = self._row_spec_block()
             return [row_sharded_bag_lookup(plan, tbl, spec, owner,
-                                           local, d, self.aggr, block)]
+                                           local, d, self.aggr, block,
+                                           gid=gid)]
         if (self.aggr in (AGGR_MODE_SUM, AGGR_MODE_AVG) and r == 1
                 and _pallas_ok(self.model, self.out_dim, self.name)):
             # one Pallas row-stream over the concatenated table; per-table
@@ -1696,8 +2043,8 @@ class EmbeddingBagConcat(Op):
     def update_random_hbm_rows(self, pc=None) -> float:
         return _embedding_update_rows(self, pc)
 
-    def alltoall_payload_bytes(self, ndev: int, itemsize: int):
-        return _a2a_payload_bytes(self, ndev, itemsize)
+    def alltoall_payload_bytes(self, ndev: int, itemsize: int, pc=None):
+        return _a2a_payload_bytes(self, ndev, itemsize, pc=pc)
 
     def param_bytes_touched_per_step(self, num_parts: int = 1) -> int:
         if not _sparse_update_active(self):
@@ -1748,10 +2095,10 @@ class EmbeddingBagConcat(Op):
         plan = _row_plan(self)
         if plan is not None and g.size % plan.ndev == 0:
             from ..parallel.alltoall import row_sharded_sgd_update
-            owner, local = self._row_owner_local(g.reshape(-1))
+            owner, local, gid, _hot = self._row_route(g.reshape(-1))
             spec, _ = self._row_spec_block()
             new = row_sharded_sgd_update(plan, tbl, spec, owner, local,
-                                         upd, lr, d)
+                                         upd, lr, d, gid=gid)
             return {"kernel": new}
         if fwd is not None and self._fwd_residual_ok():
             from .pallas.embedding_kernel import scatter_write_rows_packed
@@ -1794,10 +2141,11 @@ class EmbeddingBagConcat(Op):
         upd = jnp.broadcast_to(ct[..., None, :],
                                g.shape + (d,)).reshape(-1, d)
         fwd_tiles = fwd[1] if fwd is not None else None
-        new_k, new_s = _sparse_opt_update(self, tbl, g.reshape(-1), upd,
-                                          opt, slabs, step,
-                                          self.total_rows, fwd_tiles)
-        return {"kernel": new_k}, new_s
+        kslabs, _hslabs, nested = _norm_slabs(slabs)
+        out = _sparse_opt_update(self, tbl, g.reshape(-1), upd,
+                                 opt, kslabs, step,
+                                 self.total_rows, fwd_tiles)
+        return _finish_opt_update(out, nested)
 
     # ---- host-resident table form (reference embedding_avx2.cc) --------
     def host_init(self, seed: int):
@@ -1842,4 +2190,9 @@ class EmbeddingBagConcat(Op):
         # host table is the unpacked (total_rows, d) concatenation
         import numpy as np
         return np.unique(self._host_global_indices(idx_np).reshape(-1))
+
+    def flat_lookup_ids(self, idx_np) -> "np.ndarray":
+        """Batch indices -> concatenated global rows, for the
+        id-frequency sketch collected at staging."""
+        return self._host_global_indices(idx_np).reshape(-1)
 

@@ -47,6 +47,20 @@ class ParallelConfig:
     # tables + all-to-all lookup exchange). 1 = replicated/whole rows
     # (legacy behavior for every op that ignores it).
     param_degree: int = 1
+    # skew-aware refinements of the row-sharded exchange (param_degree
+    # > 1 only; both default to the legacy behavior so files and
+    # strategies without them are unchanged):
+    # - exchange "dedup": sort→unique the lookup ids before the
+    #   all-to-all and pre-accumulate gradient rows per unique id before
+    #   the return exchange, so exchanged bytes scale with DISTINCT ids
+    #   rather than batch size (Neo/ZionEX dedup-before-exchange).
+    # - hot_fraction f in (0, 1): frequency-aware hybrid placement — the
+    #   top f of each table's rows (the low-numbered, hot ids) are
+    #   REPLICATED on every device (local lookups, allreduce-style
+    #   lockstep updates) while the cold tail stays row-sharded (FAE,
+    #   Adnan 2021). 0 = every row routed.
+    exchange: str = "dense"
+    hot_fraction: float = 0.0
 
     def __post_init__(self):
         object.__setattr__(self, "degrees", tuple(int(d) for d in self.degrees))
@@ -57,6 +71,15 @@ class ParallelConfig:
         if self.param_degree < 1:
             raise ValueError(
                 f"invalid parameter-axis degree {self.param_degree}")
+        if self.exchange not in ("dense", "dedup"):
+            raise ValueError(
+                f"invalid exchange mode {self.exchange!r} "
+                f"(expected 'dense' or 'dedup')")
+        object.__setattr__(self, "hot_fraction", float(self.hot_fraction))
+        if not 0.0 <= self.hot_fraction < 1.0:
+            raise ValueError(
+                f"invalid hot_fraction {self.hot_fraction} "
+                f"(expected 0 <= f < 1)")
 
     @property
     def num_parts(self) -> int:

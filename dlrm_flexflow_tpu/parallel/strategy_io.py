@@ -67,7 +67,8 @@ def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
 
 def _encode_op(name: str, device_type: int, dims: List[int],
                device_ids: List[int],
-               memory_types: List[int], param_dim: int = 1) -> bytes:
+               memory_types: List[int], param_dim: int = 1,
+               hot_ppm: int = 0, exchange: int = 0) -> bytes:
     msg = bytearray()
     nb = name.encode()
     msg += b"\x0a" + _varint(len(nb)) + nb          # 1: name (len-delim)
@@ -83,6 +84,12 @@ def _encode_op(name: str, device_type: int, dims: List[int],
         # fields, so files stay readable by it; files without row
         # sharding stay byte-identical to the legacy encoding
         msg += b"\x30" + _varint(param_dim)
+    if hot_ppm > 0:                                 # 7: hot rows, ppm
+        # hybrid hot/cold placement fraction in parts-per-million (a
+        # varint round-trips exactly; floats would need a fixed64)
+        msg += b"\x38" + _varint(hot_ppm)
+    if exchange > 0:                                # 8: exchange mode
+        msg += b"\x40" + _varint(exchange)          # 1 = dedup
     return bytes(msg)
 
 
@@ -127,9 +134,13 @@ def save_strategies_pb(path: str, strategies: StrategyMap) -> None:
     for name, pc in sorted(strategies.items()):
         dt = 1 if pc.device_type == "CPU" else 0
         mts = [1 if m == "ZCM" else 0 for m in pc.memory_types]
-        op = _encode_op(name, dt, list(reversed(pc.degrees)),
-                        list(pc.device_ids), mts,
-                        param_dim=getattr(pc, "param_degree", 1))
+        op = _encode_op(
+            name, dt, list(reversed(pc.degrees)),
+            list(pc.device_ids), mts,
+            param_dim=getattr(pc, "param_degree", 1),
+            hot_ppm=int(round(getattr(pc, "hot_fraction", 0.0) * 1e6)),
+            exchange=1 if getattr(pc, "exchange",
+                                  "dense") == "dedup" else 0)
         body += b"\x0a" + _varint(len(op)) + op     # Strategy.ops = 1
     with open(path, "wb") as f:
         f.write(bytes(body))
@@ -152,6 +163,7 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
         if field != 1 or wt != 2:
             continue
         name, dt, dims, dev_ids, mts, pd = "", 0, [], [], [], 1
+        hot_ppm, exch = 0, 0
         for f2, wt2, v2 in _decode_message(v):
             if f2 == 1:
                 name = v2.decode()
@@ -165,14 +177,26 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
                 mts += _unpack_varints(v2) if wt2 == 2 else [v2]
             elif f2 == 6:
                 pd = v2                    # PARAM-axis (row-shard) degree
+            elif f2 == 7:
+                hot_ppm = v2               # hybrid hot fraction, ppm
+            elif f2 == 8:
+                exch = v2                  # exchange mode (1 = dedup)
         if pd < 1:
             raise ValueError(
                 f"op {name!r}: parameter-axis degree {pd} < 1")
+        if not 0 <= hot_ppm < 1_000_000:
+            raise ValueError(
+                f"op {name!r}: hot fraction {hot_ppm} ppm out of "
+                f"[0, 1e6)")
+        if exch not in (0, 1):
+            raise ValueError(
+                f"op {name!r}: unknown exchange mode {exch}")
         out[name] = ParallelConfig(
             tuple(reversed(dims)), device_type="CPU" if dt == 1 else "TPU",
             device_ids=tuple(dev_ids),
             memory_types=tuple("ZCM" if m == 1 else "FBM" for m in mts),
-            param_degree=pd)
+            param_degree=pd, hot_fraction=hot_ppm / 1e6,
+            exchange="dedup" if exch == 1 else "dense")
     return out
 
 
@@ -205,17 +229,26 @@ def validate_strategies(strategies: StrategyMap,
                         num_devices: Optional[int] = None,
                         axis_sizes: Optional[Sequence[int]] = None,
                         known_ops: Optional[Set[str]] = None,
-                        path: str = "<memory>") -> StrategyMap:
+                        path: str = "<memory>",
+                        row_shard_ops: Optional[Set[str]] = None
+                        ) -> StrategyMap:
     """Structural + mesh validation of a loaded strategy map.
 
     Always checked: op names are non-empty, degrees are a non-empty
     tuple of positive ints (ParallelConfig enforces positivity at
-    construction), device/memory types are from the schema's vocabulary.
-    With ``num_devices``/``axis_sizes``: each op's degrees must be
-    jointly expressible over the factorized target mesh
+    construction), device/memory types are from the schema's
+    vocabulary, and the skew-aware placement fields are coherent
+    (hot_fraction / exchange="dedup" refine the ROW-SHARDED exchange,
+    so both require param_degree > 1). With
+    ``num_devices``/``axis_sizes``: each op's degrees must be jointly
+    expressible over the factorized target mesh
     (``parallel.sharding.assign_indices`` — the exact feasibility rule
     compile() uses). With ``known_ops``: every op must name a model op
     (or a reference-style generic key like ``embedding3``/``linear``).
+    With ``row_shard_ops`` (names of the model's row-shardable
+    embedding ops): hot_fraction/exchange on any OTHER op is rejected —
+    a hot/cold placement on a Linear is a corrupt or mis-keyed file,
+    not a strategy.
 
     Returns the map unchanged so call sites can chain it; raises
     :class:`StrategyValidationError` (a ``ValueError``) with
@@ -225,6 +258,30 @@ def validate_strategies(strategies: StrategyMap,
         from .mesh import structural_axis_sizes
         axis_sizes = structural_axis_sizes(int(num_devices))
     for name, pc in strategies.items():
+        frac = getattr(pc, "hot_fraction", 0.0)
+        exch = getattr(pc, "exchange", "dense")
+        pd0 = getattr(pc, "param_degree", 1)
+        if frac > 0 and pd0 <= 1:
+            raise StrategyValidationError(
+                path, str(name),
+                f"hot_fraction={frac:g} without row sharding "
+                f"(param_degree must be > 1 — the hybrid placement "
+                f"splits a row-sharded table into a replicated hot "
+                f"head and a sharded cold tail)")
+        if exch != "dense" and pd0 <= 1:
+            raise StrategyValidationError(
+                path, str(name),
+                f"exchange={exch!r} without row sharding "
+                f"(param_degree must be > 1 — there is no exchange "
+                f"to dedup on a replicated table)")
+        if (frac > 0 or exch != "dense") and row_shard_ops is not None \
+                and name not in row_shard_ops \
+                and not _GENERIC_KEY_RE.match(str(name)):
+            raise StrategyValidationError(
+                path, str(name),
+                f"hot_fraction/exchange set on an op with no row-shard "
+                f"support (not one of the model's embedding ops: "
+                f"{sorted(row_shard_ops)[:8]}...)")
         if not name or not isinstance(name, str):
             raise StrategyValidationError(
                 path, repr(name), "empty/non-string op name")
@@ -305,6 +362,10 @@ def save_strategies(path: str, strategies: StrategyMap) -> None:
             # row/PARAM-axis shard degree (omitted when 1 so legacy
             # files stay diff-identical)
             entry["param_dim"] = int(pc.param_degree)
+        if getattr(pc, "hot_fraction", 0.0) > 0.0:
+            entry["hot_frac"] = float(pc.hot_fraction)
+        if getattr(pc, "exchange", "dense") != "dense":
+            entry["exchange"] = pc.exchange
         ops.append(entry)
     doc = {"ops": ops}
     with open(path, "w") as f:
@@ -312,7 +373,9 @@ def save_strategies(path: str, strategies: StrategyMap) -> None:
 
 
 def load_strategies(path: str, num_devices: Optional[int] = None,
-                    known_ops: Optional[Set[str]] = None) -> StrategyMap:
+                    known_ops: Optional[Set[str]] = None,
+                    row_shard_ops: Optional[Set[str]] = None
+                    ) -> StrategyMap:
     """Load + validate a strategy file. Structural validation always
     runs; pass ``num_devices`` to also require every op's degrees to
     factorize the target mesh, and ``known_ops`` to require every entry
@@ -332,10 +395,13 @@ def load_strategies(path: str, num_devices: Optional[int] = None,
                     device_type=entry.get("device_type", "TPU"),
                     device_ids=tuple(entry.get("device_ids", ())),
                     memory_types=tuple(entry.get("memory_types", ())),
-                    param_degree=int(entry.get("param_dim", 1)))
+                    param_degree=int(entry.get("param_dim", 1)),
+                    hot_fraction=float(entry.get("hot_frac", 0.0)),
+                    exchange=str(entry.get("exchange", "dense")))
             except (KeyError, TypeError, ValueError) as e:
                 raise StrategyValidationError(
                     path, str(entry.get("name", "?")),
                     f"malformed entry: {e}") from None
     return validate_strategies(out, num_devices=num_devices,
-                               known_ops=known_ops, path=path)
+                               known_ops=known_ops, path=path,
+                               row_shard_ops=row_shard_ops)

@@ -199,8 +199,11 @@ class CostModel:
         # residency/device-type must key the cache: a ZCM config and an
         # HBM config with equal degrees have sharply different costs, and
         # MCMC rewrite proposals compare exactly such pairs (the PARAM-
-        # axis row-shard degree likewise changes the update/comm shape)
+        # axis row-shard degree — and its skew policies — likewise
+        # change the update/comm shape)
         key = (op.name, pc.degrees, getattr(pc, "param_degree", 1),
+               getattr(pc, "exchange", "dense"),
+               getattr(pc, "hot_fraction", 0.0),
                pc.device_type, pc.memory_types, backward)
         if key in self._cache:
             return self._cache[key]
@@ -303,6 +306,20 @@ class CostModel:
         # random-row HBM accesses (embedding gathers) are latency-bound,
         # not bandwidth-bound — the dominant term for sparse ops
         rand_rows = op.random_hbm_rows(backward) / max(pc.num_parts, 1)
+        if (not backward and rand_rows > 0
+                and getattr(pc, "param_degree", 1) > 1
+                and hasattr(op, "_row_shard_geometry")
+                and (getattr(pc, "exchange", "dense") == "dedup"
+                     or getattr(pc, "hot_fraction", 0.0) > 0)):
+            # skew-aware routed gather: owners gather one row per
+            # DISTINCT routed id (dedup collapses duplicates before the
+            # exchange; hot lookups hit the small replicated hot block,
+            # which streams like the tiny tables above)
+            from ..ops.embedding import (_lookup_count,
+                                         expected_routed_lookups)
+            n_dev = _lookup_count(op) / max(pc.num_parts, 1)
+            rand_rows = min(rand_rows,
+                            expected_routed_lookups(op, pc, n_dev))
         t = max(t, self.random_rows_time(rand_rows))
         # serial scan iterations floor at the per-iteration loop
         # overhead; the vjp of a scan runs its own reverse-order scan
@@ -333,6 +350,25 @@ class CostModel:
         full_bytes = sum(math.prod(d.shape) * 4.0
                          for d in op.param_defs().values())
         return full_bytes * 3.0 / self.spec.host_bytes_per_s
+
+    def dedup_overhead_time(self, op, ndev: int) -> float:
+        """Sender-side cost of the dedup-before-exchange machinery
+        (parallel/alltoall.py): two stable sorts + segment sums over
+        the local lookup ids (~8 streaming passes of 4 B each) plus one
+        gather/scatter of the returned rows through the inverse map.
+
+        THE term that makes dedup lose on uniform ids: the exchange
+        barely shrinks (every id is distinct) but the sort still runs
+        every step — so the MCMC search only picks the dedup'd exchange
+        when the observed histogram's duplicate mass pays for it
+        (README troubleshooting: "dedup slower than dense on uniform
+        ids")."""
+        from ..ops.embedding import _lookup_count
+        n_dev = _lookup_count(op) / max(ndev, 1)
+        d = getattr(op, "out_dim", 0)
+        isz = jnp.dtype(self.compute_dtype).itemsize
+        bytes_ = 8.0 * n_dev * 4.0 + 2.0 * n_dev * d * isz
+        return bytes_ / self._hbm_rate()
 
     def random_rows_time(self, rows: float) -> float:
         if rows <= 0:

@@ -75,6 +75,7 @@ def _project_op(op, pc: ParallelConfig, axis_sizes,
     rows = pack = None
     if pd_old > 1 and hasattr(op, "_row_shard_geometry"):
         rows, pack, _tables = op._row_shard_geometry()
+    pd_new = clamp_param_degree(pd_old, axis_sizes, rows=rows, pack=pack)
     new_pc = ParallelConfig(
         clamp_degrees(pc.degrees, axis_sizes),
         device_type=pc.device_type,
@@ -83,8 +84,15 @@ def _project_op(op, pc: ParallelConfig, axis_sizes,
         # feasible shard count that still equal-blocks the rows), they
         # don't fall back to replication — replicating a >HBM table is
         # exactly what cannot happen
-        param_degree=clamp_param_degree(pd_old, axis_sizes,
-                                        rows=rows, pack=pack))
+        param_degree=pd_new,
+        # skew policies follow the exchange they refine: kept while row
+        # sharding survives (the hot quantum is degree-independent, so
+        # the hot block's SHAPE — and the checkpoint — survive the
+        # reshard), dropped with it
+        exchange=(getattr(pc, "exchange", "dense") if pd_new > 1
+                  else "dense"),
+        hot_fraction=(getattr(pc, "hot_fraction", 0.0) if pd_new > 1
+                      else 0.0))
     hazard: Optional[Tuple[str, bool]] = None
     if pd_old > 1 and new_pc.param_degree == 1:
         table_bytes = float(op.param_bytes()) if op.param_defs() else 0.0

@@ -258,12 +258,24 @@ class Simulator:
                                for d in self._participants(pc, ndev, op)]
             # row-sharded embedding lookups: explicit all-to-alls ride
             # the row axes' channels — request ids to the owning shards
-            # before the local gather, embedded rows back after it
+            # before the local gather, embedded rows back after it. The
+            # skew-aware policies shrink the routed bytes (dedup /
+            # hot/cold hybrid — _a2a_payload_bytes prices the expected
+            # routed count from the observed id histogram); dedup also
+            # pays its sort/unique machinery as a compute task, which
+            # is what makes it LOSE on uniform ids.
             pd = max(getattr(pc, "param_degree", 1), 1)
             if pd > 1 and hasattr(op, "alltoall_payload_bytes"):
-                req_b, rows_b, _ = op.alltoall_payload_bytes(ndev,
-                                                             itemsize)
-                req = _a2a_chain([], req_b, pd, f"a2a_idx:{op.name}")
+                req_b, rows_b, _ = op.alltoall_payload_bytes(
+                    ndev, itemsize, pc=pc)
+                pre: List[SimTask] = []
+                if getattr(pc, "exchange", "dense") == "dedup":
+                    t_sort = self.cost.dedup_overhead_time(op, ndev)
+                    if t_sort > 0:
+                        pre = [new_task(t_sort, d, f"dedup:{op.name}")
+                               for d in self._participants(pc, ndev,
+                                                           op)]
+                req = _a2a_chain(pre, req_b, pd, f"a2a_idx:{op.name}")
                 for r in req:
                     for ft in fwd_of[op.name]:
                         r.add_next(ft)
@@ -343,9 +355,33 @@ class Simulator:
                 # row-sharded table: gradient rows route to their owning
                 # shard (all-to-all over the row axes) instead of a DP
                 # all-reduce — optimizer state stays shard-local
-                _, _, grad_b = op.alltoall_payload_bytes(ndev, itemsize)
+                _, _, grad_b = op.alltoall_payload_bytes(ndev, itemsize,
+                                                         pc=pc)
                 parents = _a2a_chain(parents, grad_b, pd,
                                      f"a2a_grad:{op.name}")
+                # hybrid placement: the replicated hot head applies its
+                # (small) update stream in lockstep from an all-gather —
+                # the allreduce-style cost the simulator already prices
+                # for replicated tables, but only over the hot hits
+                hot_b = 0.0
+                if (getattr(pc, "hot_fraction", 0.0) > 0
+                        and hasattr(op, "_row_shard_geometry")):
+                    from ..ops.embedding import hot_update_bytes
+                    hot_b = hot_update_bytes(op, pc, ndev)
+                if hot_b > 0:
+                    for ax_i, (ax_name, size) in enumerate(topo):
+                        if size <= 1:
+                            continue
+                        ph = self.cost.allreduce_time_axes(
+                            float(hot_b), [(_axis_kind(ax_name), size)])
+                        if ph <= 0:
+                            continue
+                        s = new_task(
+                            ph, self._channel(ax_i),
+                            f"hot_allgather[{ax_name}]:{op.name}")
+                        for p in parents:
+                            p.add_next(s)
+                        parents = [s]
             elif replicas > 1:
                 if asn is not None and asn[0]:
                     b = float(dev_bytes)
@@ -381,13 +417,34 @@ class Simulator:
                     math.prod(d.shape) * 4.0
                     for d in op.param_defs().values())
                 tshards = max(full_bytes / max(shard_bytes, 1.0), 1.0)
+                upd_rows = op.update_random_hbm_rows(pc)
+                hot_rows_dev = 0.0
+                if (pd > 1 and upd_rows > 0
+                        and hasattr(op, "_row_shard_geometry")
+                        and (getattr(pc, "exchange", "dense") == "dedup"
+                             or getattr(pc, "hot_fraction", 0.0) > 0)):
+                    # skew-aware scatter: the routed update stream is
+                    # pre-combined per (row, device), so each shard
+                    # scatters its share of the ROUTED entries, not the
+                    # raw lookups; every replica also applies the hot
+                    # partials locally
+                    from ..ops.embedding import (_lookup_count,
+                                                 expected_hot_distinct,
+                                                 expected_routed_lookups)
+                    lookups = max(_lookup_count(op), 1.0)
+                    acc = upd_rows / lookups
+                    n_dev = lookups / max(ndev, 1)
+                    upd_rows = acc * ndev * expected_routed_lookups(
+                        op, pc, n_dev)
+                    hot_rows_dev = acc * expected_hot_distinct(op, pc,
+                                                               n_dev)
                 upd_compute = max(
                     dev_bytes / self.cost._hbm_rate() * 3.0,  # r/w+momentum
                     # sparse touched-rows scatter is random-access
                     # latency bound (write-pipeline rate, slower than
                     # the gather's)
                     self.cost.scatter_rows_time(
-                        op.update_random_hbm_rows(pc) / tshards))
+                        upd_rows / tshards + hot_rows_dev))
             for d in self._participants(pc, ndev, op):
                 u = new_task(upd_compute, d, f"update:{op.name}")
                 for p in parents:
@@ -436,22 +493,38 @@ class Simulator:
             feas = feasible_degrees_for(axis_sizes)
         out = {}
         by_name = {op.name: op for op in self.model.ops}
+
+        def _skew(pc, pd):
+            """Skew policies survive a clamp only while the exchange
+            itself does (pd > 1) — a fully-replicated table has nothing
+            to dedup and no cold tail to split."""
+            if pd > 1:
+                return (getattr(pc, "exchange", "dense"),
+                        getattr(pc, "hot_fraction", 0.0))
+            return "dense", 0.0
+
         for name, pc in strategies.items():
             op = by_name.get(name)
             pd = clamp_param_degree(getattr(pc, "param_degree", 1),
                                     axis_sizes)
+            exch, frac = _skew(pc, pd)
             if (op is None or not op.outputs
                     or getattr(op, "raw_degree_semantics", False)):
-                if pd != getattr(pc, "param_degree", 1):
+                if (pd != getattr(pc, "param_degree", 1)
+                        or exch != getattr(pc, "exchange", "dense")
+                        or frac != getattr(pc, "hot_fraction", 0.0)):
                     pc = ParallelConfig(pc.degrees, pc.device_type,
                                         pc.device_ids, pc.memory_types,
-                                        param_degree=pd)
+                                        param_degree=pd, exchange=exch,
+                                        hot_fraction=frac)
                 out[name] = pc
                 continue
             shape = op.outputs[0].shape
             degs = list(pc.degrees)[:len(shape)]
             degs += [1] * (len(shape) - len(degs))
-            changed = pd != getattr(pc, "param_degree", 1)
+            changed = (pd != getattr(pc, "param_degree", 1)
+                       or exch != getattr(pc, "exchange", "dense")
+                       or frac != getattr(pc, "hot_fraction", 0.0))
             for i, d in enumerate(degs):
                 d = min(d, shape[i])
                 while d > 1 and (shape[i] % d != 0 or d not in feas):
@@ -461,7 +534,8 @@ class Simulator:
                 degs[i] = max(d, 1)
             out[name] = (ParallelConfig(tuple(degs), pc.device_type,
                                         pc.device_ids, pc.memory_types,
-                                        param_degree=pd)
+                                        param_degree=pd, exchange=exch,
+                                        hot_fraction=frac)
                          if changed else pc)
         return out
 

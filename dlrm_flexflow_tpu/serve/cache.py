@@ -97,6 +97,25 @@ class EmbeddingCache:
                     self._d.popitem(last=False)
         return np.stack(vals, axis=0)
 
+    def prewarm(self, op, table_params, idx_np: np.ndarray) -> int:
+        """Warm the cache with per-sample index rows drawn from the
+        EXPECTED traffic distribution (the engine samples them from a
+        published id-frequency histogram, --serve-cache-warm): each row
+        inserts exactly what a real request would — the cached value is
+        op.host_lookup's output — so warm hits stay bit-identical to
+        cold lookups and the old-or-new-never-mixed reload semantics
+        are untouched (a pre-warmed entry invalidates like any other).
+        Returns how many NEW entries the warm-up inserted. Stat-neutral:
+        hits/misses keep describing real traffic only, so a warm
+        replica's hit RATE is comparable to a cold one's."""
+        with self._lock:
+            h0, m0 = self.hits, self.misses
+        before = len(self)
+        self.lookup(op, table_params, idx_np)
+        with self._lock:
+            self.hits, self.misses = h0, m0
+        return len(self) - before
+
     def invalidate(self) -> None:
         """Drop everything (hot reload replaced the tables)."""
         with self._lock:

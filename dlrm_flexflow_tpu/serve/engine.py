@@ -138,6 +138,8 @@ class ServeConfig:
     queue_capacity: int = 256    # bounded queue -> Overloaded past this
     deadline_ms: float = 0.0     # per-request budget; 0 = none
     cache_rows: int = 0          # embedding-row cache capacity; 0 = off
+    cache_warm: str = ""         # id-histogram npz (or checkpoint dir)
+    #                              to pre-warm the row cache from
     poll_s: float = 0.5          # snapshot-watcher poll interval
     warmup: bool = True          # AOT-compile all buckets at start()
     continuous: bool = True      # iteration-level admission (Orca);
@@ -154,6 +156,7 @@ class ServeConfig:
             queue_capacity=int(getattr(cfg, "serve_queue", 256)),
             deadline_ms=float(getattr(cfg, "serve_deadline_ms", 0.0)),
             cache_rows=int(getattr(cfg, "serve_cache_rows", 0)),
+            cache_warm=str(getattr(cfg, "serve_cache_warm", "")),
             poll_s=float(getattr(cfg, "serve_poll_s", 0.5)),
             continuous=(getattr(cfg, "serve_batching", "continuous")
                         != "flush"),
@@ -291,6 +294,7 @@ class InferenceEngine:
             log_serve.info("warmed %d bucket executables %s in %.0f ms",
                            len(self._buckets), list(self._buckets),
                            1e3 * self._warmup_s)
+        self._prewarm_cache()
         self._thread = threading.Thread(target=self._batcher, daemon=True,
                                         name=self._thread_name())
         self._thread.start()
@@ -448,6 +452,63 @@ class InferenceEngine:
                     for r in take:
                         if not r.future.done():
                             r.future.set_exception(e)
+
+    def _prewarm_cache(self) -> None:
+        """Pre-warm the embedding-row cache from a published
+        id-frequency histogram (``--serve-cache-warm PATH``: the
+        ``id_histogram.npz`` a DeltaPublisher writes next to its
+        snapshots, or the checkpoint directory holding one). Sample
+        index tuples are drawn from the per-table observed marginals —
+        zipfian traffic concentrates on few tuples, so a fresh replica
+        starts with the hot working set cached instead of paying cold
+        host gathers for it. Non-fatal: a missing/foreign histogram
+        just starts cold."""
+        if self._cache is None or not self.config.cache_warm:
+            return
+        import os
+
+        from ..utils.histogram import HISTOGRAM_FILE, load_histograms
+        path = self.config.cache_warm
+        if os.path.isdir(path):
+            path = os.path.join(path, HISTOGRAM_FILE)
+        try:
+            hists = load_histograms(path)
+        except (IOError, OSError, ValueError, KeyError) as e:
+            log_serve.warning(
+                "cache pre-warm skipped: cannot read id histogram "
+                "%s (%s)", path, e)
+            return
+        model = self._model
+        rng = np.random.RandomState(0)
+        n = max(min(self.config.cache_rows, 2048), 1)
+        warmed = 0
+        for op in model._host_resident_list:
+            sk = hists.get(op.name)
+            if sk is None:
+                continue
+            sample_shape = tuple(op.inputs[0].shape[1:])  # (T, bag)|(bag,)
+            if hasattr(op, "table_sizes"):        # concat: offset ranges
+                bag = sample_shape[-1]
+                cols = [sk.sample_range(rng, off, off + sz, (n, bag))
+                        for off, sz in zip(op._offsets, op.table_sizes)]
+                idx = np.stack(cols, axis=1)
+            elif len(sample_shape) == 2:          # stacked (T, bag)
+                rows = op.num_entries
+                cols = [sk.sample_range(rng, t * rows, (t + 1) * rows,
+                                        (n, sample_shape[1]))
+                        for t in range(sample_shape[0])]
+                idx = np.stack(cols, axis=1)
+            else:                                 # per-table (bag,)
+                idx = sk.sample_range(rng, 0, op.num_entries,
+                                      (n,) + sample_shape)
+            idx = np.ascontiguousarray(idx, np.int32)
+            with model._host_lock:
+                warmed += self._cache.prewarm(
+                    op, model.host_params[op.name], idx)
+        if warmed:
+            log_serve.info("pre-warmed %d embedding-cache entr%s from "
+                           "%s", warmed, "y" if warmed == 1 else "ies",
+                           path)
 
     def _host_gather(self):
         """The cached host-table gather (None = model default)."""
@@ -617,6 +678,12 @@ class InferenceEngine:
                         op_state=state.get("op_state"))
                     if self._cache is not None:
                         self._cache.invalidate()
+                        # a full reload leaves the cache exactly as
+                        # cold as a fresh start — re-warm from the
+                        # histogram against the NEW tables (entries
+                        # are post-swap lookups, so never-mixed holds;
+                        # no-op unless --serve-cache-warm is set)
+                        self._prewarm_cache()
                 else:
                     self._model.apply_delta(state)
                     self._invalidate_cache_rows(state)

@@ -126,6 +126,18 @@ def mesh_meta(model) -> Dict[str, Any]:
            if getattr(pc, "param_degree", 1) > 1}
     if pds:
         meta["param_degrees"] = pds
+    # skew-aware placement policies, only where non-default (same
+    # round-trip discipline as param_degrees: a hybrid snapshot's
+    # hot/cold split is layout — hot_kernel shapes depend on it)
+    hots = {name: float(getattr(pc, "hot_fraction", 0.0))
+            for name, pc in strategies.items()
+            if getattr(pc, "hot_fraction", 0.0) > 0.0}
+    if hots:
+        meta["hot_fractions"] = hots
+    exch = {name: pc.exchange for name, pc in strategies.items()
+            if getattr(pc, "exchange", "dense") != "dense"}
+    if exch:
+        meta["exchanges"] = exch
     return meta
 
 
@@ -568,6 +580,18 @@ class CheckpointManager:
                 pass
         manifest["entries"] = sorted(spared + keep,
                                      key=lambda e: e.get("step", -1))
+
+    def set_manifest_extra(self, key: str, value: Any) -> None:
+        """Set one top-level manifest key (atomic read-modify-replace
+        under the manifest lock) — sidecar pointers like the id-
+        frequency histogram ride the manifest without touching the
+        entries/deltas machinery. Reserved keys are refused."""
+        if key in ("entries", "deltas"):
+            raise ValueError(f"manifest key {key!r} is reserved")
+        with self._manifest_lock:
+            manifest = self._read_manifest()
+            manifest[key] = value
+            self._write_manifest(manifest)
 
     # --- delta chain (utils/delta.py DeltaPublisher) -------------------
     def delta_entries(self) -> List[Dict[str, Any]]:

@@ -114,6 +114,19 @@ class TouchedRowTracker:
         self._batches = 0
         # (op, input name, flat key, host?) tuples resolved once
         self._tracked = self._resolve_tracked()
+        # id-frequency sketches ride the same staging-thread observe():
+        # one per embedding op, over its flat lookup-id space — the
+        # skew signal the cost model / serving cache warm consume
+        # (utils/histogram.py)
+        from .histogram import IdFrequencySketch
+        self._sketch_ops = []
+        self._sketches: Dict[str, "IdFrequencySketch"] = {}
+        for op in getattr(model, "ops", []):
+            if (op.inputs and hasattr(op, "flat_lookup_ids")
+                    and hasattr(op, "_row_shard_geometry")):
+                rows, _pack, tables = op._row_shard_geometry()
+                self._sketches[op.name] = IdFrequencySketch(rows * tables)
+                self._sketch_ops.append((op, op.inputs[0].name))
 
     def _resolve_tracked(self) -> List[Tuple[Any, str, str, bool]]:
         from ..ops.embedding import _sparse_update_active
@@ -144,10 +157,22 @@ class TouchedRowTracker:
             rows = (op.host_delta_touched_rows(idx) if host
                     else op.delta_touched_rows(idx))
             adds.append((key, rows))
+        flats = [(op.name, op.flat_lookup_ids(batch[in_name]))
+                 for op, in_name in self._sketch_ops
+                 if batch.get(in_name) is not None]
         with self._lock:
             self._batches += 1
             for key, rows in adds:
                 self._pending.setdefault(key, []).append(rows)
+            for name, ids in flats:
+                self._sketches[name].observe(ids)
+
+    def id_histograms(self) -> Dict[str, object]:
+        """The per-op id-frequency sketches observed so far (live
+        references — callers persisting them should do so under a
+        quiesced stream, which publish-time is)."""
+        with self._lock:
+            return dict(self._sketches)
 
     def snapshot(self) -> Tuple[Dict[str, np.ndarray], int]:
         """Merge pending observations and return (a copy of) the
@@ -470,7 +495,31 @@ class DeltaPublisher:
         self._deltas_since_full = 0
         self.publishes += 1
         self.full_publishes += 1
+        self._publish_histograms()
         return entry
+
+    def _publish_histograms(self) -> None:
+        """Persist the observed id-frequency sketches next to the chain
+        base (the `id_histogram.npz` sidecar + a manifest pointer):
+        the offline strategy search reads them to price the skew-aware
+        exchanges, and a fresh serving replica pre-warms its
+        EmbeddingCache from the same file (--serve-cache-warm).
+        Non-fatal — traffic statistics must never fail a publish."""
+        from .histogram import HISTOGRAM_FILE, save_histograms
+        sketches = self.tracker.id_histograms()
+        observed = {n: s for n, s in sketches.items() if s.total > 0}
+        if not observed:
+            return
+        try:
+            path = os.path.join(self.mgr.directory, HISTOGRAM_FILE)
+            save_histograms(path, observed)
+            self.mgr.set_manifest_extra("id_histogram", {
+                "file": HISTOGRAM_FILE,
+                "total_lookups": {n: int(s.total)
+                                  for n, s in observed.items()}})
+        except (IOError, OSError) as e:
+            log_delta.warning("id-histogram publish failed (%s); "
+                              "will retry at the next full publish", e)
 
     # --- delta publish ---------------------------------------------------
     def publish_delta(self, loader_state: Optional[Dict[str, Any]] = None

@@ -78,9 +78,10 @@ def main(argv=None):
     else:
         ndev = min(cfg.num_devices, len(jax.devices())) or len(jax.devices())
         mesh = make_mesh(num_devices=ndev)
-    log_app.info("devices=%d processes=%d batch=%d tables=%d", ndev,
+    log_app.info("devices=%d processes=%d batch=%d tables=%d "
+                 "zipf_alpha=%g", ndev,
                  jax.process_count(), cfg.batch_size,
-                 len(dcfg.embedding_size))
+                 len(dcfg.embedding_size), dcfg.zipf_alpha)
 
     model = ff.FFModel(cfg)
     build_dlrm(model, dcfg)

@@ -803,3 +803,121 @@ class TestServeFaults:
             assert faults.maybe_corrupt_reload(str(p)) is True
             assert p.stat().st_size == 64
             assert faults.maybe_corrupt_reload(str(p)) is False
+
+
+class TestCacheWarmStart:
+    """ISSUE 11: EmbeddingCache pre-warm from a persisted id-frequency
+    histogram (--serve-cache-warm) — a fresh replica starts with the
+    zipfian hot working set cached instead of paying cold host gathers
+    for it, and the old-or-new-never-mixed reload semantics are
+    untouched (a pre-warmed entry is an ordinary entry)."""
+
+    ALPHA = 1.2
+
+    def _histogram_file(self, model, tmp_path, draws=20000):
+        from dlrm_flexflow_tpu.data.dataloader import zipf_indices
+        from dlrm_flexflow_tpu.utils.histogram import (IdFrequencySketch,
+                                                       save_histograms)
+        rng = np.random.RandomState(0)
+        sketches = {}
+        for op in model._host_resident_list:
+            rows, _p, tables = op._row_shard_geometry()
+            sk = IdFrequencySketch(rows * tables)
+            for t in range(tables):
+                sk.observe(zipf_indices(rng, rows, draws, self.ALPHA)
+                           + t * rows)
+            sketches[op.name] = sk
+        path = str(tmp_path / "id_histogram.npz")
+        save_histograms(path, sketches)
+        return path
+
+    def _trace(self, n, seed):
+        """A zipfian request trace (same distribution the histogram
+        observed, fresh draws)."""
+        x, _ = synthetic_batch(DCFG, n, seed=seed, zipf_alpha=self.ALPHA)
+        return x
+
+    # single hot table: the per-sample cache keys whole index tuples,
+    # so the pre-warm pays off exactly when the tuple space is
+    # low-entropy (hot ids ~ hot requests) — the regime the histogram
+    # describes
+    DCFG1 = DLRMConfig(embedding_size=[512], sparse_feature_size=8,
+                       mlp_bot=[4, 16, 8], mlp_top=[16, 16, 1])
+
+    def _build1(self):
+        model = ff.FFModel(ff.FFConfig(batch_size=BS, seed=2,
+                                       host_resident_tables=True))
+        build_dlrm(model, self.DCFG1)
+        model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                      ["mse"], mesh=None)
+        model.init_layers()
+        return model
+
+    def test_warm_start_beats_cold_on_zipf_trace(self, tmp_path):
+        m_cold = self._build1()
+        m_warm = self._build1()
+        hist = self._histogram_file(m_warm, tmp_path)
+        trace = []
+        for i in range(8):
+            x, _ = synthetic_batch(self.DCFG1, 4, seed=100 + i,
+                                   zipf_alpha=self.ALPHA)
+            trace.append(x)
+        cold = InferenceEngine(m_cold, ServeConfig(
+            max_batch=BS, max_delay_ms=1.0, cache_rows=512))
+        warm = InferenceEngine(m_warm, ServeConfig(
+            max_batch=BS, max_delay_ms=1.0, cache_rows=512,
+            cache_warm=hist))
+        with cold, warm:
+            warmed = len(warm._cache)
+            assert warmed > 0          # pre-warm inserted entries
+            preds = []
+            for t in trace:
+                pc = cold.predict(t, timeout=30)
+                pw = warm.predict(t, timeout=30)
+                preds.append((pc.scores, pw.scores))
+        # warm results are bit-identical to cold ones (cache entries
+        # are exactly host_lookup outputs)
+        for sc, sw in preds:
+            np.testing.assert_array_equal(sc, sw)
+        st_cold = cold.stats()["embedding_cache"]
+        st_warm = warm.stats()["embedding_cache"]
+        assert st_warm["hits"] > st_cold["hits"], (st_warm, st_cold)
+        assert st_warm["hit_rate"] > st_cold["hit_rate"]
+
+    def test_warm_entries_invalidate_on_reload(self, tmp_path):
+        """Old-or-new-never-mixed survives the pre-warm: a hot reload
+        drops pre-warmed entries like any other."""
+        x, y = synthetic_batch(DCFG, BS, seed=0)
+        d = str(tmp_path / "ckpt")
+        trainer = _build(host_resident_tables=True)
+        mgr = CheckpointManager(d, keep_last=3)
+        server = _build(host_resident_tables=True)
+        hist = self._histogram_file(server, tmp_path)
+        eng = InferenceEngine(server, ServeConfig(
+            max_batch=BS, max_delay_ms=1.0, poll_s=0.02,
+            cache_rows=256, cache_warm=hist), checkpoint_dir=d)
+        with eng:
+            assert len(eng._cache) > 0
+            _publish(trainer, mgr, x, y, steps=1)
+            deadline = time.time() + 20
+            while eng.version == 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert eng.version > 0
+            # reload invalidated the cache (pre-warmed entries included)
+            assert eng.stats()["embedding_cache"]["invalidations"] >= 1
+            # post-reload answers match the new tables exactly
+            p = eng.predict(x, timeout=30)
+            np.testing.assert_array_equal(
+                p.scores, np.asarray(server.forward_batch(dict(x))))
+
+    def test_missing_histogram_starts_cold_nonfatal(self, tmp_path):
+        m = _build(host_resident_tables=True)
+        eng = InferenceEngine(m, ServeConfig(
+            max_batch=BS, max_delay_ms=1.0, cache_rows=64,
+            cache_warm=str(tmp_path / "nope.npz")))
+        with eng:
+            # nothing pre-warmed beyond the bucket warm-up's dummy
+            # lookups; serving proceeds normally
+            assert len(eng._cache) <= len(m._host_resident_list)
+            p = eng.predict(_slice(_rows(4), 0, 4), timeout=30)
+            assert p.scores.shape[0] == 4

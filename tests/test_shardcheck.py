@@ -386,6 +386,71 @@ class TestHloGoldens:
 
 
 # =====================================================================
+# dedup'd exchange golden (ISSUE 11: shardcheck honesty on both paths)
+# =====================================================================
+DEDUP_ROWS, DEDUP_BAG, DEDUP_BATCH = 256, 4, 512
+
+
+@pytest.fixture(scope="module")
+def dedup_audit():
+    """Duplicate-GUARANTEED geometry (512 lookups/table/device into
+    64 cold rows/shard): the dedup lowering's padded per-peer capacity
+    min(n_local, flat_rows_local) is 8x smaller than the dense one, so
+    the prediction must track a genuinely different program."""
+    from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+    dcfg = DLRMConfig(embedding_size=[DEDUP_ROWS] * TABLES,
+                      sparse_feature_size=DIM,
+                      embedding_bag_size=DEDUP_BAG,
+                      mlp_bot=[DIM, 64, DIM],
+                      mlp_top=[DIM * (TABLES + 1), 64, 1])
+    model = ff.FFModel(ff.FFConfig(batch_size=DEDUP_BATCH, seed=0))
+    build_dlrm(model, dcfg)
+    plan = _dp_plan(model)
+    plan[_emb(model).name] = ParallelConfig((NDEV, 1, 1),
+                                            param_degree=NDEV,
+                                            exchange="dedup")
+    model.compile(ff.SGDOptimizer(lr=0.05), "mean_squared_error",
+                  ["mse"], mesh=make_mesh(devices=jax.devices()[:NDEV]),
+                  strategies=plan)
+    model.init_layers()
+    return hlo_audit.audit_model(model, include_eval=True,
+                                 path="dedup"), model
+
+
+class TestDedupHloGolden:
+    def test_dedup_plan_audits_clean(self, dedup_audit):
+        (findings, _report), _m = dedup_audit
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_dedup_a2a_counts_golden(self, dedup_audit):
+        (_f, report), _m = dedup_audit
+        # same exchange structure as dense: ids out, rows back, grad
+        # ids/positions/rows — dedup shrinks capacities, not counts
+        assert report["collective_counts"]["all-to-all"] == 5
+        assert report["eval_collective_counts"]["all-to-all"] == 2
+
+    def test_dedup_drift_within_tolerance(self, dedup_audit):
+        """THE acceptance pin: predicted-vs-lowered byte drift <= 0.25
+        on the DEDUP'd plan too (dedup_exchange_hlo_bytes knows the
+        shrunk capacity, so the drift is exact)."""
+        (_f, report), _m = dedup_audit
+        drift = float(report["drift"]["all-to-all"])
+        assert drift <= 0.25, report
+
+    def test_dedup_buffers_genuinely_smaller(self, dedup_audit):
+        from dlrm_flexflow_tpu.parallel.alltoall import (
+            dedup_exchange_hlo_bytes, dense_exchange_hlo_bytes)
+        (_f, report), model = dedup_audit
+        emb = _emb(model)
+        plan = emb._row_plan
+        lookups = DEDUP_BATCH * TABLES * DEDUP_BAG
+        dense_b = dense_exchange_hlo_bytes(plan, lookups, DIM)
+        dedup_b = dedup_exchange_hlo_bytes(plan, lookups, DIM)
+        assert dedup_b * 4 <= dense_b   # capacity 64 vs 512 per peer
+        assert report["measured_bytes"]["all-to-all"] == dedup_b
+
+
+# =====================================================================
 # CLI gate
 # =====================================================================
 class TestCli:
