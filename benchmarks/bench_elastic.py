@@ -14,7 +14,18 @@ Measures, on a small DLRM (CPU or attached accelerator):
   recompile on the shrunken mesh, re-split params/opt state;
 - ``steps_per_s_before`` / ``steps_per_s_after`` — steady-state training
   rate on the full mesh vs the shrunken one (the capacity actually lost,
-  as opposed to the whole job, which is what a non-elastic run loses).
+  as opposed to the whole job, which is what a non-elastic run loses);
+- ``expand_*`` — scale-UP: detect (consume the return signal) → replan →
+  reshard → FIRST post-expansion step, the end-to-end time from capacity
+  coming back to the grown mesh training on it;
+- ``warm_vs_cold`` — the persistent-cache story (ISSUE 12): the same
+  recover-and-first-step cycle with an empty warm cache (cold: MCMC
+  search + XLA compile) vs a populated one (warm: plan-cache hit + AOT
+  executable deserialize), plus a corrupt-cache run proving the
+  degradation path re-compiles instead of failing. The acceptance bar is
+  warm recovery dropping from seconds to milliseconds
+  (``warm_speedup`` >> 1, warm total in single-digit ms territory on
+  this tiny model; real models amortize far more compile time).
 
 Prints ONE JSON line (the BENCH_*.json convention); `measure()` is also
 imported by bench.py when BENCH_ELASTIC=1 so recovery-cost regressions
@@ -115,6 +126,61 @@ def measure(steps=30, batch=128, search_budget=50):
     recover_total_ms = 1e3 * (time.perf_counter() - t0)
     after = _steps_per_s(model, staged(model, dcfg), steps)
 
+    # --- scale-UP: detect -> replan -> reshard -> first step -----------
+    from dlrm_flexflow_tpu.parallel.elastic import expand
+    from dlrm_flexflow_tpu.parallel.distributed import MeshReturned
+    model.config.elastic_expand = True
+    returned = [d for d in jax.devices()
+                if d.id not in {dd.id for dd in model.mesh.devices.flat}]
+    b0 = staged(model, dcfg, n=1)[0]
+    with faults.active_plan(faults.FaultPlan(
+            return_device_steps={int(model._step): len(returned)})):
+        t0 = time.perf_counter()
+        try:
+            model.train_batch_device(b0)          # detection point
+            raise RuntimeError("return-device fault did not fire")
+        except MeshReturned as exc:
+            detect_expand_ms = 1e3 * (time.perf_counter() - t0)
+            erep = expand(model, returned=exc.returned, mode="inplace",
+                          budget=search_budget)
+    t0 = time.perf_counter()
+    b1 = staged(model, dcfg, n=1)[0]              # restage on new mesh
+    float(model.train_batch_device(b1)["loss"])   # first grown step
+    expand_first_step_ms = 1e3 * (time.perf_counter() - t0)
+
+    # --- warm vs cold recovery (persistent plan + compile caches) ------
+    import shutil
+    import tempfile
+
+    def _recover_cycle(cache_dir, corrupt=False):
+        m, dc = _build(ndev, batch, elastic="inplace",
+                       elastic_search_budget=search_budget)
+        if cache_dir:
+            m.attach_plan_cache(cache_dir)
+            m.attach_compile_cache(cache_dir)
+        bts = staged(m, dc, n=1)
+        float(m.train_batch_device(bts[0])["loss"])   # pre-shrink warm
+        plan = (faults.FaultPlan(corrupt_cache_entries=10 ** 6)
+                if corrupt else faults.FaultPlan())
+        with faults.active_plan(plan):
+            t0 = time.perf_counter()
+            rep = recover(m, lost=list(m.mesh.devices.flat)[half:],
+                          mode="inplace", budget=search_budget)
+            bt = staged(m, dc, n=1)[0]
+            float(m.train_batch_device(bt)["loss"])   # first step
+            total_ms = 1e3 * (time.perf_counter() - t0)
+        return total_ms, rep
+
+    cache_dir = tempfile.mkdtemp(prefix="ff-warmcache-")
+    try:
+        cold_ms, cold_rep = _recover_cycle(cache_dir)      # fills cache
+        warm_ms, warm_rep = _recover_cycle(cache_dir)      # hits cache
+        corrupt_ms, corrupt_rep = _recover_cycle(cache_dir,
+                                                 corrupt=True)
+        nocache_ms, _ = _recover_cycle(None)
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
     return {
         "devices": ndev,
         "devices_after": report.surviving,
@@ -129,6 +195,28 @@ def measure(steps=30, batch=128, search_budget=50):
         "steps_per_s_after": round(after, 2),
         "shrink_throughput_ratio": round(after / before, 4)
         if before > 0 else None,
+        # scale-UP: capacity back -> grown mesh training on it
+        "expand_detect_ms": round(detect_expand_ms, 2),
+        "expand_replan_ms": round(1e3 * erep.replan_s, 2),
+        "expand_reshard_ms": round(1e3 * erep.reshard_s, 2),
+        "expand_first_step_ms": round(expand_first_step_ms, 2),
+        "expand_devices": erep.surviving,
+        # warm vs cold recovery (recover + first post-reshard step)
+        "warm_vs_cold": {
+            "no_cache_ms": round(nocache_ms, 2),
+            "cold_ms": round(cold_ms, 2),
+            "warm_ms": round(warm_ms, 2),
+            "warm_speedup": round(nocache_ms / warm_ms, 2)
+            if warm_ms > 0 else None,
+            "warm_plan_cache_hit": bool(warm_rep.plan_cache_hit),
+            "cold_plan_cache_hit": bool(cold_rep.plan_cache_hit),
+            # corrupt entries must degrade to a fresh compile (cold
+            # speed, zero failures), never to a dead recovery
+            "corrupt_cache_ms": round(corrupt_ms, 2),
+            "corrupt_degraded_ok": bool(
+                not corrupt_rep.plan_cache_hit
+                and corrupt_rep.surviving == half),
+        },
     }
 
 

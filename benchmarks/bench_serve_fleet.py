@@ -12,6 +12,15 @@ Three questions, matching the ISSUE-6 acceptance bar:
   replica killed mid-run (`FF_FAULT_REPLICA_DOWN`) — failed requests
   (the bar is ZERO: every request retried to success on the survivor)
   and p99 before/during the outage.
+- **Autoscaling under a load spike** (ISSUE 12): a 1-replica fleet with
+  the SLO autoscaler attached serves comfortably inside the SLO; the
+  offered rate then DOUBLES past single-replica capacity (each dispatch
+  carries an injected fixed cost so capacity is dispatch-bound, not
+  host-CPU-bound — the accelerator-serving shape, and the only regime
+  where in-process CPU replicas scale at all). The autoscaler must grow
+  the fleet on the sustained breach and the post-growth p99 must
+  RE-ENTER the SLO with zero failed requests across all three phases —
+  the ISSUE-12 acceptance bar.
 - **Continuous vs flush batching**: the same open-loop ladder through
   one engine in continuous (iteration-level) admission vs the
   pre-continuous size/deadline flush cycle. Continuous batching is
@@ -160,6 +169,88 @@ def _qps_at_slo(submit, reqs, slo_ms, rates):
     return best, detail
 
 
+def _measure_autoscale(slo_ms=150.0, dispatch_cost_s=0.02,
+                       max_batch=8):
+    """Load-doubling chaos: 1 replica inside the SLO -> offered rate
+    doubles past its capacity -> the autoscaler grows the fleet -> p99
+    re-enters the SLO with zero failed requests.
+
+    Capacity is made dispatch-bound by injecting a fixed per-dispatch
+    cost (``FF_FAULT_SERVE_DELAY`` semantics): one replica sustains
+    ~max_batch/dispatch_cost rows/s, so doubling the offered rate past
+    that backs its queue up — the breach signal — while a second
+    replica honestly doubles capacity (pure host-CPU-bound replicas
+    would NOT scale in-process; see the module-note)."""
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.models.dlrm import DLRMConfig
+    from dlrm_flexflow_tpu.serve import percentile
+    from dlrm_flexflow_tpu.utils import faults
+
+    dcfg = DLRMConfig(embedding_size=[8192] * 8, sparse_feature_size=16,
+                      mlp_bot=[16, 64, 16], mlp_top=[144, 64, 1])
+    reqs = _requests(dcfg, 128)
+    cap_qps = max_batch / dispatch_cost_s        # one replica's ceiling
+    rate_lo = 0.6 * cap_qps
+    rate_hi = 1.5 * cap_qps                      # the doubled+ spike
+
+    def factory(i):
+        return _build(dev=i, max_batch=max_batch)[0]
+
+    fleet = ff.Fleet.build(factory, 1, ff.ServeConfig(
+        max_batch=max_batch, queue_capacity=8192))
+    router = ff.FleetRouter(fleet, ff.RouterConfig(
+        retries=4, backoff_ms=2.0, cooldown_s=0.5,
+        health_interval_s=0.1, probe_deadline_s=60.0))
+    scaler = ff.Autoscaler(router, ff.AutoscaleConfig(
+        slo_ms=slo_ms, min_replicas=1, max_replicas=3,
+        interval_s=0.1, sustain=3, queue_hwm=2.0,
+        idle_sustain=10 ** 6,                    # no shrink mid-bench
+        cooldown_s=1.0))
+    router.start()
+    scaler.start()
+    try:
+        for r in reqs[:16]:
+            router.predict(r, timeout=120)
+        with faults.active_plan(faults.FaultPlan(
+                serve_delay_s=dispatch_cost_s)):
+            lat_before, failed_before, _ = _poisson_drive(
+                router.submit, reqs, rate_lo,
+                n=_trial_n(reqs, rate_lo, min_s=2.0))
+            # the spike: sustained past one replica's ceiling. Drive
+            # long enough for breach detection + replica build/warm.
+            lat_spike, failed_spike, _ = _poisson_drive(
+                router.submit, reqs, rate_hi,
+                n=_trial_n(reqs, rate_hi, min_s=8.0))
+            # after growth: same doubled rate, now under capacity
+            lat_after, failed_after, _ = _poisson_drive(
+                router.submit, reqs, rate_hi,
+                n=_trial_n(reqs, rate_hi, min_s=3.0))
+        sstats = scaler.stats()
+        p99_before = percentile(lat_before, 99)
+        p99_spike = percentile(lat_spike, 99)
+        p99_after = percentile(lat_after, 99)
+        return {
+            "slo_ms": slo_ms,
+            "single_replica_cap_qps": round(cap_qps, 1),
+            "offered_qps_before": round(rate_lo, 1),
+            "offered_qps_spike": round(rate_hi, 1),
+            "p99_ms_before": round(p99_before or 0, 2),
+            "p99_ms_during_spike": round(p99_spike or 0, 2),
+            "p99_ms_after_growth": round(p99_after or 0, 2),
+            "failed_total": failed_before + failed_spike + failed_after,
+            "grows": sstats["grows"],
+            "fleet_size_final": sstats["size"],
+            "grow_reason": sstats["last_reason"],
+            "p99_reenters_slo": bool(p99_after is not None
+                                     and p99_after <= slo_ms),
+            "zero_failed": (failed_before + failed_spike
+                            + failed_after) == 0,
+        }
+    finally:
+        scaler.close()
+        router.close()
+
+
 def measure(requests=256, slo_ms=50.0, replica_counts=(1, 2, 4)):
     import jax
 
@@ -234,6 +325,9 @@ def measure(requests=256, slo_ms=50.0, replica_counts=(1, 2, 4)):
         }
     finally:
         router.close()
+
+    # --- autoscaler chaos: load doubles, fleet grows, p99 re-enters -----
+    out["autoscale"] = _measure_autoscale(slo_ms=150.0)
 
     # --- continuous vs flush batching (open-loop ladder each) -----------
     modes = {}

@@ -22,12 +22,12 @@ from .core.initializers import (ConstantInitializer, GlorotUniform,
 from .core.tensor import Tensor
 from .parallel.mesh import make_mesh
 from .parallel.pconfig import ParallelConfig
-from .parallel.distributed import MeshDegraded
+from .parallel.distributed import MeshDegraded, MeshReturned
 from .utils.watchdog import Deadline, StallReport, WorkerStalled
-from .serve import (DeadlineExceeded, Fleet, FleetRouter,
-                    FleetUnavailable, InferenceEngine, Overloaded,
-                    Prediction, ReplicaDown, RouterConfig, ServeConfig,
-                    SnapshotWatcher)
+from .serve import (AutoscaleConfig, Autoscaler, DeadlineExceeded,
+                    Fleet, FleetRouter, FleetUnavailable,
+                    InferenceEngine, Overloaded, Prediction, ReplicaDown,
+                    RouterConfig, ServeConfig, SnapshotWatcher)
 
 __version__ = "0.1.0"
 
@@ -39,9 +39,10 @@ __all__ = [
     "GlorotUniform", "ZeroInitializer", "UniformInitializer",
     "NormInitializer", "ConstantInitializer",
     "ParallelConfig", "make_mesh",
-    "MeshDegraded", "WorkerStalled", "StallReport", "Deadline",
+    "MeshDegraded", "MeshReturned", "WorkerStalled", "StallReport",
+    "Deadline",
     "InferenceEngine", "ServeConfig", "Prediction", "Overloaded",
     "DeadlineExceeded", "SnapshotWatcher",
     "Fleet", "FleetRouter", "FleetUnavailable", "RouterConfig",
-    "ReplicaDown",
+    "ReplicaDown", "Autoscaler", "AutoscaleConfig",
 ]

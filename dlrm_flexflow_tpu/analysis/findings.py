@@ -33,6 +33,11 @@ RULES = {
     "FLX103": ("thread-unjoined", "high",
                "thread is never joined/drained on any close()/shutdown() "
                "path (leaked worker; racy teardown)"),
+    "FLX104": ("policy-loop-no-stop-signal", "high",
+               "a *_loop policy/health thread (autoscaler, router "
+               "health, watcher) is joined without a stop Event being "
+               "set on any close path — the join waits out a full "
+               "sleep interval, or forever on a non-waiting loop"),
     # --- lock discipline ----------------------------------------------
     "FLX201": ("racy-attribute", "medium",
                "attribute written both inside and outside `with <lock>` "
@@ -86,6 +91,11 @@ RULES = {
                "plan cannot project onto the survivor mesh: "
                "clamp_strategies would shed row shards into replication "
                "or exceed the survivor's HBM"),
+    "FLX506": ("plan-cache-mesh-mismatch", "high",
+               "a cached MCMC plan's recorded mesh signature does not "
+               "match the topology it would be served for (or its "
+               "degrees cannot assign on that mesh) — a warm-start hit "
+               "on the wrong topology is a silent correctness hazard"),
     # --- lowered-HLO audit (analysis/hlo_audit.py) ----------------------
     "FLX511": ("hlo-table-collective", "high",
                "lowered HLO moves a table-scale buffer through an "

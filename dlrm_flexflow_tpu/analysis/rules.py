@@ -218,6 +218,68 @@ def _check_join(idx: PackageIndex, site, findings: List[Finding],
 
 
 # ---------------------------------------------------------------------
+# FLX104 — policy-loop threads must be stop-signalled before the join
+# ---------------------------------------------------------------------
+def _loop_target_name(call: ast.Call) -> Optional[str]:
+    """The thread's target method name when it looks like a long-lived
+    policy/health loop (``target=self._policy_loop`` — the ``*_loop``
+    naming every such worker in this package follows)."""
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+    target = kw.get("target")
+    if isinstance(target, ast.Attribute) and target.attr.endswith("_loop"):
+        return target.attr
+    if isinstance(target, ast.Name) and target.id.endswith("_loop"):
+        return target.id
+    return None
+
+
+def _sets_event_before_join(cnode: ast.ClassDef, attr: str) -> bool:
+    """True when some method of the class that joins self.<attr> (or an
+    alias, or delegates via close/stop) also calls ``<something>.set()``
+    — the stop-Event signal that lets a waiting loop exit immediately
+    instead of sleeping out its interval (or never exiting at all)."""
+    for node in ast.walk(cnode):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if not _joins_attr(node, attr):
+            continue
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "set"):
+                return True
+    return False
+
+
+def check_policy_loops(idx: PackageIndex,
+                       findings: List[Finding]) -> None:
+    """FLX104: a thread whose target is a ``*_loop`` method (the
+    autoscaler's policy loop, the router's health loop, pollers) runs
+    ``while not stop.wait(interval)``-shaped bodies. Joining such a
+    thread WITHOUT setting its stop event first blocks close() for a
+    full sleep interval at best and forever at worst — every close path
+    that joins the loop thread must ``.set()`` a stop Event. Reuses the
+    FLX101-103 thread index; fires only on threads stored on self (a
+    local loop thread is FLX103's business)."""
+    for site in idx.threads:
+        loop = _loop_target_name(site.call)
+        if loop is None or not site.stored_attr or not site.cls:
+            continue
+        _, cnode = idx.classes[site.cls]
+        if not _joins_attr(cnode, site.stored_attr):
+            continue   # unjoined is FLX103's finding, not a double
+        if _sets_event_before_join(cnode, site.stored_attr):
+            continue
+        findings.append(make_finding(
+            "FLX104", site.file, site.line,
+            f"policy thread {loop}() (self.{site.stored_attr}) is "
+            f"joined on close without a stop Event .set(): the join "
+            f"waits out the loop's full sleep interval, or hangs on a "
+            f"loop that never checks a flag",
+            scope=site.scope, token=site.stored_attr))
+
+
+# ---------------------------------------------------------------------
 # FLX201 — attribute written both inside and outside lock scopes
 # ---------------------------------------------------------------------
 _INIT_METHODS = {"__init__", "__post_init__", "__new__"}
@@ -749,6 +811,6 @@ def check_env_parsing(idx: PackageIndex,
                     scope=fn.name, token=ast.unparse(arg)[:40]))
 
 
-ALL_PASSES = (check_threads, check_racy_attributes, check_locks,
-              check_manifest_atomicity, check_jax_hazards,
+ALL_PASSES = (check_threads, check_policy_loops, check_racy_attributes,
+              check_locks, check_manifest_atomicity, check_jax_hazards,
               check_env_parsing)

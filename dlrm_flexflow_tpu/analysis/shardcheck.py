@@ -36,6 +36,13 @@ baseline machinery):
 - FLX505 elastic-clamp-hazard: `search.replan.clamp_report` projects
   the plan onto a survivor mesh and the projection sheds row shards
   into replication (or cannot fit).
+- FLX506 plan-cache-mesh-mismatch: an entry in the persistent plan
+  cache (``utils/warmcache.PlanCache`` — what elastic
+  ``recover()``/``expand()`` warm-start from) records a device count or
+  axis factorization that disagrees with its own key, or carries
+  degrees that cannot assign on the recorded mesh. The runtime cache
+  rejects such entries too; the static audit (``--plan-cache DIR``)
+  finds them before a recovery is on the clock.
 
 The lowered-HLO half of the PR lives in :mod:`.hlo_audit` (FLX51x).
 """
@@ -415,6 +422,83 @@ def verify_file(path: str, model_name: Optional[str] = None,
                        path=rel)
 
 
+def audit_plan_cache(cache_dir: str) -> List[Finding]:
+    """FLX506: re-verify every entry of a persistent plan cache
+    (``utils/warmcache.PlanCache``) against the mesh its own key names.
+
+    A cached plan is exactly as dangerous as a strategy file, plus one
+    hazard files don't have: it is keyed by topology, and a warm-start
+    hit whose RECORDED mesh disagrees with its key (corruption, a
+    hand-edited plans.json, a cache directory copied between fleets)
+    would reshard silently at best and replicate a >HBM table at worst.
+    The runtime ``PlanCache.get`` rejects the same mismatches with a
+    reason; this static audit sweeps the whole file before a recovery
+    is on the clock — shardcheck warm-starts from the cache instead of
+    re-deriving plans.
+
+    Per entry: key ndev vs recorded ndev, key axes vs the structural
+    factorization this package builds for that ndev, per-op degree
+    assignability on that factorization, and decodability. Everything
+    wrong becomes an FLX506 finding naming the entry."""
+    from ..parallel.mesh import structural_axis_sizes
+    from ..parallel.sharding import assignable
+    from ..utils.warmcache import PLANS_FILE, PlanCache, _pc_from_json
+    rel = PLANS_FILE
+    findings: List[Finding] = []
+    cache = PlanCache(cache_dir)
+    entries = cache.entries()
+    for key, entry in sorted(entries.items()):
+        short = key.split("|", 1)[0]
+        fields = dict(p.split("=", 1) for p in key.split("|")[1:]
+                      if "=" in p)
+        try:
+            key_ndev = int(fields.get("ndev", ""))
+        except ValueError:
+            findings.append(make_finding(
+                "FLX506", rel, 0,
+                f"entry {short}...: key carries no parseable device "
+                f"count ({key!r:.80})", scope=short, token=key[:60]))
+            continue
+        key_axes = [int(a) for a in fields.get("axes", "").split("x")
+                    if a.isdigit()]
+        structural = [int(a) for a in structural_axis_sizes(key_ndev)]
+        ent_ndev = entry.get("ndev")
+        if int(ent_ndev or -1) != key_ndev:
+            findings.append(make_finding(
+                "FLX506", rel, 0,
+                f"entry {short}... records ndev={ent_ndev} but its key "
+                f"names {key_ndev} device(s) — served on the wrong "
+                f"topology this plan resharded silently",
+                scope=short, token=f"ndev:{key[:40]}"))
+            continue
+        if key_axes != structural:
+            findings.append(make_finding(
+                "FLX506", rel, 0,
+                f"entry {short}... key axes {key_axes} are not the "
+                f"structural factorization {structural} this package "
+                f"builds for {key_ndev} device(s)",
+                scope=short, token=f"axes:{key[:40]}"))
+            continue
+        for op_name, d in sorted((entry.get("strategies") or {}).items()):
+            try:
+                pc = _pc_from_json(d)
+            except (KeyError, TypeError, ValueError) as e:
+                findings.append(make_finding(
+                    "FLX506", rel, 0,
+                    f"entry {short}... op {op_name!r} fails to decode "
+                    f"({e})", scope=short, token=f"{op_name}:{key[:40]}"))
+                continue
+            if not assignable(pc.degrees, structural):
+                findings.append(make_finding(
+                    "FLX506", rel, 0,
+                    f"entry {short}... op {op_name!r} degrees "
+                    f"{list(pc.degrees)} cannot assign on the "
+                    f"{key_ndev}-device mesh (axes {structural}) the "
+                    f"entry is keyed for", scope=short,
+                    token=f"{op_name}:{key[:40]}"))
+    return findings
+
+
 def _parse_axes(spec: str) -> List[Tuple[str, int]]:
     """--axes dcn:2,ici:4 -> [("dcn", 2), ("ici", 4)]."""
     out = []
@@ -458,6 +542,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="also project the plan onto this many surviving "
                          "devices and report elastic-clamp hazards "
                          "(FLX505)")
+    ap.add_argument("--plan-cache", default=None, metavar="DIR",
+                    help="also audit every entry of the persistent plan "
+                         "cache in DIR (utils/warmcache.PlanCache — "
+                         "what elastic recover()/expand() warm-start "
+                         "from) against its recorded mesh signature "
+                         "(FLX506)")
     ap.add_argument("--audit", action="store_true",
                     help="additionally AOT-lower the train step on the "
                          "attached devices and audit the compiled HLO "
@@ -480,12 +570,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             if rid.startswith("FLX5"):
                 print(f"{rid}  {name:<26} {sev:<7} {doc}")
         return 0
-    if not args.paths:
-        ap.error("no strategy files given (or use --list-rules)")
+    if not args.paths and not args.plan_cache:
+        ap.error("no strategy files given (or use --plan-cache / "
+                 "--list-rules)")
 
     topology = _parse_axes(args.axes) if args.axes else None
     hbm = args.hbm_gb * 1e9 if args.hbm_gb else None
     findings: List[Finding] = []
+    if args.plan_cache:
+        try:
+            findings.extend(audit_plan_cache(args.plan_cache))
+        except (ValueError, OSError) as e:
+            print(f"shardcheck: plan-cache audit failed: {e}",
+                  file=sys.stderr)
+            return 2
     for path in args.paths:
         try:
             findings.extend(verify_file(

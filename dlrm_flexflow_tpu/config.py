@@ -177,6 +177,20 @@ class FFConfig:
     # re-raised (a flapping fleet must not loop forever). Set with
     # --max-recoveries N.
     max_recoveries: int = 3
+    # elastic scale-UP: when ON, returned devices (ParticipantRegistry
+    # heartbeats from a re-admitted host / FF_FAULT_RETURN_DEVICE) raise
+    # a typed MeshReturned at a step boundary and fit() grows the mesh
+    # back via parallel.elastic.expand — the inverse of the shrink
+    # recovery above. Requires elastic != "off". Set with
+    # --elastic-expand.
+    elastic_expand: bool = False
+    # persistent warm caches (utils/warmcache.py): serialize AOT
+    # executables + MCMC plans so recoveries, expansions, and serving
+    # replica boots warm-start from disk instead of re-searching /
+    # recompiling. "" = off; "auto" = <checkpoint_dir>/cache (the caches
+    # live next to the manifest); any other value = that directory. Set
+    # with --compile-cache-dir {auto,PATH}.
+    compile_cache_dir: str = ""
     # ---- online serving (serve/engine.py InferenceEngine) -------------
     # largest dynamic batch per dispatch; requests coalesce up to this
     # and pad to the smallest power-of-two bucket, every bucket AOT-
@@ -232,6 +246,16 @@ class FFConfig:
     # share of traffic routed to the canary cohort while a canary
     # deploy is active. Set with --serve-canary-fraction F.
     serve_canary_fraction: float = 0.1
+    # ---- SLO-driven autoscaling (serve/autoscale.py Autoscaler) -------
+    # serving latency objective in ms: the autoscaler grows the fleet
+    # while sustained client-observed p99 exceeds this (0 disables the
+    # latency trigger; queue depth still applies). Set with
+    # --serve-slo-ms MS.
+    serve_slo_ms: float = 0.0
+    # fleet size bounds the autoscaler operates within. Set with
+    # --serve-min-replicas N / --serve-max-replicas N.
+    serve_min_replicas: int = 1
+    serve_max_replicas: int = 8
     # LRU cap on the eval-path AOT executable cache (_eval_step_execs):
     # serving many ad-hoc shapes must not leak executables. Evictions
     # are counted (FFModel.eval_exec_cache_stats / engine stats()). Set
@@ -348,6 +372,10 @@ class FFConfig:
                 cfg.elastic_search_budget = int(take())
             elif a == "--max-recoveries":
                 cfg.max_recoveries = int(take())
+            elif a == "--elastic-expand":
+                cfg.elastic_expand = True
+            elif a == "--compile-cache-dir":
+                cfg.compile_cache_dir = take()
             elif a == "--host-tables":
                 cfg.host_resident_tables = True
             elif a == "--host-tables-async":
@@ -403,6 +431,20 @@ class FFConfig:
                 cfg.serve_hedge_ms = float(take())
             elif a == "--serve-canary-fraction":
                 cfg.serve_canary_fraction = float(take())
+            elif a == "--serve-slo-ms":
+                cfg.serve_slo_ms = float(take())
+            elif a == "--serve-min-replicas":
+                cfg.serve_min_replicas = int(take())
+                if cfg.serve_min_replicas < 1:
+                    raise ValueError(
+                        f"--serve-min-replicas expects N >= 1, got "
+                        f"{cfg.serve_min_replicas}")
+            elif a == "--serve-max-replicas":
+                cfg.serve_max_replicas = int(take())
+                if cfg.serve_max_replicas < 1:
+                    raise ValueError(
+                        f"--serve-max-replicas expects N >= 1, got "
+                        f"{cfg.serve_max_replicas}")
             elif a == "--eval-exec-cache":
                 cfg.eval_exec_cache = int(take())
             elif a == "--stage-dataset":

@@ -36,7 +36,7 @@ from ..config import FFConfig
 from ..parallel.mesh import make_mesh
 from ..parallel.pconfig import ParallelConfig, StrategyMap
 from ..parallel.sharding import AxisAssigner
-from ..parallel.distributed import MeshDegraded, put_global
+from ..parallel.distributed import MeshDegraded, MeshReturned, put_global
 from ..utils.profiling import superstep_annotation
 from ..utils.watchdog import StallReport, WorkerStalled
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -1384,6 +1384,100 @@ class FFModel:
             (k, v.shape, _dname(v.dtype), _shs(v))
             for k, v in device_batch.items()))
 
+    # --- persistent warm caches (utils/warmcache.py) -------------------
+    def attach_compile_cache(self, cache) -> None:
+        """Attach a persistent :class:`~..utils.warmcache.CompileCache`
+        (or a directory path) so AOT train/eval/superstep executables
+        serialize to disk and later boots/recoveries load instead of
+        recompiling. Survives ``compile()``/elastic reshards — the
+        in-memory exec dicts reset, the disk cache persists."""
+        if isinstance(cache, str):
+            from ..utils.warmcache import CompileCache
+            cache = CompileCache(cache)
+        self._compile_cache = cache
+
+    def attach_plan_cache(self, cache) -> None:
+        """Attach a persistent :class:`~..utils.warmcache.PlanCache` so
+        elastic ``recover()``/``expand()`` re-plans warm-start from disk
+        instead of re-running the MCMC search."""
+        if isinstance(cache, str):
+            from ..utils.warmcache import PlanCache
+            cache = PlanCache(cache)
+        self._plan_cache = cache
+
+    def compile_cache_stats(self) -> Optional[Dict[str, Any]]:
+        cache = getattr(self, "_compile_cache", None)
+        return None if cache is None else cache.stats()
+
+    def _cached_compile(self, kind: str, shape_key, lower,
+                        fresh: bool = False):
+        """lower().compile() through the persistent CompileCache when
+        one is attached: a hit deserializes the stored executable (ms)
+        instead of recompiling (s); misses and EVERY invalid entry
+        (torn, stale code, wrong mesh) compile fresh and re-store.
+        `fresh=True` skips the lookup — the GSPMD
+        recompile-on-sharding-disagree fallback must not re-load the
+        very entry that just disagreed."""
+        cache = getattr(self, "_compile_cache", None)
+        if cache is None:
+            return lower().compile()
+        ckey = cache.exec_key(kind, self, shape_key)
+        if not fresh:
+            exec_ = cache.get(ckey)
+            if exec_ is not None:
+                return exec_
+        exec_ = lower().compile()
+        cache.put(ckey, exec_)
+        return exec_
+
+    def _maybe_return_devices(self, k: int = 1) -> None:
+        """Scale-UP detection at a dispatch boundary: when elastic
+        expansion is enabled and the fault plan (or a registry poll)
+        reports devices RETURNED at any of the next `k` steps (a fused
+        superstep checks its whole window, like the drop hook), raise
+        the typed :class:`MeshReturned` BEFORE dispatch — symmetric with
+        the drop-device hook, so no state for this step is half-applied
+        and fit()'s expansion resumes exactly where the shrink path
+        does."""
+        if not getattr(self.config, "elastic_expand", False):
+            return
+        nret = 0
+        for s in range(max(int(k), 1)):
+            nret += faults.take_return_device(self._step + s)
+        if not nret:
+            return
+        in_mesh = {id(d) for d in self.mesh.devices.flat}
+        avail = [d for d in jax.devices() if id(d) not in in_mesh]
+        if not avail:
+            log_model.warning(
+                "fault-injected device return at step %d ignored: no "
+                "device outside the current %d-device mesh", self._step,
+                self.mesh.size)
+            return
+        returned = avail[:nret]
+        raise MeshReturned(
+            f"fault-injected return of {len(returned)} device(s) at "
+            f"step {self._step}", returned=returned)
+
+    def _attach_configured_caches(self, checkpoint_dir=None) -> None:
+        """Open the persistent plan/compile caches per
+        ``FFConfig.compile_cache_dir`` ("" = off, "auto" = next to the
+        checkpoint manifest, else an explicit path) and attach them,
+        keeping any caches the caller attached explicitly."""
+        configured = getattr(self.config, "compile_cache_dir", "") or ""
+        if not configured:
+            return
+        if (getattr(self, "_plan_cache", None) is not None
+                and getattr(self, "_compile_cache", None) is not None):
+            return
+        from ..utils.warmcache import open_caches
+        plan, comp = open_caches(checkpoint_dir, configured)
+        if plan is not None and getattr(self, "_plan_cache", None) is None:
+            self._plan_cache = plan
+        if comp is not None and getattr(self, "_compile_cache",
+                                        None) is None:
+            self._compile_cache = comp
+
     def _stage_step(self, batch: Dict[str, np.ndarray],
                     with_label: bool = True) -> "StagedStep":
         """Fully stage one host batch for the jitted step: H2D put against
@@ -1567,6 +1661,7 @@ class FFModel:
                     # steps in the scan must stay clean, exactly like
                     # the K=1 path poisons one step's batch
                     sbatch = faults.poison_batch(sbatch, row=s)
+            self._maybe_return_devices(k)
         args = (self.params, self.opt_state, self.op_state, self._msums,
                 sbatch, self._step_dev)
         key = (k,) + self._exec_key(sbatch)
@@ -1575,7 +1670,8 @@ class FFModel:
             execs = self._superstep_execs = {}
         exec_ = execs.get(key)
         if exec_ is None:
-            exec_ = execs[key] = self._superstep_fn.lower(*args).compile()
+            exec_ = execs[key] = self._cached_compile(
+                "superstep", key, lambda: self._superstep_fn.lower(*args))
         with superstep_annotation(self._step, k,
                                   enabled=bool(self.config.profile_dir)):
             try:
@@ -1585,8 +1681,9 @@ class FFModel:
                 # the K=1 dispatch
                 if not _sharding_mismatch(e):
                     raise
-                exec_ = execs[key] = self._superstep_fn.lower(
-                    *args).compile()
+                exec_ = execs[key] = self._cached_compile(
+                    "superstep", key,
+                    lambda: self._superstep_fn.lower(*args), fresh=True)
                 outs = exec_(*args)
         (self.params, self.opt_state, self.op_state, self._msums,
          self._step_dev, last, stacked) = outs
@@ -1628,6 +1725,7 @@ class FFModel:
                     f"fault-injected loss of {ndrop} device(s) at step "
                     f"{self._step}", lost=devs[len(devs) - ndrop:],
                     surviving=devs[:len(devs) - ndrop])
+            self._maybe_return_devices()
         if faults.active() is not None and faults.take_nan_grad(self._step):
             # fault harness: poison the batch so NaNs flow through the
             # REAL autodiff into the loss/grad-norm the sentinel watches
@@ -1651,7 +1749,8 @@ class FFModel:
             execs = self._train_step_execs = {}
         exec_ = execs.get(key)
         if exec_ is None:
-            exec_ = execs[key] = self._train_step.lower(*args).compile()
+            exec_ = execs[key] = self._cached_compile(
+                "train", key, lambda: self._train_step.lower(*args))
         try:
             outs = exec_(*args)
         except ValueError as e:
@@ -1661,7 +1760,9 @@ class FFModel:
             # before execution, so donated buffers are still intact)
             if not _sharding_mismatch(e):
                 raise
-            exec_ = execs[key] = self._train_step.lower(*args).compile()
+            exec_ = execs[key] = self._cached_compile(
+                "train", key, lambda: self._train_step.lower(*args),
+                fresh=True)
             outs = exec_(*args)
         (self.params, self.opt_state, self.op_state, self._msums,
          self._step_dev, mets) = outs
@@ -2210,7 +2311,8 @@ class FFModel:
             execs = self._eval_step_execs = OrderedDict()
         exec_ = execs.get(key)
         if exec_ is None:
-            exec_ = execs[key] = self._eval_step.lower(*args).compile()
+            exec_ = execs[key] = self._cached_compile(
+                "eval", key, lambda: self._eval_step.lower(*args))
             # LRU-bound the cache: a serving engine fed many ad-hoc
             # shapes must not leak one compiled executable per shape
             # forever (config.eval_exec_cache, 0/negative = unbounded)
@@ -2226,7 +2328,9 @@ class FFModel:
         except ValueError as e:
             if not _sharding_mismatch(e):
                 raise
-            exec_ = execs[key] = self._eval_step.lower(*args).compile()
+            exec_ = execs[key] = self._cached_compile(
+                "eval", key, lambda: self._eval_step.lower(*args),
+                fresh=True)
             return exec_(*args)
 
     def eval_exec_cache_stats(self) -> Dict[str, int]:
@@ -2370,9 +2474,19 @@ class FFModel:
         # --- fault tolerance: rolling checkpoints + auto-resume ---------
         mgr = None
         start_epoch = start_batch = 0
+        self._attach_configured_caches(checkpoint_dir)
         if checkpoint_dir:
             from ..utils.checkpoint import CheckpointManager
             mgr = CheckpointManager(checkpoint_dir, keep_last=keep_last)
+            cc = getattr(self, "_compile_cache", None)
+            if cc is not None:
+                # record the warm-cache location in the manifest so a
+                # serving host that mounts only the checkpoint dir can
+                # find the executables/plans published next to it
+                import os as _os
+                mgr.set_manifest_extra(
+                    "warm_cache_dir",
+                    _os.path.relpath(cc.directory, mgr.directory))
             if resume:
                 entry = mgr.restore_latest(self)
                 if entry is not None:
@@ -2389,7 +2503,7 @@ class FFModel:
                     "nothing to train", checkpoint_dir, start_epoch, epochs)
                 return {"elapsed": 0.0, "throughput": 0.0,
                         "num_samples": 0, "rollbacks": 0,
-                        "recoveries": 0,
+                        "recoveries": 0, "expansions": 0,
                         "metrics": self.perf.report()}
             if (getattr(self, "_anomaly_policy", "none") == "rollback"
                     or getattr(self.config, "elastic", "off") == "resume") \
@@ -2435,7 +2549,9 @@ class FFModel:
         wkey = self._exec_key(db)
         if wkey not in execs:
             try:
-                execs[wkey] = self._train_step.lower(*wargs).compile()
+                execs[wkey] = self._cached_compile(
+                    "train", wkey,
+                    lambda: self._train_step.lower(*wargs))
             except Exception as e:
                 if bs != self.config.batch_size:
                     raise ValueError(
@@ -2455,7 +2571,9 @@ class FFModel:
             if skey not in sexecs:
                 sargs = (self.params, self.opt_state, self.op_state,
                          self._msums, sdb, self._step_dev)
-                sexecs[skey] = self._superstep_fn.lower(*sargs).compile()
+                sexecs[skey] = self._cached_compile(
+                    "superstep", skey,
+                    lambda: self._superstep_fn.lower(*sargs))
 
         if self.config.profiling:
             # per-op timing report (reference --profiling cudaEvent prints,
@@ -2575,6 +2693,7 @@ class FFModel:
         rollbacks = 0
         max_rollbacks = getattr(self.config, "max_rollbacks", 3)
         recoveries = 0
+        expansions = 0
         max_recoveries = getattr(self.config, "max_recoveries", 3)
         elastic_mode = getattr(self.config, "elastic", "off")
 
@@ -2829,10 +2948,17 @@ class FFModel:
                         # rewound position (deterministic, so exact)
                         _build_pipe(epoch, b0)
                     continue
-                except (MeshDegraded, WorkerStalled) as exc:
-                    if elastic_mode == "off" or recoveries >= max_recoveries:
+                except (MeshDegraded, WorkerStalled,
+                        MeshReturned) as exc:
+                    grow = isinstance(exc, MeshReturned)
+                    if elastic_mode == "off" or (
+                            recoveries if not grow else
+                            expansions) >= max_recoveries:
                         raise
-                    recoveries += 1
+                    if grow:
+                        expansions += 1
+                    else:
+                        recoveries += 1
                     inflight.clear()
                     _close_pipe()
                     if mgr is not None:
@@ -2843,9 +2969,18 @@ class FFModel:
                                 "background checkpoint save failed "
                                 "during elastic recovery (%s); older "
                                 "snapshots remain usable", save_exc)
-                    from ..parallel.elastic import recover
-                    report = recover(self, lost=getattr(exc, "lost", []),
-                                     mode=elastic_mode, manager=mgr)
+                    from ..parallel.elastic import expand, recover
+                    if grow:
+                        # scale-UP: capacity came back — regrow the mesh
+                        # (the inverse of the shrink below; resume
+                        # position logic is shared)
+                        report = expand(
+                            self, returned=getattr(exc, "returned", []),
+                            mode=elastic_mode, manager=mgr)
+                    else:
+                        report = recover(
+                            self, lost=getattr(exc, "lost", []),
+                            mode=elastic_mode, manager=mgr)
                     if elastic_mode == "resume":
                         ls = (report.entry or {}).get("loader_state") or {}
                         epoch = int(ls.get("epoch", 0))
@@ -2863,10 +2998,13 @@ class FFModel:
                             e_, b_ = e_ + 1, 0
                         epoch, b0 = e_, b_
                     log_model.warning(
-                        "mesh degradation (%s); elastic recovery %d/%d "
-                        "(%s) onto %d device(s) — resuming at epoch %d, "
-                        "batch %d", exc, recoveries, max_recoveries,
-                        elastic_mode, report.surviving, epoch, b0)
+                        "%s (%s); elastic %s %d/%d (%s) onto %d "
+                        "device(s) — resuming at epoch %d, batch %d",
+                        "mesh growth" if grow else "mesh degradation",
+                        exc, "expansion" if grow else "recovery",
+                        expansions if grow else recoveries,
+                        max_recoveries, elastic_mode, report.surviving,
+                        epoch, b0)
                     if staged is not None:
                         # re-stage the dataset against the NEW mesh's
                         # input shardings (old-mesh arrays must not feed
@@ -2900,7 +3038,7 @@ class FFModel:
                   f"THROUGHPUT = {throughput:.2f} samples/s")
         return {"elapsed": elapsed, "throughput": throughput,
                 "num_samples": num_samples, "rollbacks": rollbacks,
-                "recoveries": recoveries,
+                "recoveries": recoveries, "expansions": expansions,
                 "metrics": self.perf.report()}
 
     # ------------------------------------------------------------------

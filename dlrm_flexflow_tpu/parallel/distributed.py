@@ -75,6 +75,25 @@ class MeshDegraded(RuntimeError):
         self.report = report   # optional utils.watchdog.StallReport
 
 
+class MeshReturned(RuntimeError):
+    """Lost capacity came BACK (a preempted host re-admitted, a repaired
+    chip re-enumerated). The typed inverse of :class:`MeshDegraded`:
+    ``parallel.elastic.expand`` catches this and re-plans onto the grown
+    device set instead of leaving a shrunken mesh shrunk forever.
+    ``returned`` lists the device (or host-id) objects that came back;
+    it may be empty when the detection path only knows a count."""
+
+    def __init__(self, reason: str, returned: Sequence = ()):
+        returned = list(returned)
+        msg = f"mesh capacity returned: {reason}"
+        if returned:
+            msg += (f" (returned {len(returned)}: "
+                    f"{[str(d) for d in returned]})")
+        super().__init__(msg)
+        self.reason = reason
+        self.returned = returned
+
+
 class ParticipantRegistry:
     """Heartbeat registry over the cluster's participants (hosts or
     devices).
@@ -87,6 +106,13 @@ class ParticipantRegistry:
     raises :class:`MeshDegraded` naming every participant whose last
     heartbeat is older than the deadline. Thread-safe — workers heartbeat
     from their own threads.
+
+    The registry also watches the OTHER direction: a heartbeat from a
+    participant it has never seen (a new host joining the job), or from
+    one it had written off as dead, marks that participant RETURNED.
+    :meth:`take_returned` drains the returned set — the scale-UP analog
+    of :meth:`check`, polled by the elastic layer to trigger
+    ``parallel.elastic.expand``.
     """
 
     def __init__(self, participants: Sequence, deadline_s: float = 30.0):
@@ -97,6 +123,7 @@ class ParticipantRegistry:
         self._lock = make_lock("ParticipantRegistry._lock")
         now = time.monotonic()
         self._last: Dict = {p: now for p in participants}
+        self._returned: List = []
 
     @property
     def participants(self) -> List:
@@ -104,8 +131,23 @@ class ParticipantRegistry:
             return list(self._last)
 
     def heartbeat(self, participant) -> None:
+        now = time.monotonic()
         with self._lock:
-            self._last[participant] = time.monotonic()
+            prev = self._last.get(participant)
+            if prev is None or now - prev > self.deadline_s:
+                # a brand-new participant, or one that had missed its
+                # deadline (mark_dead included): capacity came back
+                if participant not in self._returned:
+                    self._returned.append(participant)
+            self._last[participant] = now
+
+    def take_returned(self) -> List:
+        """Participants that (re)joined since the last call — new ids
+        and revived dead ones — in arrival order; drains the set. The
+        caller decides whether to grow (``parallel.elastic.expand``)."""
+        with self._lock:
+            out, self._returned = self._returned, []
+            return out
 
     def mark_dead(self, participant) -> None:
         """Force-expire a participant (external failure signal — e.g. a

@@ -32,6 +32,24 @@ host-gathered full arrays, so loading them through the model's freshly
 compiled ``_param_sharding`` (plain ``device_put`` per parameter) IS the
 gather-to-host → re-split per new partition degrees step; host-resident
 tables are already mesh-agnostic numpy.
+
+Scale-UP (:func:`expand`) is the inverse verb: when lost capacity comes
+BACK (``parallel.distributed.MeshReturned`` — registry heartbeats from a
+re-admitted host, or the ``FF_FAULT_RETURN_DEVICE`` hook on CPU test
+meshes), the model re-plans onto the GROWN device set
+(``search.replan.expand_strategies`` — the clamp machinery in reverse,
+warm-started from the remembered pre-shrink plan when one matches) and
+reshards the same way. A shrink followed by an expand is bit-identical
+to a fresh run on the large mesh from the same snapshot
+(tests/test_elastic.py pins this).
+
+Warm starts: both verbs consult the persistent plan + compile caches
+(``utils/warmcache``, attached to the model by ``fit()`` when
+``--compile-cache-dir`` is configured, or passed explicitly) so a
+recovery on a previously-seen topology skips the MCMC search and the
+first post-reshard dispatch loads its AOT executable instead of
+recompiling — seconds of downtime become milliseconds
+(benchmarks/bench_elastic.py measures both sides).
 """
 
 from __future__ import annotations
@@ -50,7 +68,8 @@ log_elastic = get_logger("elastic")
 
 @dataclass
 class RecoveryReport:
-    """What one elastic recovery did, with timings for bench_elastic."""
+    """What one elastic recovery (or expansion) did, with timings for
+    bench_elastic."""
 
     mode: str
     lost: List[Any]
@@ -62,6 +81,8 @@ class RecoveryReport:
     total_s: float = 0.0
     searched: bool = False          # MCMC ran (vs greedy clamp only)
     greedy_fallback: bool = False
+    kind: str = "recover"           # "recover" (shrink) | "expand" (grow)
+    plan_cache_hit: bool = False    # re-plan served from the PlanCache
     # manifest entry for "resume" mode (carries loader_state so fit can
     # rewind its (epoch, batch) position); None for "inplace"
     entry: Optional[Dict[str, Any]] = field(default=None, repr=False)
@@ -74,28 +95,106 @@ def surviving_devices(mesh, lost: Sequence) -> List:
             if id(d) not in lost_ids and str(d) not in lost_ids]
 
 
-def recover(model, lost: Sequence = (), mode: Optional[str] = None,
-            manager=None, budget: Optional[int] = None,
-            seed: int = 0) -> RecoveryReport:
-    """Re-plan + reshard `model` onto the devices surviving `lost`.
-
-    Steps: quiesce background workers → re-search strategies for the
-    surviving count (greedy fallback on failure/zero budget) → factorize
-    a fresh mesh → recompile the step functions → reshard params/opt
-    state/op state (from memory for ``inplace``, from the newest valid
-    snapshot via `manager` for ``resume``). Raises MeshDegraded when no
-    devices survive, ValueError on misuse (mode "off", resume without a
-    manager or restorable snapshot).
-    """
-    t_start = time.perf_counter()
+def _resolve_mode(model, mode: Optional[str], verb: str) -> str:
     cfg = getattr(model, "config", None)
     mode = mode or getattr(cfg, "elastic", "off")
     if mode not in ("resume", "inplace"):
         raise ValueError(
-            f"elastic recovery needs mode 'resume' or 'inplace', got "
+            f"elastic {verb} needs mode 'resume' or 'inplace', got "
             f"{mode!r} (set FFConfig.elastic / --elastic)")
-    if budget is None:
-        budget = int(getattr(cfg, "elastic_search_budget", 100) or 0)
+    return mode
+
+
+def _resolve_budget(model, budget: Optional[int]) -> int:
+    if budget is not None:
+        return int(budget)
+    cfg = getattr(model, "config", None)
+    return int(getattr(cfg, "elastic_search_budget", 100) or 0)
+
+
+def _reshard_onto(model, devices, strategies, mode: str, manager,
+                  degraded_reason: Optional[Sequence] = None
+                  ) -> tuple:
+    """Shared shrink/grow reshard: (optionally) gather in-memory state,
+    rebuild the mesh, recompile, restore. Returns (entry, reshard_s)."""
+    # inplace: gather current state to host BEFORE the recompile
+    # (device arrays stay valid either way — np.asarray reads any
+    # sharding — but gathering first keeps the invariant that a
+    # recompile failure leaves the model untouched)
+    flat = None
+    if mode == "inplace":
+        from ..utils.checkpoint import _model_flat
+        flat = _model_flat(model, copy_host=True)
+
+    # fresh factorized mesh + recompile the step. compile() rebuilds
+    # shardings, host-residency sets, and the jitted train/eval steps;
+    # the in-memory executable cache is dropped (a persistent
+    # CompileCache attached to the model survives, so the first
+    # post-reshard dispatch warm-starts from disk).
+    t_reshard = time.perf_counter()
+    new_mesh = make_mesh(devices=list(devices))
+    model.compile(optimizer=model.optimizer, loss_type=model.loss_type,
+                  metrics=model.metrics, mesh=new_mesh,
+                  strategies=strategies,
+                  final_tensor=model._preds_tensor)
+
+    entry = None
+    if mode == "inplace":
+        from ..utils.checkpoint import restore_from_flat
+        restore_from_flat(model, flat, source="<elastic inplace>")
+    else:
+        if manager is None:
+            raise ValueError(
+                'elastic mode "resume" needs a CheckpointManager '
+                "(fit(checkpoint_dir=...) provides one)")
+        entry = manager.restore_latest(model)
+        if entry is None:
+            raise MeshDegraded(
+                "no restorable snapshot for elastic resume (checkpoint "
+                "directory empty or all snapshots invalid)",
+                lost=list(degraded_reason or []))
+    return entry, time.perf_counter() - t_reshard
+
+
+def _remember_plan(model, mesh, strategies) -> None:
+    """Record (size, strategies) so a later expand() back to this device
+    count restores the exact pre-shrink intent — the round-trip
+    (shrink at j, expand at k) then reproduces the original plan and
+    stays bit-identical to a fresh large-mesh run."""
+    hist = getattr(model, "_elastic_history", None)
+    if hist is None:
+        hist = model._elastic_history = []
+    hist.append((int(mesh.size), dict(strategies or {})))
+
+
+def _recall_plan(model, ndev: int) -> Optional[StrategyMap]:
+    """The most recent remembered plan for exactly `ndev` devices."""
+    for size, strategies in reversed(getattr(model, "_elastic_history",
+                                             [])):
+        if size == int(ndev):
+            return dict(strategies)
+    return None
+
+
+def recover(model, lost: Sequence = (), mode: Optional[str] = None,
+            manager=None, budget: Optional[int] = None,
+            seed: int = 0, plan_cache=None) -> RecoveryReport:
+    """Re-plan + reshard `model` onto the devices surviving `lost`.
+
+    Steps: quiesce background workers → re-search strategies for the
+    surviving count (greedy fallback on failure/zero budget; served from
+    the attached/given PlanCache when the topology was seen before) →
+    factorize a fresh mesh → recompile the step functions → reshard
+    params/opt state/op state (from memory for ``inplace``, from the
+    newest valid snapshot via `manager` for ``resume``). Raises
+    MeshDegraded when no devices survive, ValueError on misuse (mode
+    "off", resume without a manager or restorable snapshot).
+    """
+    t_start = time.perf_counter()
+    mode = _resolve_mode(model, mode, "recovery")
+    budget = _resolve_budget(model, budget)
+    if plan_cache is None:
+        plan_cache = getattr(model, "_plan_cache", None)
     if model.mesh is None:
         raise ValueError("recover() needs a compiled model (no mesh)")
 
@@ -117,48 +216,17 @@ def recover(model, lost: Sequence = (), mode: Optional[str] = None,
             [str(d) for d in lost], len(survivors))
 
     # 2. re-plan parallelism for the surviving count (deterministic for
-    #    a fixed seed — the bit-identity contract depends on it)
+    #    a fixed seed — the bit-identity contract depends on it), and
+    #    remember the pre-shrink plan so a later expand() back to this
+    #    size restores the exact intent
     from ..search.replan import replan_strategies
+    _remember_plan(model, old_mesh, model.strategies)
     strategies, info = replan_strategies(
         model, len(survivors), old=model.strategies, budget=budget,
-        seed=seed)
+        seed=seed, plan_cache=plan_cache)
 
-    # 3. inplace: gather current state to host BEFORE the recompile
-    #    (device arrays stay valid either way — np.asarray reads any
-    #    sharding — but gathering first keeps the invariant that a
-    #    recompile failure leaves the model untouched)
-    flat = None
-    if mode == "inplace":
-        from ..utils.checkpoint import _model_flat
-        flat = _model_flat(model, copy_host=True)
-
-    # 4. fresh factorized mesh over the survivors + recompile the step.
-    #    compile() rebuilds shardings, host-residency sets, and the
-    #    jitted train/eval steps; the executable cache is dropped.
-    t_reshard = time.perf_counter()
-    new_mesh = make_mesh(devices=survivors)
-    model.compile(optimizer=model.optimizer, loss_type=model.loss_type,
-                  metrics=model.metrics, mesh=new_mesh,
-                  strategies=strategies,
-                  final_tensor=model._preds_tensor)
-
-    # 5. reshard state onto the new mesh
-    entry = None
-    if mode == "inplace":
-        from ..utils.checkpoint import restore_from_flat
-        restore_from_flat(model, flat, source="<elastic inplace>")
-    else:
-        if manager is None:
-            raise ValueError(
-                'elastic mode "resume" needs a CheckpointManager '
-                "(fit(checkpoint_dir=...) provides one)")
-        entry = manager.restore_latest(model)
-        if entry is None:
-            raise MeshDegraded(
-                "no restorable snapshot for elastic resume (checkpoint "
-                "directory empty or all snapshots invalid)",
-                lost=list(lost))
-    reshard_s = time.perf_counter() - t_reshard
+    entry, reshard_s = _reshard_onto(model, survivors, strategies, mode,
+                                     manager, degraded_reason=lost)
 
     report = RecoveryReport(
         mode=mode, lost=list(lost), surviving=len(survivors),
@@ -168,11 +236,107 @@ def recover(model, lost: Sequence = (), mode: Optional[str] = None,
         total_s=time.perf_counter() - t_start,
         searched=bool(info.get("searched", False)),
         greedy_fallback=bool(info.get("greedy_fallback", False)),
+        kind="recover",
+        plan_cache_hit=bool(info.get("plan_cache_hit", False)),
         entry=entry)
     log_elastic.warning(
         "elastic recovery (%s): %d -> %d devices, replan %.0f ms "
         "(%s), reshard %.0f ms, resuming at step %d",
         mode, old_mesh.size, len(survivors), 1e3 * report.replan_s,
-        "searched" if report.searched else "greedy clamp",
+        "plan cache" if report.plan_cache_hit
+        else ("searched" if report.searched else "greedy clamp"),
         1e3 * report.reshard_s, report.step)
+    return report
+
+
+def _canonical_device_order(devices) -> List:
+    """Stable full-mesh device order: by device id when every device has
+    one (the order ``jax.devices()`` enumerates), else by string. A
+    shrink that lost the middle of the mesh followed by an expand must
+    rebuild the SAME mesh a fresh job on the full device set would —
+    the bit-identity contract is over device order too."""
+    if all(getattr(d, "id", None) is not None for d in devices):
+        return sorted(devices, key=lambda d: int(d.id))
+    return sorted(devices, key=str)
+
+
+def expand(model, returned: Sequence = (), mode: Optional[str] = None,
+           manager=None, budget: Optional[int] = None,
+           seed: int = 0, plan_cache=None) -> RecoveryReport:
+    """Grow `model` back onto its current devices PLUS `returned` — the
+    inverse of :func:`recover` (ROADMAP item 4's missing half: a
+    shrunken mesh no longer stays shrunk forever).
+
+    Steps: quiesce → un-clamp strategies for the grown count
+    (``search.replan.expand_strategies``, warm-started from the
+    remembered pre-shrink plan when one matches the target size;
+    ``ClampError`` with op + reason when growth would violate row-shard
+    quanta) → fresh factorized mesh over the grown set in canonical
+    device order → recompile → reshard (from memory for ``inplace``,
+    from the newest valid snapshot for ``resume``). The result is
+    bit-identical to a fresh run on the large mesh from the same
+    snapshot (tests pin it). Raises :class:`MeshReturned`-flavored
+    ValueError on misuse (no returned devices, devices already in the
+    mesh), ValueError on mode "off".
+    """
+    t_start = time.perf_counter()
+    mode = _resolve_mode(model, mode, "expansion")
+    budget = _resolve_budget(model, budget)
+    if plan_cache is None:
+        plan_cache = getattr(model, "_plan_cache", None)
+    if model.mesh is None:
+        raise ValueError("expand() needs a compiled model (no mesh)")
+
+    old_mesh = model.mesh
+    cur = list(old_mesh.devices.flat)
+    cur_ids = {id(d) for d in cur} | {str(d) for d in cur}
+    fresh = [d for d in returned
+             if id(d) not in cur_ids and str(d) not in cur_ids]
+    if not fresh:
+        raise ValueError(
+            "expand() needs at least one returned device that is not "
+            "already part of the mesh (got "
+            f"{[str(d) for d in returned] or 'none'})")
+    if len(fresh) < len(list(returned)):
+        log_elastic.warning(
+            "%d returned device(s) were already in the mesh; growing by "
+            "the remaining %d", len(list(returned)) - len(fresh),
+            len(fresh))
+    grown = _canonical_device_order(cur + fresh)
+
+    # quiesce exactly like recover: nothing may scatter into state that
+    # is about to reshard
+    if hasattr(model, "_host_abandon"):
+        model._host_abandon()
+
+    # re-plan for the grown count: the remembered pre-shrink plan for
+    # this exact size is the intent (round-trip restores the original
+    # plan); otherwise the running plan un-clamps / re-searches
+    from ..search.replan import expand_strategies
+    orig = _recall_plan(model, len(grown))
+    strategies, info = expand_strategies(
+        model, len(grown), old=model.strategies, orig=orig,
+        budget=budget, seed=seed, plan_cache=plan_cache)
+
+    entry, reshard_s = _reshard_onto(model, grown, strategies, mode,
+                                     manager)
+
+    report = RecoveryReport(
+        mode=mode, lost=[], surviving=len(grown),
+        strategies=strategies, step=int(model._step),
+        replan_s=float(info.get("replan_s", 0.0)),
+        reshard_s=reshard_s,
+        total_s=time.perf_counter() - t_start,
+        searched=bool(info.get("searched", False)),
+        greedy_fallback=bool(info.get("greedy_fallback", False)),
+        kind="expand",
+        plan_cache_hit=bool(info.get("plan_cache_hit", False)),
+        entry=entry)
+    log_elastic.warning(
+        "elastic expansion (%s): %d -> %d devices (%s plan%s), replan "
+        "%.0f ms, reshard %.0f ms, resuming at step %d",
+        mode, old_mesh.size, len(grown),
+        "remembered pre-shrink" if orig is not None else "un-clamped",
+        " via plan cache" if report.plan_cache_hit else "",
+        1e3 * report.replan_s, 1e3 * report.reshard_s, report.step)
     return report

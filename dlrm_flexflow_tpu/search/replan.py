@@ -26,6 +26,24 @@ Two layers:
   from the clamped map so the walk starts from a feasible, near-optimal
   point. Deterministic for a fixed seed — the elastic bit-identity test
   relies on an independent caller reproducing the same plan.
+- :func:`expand_strategies` — the INVERSE projection, for scale-UP
+  (``parallel.elastic.expand``): un-clamp a running plan onto a GROWN
+  device count. The machinery is the clamp run in reverse: the intent
+  plan (the remembered pre-shrink map when the elastic layer has one,
+  else the running plan) projects onto the larger factorized mesh with
+  the same per-op feasibility repair, so row-shard degrees grow back
+  only to counts that still equal-block the table rows (the row-shard
+  quantum) and a growth that would force an infeasible layout is
+  REJECTED with op + reason (:class:`ClampError`), exactly like an
+  infeasible shrink.
+
+Both re-planners consult an optional :class:`~..utils.warmcache.PlanCache`
+keyed by (graph, topology, warm-start, budget, seed): the search is
+deterministic per key, so a cache hit returns byte-for-byte the plan a
+fresh search would have produced — recovery skips the MCMC walk without
+touching the bit-identity contract. Corrupt or wrong-topology entries are
+rejected by the cache itself (reject-with-reason) and the search runs
+fresh.
 """
 
 from __future__ import annotations
@@ -175,26 +193,39 @@ def clamp_strategies(model, strategies: Optional[StrategyMap],
     return out
 
 
-def replan_strategies(model, ndev: int,
-                      old: Optional[StrategyMap] = None,
-                      budget: int = 100, seed: int = 0,
-                      cost_model=None,
-                      ) -> Tuple[StrategyMap, Dict[str, float]]:
-    """Re-plan the per-op strategy map for `ndev` surviving devices.
+def _plan_cache_key(model, intent: StrategyMap, ndev: int, budget: int,
+                    seed: int) -> str:
+    from ..parallel.mesh import structural_axis_sizes as _sas
+    from ..utils.warmcache import (PlanCache, graph_fingerprint,
+                                   strategy_signature)
+    return (PlanCache.key(graph_fingerprint(model), ndev, _sas(ndev),
+                          budget, seed)
+            + f"|start={strategy_signature(intent)}")
 
-    Returns ``(strategies, info)`` where info carries ``replan_s`` (wall
-    time), ``searched`` (whether the MCMC walk actually ran) and
-    ``greedy_fallback`` (True when the search failed or the budget was
-    exhausted and the clamped map shipped as-is). Deterministic for fixed
-    (model, ndev, old, budget, seed). An INFEASIBLE projection raises
-    :class:`ClampError` before any search — there is no survivable plan
-    to fall back to, and the caller's recovery must surface the named
-    op + reason rather than OOM blind.
-    """
+
+def _searched_plan(model, intent: StrategyMap, ndev: int, budget: int,
+                   seed: int, cost_model, plan_cache,
+                   hbm_bytes=None) -> Tuple[StrategyMap, Dict[str, float]]:
+    """Shared shrink/grow core: project `intent` onto `ndev` (may raise
+    ClampError), then search from the projection under `budget` —
+    consulting/filling the plan cache around the whole thing. The cache
+    key pins (graph, topology, warm-start, budget, seed), every input
+    the deterministic result depends on."""
     t0 = time.perf_counter()
-    old = old if old is not None else dict(model.strategies or {})
-    greedy = clamp_strategies(model, old, ndev)
-    info: Dict[str, float] = {"searched": False, "greedy_fallback": True}
+    info: Dict[str, float] = {"searched": False, "greedy_fallback": True,
+                              "plan_cache_hit": False}
+    key = None
+    if plan_cache is not None:
+        key = _plan_cache_key(model, intent, ndev, budget, seed)
+        hit = plan_cache.get(key, ndev)
+        if hit is not None:
+            info["searched"] = bool(hit["searched"])
+            info["greedy_fallback"] = not hit["searched"]
+            info["plan_cache_hit"] = True
+            info["replan_s"] = time.perf_counter() - t0
+            return hit["strategies"], info
+    greedy = clamp_strategies(model, intent, ndev,
+                              hbm_bytes=hbm_bytes)
     best = greedy
     if budget and budget > 0:
         try:
@@ -212,5 +243,62 @@ def replan_strategies(model, ndev: int,
                 "strategy re-search failed (%s); recovering on the "
                 "greedy clamped plan", e)
             best = greedy
+    if plan_cache is not None:
+        plan_cache.put(key, best, ndev, searched=bool(info["searched"]))
     info["replan_s"] = time.perf_counter() - t0
     return best, info
+
+
+def replan_strategies(model, ndev: int,
+                      old: Optional[StrategyMap] = None,
+                      budget: int = 100, seed: int = 0,
+                      cost_model=None, plan_cache=None,
+                      hbm_bytes=None,
+                      ) -> Tuple[StrategyMap, Dict[str, float]]:
+    """Re-plan the per-op strategy map for `ndev` surviving devices.
+
+    Returns ``(strategies, info)`` where info carries ``replan_s`` (wall
+    time), ``searched`` (whether the MCMC walk actually ran),
+    ``greedy_fallback`` (True when the search failed or the budget was
+    exhausted and the clamped map shipped as-is) and ``plan_cache_hit``.
+    Deterministic for fixed (model, ndev, old, budget, seed) — with or
+    without a `plan_cache` (the cache key pins all of those, so a hit IS
+    the plan a fresh search would produce). An INFEASIBLE projection
+    raises :class:`ClampError` before any search — there is no
+    survivable plan to fall back to, and the caller's recovery must
+    surface the named op + reason rather than OOM blind.
+    """
+    old = old if old is not None else dict(model.strategies or {})
+    return _searched_plan(model, old, ndev, budget, seed, cost_model,
+                          plan_cache, hbm_bytes=hbm_bytes)
+
+
+def expand_strategies(model, ndev: int,
+                      old: Optional[StrategyMap] = None,
+                      orig: Optional[StrategyMap] = None,
+                      budget: int = 100, seed: int = 0,
+                      cost_model=None, plan_cache=None,
+                      hbm_bytes=None,
+                      ) -> Tuple[StrategyMap, Dict[str, float]]:
+    """Un-clamp the per-op strategy map onto a GROWN `ndev` (scale-UP).
+
+    The intent projected onto the larger mesh is `orig` — the remembered
+    pre-shrink plan, when the elastic layer has one for this device
+    count — falling back to the running plan `old` per op. Projection is
+    the PR 8 clamp machinery run in reverse: degrees grow back to the
+    largest feasible values dividing the intent, row-shard degrees only
+    to counts that still equal-block the table rows (the row-shard
+    quantum), and a growth that would force an infeasible layout (a
+    row-sharded table that can neither reshard onto the grown mesh nor
+    fit replicated in HBM) raises :class:`ClampError` with op + reason
+    instead of shipping a plan that OOMs mid-expand.
+
+    Returns ``(strategies, info)`` with the same info keys (and the same
+    determinism + plan-cache contract) as :func:`replan_strategies`.
+    """
+    old = old if old is not None else dict(model.strategies or {})
+    intent = dict(orig or {})
+    for name, pc in old.items():
+        intent.setdefault(name, pc)
+    return _searched_plan(model, intent, ndev, budget, seed, cost_model,
+                          plan_cache, hbm_bytes=hbm_bytes)

@@ -4,6 +4,7 @@ host-resident tables, zero-downtime snapshot hot reload, and a
 fault-tolerant multi-replica fleet router with canary/shadow rollout.
 See engine.py / router.py for the design notes."""
 
+from .autoscale import AutoscaleConfig, Autoscaler
 from .cache import EmbeddingCache
 from .engine import (DeadlineExceeded, InferenceEngine, Overloaded,
                      Prediction, ReplicaDown, ServeConfig, percentile)
@@ -14,4 +15,5 @@ from .watcher import SnapshotWatcher
 __all__ = ["InferenceEngine", "ServeConfig", "Prediction", "Overloaded",
            "DeadlineExceeded", "ReplicaDown", "EmbeddingCache",
            "SnapshotWatcher", "Fleet", "Replica", "FleetRouter",
-           "FleetUnavailable", "RouterConfig", "percentile"]
+           "FleetUnavailable", "RouterConfig", "percentile",
+           "Autoscaler", "AutoscaleConfig"]

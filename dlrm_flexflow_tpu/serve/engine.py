@@ -218,6 +218,12 @@ class InferenceEngine:
                 and getattr(model, "_host_resident_list", None)):
             self._cache = EmbeddingCache(self.config.cache_rows)
         self._checkpoint_dir = checkpoint_dir
+        # persistent compile cache (utils/warmcache.py): when the model
+        # config enables one, bucket warmup deserializes stored AOT
+        # executables instead of recompiling — a replica cold start (or
+        # autoscaler grow) costs milliseconds on a cache hit
+        if hasattr(model, "_attach_configured_caches"):
+            model._attach_configured_caches(checkpoint_dir)
         self._watcher = None
         # queue + batcher state
         self._q: "deque[_Request]" = deque()
@@ -869,6 +875,9 @@ class InferenceEngine:
         }
         if self.replica_id is not None:
             out["replica_id"] = self.replica_id
+        cc = getattr(self._model, "_compile_cache", None)
+        if cc is not None:
+            out["compile_cache"] = cc.stats()
         if self._cache is not None:
             out["embedding_cache"] = self._cache.stats()
         if self._watcher is not None:

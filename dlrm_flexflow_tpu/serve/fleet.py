@@ -52,14 +52,19 @@ class Replica:
     """
 
     def __init__(self, engine: InferenceEngine, rid: int,
-                 cohort: str = "stable"):
+                 cohort: str = "stable", state: str = HEALTHY):
         self.engine = engine
         self.rid = rid
         # deployment cohort: "stable" serves normal traffic, "canary"
         # serves the routed fraction on a candidate snapshot, "shadow"
         # serves only duplicated traffic and never answers a client
         self.cohort = cohort
-        self.state = HEALTHY
+        self.state = state
+        # a freshly-grown replica is born PROBING (`state=PROBING`) and
+        # carries this flag: it receives NO client traffic until the
+        # router's end-to-end admission probe succeeds — a replica that
+        # boots broken costs a probe failure, never a client error
+        self.awaiting_admission = state == PROBING
         self._lock = make_lock(f"Replica._lock[{rid}]")
         self.consecutive_errors = 0
         self.ejected_at = 0.0
@@ -119,13 +124,16 @@ class Replica:
 
     def due_for_probe(self, cooldown_s: float) -> bool:
         with self._lock:
-            return (self.state == EJECTED
+            if self.awaiting_admission:     # born-PROBING (Fleet.grow):
+                return True                 # admission probe runs at the
+            return (self.state == EJECTED   # next health tick, no cooldown
                     and time.monotonic() - self.ejected_at >= cooldown_s)
 
     def begin_probe(self) -> None:
         with self._lock:
             if self.state == EJECTED:
                 self.state = PROBING
+            self.awaiting_admission = False
             self.probes += 1
 
     def probe_failed(self, reason: str) -> None:
@@ -180,26 +188,51 @@ class Replica:
 
 
 class Fleet:
-    """The replica set: lifecycle + fleet-wide stats aggregation.
+    """The replica set: lifecycle, elastic grow/shrink, and fleet-wide
+    stats aggregation.
 
     Construct from engines (``replica_id`` is assigned positionally when
     the engine doesn't carry one) or via :meth:`build` from a model
     factory — each replica needs its OWN model instance (its own param
     arrays to hot-swap independently); data-parallelism comes from every
-    model being compiled/restored identically.
+    model being compiled/restored identically. A fleet built with a
+    factory can also :meth:`grow` (new replicas boot from the persistent
+    compile cache when one is configured, enter PROBING, and are
+    admitted only after the router's end-to-end probe succeeds) and
+    :meth:`shrink` back when idle — the verbs the SLO autoscaler
+    (``serve/autoscale.py``) drives.
     """
 
-    def __init__(self, engines: List[InferenceEngine]):
+    # bounded warm-up pool: N-replica cold start used to AOT-warm every
+    # bucket serially, making it N x single-replica warmup; replicas warm
+    # concurrently up to this many at a time (compilation is host-CPU
+    # work — unbounded parallelism would thrash the compiler)
+    WARM_POOL = 4
+
+    def __init__(self, engines: List[InferenceEngine],
+                 model_factory=None, config=None,
+                 checkpoint_dir: Optional[str] = None):
         if not engines:
             raise ValueError("a fleet needs at least one replica")
-        self.replicas: List[Replica] = []
+        # grow() provisioning recipe (None = fixed-size fleet)
+        self._factory = model_factory
+        self._config = config
+        self._checkpoint_dir = checkpoint_dir
+        # replicas list is COPY-ON-WRITE under this lock: readers (the
+        # router's pick/health loops) grab the current list reference
+        # without locking; grow/shrink build a new list and swap it
+        self._fleet_lock = make_lock("Fleet._fleet_lock")
+        self.grows = 0
+        self.shrinks = 0
+        replicas: List[Replica] = []
         for i, eng in enumerate(engines):
             if eng.replica_id is None:
                 eng.replica_id = i
-            self.replicas.append(Replica(eng, eng.replica_id))
-        rids = [r.rid for r in self.replicas]
+            replicas.append(Replica(eng, eng.replica_id))
+        rids = [r.rid for r in replicas]
         if len(set(rids)) != len(rids):
             raise ValueError(f"duplicate replica ids {rids}")
+        self.replicas = replicas
 
     @classmethod
     def build(cls, model_factory, n: int, config=None,
@@ -213,12 +246,14 @@ class Fleet:
         mesh would serialize (and on CPU can deadlock: two dispatches'
         collective participants interleave on the shared device set).
         A data-parallel fleet means N independent single-replica meshes,
-        not N views of one mesh."""
+        not N views of one mesh. The factory is retained so the
+        autoscaler can :meth:`grow` the fleet later."""
         engines = [InferenceEngine(model_factory(i), config,
                                    checkpoint_dir=checkpoint_dir,
                                    replica_id=i)
                    for i in range(n)]
-        return cls(engines)
+        return cls(engines, model_factory=model_factory, config=config,
+                   checkpoint_dir=checkpoint_dir)
 
     def __len__(self) -> int:
         return len(self.replicas)
@@ -241,10 +276,117 @@ class Fleet:
         return out
 
     # --- lifecycle -----------------------------------------------------
+    def _start_engines(self, replicas: List[Replica]) -> None:
+        """Start (and AOT-warm) a set of engines CONCURRENTLY through a
+        bounded pool of ff-named daemon threads, every one joined before
+        return. Bucket warmup is the dominant cold-start cost; with the
+        persistent compile cache attached each warm is a deserialize,
+        and either way N replicas no longer pay N serial warmups."""
+        import threading
+        if len(replicas) == 1:
+            replicas[0].engine.start()
+            return
+        errs: List[BaseException] = []
+        errs_lock = make_lock("Fleet._warm_errs_lock")
+        it = iter(list(replicas))
+        it_lock = make_lock("Fleet._warm_iter_lock")
+
+        def _worker():
+            while True:
+                with it_lock:
+                    rep = next(it, None)
+                if rep is None:
+                    return
+                try:
+                    rep.engine.start()
+                except BaseException as e:   # noqa: BLE001 — surface
+                    with errs_lock:          # after every join
+                        errs.append(e)
+
+        threads = [threading.Thread(target=_worker, daemon=True,
+                                    name=f"ff-fleet-warm-{i}")
+                   for i in range(min(self.WARM_POOL, len(replicas)))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
     def start(self) -> "Fleet":
-        for r in self.replicas:
-            r.engine.start()
+        self._start_engines(self.replicas)
         return self
+
+    # --- elastic size (driven by serve/autoscale.py) -------------------
+    @property
+    def can_grow(self) -> bool:
+        return self._factory is not None
+
+    def grow(self, n: int = 1) -> List[int]:
+        """Provision `n` new replicas from the retained factory: build
+        each model (booting from the persistent compile cache when the
+        config enables one), start+warm the engines concurrently, and
+        add them in PROBING state — the router's next health tick runs
+        the end-to-end admission probe and only success makes them
+        routable. Returns the new replica ids."""
+        if self._factory is None:
+            raise RuntimeError(
+                "this fleet was not built with Fleet.build(model_factory"
+                "=...); it has no recipe to provision new replicas from")
+        if n < 1:
+            raise ValueError(f"grow() needs n >= 1, got {n}")
+        with self._fleet_lock:
+            next_rid = max(r.rid for r in self.replicas) + 1
+        fresh: List[Replica] = []
+        for k in range(n):
+            rid = next_rid + k
+            eng = InferenceEngine(self._factory(rid), self._config,
+                                  checkpoint_dir=self._checkpoint_dir,
+                                  replica_id=rid)
+            fresh.append(Replica(eng, rid, state=PROBING))
+        self._start_engines(fresh)
+        with self._fleet_lock:
+            self.replicas = self.replicas + fresh
+            self.grows += n
+        ids = [r.rid for r in fresh]
+        log_fleet.warning("fleet grew by %d replica(s) %s (now %d); "
+                          "awaiting admission probes", n, ids,
+                          len(self.replicas))
+        return ids
+
+    def shrink(self, n: int = 1, deadline_s: float = 10.0) -> List[int]:
+        """Retire `n` healthy STABLE replicas (highest rid first —
+        canary/shadow cohorts and already-ejected replicas are never
+        chosen), always leaving at least one. Queued requests drain with
+        a typed ReplicaDown so the router retries them on survivors;
+        the engine then closes. Returns the retired replica ids."""
+        if n < 1:
+            raise ValueError(f"shrink() needs n >= 1, got {n}")
+        with self._fleet_lock:
+            victims = [r for r in self.replicas
+                       if r.state == HEALTHY and r.cohort == "stable"]
+            victims = sorted(victims, key=lambda r: r.rid)[-n:]
+            keep_floor = 1
+            while (len(self.replicas) - len(victims)) < keep_floor \
+                    and victims:
+                victims.pop()
+            if not victims:
+                return []
+            gone = {r.rid for r in victims}
+            self.replicas = [r for r in self.replicas
+                             if r.rid not in gone]
+            self.shrinks += len(victims)
+        for r in victims:
+            r.eject("retired by autoscaler shrink")
+            try:
+                r.engine.close(deadline_s)
+            except Exception as e:   # noqa: BLE001 — a wedged retiree
+                log_fleet.warning("shrink: replica %d close failed "
+                                  "(%s)", r.rid, e)
+        ids = [r.rid for r in victims]
+        log_fleet.warning("fleet shrank by %d replica(s) %s (now %d)",
+                          len(ids), ids, len(self.replicas))
+        return ids
 
     def close(self, deadline_s: float = 10.0) -> None:
         errs = []
@@ -281,4 +423,6 @@ class Fleet:
             "p99_ms": percentile(lat, 99),
             "totals": totals,
             "requests_dispatched": dispatched,
+            "grows": self.grows,
+            "shrinks": self.shrinks,
         }

@@ -433,6 +433,12 @@ class CheckpointManager:
     """
 
     MANIFEST = "manifest.json"
+    # conventional home of the persistent warm caches (plan + compile,
+    # utils/warmcache.py) — a SUBDIRECTORY next to the manifest, so the
+    # snapshot and the executables/plans that can serve it travel
+    # together, and the manager's tmp sweep / file GC (which only touch
+    # top-level files) never race a cache writer
+    CACHE_DIR = "cache"
 
     def __init__(self, directory: str, keep_last: int = 3):
         if keep_last < 1:
@@ -445,6 +451,13 @@ class CheckpointManager:
         from ..analysis.sanitizer import make_lock
         self._manifest_lock = make_lock("CheckpointManager._manifest_lock")
         self._sweep_orphan_tmps()
+
+    @property
+    def cache_dir(self) -> str:
+        """Where ``--compile-cache-dir auto`` puts the warm caches for
+        this checkpoint directory (the directory itself is created by
+        the caches on first use, not here)."""
+        return os.path.join(self.directory, self.CACHE_DIR)
 
     # --- manifest ------------------------------------------------------
     def _manifest_path(self) -> str:

@@ -29,6 +29,15 @@ reproducible points so every recovery branch runs under test:
   ``MeshDegraded`` on a healthy CPU mesh (the devices stay physically
   alive — only the runtime's view shrinks, which is exactly what a TPU
   preemption looks like from the surviving hosts).
+- **Device return** (`return_device_steps`): the inverse — at a chosen
+  global step, report N devices as having come BACK (a preempted host
+  re-admitted to the fleet), so the elastic scale-UP path
+  (``parallel.elastic.expand`` via a typed ``MeshReturned``) runs on a
+  healthy CPU mesh whose runtime view previously shrank.
+- **Cache corruption** (`corrupt_cache_entries`): truncate the next N
+  persistent warm-cache files (compile/plan cache, ``utils/warmcache``)
+  at the moment they are read, so the reject-with-reason →
+  fresh-compile degradation path is test-driven, not just written.
 - **Stalled workers/collectives** (`stall_s`): sleep a named site once —
   ``"collective"`` freezes the mesh-liveness probe
   (``parallel.distributed.probe_mesh``), ``"scatter"`` wedges the async
@@ -80,6 +89,10 @@ subprocess kill-test needs):
 - ``FF_FAULT_IO_ERRORS=ffbin_read:2``  2 transient IOErrors at that site
 - ``FF_FAULT_DROP_DEVICE=4:2``     lose 2 devices at global step 4
   (``=4`` alone loses 1 device at step 4)
+- ``FF_FAULT_RETURN_DEVICE=6:2``   2 lost devices come back at global
+  step 6 (``=6`` alone returns 1 device at step 6)
+- ``FF_FAULT_CACHE_CORRUPT=1``     truncate the next 1 warm-cache entry
+  file (compile/plan cache) as it is read
 - ``FF_FAULT_STALL_COLLECTIVE=3``  stall the next collective probe 3s
 - ``FF_FAULT_SERVE_DELAY=0.05``    sleep 50 ms inside EVERY serving batch
   dispatch (not consume-once); ``1:0.2`` delays only replica 1, and the
@@ -145,6 +158,15 @@ class FaultPlan:
     # global step -> number of devices to report lost at that step
     # (consume-once; drives parallel.elastic recovery on CPU meshes)
     drop_device_steps: Dict[int, int] = field(default_factory=dict)
+    # global step -> number of devices to report RETURNED at that step
+    # (consume-once; drives parallel.elastic.expand scale-UP — the
+    # inverse of drop_device_steps)
+    return_device_steps: Dict[int, int] = field(default_factory=dict)
+    # number of future warm-cache entry reads to corrupt (truncate the
+    # compile/plan cache file being opened; the read must reject with a
+    # reason and degrade to a fresh search/compile)
+    corrupt_cache_entries: int = 0
+    corrupt_cache_bytes: int = 16
     # site name ("collective", "scatter", "prefetch", ...) -> seconds to
     # sleep there once (consume-once; the watchdog deadline must fire)
     stall_s: Dict[str, float] = field(default_factory=dict)
@@ -205,10 +227,12 @@ _ENV_CHECKED = False
 _KNOWN_ENV_KEYS = ("FF_FAULT_NAN_STEPS", "FF_FAULT_TRUNCATE_CKPTS",
                    "FF_FAULT_ABORT_WRITES", "FF_FAULT_WRITE_DELAY",
                    "FF_FAULT_IO_ERRORS", "FF_FAULT_DROP_DEVICE",
+                   "FF_FAULT_RETURN_DEVICE",
                    "FF_FAULT_STALL_COLLECTIVE", "FF_FAULT_SERVE_DELAY",
                    "FF_FAULT_CORRUPT_RELOAD", "FF_FAULT_REPLICA_DOWN",
                    "FF_FAULT_POISON_RELOAD", "FF_FAULT_DELTA_TORN",
-                   "FF_FAULT_PUBLISH_ABORT", "FF_FAULT_DELTA_GAP")
+                   "FF_FAULT_PUBLISH_ABORT", "FF_FAULT_DELTA_GAP",
+                   "FF_FAULT_CACHE_CORRUPT")
 
 
 # --- strict env parsing ----------------------------------------------
@@ -285,6 +309,8 @@ def plan_from_env() -> Optional[FaultPlan]:
     delay = os.environ.get("FF_FAULT_WRITE_DELAY", "")
     ioerrs = os.environ.get("FF_FAULT_IO_ERRORS", "")
     drop = os.environ.get("FF_FAULT_DROP_DEVICE", "")
+    ret = os.environ.get("FF_FAULT_RETURN_DEVICE", "")
+    cache_corrupt = os.environ.get("FF_FAULT_CACHE_CORRUPT", "")
     stall_coll = os.environ.get("FF_FAULT_STALL_COLLECTIVE", "")
     serve_delay = os.environ.get("FF_FAULT_SERVE_DELAY", "")
     corrupt_reload = os.environ.get("FF_FAULT_CORRUPT_RELOAD", "")
@@ -293,7 +319,8 @@ def plan_from_env() -> Optional[FaultPlan]:
     delta_torn = os.environ.get("FF_FAULT_DELTA_TORN", "")
     publish_abort = os.environ.get("FF_FAULT_PUBLISH_ABORT", "")
     delta_gap = os.environ.get("FF_FAULT_DELTA_GAP", "")
-    if not any((nan, trunc, aborts, delay, ioerrs, drop, stall_coll,
+    if not any((nan, trunc, aborts, delay, ioerrs, drop, ret,
+                cache_corrupt, stall_coll,
                 serve_delay, corrupt_reload, replica_down,
                 poison_reload, delta_torn, publish_abort, delta_gap)):
         return None
@@ -324,6 +351,15 @@ def plan_from_env() -> Optional[FaultPlan]:
             plan.drop_device_steps[cnt] = 1
         else:                                 # "4:2" — 2 devices, step 4
             plan.drop_device_steps[step] = cnt
+    for step, cnt in _env_pairs("FF_FAULT_RETURN_DEVICE", ret, _env_int,
+                                bare=_env_int):
+        if step is None:                      # "=6" — one device, step 6
+            plan.return_device_steps[cnt] = 1
+        else:                                 # "6:2" — 2 devices, step 6
+            plan.return_device_steps[step] = cnt
+    if cache_corrupt:
+        plan.corrupt_cache_entries = _env_int("FF_FAULT_CACHE_CORRUPT",
+                                              cache_corrupt)
     if stall_coll:
         plan.stall_s["collective"] = _env_float(
             "FF_FAULT_STALL_COLLECTIVE", stall_coll)
@@ -417,6 +453,43 @@ def take_drop_device(step: int) -> int:
         if n:
             plan._record("drop_device", (step, n))
     return n
+
+
+def take_return_device(step: int) -> int:
+    """Number of devices reported RETURNED at this global step (0 =
+    none). Consume-once, like :func:`take_drop_device`: a recovery that
+    re-winds through the step does not re-grow."""
+    plan = active()
+    if plan is None:
+        return 0
+    with plan._lock:
+        n = plan.return_device_steps.pop(step, 0)
+        if n:
+            plan._record("return_device", (step, n))
+    return n
+
+
+def maybe_corrupt_cache(path: str) -> bool:
+    """Truncate a warm-cache entry file at the moment it is read
+    (simulated torn write / bit rot in the persistent compile/plan
+    cache). The reader must reject-with-reason and degrade to a fresh
+    search/compile — never crash, never load garbage."""
+    plan = active()
+    if plan is None:
+        return False
+    with plan._lock:
+        if plan.corrupt_cache_entries <= 0:
+            return False
+        if not os.path.isfile(path):
+            return False    # nothing to corrupt yet; keep the budget
+        plan.corrupt_cache_entries -= 1
+        plan._record("cache_corrupt", path)
+    try:
+        with open(path, "r+b") as f:
+            f.truncate(plan.corrupt_cache_bytes)
+    except OSError:
+        return False
+    return True
 
 
 def maybe_stall(site: str) -> None:

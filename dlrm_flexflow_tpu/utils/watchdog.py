@@ -97,6 +97,34 @@ class Heartbeat:
                           detail=detail, alive=alive)
 
 
+class Sustained:
+    """Consecutive-observation debouncer for policy loops.
+
+    An SLO breach (or an idle fleet) must persist for N consecutive
+    evaluation periods before a scaling action fires — one slow dispatch
+    or one quiet tick must not flap the fleet. ``observe(breach)``
+    returns True once the condition has held for ``periods``
+    observations in a row; any non-breach observation resets the count.
+    Used by the serving autoscaler (``serve/autoscale.py``) for both its
+    grow and shrink triggers. Single-threaded by design (one policy
+    loop owns each instance)."""
+
+    __slots__ = ("periods", "count")
+
+    def __init__(self, periods: int):
+        if periods < 1:
+            raise ValueError(f"periods must be >= 1, got {periods}")
+        self.periods = int(periods)
+        self.count = 0
+
+    def observe(self, breach: bool) -> bool:
+        self.count = self.count + 1 if breach else 0
+        return self.count >= self.periods
+
+    def reset(self) -> None:
+        self.count = 0
+
+
 @dataclass
 class Deadline:
     """A wall-clock budget shared by the serving path's per-request

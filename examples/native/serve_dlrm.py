@@ -223,8 +223,22 @@ def main(argv=None):
                     "until the trainer publishes one", ckpt_dir)
     input_names = [t.name for t in model.input_tensors]
 
+    # SLO-driven autoscaling over the fleet (--serve-slo-ms + the
+    # min/max replica bounds): grows on sustained p99/queue pressure,
+    # replaces dead replicas, shrinks when idle. Fleet mode only — a
+    # single engine has nothing to grow.
+    scaler = None
+    if n > 1 and float(getattr(cfg, "serve_slo_ms", 0.0)) > 0:
+        scaler = ff.Autoscaler(serve, ff.AutoscaleConfig.from_config(cfg))
+        log_app.info(
+            "autoscaler on: SLO %.0f ms, %d..%d replicas",
+            cfg.serve_slo_ms, cfg.serve_min_replicas,
+            cfg.serve_max_replicas)
+
     from http.server import ThreadingHTTPServer
     with serve:
+        if scaler is not None:
+            scaler.start()
         httpd = ThreadingHTTPServer(
             ("0.0.0.0", port), make_handler(serve, input_names))
         log_app.info(
@@ -237,6 +251,8 @@ def main(argv=None):
         except KeyboardInterrupt:
             pass
         finally:
+            if scaler is not None:
+                scaler.close()
             httpd.server_close()
     return 0
 

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Subprocess target for the autoscaler replica-kill chaos test.
+
+Runs a 2-replica fleet + router + SLO autoscaler under steady traffic,
+then kills replica 1 mid-run (``FaultPlan.replica_down = -1`` — the
+crashed-process simulation: every dispatch and probe against it raises a
+typed ReplicaDown until the process would be restarted, which for an
+in-process replica set is exactly what a SIGKILL'd replica host looks
+like from the router). The bar, printed as one JSON verdict line for the
+parent test:
+
+- zero failed client requests (survivor absorbs retries while the
+  autoscaler provisions the replacement);
+- the autoscaler replaces the dead replica (``replacements >= 1``) and
+  the healthy count returns to ``min_replicas``;
+- response versions are monotonic — no response ever carries an older
+  weight version than one already observed (old-or-new-never-mixed
+  survives the fleet growing under fire).
+
+Run directly (never under pytest):
+    python _autoscale_worker.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlrm_flexflow_tpu.utils.testing import ensure_cpu_devices  # noqa: E402
+
+ensure_cpu_devices(4)
+
+import jax  # noqa: E402
+
+import dlrm_flexflow_tpu as ff  # noqa: E402
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,  # noqa: E402
+                                           synthetic_batch)
+from dlrm_flexflow_tpu.parallel.mesh import make_mesh  # noqa: E402
+from dlrm_flexflow_tpu.utils import faults  # noqa: E402
+
+DCFG = DLRMConfig(embedding_size=[64] * 4, sparse_feature_size=8,
+                  mlp_bot=[4, 16, 8], mlp_top=[40, 16, 1])
+
+
+def _factory(i):
+    model = ff.FFModel(ff.FFConfig(batch_size=16, seed=3))
+    build_dlrm(model, DCFG)
+    devs = jax.devices()
+    lo = i % len(devs)
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+                  mesh=make_mesh(devices=devs[lo:lo + 1]))
+    model.init_layers()
+    return model
+
+
+def main() -> int:
+    x, _ = synthetic_batch(DCFG, 64, seed=0)
+    reqs = [{k: v[i:i + 1] for k, v in x.items()} for i in range(64)]
+
+    fleet = ff.Fleet.build(_factory, 2,
+                           ff.ServeConfig(max_batch=16,
+                                          queue_capacity=1024))
+    router = ff.FleetRouter(
+        fleet, ff.RouterConfig(retries=4, backoff_ms=2.0,
+                               cooldown_s=0.3, health_interval_s=0.1,
+                               probe_deadline_s=30.0)).start()
+    scaler = ff.Autoscaler(
+        router, ff.AutoscaleConfig(min_replicas=2, max_replicas=4,
+                                   interval_s=0.1,
+                                   cooldown_s=0.2)).start()
+    failed = 0
+    versions = []
+    try:
+        for r in reqs[:8]:                       # warm every replica
+            router.predict(r, timeout=120)
+        with faults.active_plan(faults.FaultPlan(replica_down={1: -1})):
+            for i in range(150):
+                try:
+                    pred = router.predict(reqs[i % len(reqs)],
+                                          timeout=120)
+                    versions.append(int(pred.version))
+                except Exception as e:   # noqa: BLE001 — counted
+                    failed += 1
+                    print(f"request failed: {e}", file=sys.stderr)
+                time.sleep(0.01)
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                st = scaler.stats()
+                if st["replacements"] >= 1 and st["healthy"] >= 2:
+                    break
+                time.sleep(0.2)
+            # traffic through the regrown fleet, still under the fault
+            for i in range(30):
+                try:
+                    pred = router.predict(reqs[i % len(reqs)],
+                                          timeout=120)
+                    versions.append(int(pred.version))
+                except Exception as e:   # noqa: BLE001
+                    failed += 1
+                    print(f"request failed: {e}", file=sys.stderr)
+        sstats = scaler.stats()
+        monotonic = all(b >= a for a, b in zip(versions, versions[1:]))
+        print(json.dumps({
+            "failed": failed,
+            "replacements": sstats["replacements"],
+            "healthy": sstats["healthy"],
+            "size": sstats["size"],
+            "versions_monotonic": monotonic,
+            "n_responses": len(versions),
+        }))
+        return 0
+    finally:
+        scaler.close()
+        router.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

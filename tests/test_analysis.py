@@ -122,6 +122,103 @@ class TestThreadRules:
         assert found == []
 
 
+class TestPolicyLoopRule:
+    """FLX104: a *_loop policy thread joined without a stop Event being
+    set (autoscaler/health/poller loops sleep on an Event; join without
+    .set() waits out the interval or hangs)."""
+
+    def test_loop_joined_without_stop_signal(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            class Scaler:
+                def start(self):
+                    self._t = threading.Thread(
+                        target=self._policy_loop, daemon=True,
+                        name="ff-autoscaler")
+                    self._t.start()
+
+                def _policy_loop(self):
+                    pass
+
+                def close(self):
+                    self._t.join(5.0)
+        """)
+        assert "FLX104" in _rules(found)
+        f = [x for x in found if x.rule == "FLX104"][0]
+        assert "_policy_loop" in f.message and "stop" in f.message
+
+    def test_loop_with_stop_event_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            class Scaler:
+                def __init__(self):
+                    self._stop = threading.Event()
+
+                def start(self):
+                    self._t = threading.Thread(
+                        target=self._policy_loop, daemon=True,
+                        name="ff-autoscaler")
+                    self._t.start()
+
+                def _policy_loop(self):
+                    while not self._stop.wait(0.25):
+                        pass
+
+                def close(self):
+                    self._stop.set()
+                    self._t.join(5.0)
+        """)
+        assert "FLX104" not in _rules(found)
+
+    def test_non_loop_thread_not_flagged(self, tmp_path):
+        # a worker that is not a *_loop (one-shot writer) is FLX101-103
+        # territory only — FLX104 must not fire
+        found = _findings(tmp_path, """
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._write,
+                                               daemon=True, name="ff-w")
+                    self._t.start()
+
+                def _write(self):
+                    pass
+
+                def close(self):
+                    self._t.join(5.0)
+        """)
+        assert "FLX104" not in _rules(found)
+
+    def test_unjoined_loop_is_flx103_not_104(self, tmp_path):
+        # the missing join is FLX103's finding; FLX104 would be a
+        # confusing double-report on the same defect
+        found = _findings(tmp_path, """
+            import threading
+
+            class Scaler:
+                def start(self):
+                    self._t = threading.Thread(
+                        target=self._policy_loop, daemon=True,
+                        name="ff-autoscaler")
+                    self._t.start()
+
+                def _policy_loop(self):
+                    pass
+        """)
+        assert "FLX103" in _rules(found)
+        assert "FLX104" not in _rules(found)
+
+    def test_shipped_policy_loops_are_clean(self):
+        # the router's health loop, the autoscaler's policy loop, and
+        # the watcher all set their stop events before the join — the
+        # package-wide run must not gain FLX104 findings
+        found = run_analysis(os.path.join(_REPO, "dlrm_flexflow_tpu"))
+        assert [f for f in found if f.rule == "FLX104"] == []
+
+
 class TestLockRules:
     def test_racy_attribute(self, tmp_path):
         found = _findings(tmp_path, """

@@ -1,0 +1,359 @@
+"""SLO-driven autoscaler tests (ISSUE 12): fleet grow/shrink mechanics,
+probe-gated admission of grown replicas, concurrent bucket warmup, the
+policy triggers (sustained breach -> grow, sustained idle -> shrink,
+dead replica -> immediate replace), and the slow subprocess chaos run
+(replica killed under traffic, autoscaler replaces it, versions
+monotonic, zero failed requests).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                           synthetic_batch)
+from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+from dlrm_flexflow_tpu.serve.fleet import HEALTHY, PROBING
+from dlrm_flexflow_tpu.utils import faults
+from dlrm_flexflow_tpu.utils.watchdog import Sustained
+
+DCFG = DLRMConfig(embedding_size=[64] * 4, sparse_feature_size=8,
+                  mlp_bot=[4, 16, 8], mlp_top=[40, 16, 1])
+
+
+def _factory(i):
+    model = ff.FFModel(ff.FFConfig(batch_size=16, seed=3))
+    build_dlrm(model, DCFG)
+    devs = jax.devices()
+    lo = i % len(devs)
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+                  mesh=make_mesh(devices=devs[lo:lo + 1]))
+    model.init_layers()
+    return model
+
+
+def _reqs(n=32):
+    x, _ = synthetic_batch(DCFG, n, seed=0)
+    return [{k: v[i:i + 1] for k, v in x.items()} for i in range(n)]
+
+
+def _scfg(**kw):
+    kw.setdefault("max_batch", 16)
+    kw.setdefault("queue_capacity", 1024)
+    return ff.ServeConfig(**kw)
+
+
+def _rcfg(**kw):
+    kw.setdefault("retries", 4)
+    kw.setdefault("backoff_ms", 2.0)
+    kw.setdefault("cooldown_s", 0.3)
+    kw.setdefault("health_interval_s", 0.1)
+    kw.setdefault("probe_deadline_s", 30.0)
+    return ff.RouterConfig(**kw)
+
+
+# ---------------------------------------------------------------------
+# units: debouncer + config
+# ---------------------------------------------------------------------
+class TestSustained:
+    def test_fires_after_n_consecutive(self):
+        s = Sustained(3)
+        assert not s.observe(True)
+        assert not s.observe(True)
+        assert s.observe(True)
+        assert s.observe(True)   # keeps firing while held
+
+    def test_any_gap_resets(self):
+        s = Sustained(2)
+        assert not s.observe(True)
+        assert not s.observe(False)
+        assert not s.observe(True)
+        assert s.observe(True)
+
+    def test_reset_and_validation(self):
+        s = Sustained(1)
+        assert s.observe(True)
+        s.reset()
+        assert s.count == 0
+        with pytest.raises(ValueError):
+            Sustained(0)
+
+
+class TestAutoscaleConfig:
+    def test_bounds_validated(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            ff.AutoscaleConfig(min_replicas=0)
+        with pytest.raises(ValueError, match="max_replicas"):
+            ff.AutoscaleConfig(min_replicas=4, max_replicas=2)
+
+    def test_from_config_lifts_flags(self):
+        cfg = ff.FFConfig.parse_args(
+            ["--serve-slo-ms", "25", "--serve-min-replicas", "2",
+             "--serve-max-replicas", "6"])
+        ac = ff.AutoscaleConfig.from_config(cfg)
+        assert ac.slo_ms == 25.0
+        assert ac.min_replicas == 2
+        assert ac.max_replicas == 6
+
+    def test_bad_replica_flags_rejected(self):
+        with pytest.raises(ValueError, match="serve-min-replicas"):
+            ff.FFConfig.parse_args(["--serve-min-replicas", "0"])
+        with pytest.raises(ValueError, match="serve-max-replicas"):
+            ff.FFConfig.parse_args(["--serve-max-replicas", "0"])
+
+
+# ---------------------------------------------------------------------
+# fleet grow/shrink mechanics
+# ---------------------------------------------------------------------
+class TestFleetElasticity:
+    def test_grow_needs_factory(self):
+        model = _factory(0)
+        fleet = ff.Fleet([ff.InferenceEngine(model, _scfg())])
+        assert not fleet.can_grow
+        with pytest.raises(RuntimeError, match="model_factory"):
+            fleet.grow(1)
+
+    def test_grown_replica_probes_before_admission(self):
+        fleet = ff.Fleet.build(_factory, 2, _scfg())
+        router = ff.FleetRouter(fleet, _rcfg()).start()
+        try:
+            for r in _reqs(4):
+                router.predict(r, timeout=60)
+            ids = fleet.grow(1)
+            assert ids == [2]
+            rep = fleet.get(2)
+            # born PROBING: not routable until the admission probe
+            assert rep.state == PROBING
+            assert not rep.routable()
+            assert rep.due_for_probe(cooldown_s=1e9)   # no cooldown wait
+            deadline = time.time() + 15
+            while time.time() < deadline and rep.state != HEALTHY:
+                time.sleep(0.1)
+            assert rep.state == HEALTHY
+            assert rep.readmissions == 1
+            assert fleet.stats()["grows"] == 1
+        finally:
+            router.close()
+
+    def test_grow_boots_from_compile_cache(self, tmp_path):
+        # replicas share one cache dir; the grown replica's bucket
+        # warmup deserializes what replica 0's warmup stored for its
+        # device... only same-device entries apply, so grow a replica
+        # onto a device that already warmed once (rid 2 -> device 2 of
+        # 4; rid 6 maps to the same device modulo the device count)
+        def factory(i):
+            m = _factory(i)
+            m.attach_compile_cache(str(tmp_path))
+            return m
+
+        fleet = ff.Fleet.build(factory, 3, _scfg())
+        fleet.start()
+        try:
+            assert fleet.grow(1) == [3]   # fresh device: all misses
+            eng0 = fleet.get(0).engine
+            assert eng0.stats()["compile_cache"]["puts"] >= 1
+        finally:
+            fleet.close()
+        # a second fleet boot over the SAME devices is the warm path
+        fleet2 = ff.Fleet.build(factory, 3, _scfg())
+        fleet2.start()
+        try:
+            st = fleet2.get(0).engine.stats()["compile_cache"]
+            assert st["hits"] >= 1, st
+        finally:
+            fleet2.close()
+
+    def test_shrink_retires_highest_rid_stable(self):
+        fleet = ff.Fleet.build(_factory, 3, _scfg())
+        fleet.start()
+        try:
+            gone = fleet.shrink(1)
+            assert gone == [2]
+            assert len(fleet) == 2
+            assert fleet.stats()["shrinks"] == 1
+            # retired engine is closed; survivors still serve
+            assert not fleet.get(0).engine._closing
+        finally:
+            fleet.close()
+
+    def test_shrink_never_empties_fleet(self):
+        fleet = ff.Fleet.build(_factory, 1, _scfg())
+        fleet.start()
+        try:
+            assert fleet.shrink(5) == []
+            assert len(fleet) == 1
+        finally:
+            fleet.close()
+
+    def test_concurrent_warmup_starts_every_replica(self):
+        fleet = ff.Fleet.build(_factory, 3, _scfg())
+        fleet.start()
+        try:
+            for rep in fleet:
+                assert rep.engine.alive()
+                assert rep.engine.stats()["warmup_s"] > 0
+        finally:
+            fleet.close()
+
+
+# ---------------------------------------------------------------------
+# policy triggers
+# ---------------------------------------------------------------------
+class TestAutoscalerPolicy:
+    def test_grows_on_sustained_queue_pressure(self):
+        fleet = ff.Fleet.build(_factory, 1, _scfg())
+        router = ff.FleetRouter(fleet, _rcfg()).start()
+        scaler = ff.Autoscaler(router, ff.AutoscaleConfig(
+            min_replicas=1, max_replicas=2, interval_s=0.05,
+            sustain=2, queue_hwm=2.0, cooldown_s=0.1)).start()
+        reqs = _reqs()
+        try:
+            for r in reqs[:4]:
+                router.predict(r, timeout=60)
+            # a slow replica backs its queue up past the high-water mark
+            with faults.active_plan(faults.FaultPlan(
+                    serve_delay_s=0.05)):
+                futs = []
+                deadline = time.time() + 20
+                while time.time() < deadline:
+                    futs.extend(router.submit(r) for r in reqs[:8])
+                    if scaler.stats()["grows"] >= 1:
+                        break
+                    time.sleep(0.05)
+                for f in futs:
+                    f.result(120)
+            st = scaler.stats()
+            assert st["grows"] >= 1, st
+            assert len(fleet) == 2
+            assert "queue depth" in st["last_reason"] \
+                or "p99" in st["last_reason"]
+        finally:
+            scaler.close()
+            router.close()
+
+    def test_shrinks_when_idle(self):
+        fleet = ff.Fleet.build(_factory, 2, _scfg())
+        router = ff.FleetRouter(fleet, _rcfg()).start()
+        scaler = ff.Autoscaler(router, ff.AutoscaleConfig(
+            min_replicas=1, max_replicas=2, interval_s=0.05,
+            idle_sustain=3, cooldown_s=0.1)).start()
+        try:
+            for r in _reqs(4):
+                router.predict(r, timeout=60)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if scaler.stats()["shrinks"] >= 1:
+                    break
+                time.sleep(0.1)
+            st = scaler.stats()
+            assert st["shrinks"] == 1, st
+            assert len(fleet) == 1
+            assert "idle" in st["last_reason"]
+            # floor respected: it never shrinks below min_replicas
+            time.sleep(0.5)
+            assert len(fleet) == 1
+        finally:
+            scaler.close()
+            router.close()
+
+    def test_respects_max_replicas(self):
+        fleet = ff.Fleet.build(_factory, 1, _scfg())
+        router = ff.FleetRouter(fleet, _rcfg()).start()
+        scaler = ff.Autoscaler(router, ff.AutoscaleConfig(
+            min_replicas=1, max_replicas=1, interval_s=0.05,
+            sustain=1, queue_hwm=0.0, cooldown_s=0.0)).start()
+        try:
+            for r in _reqs(8):
+                router.predict(r, timeout=60)
+            time.sleep(1.0)
+            assert len(fleet) == 1          # capped, despite "pressure"
+            assert scaler.stats()["grows"] == 0
+        finally:
+            scaler.close()
+            router.close()
+
+    def test_replaces_dead_replica_zero_failed(self):
+        fleet = ff.Fleet.build(_factory, 2, _scfg())
+        router = ff.FleetRouter(fleet, _rcfg()).start()
+        scaler = ff.Autoscaler(router, ff.AutoscaleConfig(
+            min_replicas=2, max_replicas=4, interval_s=0.1,
+            cooldown_s=0.2)).start()
+        reqs = _reqs()
+        failed = 0
+        try:
+            for r in reqs[:8]:
+                router.predict(r, timeout=60)
+            with faults.active_plan(faults.FaultPlan(
+                    replica_down={1: -1})):
+                for i in range(80):
+                    try:
+                        router.predict(reqs[i % len(reqs)], timeout=120)
+                    except Exception:   # noqa: BLE001 — the bar is zero
+                        failed += 1
+                    time.sleep(0.01)
+                deadline = time.time() + 20
+                while time.time() < deadline:
+                    st = scaler.stats()
+                    if st["replacements"] >= 1 and st["healthy"] >= 2:
+                        break
+                    time.sleep(0.2)
+            st = scaler.stats()
+            assert failed == 0
+            assert st["replacements"] >= 1, st
+            assert st["healthy"] >= 2, st
+        finally:
+            scaler.close()
+            router.close()
+
+    def test_policy_thread_lifecycle(self):
+        fleet = ff.Fleet.build(_factory, 1, _scfg())
+        router = ff.FleetRouter(fleet, _rcfg()).start()
+        scaler = ff.Autoscaler(router)
+        try:
+            scaler.start()
+            t = scaler._thread
+            assert t is not None and t.name == "ff-autoscaler" \
+                and t.daemon
+            scaler.close()
+            assert not t.is_alive()
+            assert scaler._thread is None
+        finally:
+            scaler.close()
+            router.close()
+
+
+# ---------------------------------------------------------------------
+# chaos: replica killed under traffic (subprocess, slow)
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+def test_chaos_replica_kill_autoscaler_replaces(tmp_path):
+    """The satellite chaos bar: a replica dies under traffic (the
+    crashed-process fault — dead until restart), the autoscaler
+    provisions a replacement admitted through the probe path, versions
+    stay monotonic, and ZERO client requests fail. Run in a subprocess
+    so a deadlock/hang fails the test instead of wedging the session."""
+    env = dict(os.environ)
+    env.pop("FF_FAULT_REPLICA_DOWN", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__),
+                      "_autoscale_worker.py")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["failed"] == 0, verdict
+    assert verdict["replacements"] >= 1, verdict
+    assert verdict["healthy"] >= 2, verdict
+    assert verdict["versions_monotonic"], verdict
+    assert verdict["n_responses"] == 180, verdict

@@ -508,3 +508,326 @@ class TestFaultEnv:
             assert faults.take_drop_device(2) == 0
             assert faults.take_drop_device(3) == 2
             assert faults.take_drop_device(3) == 0   # consumed
+
+    def test_return_device_env_forms(self, monkeypatch):
+        self._with_env(monkeypatch, FF_FAULT_RETURN_DEVICE="6:2,9")
+        plan = faults.plan_from_env()
+        assert plan.return_device_steps == {6: 2, 9: 1}
+
+    def test_return_device_bad_value_names_variable(self, monkeypatch):
+        self._with_env(monkeypatch, FF_FAULT_RETURN_DEVICE="6:x")
+        with pytest.raises(ValueError, match="FF_FAULT_RETURN_DEVICE"):
+            faults.plan_from_env()
+
+    def test_return_device_hook_consume_once(self):
+        with faults.active_plan(faults.FaultPlan(
+                return_device_steps={4: 2})):
+            assert faults.take_return_device(3) == 0
+            assert faults.take_return_device(4) == 2
+            assert faults.take_return_device(4) == 0   # consumed
+
+    def test_cache_corrupt_env(self, monkeypatch):
+        self._with_env(monkeypatch, FF_FAULT_CACHE_CORRUPT="2")
+        plan = faults.plan_from_env()
+        assert plan.corrupt_cache_entries == 2
+
+    def test_cache_corrupt_bad_value_names_variable(self, monkeypatch):
+        self._with_env(monkeypatch, FF_FAULT_CACHE_CORRUPT="two")
+        with pytest.raises(ValueError, match="FF_FAULT_CACHE_CORRUPT"):
+            faults.plan_from_env()
+
+    def test_cache_corrupt_hook_truncates_budgeted(self, tmp_path):
+        p = tmp_path / "entry.bin"
+        p.write_bytes(b"x" * 4096)
+        with faults.active_plan(faults.FaultPlan(
+                corrupt_cache_entries=1)):
+            assert faults.maybe_corrupt_cache(str(p)) is True
+            assert p.stat().st_size < 4096
+            # budget consumed: a second read is untouched
+            p.write_bytes(b"y" * 4096)
+            assert faults.maybe_corrupt_cache(str(p)) is False
+            assert p.stat().st_size == 4096
+
+    def test_cache_corrupt_missing_file_keeps_budget(self, tmp_path):
+        with faults.active_plan(faults.FaultPlan(
+                corrupt_cache_entries=1)):
+            assert faults.maybe_corrupt_cache(
+                str(tmp_path / "nope.bin")) is False
+            p = tmp_path / "real.bin"
+            p.write_bytes(b"x" * 4096)
+            assert faults.maybe_corrupt_cache(str(p)) is True
+
+
+# ---------------------------------------------------------------------
+# scale-UP: expand() — the inverse of recover()
+# ---------------------------------------------------------------------
+class TestExpand:
+    def test_expand_grows_mesh_and_preserves_state(self):
+        from dlrm_flexflow_tpu.parallel.elastic import expand
+        model = _build(8, elastic="inplace", elastic_search_budget=0)
+        x, y = _dataset()
+        batch = {k: v[:BS] for k, v in x.items()}
+        batch["label"] = y[:BS]
+        model.train_batch(batch)
+        devs = list(model.mesh.devices.flat)
+        recover(model, lost=devs[4:], mode="inplace")
+        assert model.mesh.size == 4
+        ref = _params(model)
+        step = model._step
+        returned = [d for d in jax.devices() if d.id >= 4][:4]
+        report = expand(model, returned=returned, mode="inplace")
+        assert report.kind == "expand"
+        assert report.surviving == 8
+        assert model.mesh.size == 8
+        assert model._step == step
+        got = _params(model)
+        for k in ref:
+            np.testing.assert_allclose(got[k], ref[k], err_msg=k)
+        assert np.isfinite(float(model.train_batch(batch)["loss"]))
+
+    def test_expand_restores_remembered_pre_shrink_plan(self):
+        from dlrm_flexflow_tpu.parallel.elastic import expand
+        model = _build(8, elastic="inplace", elastic_search_budget=0)
+        before = {k: pc.degrees for k, pc in model.strategies.items()}
+        devs = list(model.mesh.devices.flat)
+        recover(model, lost=devs[4:], mode="inplace")
+        expand(model, returned=devs[4:], mode="inplace")
+        after = {k: pc.degrees for k, pc in model.strategies.items()}
+        for k in after:   # lowering-relevant intent restored exactly
+            assert after[k] == before[k], (k, before[k], after[k])
+
+    def test_expand_requires_fresh_devices(self):
+        from dlrm_flexflow_tpu.parallel.elastic import expand
+        model = _build(4, elastic="inplace")
+        with pytest.raises(ValueError, match="returned device"):
+            expand(model, returned=[], mode="inplace")
+        with pytest.raises(ValueError, match="returned device"):
+            # devices already in the mesh are not growth
+            expand(model, returned=list(model.mesh.devices.flat),
+                   mode="inplace")
+
+    def test_expand_mode_off_rejected(self):
+        from dlrm_flexflow_tpu.parallel.elastic import expand
+        model = _build(2)
+        with pytest.raises(ValueError, match="resume.*inplace"):
+            expand(model, returned=jax.devices()[2:4], mode="off")
+
+    def test_expand_canonical_device_order(self):
+        # losing the MIDDLE of the mesh then expanding must rebuild the
+        # same device order a fresh full-mesh job would use
+        from dlrm_flexflow_tpu.parallel.elastic import expand
+        model = _build(8, elastic="inplace", elastic_search_budget=0)
+        devs = list(model.mesh.devices.flat)
+        recover(model, lost=devs[2:6], mode="inplace")
+        expand(model, returned=devs[2:6], mode="inplace")
+        got = [d.id for d in model.mesh.devices.flat]
+        fresh = [d.id for d in
+                 _build(8).mesh.devices.flat]
+        assert got == fresh
+
+    def test_fit_drop_then_expand_bit_identical_to_fresh_full_mesh_run(
+            self, tmp_path):
+        """THE acceptance pin: shrink at step j, expand at step k — the
+        post-expansion trajectory is bit-identical to a fresh run on the
+        full mesh restored from the same snapshot the expansion used."""
+        x, y = _dataset()
+        j, k, drop = 2, 5, 4
+
+        mA = _build(8, elastic="resume", elastic_search_budget=0,
+                    elastic_expand=True)
+        with faults.active_plan(faults.FaultPlan(
+                drop_device_steps={j: drop},
+                return_device_steps={k: drop})) as plan:
+            res = mA.fit(x, y, epochs=1, verbose=False,
+                         checkpoint_dir=str(tmp_path), save_every=1,
+                         keep_last=50)
+        assert res["recoveries"] == 1
+        assert res["expansions"] == 1
+        assert ("return_device", (k, drop)) in plan.fired
+        assert mA.mesh.size == 8
+
+        # run B: fresh 8-device job restored from the very snapshot the
+        # expansion resumed from, trained over the same remaining batches
+        mB = _build(8, elastic="resume")
+        snap = str(tmp_path / f"ckpt-{k:08d}.npz")
+        assert os.path.exists(snap), sorted(os.listdir(str(tmp_path)))
+        restore_checkpoint(mB, snap)
+        assert mB._step == k
+        for b in range(k, NB):
+            batch = {kk: v[b * BS:(b + 1) * BS] for kk, v in x.items()}
+            batch["label"] = y[b * BS:(b + 1) * BS]
+            mB.train_batch(batch)
+
+        pA, pB = _params(mA), _params(mB)
+        assert set(pA) == set(pB)
+        for name in pA:
+            np.testing.assert_array_equal(
+                pA[name], pB[name],
+                err_msg=f"{name}: drop-then-expand run diverged from "
+                f"the fresh full-mesh run from the same snapshot")
+        probe = {kk: v[:BS] for kk, v in x.items()}
+        np.testing.assert_array_equal(
+            np.asarray(mA.forward_batch(probe)),
+            np.asarray(mB.forward_batch(probe)))
+
+    def test_fit_expand_disabled_ignores_return_hook(self):
+        # without --elastic-expand the return hook must not consume or
+        # raise: the run completes on the shrunken... full mesh (no drop
+        # here), and the budget is still intact afterwards
+        x, y = _dataset()
+        m = _build(8, elastic="inplace", elastic_search_budget=0)
+        with faults.active_plan(faults.FaultPlan(
+                return_device_steps={3: 2})) as plan:
+            res = m.fit(x, y, epochs=1, verbose=False)
+        assert res["expansions"] == 0
+        assert plan.return_device_steps == {3: 2}   # not consumed
+        assert not any(h == "return_device" for h, _ in plan.fired)
+
+
+# ---------------------------------------------------------------------
+# persistent warm caches: plan + compile (utils/warmcache.py)
+# ---------------------------------------------------------------------
+class TestWarmCaches:
+    def test_recover_plan_cache_hit_reproduces_searched_plan(
+            self, tmp_path):
+        from dlrm_flexflow_tpu.utils.warmcache import PlanCache
+        cache = PlanCache(str(tmp_path))
+
+        def run():
+            m = _build(8, elastic="inplace")
+            m.attach_plan_cache(cache)
+            devs = list(m.mesh.devices.flat)
+            return recover(m, lost=devs[4:], mode="inplace", budget=10,
+                           seed=3)
+
+        cold = run()
+        warm = run()
+        assert not cold.plan_cache_hit
+        assert warm.plan_cache_hit
+        assert warm.searched == cold.searched
+        # the cached plan IS the plan the search produced
+        assert {k: (pc.degrees, pc.param_degree)
+                for k, pc in warm.strategies.items()} \
+            == {k: (pc.degrees, pc.param_degree)
+                for k, pc in cold.strategies.items()}
+        assert cache.stats()["hits"] == 1
+
+    def test_corrupt_plan_cache_degrades_to_fresh_search(self, tmp_path):
+        from dlrm_flexflow_tpu.utils.warmcache import PlanCache
+        cache = PlanCache(str(tmp_path))
+        m = _build(8, elastic="inplace")
+        m.attach_plan_cache(cache)
+        devs = list(m.mesh.devices.flat)
+        recover(m, lost=devs[4:], mode="inplace", budget=0)
+        m2 = _build(8, elastic="inplace")
+        m2.attach_plan_cache(cache)
+        with faults.active_plan(faults.FaultPlan(
+                corrupt_cache_entries=1)) as plan:
+            rep = recover(m2, lost=list(m2.mesh.devices.flat)[4:],
+                          mode="inplace", budget=0)
+        assert ("cache_corrupt" in {h for h, _ in plan.fired})
+        assert not rep.plan_cache_hit        # torn file = clean miss
+        assert m2.mesh.size == 4             # recovery still succeeded
+        assert cache.stats()["rejects"] >= 1
+
+    def test_compile_cache_roundtrip_bit_identical(self, tmp_path):
+        from dlrm_flexflow_tpu.utils.warmcache import CompileCache
+        x, y = _dataset()
+        batch = {k: v[:BS] for k, v in x.items()}
+        batch["label"] = y[:BS]
+
+        def run(attach):
+            m = _build(4)
+            if attach:
+                m.attach_compile_cache(CompileCache(str(tmp_path)))
+            for _ in range(2):
+                mets = m.train_batch(batch)
+            return _params(m), m
+
+        ref, _ = run(False)
+        cold, m_cold = run(True)
+        st = m_cold.compile_cache_stats()
+        assert st["puts"] >= 1
+        warm, m_warm = run(True)
+        st = m_warm.compile_cache_stats()
+        assert st["hits"] >= 1, st
+        for k in ref:   # cached executable computes the same bits
+            np.testing.assert_array_equal(ref[k], cold[k], err_msg=k)
+            np.testing.assert_array_equal(ref[k], warm[k], err_msg=k)
+
+    def test_corrupt_compile_cache_degrades_to_fresh_compile(
+            self, tmp_path):
+        from dlrm_flexflow_tpu.utils.warmcache import CompileCache
+        x, y = _dataset()
+        batch = {k: v[:BS] for k, v in x.items()}
+        batch["label"] = y[:BS]
+        m = _build(4)
+        m.attach_compile_cache(CompileCache(str(tmp_path)))
+        ref = np.asarray(m.train_batch(batch)["loss"])
+        m2 = _build(4)
+        cache2 = CompileCache(str(tmp_path))
+        m2.attach_compile_cache(cache2)
+        with faults.active_plan(faults.FaultPlan(
+                corrupt_cache_entries=16)):
+            got = np.asarray(m2.train_batch(batch)["loss"])
+        np.testing.assert_array_equal(ref, got)
+        st = cache2.stats()
+        assert st["rejects"] >= 1 and st["hits"] == 0
+        assert "unreadable" in st["last_reject"]
+
+    def test_stale_code_fingerprint_is_a_miss(self, tmp_path):
+        from dlrm_flexflow_tpu.utils.warmcache import CompileCache
+        x, y = _dataset()
+        batch = {k: v[:BS] for k, v in x.items()}
+        batch["label"] = y[:BS]
+        m = _build(4)
+        m.attach_compile_cache(CompileCache(str(tmp_path)))
+        m.train_batch(batch)
+        # a "new checkout": the code fingerprint is part of the key, so
+        # old entries are clean misses — never loaded, never trusted
+        stale = CompileCache(str(tmp_path))
+        stale._code_fp = "deadbeef00000000"
+        m2 = _build(4)
+        m2.attach_compile_cache(stale)
+        m2.train_batch(batch)
+        st = stale.stats()
+        assert st["hits"] == 0 and st["misses"] >= 1
+        assert st["puts"] >= 1   # re-stored under the new fingerprint
+
+    def test_tampered_entry_code_field_rejected(self, tmp_path):
+        # defense in depth: an entry whose FILE claims a different code
+        # fingerprint than its key (tampering, a renamed file, a hash
+        # collision) is rejected with a reason, not deserialized
+        import pickle
+        from dlrm_flexflow_tpu.utils.warmcache import CompileCache
+        import jax.numpy as jnp
+        cache = CompileCache(str(tmp_path))
+        co = jax.jit(lambda v: v + 1).lower(jnp.ones((2,))).compile()
+        key = "fmt=1|kind=t|code=x|strat=s|mesh=m|shape=(2,)"
+        assert cache.put(key, co)
+        path = cache._path(key)
+        blob = pickle.load(open(path, "rb"))
+        blob["code"] = "deadbeef00000000"
+        pickle.dump(blob, open(path, "wb"))
+        assert cache.get(key) is None
+        assert "stale code fingerprint" in cache.stats()["last_reject"]
+
+    def test_fit_auto_attaches_caches_next_to_manifest(self, tmp_path):
+        x, y = _dataset()
+        m = _build(4, compile_cache_dir="auto")
+        m.fit(x, y, epochs=1, verbose=False,
+              checkpoint_dir=str(tmp_path), save_every=4)
+        cache_dir = tmp_path / "cache"
+        assert cache_dir.is_dir()
+        assert getattr(m, "_compile_cache", None) is not None
+        assert getattr(m, "_plan_cache", None) is not None
+        assert m.compile_cache_stats()["puts"] >= 1
+        assert any(f.startswith("exec-") for f in os.listdir(cache_dir))
+
+    def test_no_cache_dir_configured_stays_cold(self, tmp_path):
+        x, y = _dataset()
+        m = _build(4)   # compile_cache_dir defaults to "" = off
+        m.fit(x, y, epochs=1, verbose=False,
+              checkpoint_dir=str(tmp_path), save_every=4)
+        assert getattr(m, "_compile_cache", None) is None
+        assert not (tmp_path / "cache").exists()

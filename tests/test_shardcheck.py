@@ -253,6 +253,118 @@ class TestClampRejection:
         assert out[_emb(model).name].param_degree == 4
         assert clamp_report(model, plan, 4) == []
 
+    def test_expand_rejects_row_shard_quantum_violation(self):
+        # growth direction (scale-UP): un-clamping a row-sharded plan
+        # onto a mesh whose factorization admits NO degree > 1 that
+        # divides the rows must reject-with-reason when the table
+        # cannot fit replicated — not ship a silently-replicating plan
+        from dlrm_flexflow_tpu.search.replan import expand_strategies
+        model = _graph()
+        emb = _emb(model)
+        plan = _dp_plan(model)
+        plan[emb.name] = ParallelConfig((2, 1, 1), param_degree=2)
+        # 5 devices factorize [5]; 5 does not divide the packed rows,
+        # so row sharding cannot survive the growth
+        with pytest.raises(ClampError) as ei:
+            expand_strategies(model, 5, old=plan, budget=0,
+                              hbm_bytes=1e6)
+        assert ei.value.op == emb.name
+        assert "HBM" in ei.value.reason
+
+    def test_expand_grows_row_shards_back(self):
+        from dlrm_flexflow_tpu.search.replan import expand_strategies
+        model = _graph()
+        emb = _emb(model)
+        small = _dp_plan(model)
+        small[emb.name] = ParallelConfig((4, 1, 1), param_degree=4)
+        orig = dict(small)
+        orig[emb.name] = ParallelConfig((NDEV, 1, 1), param_degree=NDEV)
+        out, info = expand_strategies(model, NDEV, old=small, orig=orig,
+                                      budget=0, hbm_bytes=1e6)
+        assert out[emb.name].param_degree == NDEV
+        assert info["greedy_fallback"] and not info["plan_cache_hit"]
+
+
+# =====================================================================
+# FLX506: plan-cache mesh-signature audit
+# =====================================================================
+class TestPlanCacheAudit:
+    def _cache(self, tmp_path):
+        from dlrm_flexflow_tpu.utils.warmcache import PlanCache
+        cache = PlanCache(str(tmp_path))
+        key = PlanCache.key("graphfp", 4, [2, 2], 10, 0) + "|start=s"
+        cache.put(key, {"op1": ParallelConfig((4, 1))}, 4, searched=True)
+        return cache, key
+
+    def test_clean_cache_no_findings(self, tmp_path):
+        self._cache(tmp_path)
+        assert shardcheck.audit_plan_cache(str(tmp_path)) == []
+
+    def _mangle(self, tmp_path, fn):
+        from dlrm_flexflow_tpu.utils.warmcache import PLANS_FILE
+        p = os.path.join(str(tmp_path), PLANS_FILE)
+        m = json.load(open(p))
+        fn(m)
+        json.dump(m, open(p, "w"))
+
+    def test_recorded_ndev_mismatch_flagged(self, tmp_path):
+        _, key = self._cache(tmp_path)
+        self._mangle(tmp_path,
+                     lambda m: m["plans"][key].update(ndev=8))
+        found = shardcheck.audit_plan_cache(str(tmp_path))
+        assert [f.rule for f in found] == ["FLX506"]
+        assert "wrong topology" in found[0].message
+        # the runtime cache rejects the same entry (defense in depth)
+        from dlrm_flexflow_tpu.utils.warmcache import PlanCache
+        cache = PlanCache(str(tmp_path))
+        assert cache.get(key, 4) is None
+        assert "records ndev=8" in cache.stats()["last_reject"]
+
+    def test_unassignable_degrees_flagged(self, tmp_path):
+        _, key = self._cache(tmp_path)
+        self._mangle(
+            tmp_path,
+            lambda m: m["plans"][key]["strategies"]["op1"].update(
+                degrees=[3, 1]))
+        found = shardcheck.audit_plan_cache(str(tmp_path))
+        assert [f.rule for f in found] == ["FLX506"]
+        assert "cannot assign" in found[0].message
+
+    def test_wrong_axes_in_key_flagged(self, tmp_path):
+        from dlrm_flexflow_tpu.utils.warmcache import PlanCache
+        cache = PlanCache(str(tmp_path))
+        # hand-build a key whose axes are NOT the structural
+        # factorization of its ndev (a cache copied between package
+        # versions with different factorization rules)
+        key = "graphfp|ndev=4|axes=4|budget=10|seed=0|start=s"
+        cache.put(key, {"op1": ParallelConfig((4, 1))}, 4)
+        found = shardcheck.audit_plan_cache(str(tmp_path))
+        assert [f.rule for f in found] == ["FLX506"]
+        assert "factorization" in found[0].message
+
+    def test_undecodable_entry_flagged(self, tmp_path):
+        _, key = self._cache(tmp_path)
+        self._mangle(
+            tmp_path,
+            lambda m: m["plans"][key]["strategies"]["op1"].update(
+                degrees=[0, 1]))   # invalid degree -> ValueError
+        found = shardcheck.audit_plan_cache(str(tmp_path))
+        assert [f.rule for f in found] == ["FLX506"]
+        assert "fails to decode" in found[0].message
+
+    def test_cli_plan_cache_flag(self, tmp_path, capsys):
+        _, key = self._cache(tmp_path)
+        assert shardcheck.main(["--plan-cache", str(tmp_path),
+                                "--fail-on", "high",
+                                "--baseline", ""]) == 0
+        self._mangle(tmp_path,
+                     lambda m: m["plans"][key].update(ndev=8))
+        assert shardcheck.main(["--plan-cache", str(tmp_path),
+                                "--fail-on", "high",
+                                "--baseline", ""]) == 1
+        out = capsys.readouterr().out
+        assert "FLX506" in out
+
 
 # =====================================================================
 # lowered-HLO auditor: parsing units (no compile)
