@@ -21,6 +21,14 @@ Three questions, matching the ISSUE-6 acceptance bar:
   the fleet on the sustained breach and the post-growth p99 must
   RE-ENTER the SLO with zero failed requests across all three phases —
   the ISSUE-12 acceptance bar.
+- **Sharded serving tier** (ISSUE 13): a host-table model whose tables
+  exceed a per-replica HBM budget is REJECTED by the replicated fleet's
+  admission check and served through the row-sharded lookup tier
+  instead, at the measured fraction of the replicated engine's
+  p99-SLO QPS on a shape that fits both (bar: >= 0.8x) — plus a chaos
+  run killing one embedding shard under open-loop traffic (zero failed
+  requests; degraded-flagged answers allowed; warm-cache replacement
+  probed in; p99 re-enters the SLO).
 - **Continuous vs flush batching**: the same open-loop ladder through
   one engine in continuous (iteration-level) admission vs the
   pre-continuous size/deadline flush cycle. Continuous batching is
@@ -251,6 +259,164 @@ def _measure_autoscale(slo_ms=150.0, dispatch_cost_s=0.02,
         router.close()
 
 
+def _build_host(max_batch=64):
+    """A host-resident-table DLRM (the >HBM configuration the sharded
+    tier exists for): same shape as ``_build`` but with tables in host
+    memory, sliceable into lookup shards."""
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+    dcfg = DLRMConfig(embedding_size=[8192] * 8, sparse_feature_size=16,
+                      mlp_bot=[16, 64, 16], mlp_top=[144, 64, 1])
+    cfg = ff.FFConfig(batch_size=max_batch, seed=3,
+                      serve_max_batch=max_batch,
+                      host_resident_tables=True,
+                      host_tables_async=False)
+    model = ff.FFModel(cfg)
+    build_dlrm(model, dcfg)
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"])
+    model.init_layers()
+    return model, dcfg
+
+
+def _measure_shardtier(slo_ms=50.0, nshards=4, requests=256):
+    """ISSUE-13 acceptance measurements for the sharded serving tier:
+
+    - **feasibility** — a model whose tables exceed the per-replica HBM
+      budget is REJECTED by the replicated fleet's admission check and
+      admitted by the sharded tier (tables stored once, divided);
+    - **throughput tax** — attained QPS at the p99 SLO through the
+      sharded tier vs the replicated (tables-resident) engine on a
+      model that FITS both; the bar is >= 0.8x;
+    - **chaos** — one embedding shard killed under open-loop traffic:
+      zero failed requests (degraded-flagged answers allowed), the
+      replacement shard boots from the warm cache and is probed in, and
+      p99 re-enters the SLO afterwards.
+    """
+    import tempfile
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.serve import percentile
+    from dlrm_flexflow_tpu.serve.shardtier import (EmbeddingShardSet,
+                                                   ShardTierConfig,
+                                                   check_serving_feasible,
+                                                   serving_footprint)
+    from dlrm_flexflow_tpu.utils import faults
+    out = {"nshards": nshards}
+
+    # --- (a) tables-exceed-one-host feasibility sweep -------------------
+    model, dcfg = _build_host()
+    fp = serving_footprint(model, replicas=2)
+    budget = fp["dense_bytes"] + fp["table_bytes"] // 2
+    replicated = check_serving_feasible(model, 2, budget, nshards=0)
+    sharded = check_serving_feasible(model, 2, budget, nshards=nshards)
+    out["feasibility"] = {
+        "budget_mb": round(budget / 1e6, 2),
+        "table_mb": round(fp["table_bytes"] / 1e6, 2),
+        "replicated_feasible": replicated["feasible"],
+        "replicated_reason": replicated["reason"],
+        "sharded_feasible": sharded["feasible"],
+        "sharded_ranker_mb": round(sharded["ranker_bytes"] / 1e6, 3),
+        "sharded_shard_mb": round(sharded["shard_bytes"] / 1e6, 3),
+    }
+
+    reqs = _requests(dcfg, requests)
+
+    def _qps(engine):
+        for r in reqs[:16]:
+            engine.predict(r, timeout=60)               # warm
+        t0 = time.perf_counter()
+        for r in reqs[:64]:
+            engine.predict(r, timeout=60)
+        base = 64 / (time.perf_counter() - t0)
+        rates = [base * f for f in (0.5, 1.0, 2.0, 4.0, 8.0)]
+        return _qps_at_slo(engine.submit, reqs, slo_ms, rates)
+
+    # --- (b) replicated (tables-resident) engine baseline ---------------
+    eng = ff.InferenceEngine(model, ff.ServeConfig(
+        max_batch=64, queue_capacity=4096)).start()
+    try:
+        best_rep, sweep_rep = _qps(eng)
+    finally:
+        eng.close()
+    out["replicated_qps_at_slo"] = round(best_rep, 1)
+
+    # --- (c) sharded tier on the same shape -----------------------------
+    cache_dir = tempfile.mkdtemp(prefix="ff-shard-cache-")
+    m2, _ = _build_host()
+    tier = ShardTierConfig(nshards=nshards, lookup_deadline_ms=1000.0,
+                           cooldown_s=0.0, replace_after=2,
+                           eject_after=2)
+    sset = EmbeddingShardSet.build(m2, nshards, config=tier,
+                                   cache_dir=cache_dir)
+    EmbeddingShardSet.release_ranker_tables(m2)
+    # cache deliberately smaller than the request pool: the chaos run
+    # must keep CONSULTING the shard tier (a pool-sized cache would
+    # ride out the outage on hits alone and measure nothing)
+    eng = ff.InferenceEngine(m2, ff.ServeConfig(
+        max_batch=64, queue_capacity=4096, cache_rows=32),
+        shard_set=sset).start()
+    try:
+        best_shd, sweep_shd = _qps(eng)
+        out["sharded_qps_at_slo"] = round(best_shd, 1)
+        out["sharded_vs_replicated"] = (
+            round(best_shd / best_rep, 3) if best_rep > 0 else None)
+
+        # --- (d) chaos: kill one shard under open-loop traffic ----------
+        rate = max(best_shd * 0.5, 8.0)
+        half = len(reqs) // 2
+        lat_before, failed_before, _ = _poisson_drive(
+            eng.submit, reqs[:half], rate)
+        stop = threading.Event()
+
+        def _health_loop():
+            while not stop.is_set():
+                try:
+                    sset.health_tick()
+                except Exception:   # noqa: BLE001 — keep ticking
+                    pass
+                time.sleep(0.05)
+
+        ht = threading.Thread(target=_health_loop, daemon=True,
+                              name="ff-bench-shard-health")
+        ht.start()
+        plan = faults.FaultPlan()
+        plan.shard_down[0] = -1
+        with faults.active_plan(plan):
+            lat_during, failed_during, _ = _poisson_drive(
+                eng.submit, reqs[half:], rate)
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline and any(
+                    r.state != "healthy" for r in sset.shards):
+                time.sleep(0.05)
+        lat_after, failed_after, _ = _poisson_drive(
+            eng.submit, reqs[:half], rate)
+        stop.set()
+        ht.join(2.0)
+        st = eng.stats()
+        p99_after = percentile(lat_after, 99)
+        out["chaos"] = {
+            "offered_qps": round(rate, 1),
+            "failed_before": failed_before,
+            "failed_during_kill": failed_during,
+            "failed_after": failed_after,
+            "p99_ms_before": round(percentile(lat_before, 99) or 0, 2),
+            "p99_ms_during_kill": round(percentile(lat_during, 99)
+                                        or 0, 2),
+            "p99_ms_after": round(p99_after or 0, 2),
+            "p99_reentered_slo": bool(p99_after is not None
+                                      and p99_after <= slo_ms),
+            "degraded_responses": st["degraded_responses"],
+            "shard_replacements": sset.replacements,
+            "all_shards_healthy": all(r.state == "healthy"
+                                      for r in sset.shards),
+            "version_vector": sset.version_vector(),
+        }
+    finally:
+        eng.close()
+        sset.close()
+    return out
+
+
 def measure(requests=256, slo_ms=50.0, replica_counts=(1, 2, 4)):
     import jax
 
@@ -328,6 +494,10 @@ def measure(requests=256, slo_ms=50.0, replica_counts=(1, 2, 4)):
 
     # --- autoscaler chaos: load doubles, fleet grows, p99 re-enters -----
     out["autoscale"] = _measure_autoscale(slo_ms=150.0)
+
+    # --- sharded serving tier (ISSUE 13) --------------------------------
+    out["shardtier"] = _measure_shardtier(slo_ms=slo_ms,
+                                          requests=requests)
 
     # --- continuous vs flush batching (open-loop ladder each) -----------
     modes = {}

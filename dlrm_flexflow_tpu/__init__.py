@@ -25,9 +25,11 @@ from .parallel.pconfig import ParallelConfig
 from .parallel.distributed import MeshDegraded, MeshReturned
 from .utils.watchdog import Deadline, StallReport, WorkerStalled
 from .serve import (AutoscaleConfig, Autoscaler, DeadlineExceeded,
-                    Fleet, FleetRouter, FleetUnavailable,
-                    InferenceEngine, Overloaded, Prediction, ReplicaDown,
-                    RouterConfig, ServeConfig, SnapshotWatcher)
+                    EmbeddingShardSet, Fleet, FleetRouter,
+                    FleetUnavailable, InferenceEngine, Overloaded,
+                    Prediction, ReplicaDown, RouterConfig, ServeConfig,
+                    ShardDown, ShardTierConfig, ShardTierUnavailable,
+                    SnapshotWatcher)
 
 __version__ = "0.1.0"
 
@@ -45,4 +47,6 @@ __all__ = [
     "DeadlineExceeded", "SnapshotWatcher",
     "Fleet", "FleetRouter", "FleetUnavailable", "RouterConfig",
     "ReplicaDown", "Autoscaler", "AutoscaleConfig",
+    "EmbeddingShardSet", "ShardTierConfig", "ShardDown",
+    "ShardTierUnavailable",
 ]

@@ -96,6 +96,13 @@ RULES = {
                "match the topology it would be served for (or its "
                "degrees cannot assign on that mesh) — a warm-start hit "
                "on the wrong topology is a silent correctness hazard"),
+    "FLX507": ("serving-plan-overreplicated", "high",
+               "a SERVING deployment replicates table-scale params "
+               "across ranker replicas (or its shard row-ranges fail "
+               "to tile a table exactly): the fleet pays tables x "
+               "replicas of memory — or a gap/overlap serves wrong "
+               "rows — where a row-sharded lookup tier stores each "
+               "table once"),
     # --- lowered-HLO audit (analysis/hlo_audit.py) ----------------------
     "FLX511": ("hlo-table-collective", "high",
                "lowered HLO moves a table-scale buffer through an "

@@ -43,6 +43,14 @@ baseline machinery):
   degrees that cannot assign on the recorded mesh. The runtime cache
   rejects such entries too; the static audit (``--plan-cache DIR``)
   finds them before a recovery is on the clock.
+- FLX507 serving-plan-overreplicated: the SERVING deployment audited
+  the same way (``--serving-replicas N [--serving-shards M]`` /
+  :func:`verify_serving_plan`) — table-scale params replicated across
+  ranker replicas where a row-sharded lookup tier
+  (``serve/shardtier.py``) would store each table once, and shard
+  row-ranges that fail to tile a table exactly (gap/overlap/short —
+  the owner math itself, ``parallel.alltoall.shard_row_ranges``, can
+  never produce this; a hand-edited plan can).
 
 The lowered-HLO half of the PR lives in :mod:`.hlo_audit` (FLX51x).
 """
@@ -307,6 +315,113 @@ def verify_plan(model, strategies, ndev: Optional[int] = None,
 
 
 # --------------------------------------------------------------------------
+# FLX507: serving-plan audit (the read path gets the training treatment)
+# --------------------------------------------------------------------------
+def verify_serving_plan(model, replicas: int,
+                        serving_plan: Optional[Dict] = None,
+                        *, ranker_holds_tables: Optional[bool] = None,
+                        hbm_bytes: Optional[float] = None,
+                        table_scale_bytes: Optional[float] = None,
+                        path: str = "<serving>") -> List[Finding]:
+    """Audit a SERVING deployment the way :func:`verify_plan` audits a
+    training plan — statically, no devices needed.
+
+    ``serving_plan`` is ``EmbeddingShardSet.serving_plan()`` (or the
+    same dict hand-built for a planned deployment): shard count and the
+    per-op flat-row ``ranges``. Two hazards are flagged under FLX507:
+
+    - **over-replication** — table-scale params resident per RANKER.
+      With a shard set configured that means the rankers never released
+      their tables (the split bought nothing); without one it is the
+      pre-split fleet paying tables x replicas (the ROADMAP-1 ceiling:
+      a DLRM-Terabyte model cannot board at all). ``hbm_bytes`` turns
+      the finding into a hard infeasibility when the per-ranker
+      residency exceeds the budget.
+    - **bad tiling** — shard row-ranges that gap, overlap, or fall
+      short of a table's flat row space. The owner math
+      (``parallel.alltoall.shard_row_ranges``) can never produce this;
+      a hand-edited or version-skewed plan can, and a gap serves
+      default rows for ids nobody owns while an overlap double-serves
+      (and double-publishes) rows.
+    """
+    from ..serve.shardtier import serving_footprint
+    findings: List[Finding] = []
+    tscale = table_scale_threshold(model, table_scale_bytes)
+    nshards = int(serving_plan.get("nshards", 0)) if serving_plan else 0
+    if serving_plan and ranker_holds_tables is None:
+        ranker_holds_tables = serving_plan.get("ranker_holds_tables")
+
+    # --- tiling: ranges must cover each table exactly ------------------
+    for op_name, ranges in ((serving_plan or {}).get("ranges")
+                            or {}).items():
+        total = ((serving_plan or {}).get("flat_rows")
+                 or {}).get(op_name)
+        cur = 0
+        for slot, (lo, hi) in enumerate(
+                sorted((tuple(r) for r in ranges), key=lambda r: r[0])):
+            if lo > cur:
+                findings.append(make_finding(
+                    "FLX507", path, 0,
+                    f"shard ranges for {op_name!r} leave a GAP: rows "
+                    f"[{cur}, {lo}) belong to no shard — lookups there "
+                    f"can only ever degrade to default rows",
+                    scope=op_name, token=f"gap-{cur}"))
+            elif lo < cur:
+                findings.append(make_finding(
+                    "FLX507", path, 0,
+                    f"shard ranges for {op_name!r} OVERLAP: rows "
+                    f"[{lo}, {cur}) have two owners — double-served "
+                    f"lookups and a torn version vector on publish",
+                    scope=op_name, token=f"overlap-{lo}"))
+            cur = max(cur, hi)
+        if total is not None and cur != total:
+            findings.append(make_finding(
+                "FLX507", path, 0,
+                f"shard ranges for {op_name!r} tile [0, {cur}) but the "
+                f"table has {total} flat rows — "
+                f"{'missing tail' if cur < total else 'ranges overrun'}",
+                scope=op_name, token="extent"))
+
+    # --- over-replication across rankers -------------------------------
+    fp = serving_footprint(model, replicas, nshards,
+                           ranker_holds_tables=ranker_holds_tables)
+    table_scale = tscale is not None and fp["table_bytes"] >= tscale
+    if nshards > 0 and fp["ranker_bytes"] > fp["dense_bytes"] \
+            and table_scale:
+        findings.append(make_finding(
+            "FLX507", path, 0,
+            f"a {nshards}-shard lookup tier is configured but each of "
+            f"the {replicas} ranker(s) still holds "
+            f"{_fmt_bytes(fp['table_bytes'])} of tables — release them "
+            f"(EmbeddingShardSet.release_ranker_tables); the split "
+            f"bought nothing", scope="<serving>",
+            token="ranker-holds-tables"))
+    elif nshards <= 0 and replicas > 1 and table_scale:
+        findings.append(make_finding(
+            "FLX507", path, 0,
+            f"{replicas} serving replicas each hold "
+            f"{_fmt_bytes(fp['table_bytes'])} of tables "
+            f"({_fmt_bytes(fp['fleet_table_bytes'])} fleet-wide) — "
+            f"row-shard the lookup tier (--serve-shards) so tables are "
+            f"stored once, divided", scope="<serving>",
+            token="replicated-serving",
+            severity="high" if (hbm_bytes is not None
+                               and fp["ranker_bytes"] > hbm_bytes)
+            else "medium"))
+    if hbm_bytes is not None and fp["ranker_bytes"] > float(hbm_bytes):
+        findings.append(make_finding(
+            "FLX507", path, 0,
+            f"per-ranker residency {_fmt_bytes(fp['ranker_bytes'])} "
+            f"exceeds the {_fmt_bytes(float(hbm_bytes))} budget — this "
+            f"deployment cannot boot"
+            + ("" if nshards > 0 else
+               " (a sharded tier would hold "
+               f"{_fmt_bytes(fp['dense_bytes'])}/ranker)"),
+            scope="<serving>", token="ranker-hbm"))
+    return sort_findings(findings)
+
+
+# --------------------------------------------------------------------------
 # CLI: verify bundled/user strategy files against their target models
 # --------------------------------------------------------------------------
 
@@ -555,6 +670,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--audit-tolerance", type=float, default=0.25,
                     help="relative drift tolerance for measured-vs-"
                          "predicted collective bytes (default 0.25)")
+    ap.add_argument("--serving-replicas", type=int, default=None,
+                    metavar="N",
+                    help="also audit a SERVING deployment of N ranker "
+                         "replicas for the target model (FLX507: "
+                         "table-scale params replicated across rankers, "
+                         "shard-range tiling)")
+    ap.add_argument("--serving-shards", type=int, default=0,
+                    metavar="M",
+                    help="row-shard the serving lookup tier M ways in "
+                         "the FLX507 audit (0 = replicated tables)")
     ap.add_argument("--fail-on", default="high",
                     choices=["high", "medium", "low", "info", "never"])
     ap.add_argument("--baseline", default=DEFAULT_PLAN_BASELINE,
@@ -570,9 +695,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             if rid.startswith("FLX5"):
                 print(f"{rid}  {name:<26} {sev:<7} {doc}")
         return 0
-    if not args.paths and not args.plan_cache:
+    if not args.paths and not args.plan_cache \
+            and args.serving_replicas is None:
         ap.error("no strategy files given (or use --plan-cache / "
-                 "--list-rules)")
+                 "--serving-replicas / --list-rules)")
 
     topology = _parse_axes(args.axes) if args.axes else None
     hbm = args.hbm_gb * 1e9 if args.hbm_gb else None
@@ -606,6 +732,34 @@ def main(argv: Optional[List[str]] = None) -> int:
             except (ValueError, OSError, RuntimeError) as e:
                 print(f"shardcheck: audit skipped for {path}: {e}",
                       file=sys.stderr)
+    if args.serving_replicas is not None:
+        name = args.model
+        if name is None and args.paths:
+            tgt = infer_target(args.paths[0])
+            name = tgt[0] if tgt else None
+        if name is None:
+            ap.error("--serving-replicas needs --model (or a strategy "
+                     "filename the target is inferable from)")
+        model = build_target_model(name, args.ndev or 1, args.batch)
+        plan = None
+        if args.serving_shards > 0:
+            from ..parallel.alltoall import shard_row_ranges
+            ranges, flat_rows = {}, {}
+            for op in model.ops:
+                if hasattr(op, "host_lookup") and op.param_defs():
+                    pd = op.param_defs()["kernel"]
+                    rows = 1
+                    for s in pd.shape[:-1]:
+                        rows *= int(s)
+                    flat_rows[op.name] = rows
+                    ranges[op.name] = shard_row_ranges(
+                        rows, args.serving_shards)
+            plan = {"nshards": args.serving_shards, "ranges": ranges,
+                    "flat_rows": flat_rows,
+                    "ranker_holds_tables": False}
+        findings.extend(verify_serving_plan(
+            model, args.serving_replicas, plan, hbm_bytes=hbm,
+            path=f"<serving:{name}>"))
     findings = sort_findings(findings)
 
     try:

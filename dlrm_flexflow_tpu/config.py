@@ -256,6 +256,19 @@ class FFConfig:
     # --serve-min-replicas N / --serve-max-replicas N.
     serve_min_replicas: int = 1
     serve_max_replicas: int = 8
+    # sharded serving tier (serve/shardtier.py): split the fleet into
+    # stateless rankers + N row-sharded embedding lookup shards so
+    # tables live once (divided), not once per replica. 0 = replicated
+    # tables (the pre-split fleet). Set with --serve-shards N.
+    serve_shards: int = 0
+    # per-shard-lookup budget (deadline + bounded retry; exhaustion
+    # degrades per --serve-degrade). --serve-lookup-deadline-ms.
+    serve_lookup_deadline_ms: float = 50.0
+    # what a spent lookup budget does: "cache" answers from cache hits
+    # + per-table default rows with degraded=True (the default — answer
+    # beats error), "fail" raises so the router retries/sheds. Set with
+    # --serve-degrade {cache,fail}.
+    serve_degrade: str = "cache"
     # LRU cap on the eval-path AOT executable cache (_eval_step_execs):
     # serving many ad-hoc shapes must not leak executables. Evictions
     # are counted (FFModel.eval_exec_cache_stats / engine stats()). Set
@@ -445,6 +458,20 @@ class FFConfig:
                     raise ValueError(
                         f"--serve-max-replicas expects N >= 1, got "
                         f"{cfg.serve_max_replicas}")
+            elif a == "--serve-shards":
+                cfg.serve_shards = int(take())
+                if cfg.serve_shards < 0:
+                    raise ValueError(
+                        f"--serve-shards expects N >= 0, got "
+                        f"{cfg.serve_shards}")
+            elif a == "--serve-lookup-deadline-ms":
+                cfg.serve_lookup_deadline_ms = float(take())
+            elif a == "--serve-degrade":
+                v = take()
+                if v not in ("cache", "fail"):
+                    raise ValueError(f"--serve-degrade expects "
+                                     f"cache|fail, got {v!r}")
+                cfg.serve_degrade = v
             elif a == "--eval-exec-cache":
                 cfg.eval_exec_cache = int(take())
             elif a == "--stage-dataset":

@@ -1271,18 +1271,31 @@ class Embedding(Op):
         return {"kernel": _host_init_table(
             self.kernel_initializer, (self.num_entries, self.out_dim), seed)}
 
-    def host_lookup(self, host_params, idx_np):
+    def host_flat_indices(self, idx_np):
+        """Per-sample FLAT row ids, shaped (batch, 1, bag) — the shared
+        geometry the host lookup and the serving shard tier
+        (serve/shardtier.py) route lookups through."""
         import numpy as np
         g = idx_np.astype(np.int64) % self.num_entries
         if g.ndim == 1:
             g = g[:, None]
+        return g[:, None, :]
+
+    def host_lookup_rows(self, rows_2d, g3):
+        """``host_lookup`` against an arbitrary (rows, d) row matrix
+        with already-remapped flat indices: the shard tier assembles
+        fetched shard rows through this, so a sharded lookup is
+        bit-identical to the local host path (same gather, same bag
+        reduction, same order)."""
+        import numpy as np
         if self.aggr == AGGR_MODE_NONE:
             # per-bag-slot outputs: no reduction, (batch, bag, d)
-            return np.ascontiguousarray(
-                host_params["kernel"][g], np.float32)
-        out = _host_bag_lookup(host_params["kernel"], g[:, None, :],
-                               self.aggr)
-        return out[:, 0]                                  # (batch, d)
+            return np.ascontiguousarray(rows_2d[g3[:, 0]], np.float32)
+        return _host_bag_lookup(rows_2d, g3, self.aggr)[:, 0]  # (batch,d)
+
+    def host_lookup(self, host_params, idx_np):
+        return self.host_lookup_rows(host_params["kernel"],
+                                     self.host_flat_indices(idx_np))
 
     def host_sgd_update(self, host_params, idx_np, ct_np, lr):
         import numpy as np
@@ -1778,13 +1791,24 @@ class EmbeddingBagStacked(Op):
             self.kernel_initializer,
             (self.num_tables, self.num_entries, self.out_dim), seed)}
 
-    def host_lookup(self, host_params, idx_np):
+    def host_flat_indices(self, idx_np):
+        """Per-sample FLAT row ids, (batch, T, bag), into the (T*rows, d)
+        flattened host table — shared with the serving shard tier."""
         import numpy as np
+        rows = self.num_entries
+        offs = (np.arange(self.num_tables, dtype=np.int64)
+                * rows)[None, :, None]
+        return idx_np.astype(np.int64) % rows + offs      # (batch, T, bag)
+
+    def host_lookup_rows(self, rows_2d, g3):
+        """See :meth:`Embedding.host_lookup_rows`."""
+        return _host_bag_lookup(rows_2d, g3, self.aggr)
+
+    def host_lookup(self, host_params, idx_np):
         T, rows, d = host_params["kernel"].shape
-        offs = (np.arange(T, dtype=np.int64) * rows)[None, :, None]
-        g = idx_np.astype(np.int64) % rows + offs         # (batch, T, bag)
-        return _host_bag_lookup(host_params["kernel"].reshape(T * rows, d),
-                                g, self.aggr)
+        return self.host_lookup_rows(
+            host_params["kernel"].reshape(T * rows, d),
+            self.host_flat_indices(idx_np))
 
     def host_sgd_update(self, host_params, idx_np, ct_np, lr):
         import numpy as np
@@ -2163,9 +2187,19 @@ class EmbeddingBagConcat(Op):
         offs = np.asarray(self._offsets, np.int64)[None, :, None]
         return idx_np.astype(np.int64) % sizes + offs     # (batch, T, bag)
 
+    def host_flat_indices(self, idx_np):
+        """Per-sample FLAT row ids, (batch, T, bag), into the
+        (total_rows, d) concatenated host table — shared with the
+        serving shard tier."""
+        return self._host_global_indices(idx_np)
+
+    def host_lookup_rows(self, rows_2d, g3):
+        """See :meth:`Embedding.host_lookup_rows`."""
+        return _host_bag_lookup(rows_2d, g3, self.aggr)
+
     def host_lookup(self, host_params, idx_np):
-        return _host_bag_lookup(host_params["kernel"],
-                                self._host_global_indices(idx_np), self.aggr)
+        return self.host_lookup_rows(host_params["kernel"],
+                                     self.host_flat_indices(idx_np))
 
     def host_sgd_update(self, host_params, idx_np, ct_np, lr):
         _host_bag_update(host_params["kernel"],

@@ -148,6 +148,49 @@ class RowShardPlan:
             return max(min(int(n_local), self.flat_rows_local), 1)
         return int(n_local)
 
+    def row_ranges(self) -> list:
+        """The [lo, hi) flat-row block each shard owns, in shard order —
+        the same owner math the exchange body evaluates as
+        ``owner = id // rows_local`` (see :func:`shard_row_ranges`)."""
+        return shard_row_ranges(self.flat_rows_local * self.nshards,
+                                self.nshards)
+
+
+# ---- shared owner math (training exchange AND the serving shard tier) ----
+#
+# The exchange body computes `owner = flat_id // rows_local` with equal
+# row blocks per shard; these module-level helpers are the host-side
+# (numpy) statement of the same placement, generalized to a row count
+# that does not divide evenly (the last shard owns the short tail). The
+# serving shard tier (serve/shardtier.py) slices lookup shards with
+# them, so a serving plan's row ownership is BY CONSTRUCTION the one a
+# row-sharded training mesh would use — and shardcheck's FLX507 tiling
+# audit verifies any plan against the same functions.
+
+
+def shard_rows_local(rows: int, nshards: int) -> int:
+    """Rows per shard (ceil-division block size)."""
+    if nshards < 1:
+        raise ValueError(f"nshards must be >= 1, got {nshards}")
+    return -(-int(rows) // int(nshards))
+
+
+def shard_row_ranges(rows: int, nshards: int) -> list:
+    """[(lo, hi), ...] per shard, tiling [0, rows) exactly — contiguous
+    equal blocks (the last possibly short, possibly empty)."""
+    per = shard_rows_local(rows, nshards)
+    return [(min(s * per, rows), min((s + 1) * per, rows))
+            for s in range(nshards)]
+
+
+def row_owners(ids, rows: int, nshards: int) -> np.ndarray:
+    """Owning shard per flat row id — `id // rows_local`, clamped into
+    range (ids are taken mod `rows` first, matching every host lookup's
+    wrap semantics)."""
+    per = shard_rows_local(rows, nshards)
+    g = np.asarray(ids, np.int64) % max(int(rows), 1)
+    return np.minimum(g // per, nshards - 1).astype(np.int64)
+
 
 def plan_row_shard(mesh: Optional[Mesh], param_degree: int,
                    rows: int, pack: int, tables: int = 1,

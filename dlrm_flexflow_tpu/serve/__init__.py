@@ -8,12 +8,20 @@ from .autoscale import AutoscaleConfig, Autoscaler
 from .cache import EmbeddingCache
 from .engine import (DeadlineExceeded, InferenceEngine, Overloaded,
                      Prediction, ReplicaDown, ServeConfig, percentile)
-from .fleet import Fleet, Replica
+from .fleet import CircuitBreaker, Fleet, Replica
 from .router import FleetRouter, FleetUnavailable, RouterConfig
+from .shardtier import (EmbeddingShard, EmbeddingShardSet, ShardDown,
+                        ShardLookupTimeout, ShardReplica,
+                        ShardTierConfig, ShardTierUnavailable,
+                        check_serving_feasible, serving_footprint)
 from .watcher import SnapshotWatcher
 
 __all__ = ["InferenceEngine", "ServeConfig", "Prediction", "Overloaded",
            "DeadlineExceeded", "ReplicaDown", "EmbeddingCache",
-           "SnapshotWatcher", "Fleet", "Replica", "FleetRouter",
-           "FleetUnavailable", "RouterConfig", "percentile",
-           "Autoscaler", "AutoscaleConfig"]
+           "SnapshotWatcher", "Fleet", "Replica", "CircuitBreaker",
+           "FleetRouter", "FleetUnavailable", "RouterConfig",
+           "percentile", "Autoscaler", "AutoscaleConfig",
+           "EmbeddingShardSet", "EmbeddingShard", "ShardReplica",
+           "ShardTierConfig", "ShardDown", "ShardLookupTimeout",
+           "ShardTierUnavailable", "check_serving_feasible",
+           "serving_footprint"]

@@ -89,9 +89,20 @@ class Autoscaler:
     through the same typed-``ReplicaDown`` retry path a crash does.
     """
 
-    def __init__(self, router, config: Optional[AutoscaleConfig] = None):
+    def __init__(self, router, config: Optional[AutoscaleConfig] = None,
+                 shard_set=None):
         self.router = router
         self.config = config or AutoscaleConfig()
+        # the row-sharded lookup tier, when the fleet serves through one
+        # (serve/shardtier.py): the autoscaler drives its health ticks —
+        # probe/re-admit ejected shards and REPLACE the ones whose
+        # probes keep failing (booted from the warm cache, admitted only
+        # on probe success). Same replace-dead philosophy as replicas,
+        # one tier down.
+        self.shard_set = shard_set if shard_set is not None \
+            else getattr(router.fleet, "shard_set", None)
+        self._shard_replacements = 0
+        self._shard_readmissions = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._m_lock = make_lock("Autoscaler._m_lock")
@@ -161,6 +172,7 @@ class Autoscaler:
     def _tick(self) -> None:
         cfg = self.config
         fleet = self.router.fleet
+        self._shard_tick()
         st = self.router.stats()
         healthy = int(st["fleet"]["healthy"])
         size = len(fleet)
@@ -223,6 +235,28 @@ class Autoscaler:
                              f" ms", {"retired": ids})
                 self._acted()
 
+    def _shard_tick(self) -> None:
+        """Shard-tier health pass: probe shards due for one, replace
+        shards whose probes keep failing. No debounce — a dark shard is
+        degraded answers RIGHT NOW, the replica floor philosophy applied
+        to the lookup tier."""
+        if self.shard_set is None or not self.config.replace_dead:
+            return
+        for action in self.shard_set.health_tick():
+            kind = action.get("action")
+            if kind == "shard-replace":
+                with self._m_lock:
+                    self._shard_replacements += 1
+                self._record("shard-replace",
+                             f"slot {action['slot']} probes kept "
+                             f"failing", action)
+            elif kind == "shard-probe" and action.get("ok"):
+                with self._m_lock:
+                    self._shard_readmissions += 1
+                self._record("shard-readmit",
+                             f"slot {action['slot']} probe succeeded",
+                             action)
+
     # --- observability -------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         with self._m_lock:
@@ -230,6 +264,8 @@ class Autoscaler:
                 "grows": self._grows,
                 "shrinks": self._shrinks,
                 "replacements": self._replacements,
+                "shard_replacements": self._shard_replacements,
+                "shard_readmissions": self._shard_readmissions,
                 "breaches": self._breaches,
                 "last_reason": self._last_reason,
                 "decisions": list(self._decisions),

@@ -57,11 +57,15 @@ class EmbeddingCache:
         self.invalidations = 0
         self.row_invalidations = 0
 
-    def lookup(self, op, table_params, idx_np: np.ndarray) -> np.ndarray:
-        """Per-sample-cached equivalent of
-        ``op.host_lookup(table_params, idx_np)``: hit samples come from
-        the cache, miss samples go through ONE sub-batch host_lookup and
-        are inserted."""
+    def probe(self, op, idx_np: np.ndarray):
+        """The read half of :meth:`lookup`: per-sample cache probe over
+        a batch. Returns ``(vals, miss)`` — ``vals`` a list with the hit
+        samples' cached values (``None`` at miss positions) and ``miss``
+        the miss sample indices. Counts hits/misses. Split out so the
+        shard tier can probe EVERY op first, batch all ops' misses into
+        ONE shard fetch (per-shard version consistency is structural
+        when each shard is read once per request), then :meth:`insert`
+        what came back."""
         rows = int(idx_np.shape[0])
         vals = [None] * rows
         miss: list = []
@@ -76,25 +80,48 @@ class EmbeddingCache:
                     vals[i] = hit[0]
             self.hits += rows - len(miss)
             self.misses += len(miss)
-        if miss:
-            sub = op.host_lookup(table_params, idx_np[np.asarray(miss)])
-            sub = np.asarray(sub)
-            # which host-table rows each missed sample's bag gathered —
-            # recorded so a delta reload can invalidate ONLY the samples
-            # a dirtied row feeds (None = unknown -> conservative drop)
-            deps = {}
-            if hasattr(op, "host_delta_touched_rows"):
-                for i in miss:
+        return vals, miss
+
+    def insert(self, op, idx_np: np.ndarray, miss, sub: np.ndarray,
+               ok=None) -> None:
+        """The write half of :meth:`lookup`: insert the miss samples'
+        freshly-looked-up values. ``ok`` (optional bool per miss
+        position) masks out samples that must NOT be cached — the shard
+        tier passes False for samples assembled from DEGRADED default
+        rows, so a shard outage never poisons the cache with
+        placeholder embeddings that would outlive the outage."""
+        sub = np.asarray(sub)
+        # which host-table rows each missed sample's bag gathered —
+        # recorded so a delta reload can invalidate ONLY the samples
+        # a dirtied row feeds (None = unknown -> conservative drop)
+        deps = {}
+        if hasattr(op, "host_delta_touched_rows"):
+            for j, i in enumerate(miss):
+                if ok is None or ok[j]:
                     deps[i] = op.host_delta_touched_rows(idx_np[i:i + 1])
-            with self._lock:
-                for j, i in enumerate(miss):
-                    v = np.ascontiguousarray(sub[j])
-                    vals[i] = v
-                    key = (op.name, idx_np[i].tobytes())
-                    self._d[key] = (v, deps.get(i))
-                    self._d.move_to_end(key)
-                while len(self._d) > self.capacity:
-                    self._d.popitem(last=False)
+        with self._lock:
+            for j, i in enumerate(miss):
+                if ok is not None and not ok[j]:
+                    continue
+                v = np.ascontiguousarray(sub[j])
+                key = (op.name, idx_np[i].tobytes())
+                self._d[key] = (v, deps.get(i))
+                self._d.move_to_end(key)
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+
+    def lookup(self, op, table_params, idx_np: np.ndarray) -> np.ndarray:
+        """Per-sample-cached equivalent of
+        ``op.host_lookup(table_params, idx_np)``: hit samples come from
+        the cache, miss samples go through ONE sub-batch host_lookup and
+        are inserted."""
+        vals, miss = self.probe(op, idx_np)
+        if miss:
+            sub = np.asarray(
+                op.host_lookup(table_params, idx_np[np.asarray(miss)]))
+            self.insert(op, idx_np, miss, sub)
+            for j, i in enumerate(miss):
+                vals[i] = np.ascontiguousarray(sub[j])
         return np.stack(vals, axis=0)
 
     def prewarm(self, op, table_params, idx_np: np.ndarray) -> int:

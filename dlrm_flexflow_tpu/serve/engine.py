@@ -122,11 +122,22 @@ class ReplicaDown(RuntimeError):
 class Prediction(NamedTuple):
     """Per-request result: model scores for the request's rows, the
     weight version (checkpoint step) that computed them, and the
-    end-to-end latency."""
+    end-to-end latency.
+
+    Under the sharded serving tier (serve/shardtier.py) two more fields
+    are populated: ``versions`` is the VERSION VECTOR — the per-shard
+    versions this request's embedding lookups actually read (keyed by
+    shard slot; old-or-new-never-mixed holds per shard, so each slot
+    appears with exactly one version) — and ``degraded`` is True when
+    any of the request's rows were answered from cache hits + per-table
+    default rows because a shard was out (the response is SERVED, just
+    flagged; see EmbeddingShardSet)."""
 
     scores: np.ndarray
     version: int
     latency_ms: float
+    versions: Optional[Dict[int, int]] = None
+    degraded: bool = False
 
 
 @dataclass
@@ -186,11 +197,19 @@ class InferenceEngine:
 
     def __init__(self, model, config: Optional[ServeConfig] = None,
                  checkpoint_dir: Optional[str] = None,
-                 replica_id: Optional[int] = None):
+                 replica_id: Optional[int] = None,
+                 shard_set=None):
         if model.params is None:
             raise ValueError("InferenceEngine needs an initialized model "
                              "(init_layers() or restore_checkpoint())")
         self._model = model
+        # the row-sharded lookup tier (serve/shardtier.py): when set,
+        # this engine is a STATELESS RANKER — sparse ids resolve through
+        # the shard set (fronted by the per-ranker EmbeddingCache), host
+        # rows of publishes route to the owning shards, and responses
+        # carry the per-shard version vector + degraded flag
+        self._shard_set = shard_set
+        self._lookup_meta = None   # batcher-thread scratch (per batch)
         # fleet identity: names the batcher thread, keys the per-replica
         # fault hooks (FF_FAULT_REPLICA_DOWN / per-replica serve delay)
         self.replica_id = replica_id
@@ -273,6 +292,8 @@ class InferenceEngine:
         self._delta_reloads = 0
         self._reload_rejects = 0
         self._last_reject = ""
+        self._n_degraded = 0
+        self._last_versions: Dict[int, int] = {}
         self._warmup_s = 0.0
         # how each dispatched batch was formed (continuous admission vs
         # flush-mode size/deadline) — lets the fleet bench verify the
@@ -471,6 +492,11 @@ class InferenceEngine:
         just starts cold."""
         if self._cache is None or not self.config.cache_warm:
             return
+        if getattr(self._model, "_host_tables_released", False):
+            log_serve.info("cache pre-warm skipped: ranker tables "
+                           "released to the shard tier (warm hits come "
+                           "from live traffic instead)")
+            return
         import os
 
         from ..utils.histogram import HISTOGRAM_FILE, load_histograms
@@ -517,7 +543,10 @@ class InferenceEngine:
                            path)
 
     def _host_gather(self):
-        """The cached host-table gather (None = model default)."""
+        """The cached host-table gather (None = model default); with a
+        shard set attached, the shard-tier gather instead."""
+        if self._shard_set is not None:
+            return self._shard_gather()
         if self._cache is None:
             return None
         model = self._model
@@ -537,6 +566,88 @@ class InferenceEngine:
             return {op.name: jax.device_put(
                         rows[op], model._out_sharding[op.outputs[0].guid])
                     for op in rows}
+
+        return gather
+
+    def attach_shard_set(self, shard_set) -> "InferenceEngine":
+        """Wire this ranker to a (shared) EmbeddingShardSet. Must
+        happen before ``start()`` — the warmed bucket executables bake
+        the gather hook's call sites."""
+        if self._started:
+            raise RuntimeError("attach_shard_set before start()")
+        self._shard_set = shard_set
+        return self
+
+    @property
+    def shard_set(self):
+        return self._shard_set
+
+    def _shard_gather(self):
+        """The sharded-tier gather: probe the per-ranker cache per
+        sample and op, batch EVERY op's misses into ONE
+        ``EmbeddingShardSet.fetch`` (one locked read per shard — the
+        version-vector consistency unit), assemble the miss samples
+        through the op's own ``host_lookup_rows`` (bit-identical to the
+        local host path), and insert only NON-degraded samples back into
+        the cache. The batch's version vector + per-row degraded marks
+        are stashed for ``_dispatch`` to tag each request's
+        Prediction."""
+        model = self._model
+        cache = self._cache
+        shard_set = self._shard_set
+
+        def gather(host_idx):
+            import jax
+            plan = {}
+            per_op = {}
+            n_rows = None
+            for op in model._host_resident_list:
+                idx = np.asarray(host_idx[op.name])
+                n_rows = int(idx.shape[0])
+                if cache is not None:
+                    vals, miss = cache.probe(op, idx)
+                else:
+                    vals, miss = [None] * n_rows, list(range(n_rows))
+                entry = {"idx": idx, "vals": vals, "miss": miss}
+                if miss:
+                    g3 = op.host_flat_indices(idx[np.asarray(miss)])
+                    u, inv = np.unique(g3, return_inverse=True)
+                    entry.update(g3=g3, u=u, inv=inv)
+                    plan[op.name] = u
+                per_op[op] = entry
+            fetch = shard_set.fetch(plan) if plan else None
+            row_degraded = np.zeros(n_rows or 0, bool)
+            out_rows = {}
+            for op, entry in per_op.items():
+                vals, miss = entry["vals"], entry["miss"]
+                if miss:
+                    g3, u, inv = entry["g3"], entry["u"], entry["inv"]
+                    rows = fetch.rows[op.name]
+                    local = inv.reshape(g3.shape).astype(np.int64)
+                    sub = np.asarray(op.host_lookup_rows(rows, local))
+                    # which miss samples were assembled from default
+                    # rows: flagged degraded, never cached
+                    dm = fetch.default_mask[op.name][inv].reshape(
+                        g3.shape)
+                    sample_deg = dm.reshape(dm.shape[0], -1).any(axis=1)
+                    if cache is not None:
+                        cache.insert(op, entry["idx"], miss, sub,
+                                     ok=~sample_deg)
+                    for j, i in enumerate(miss):
+                        vals[i] = np.ascontiguousarray(sub[j])
+                    row_degraded[np.asarray(miss)[sample_deg]] = True
+                out_rows[op.name] = np.stack(vals, axis=0)
+            self._lookup_meta = {
+                "versions": dict(fetch.versions) if fetch else
+                            shard_set.version_vector(),
+                "row_degraded": row_degraded,
+            }
+            from ..analysis import sanitizer as _san
+            _san.note_jax_dispatch("shard-tier row device_put")
+            return {op.name: jax.device_put(
+                        out_rows[op.name],
+                        model._out_sharding[op.outputs[0].guid])
+                    for op in model._host_resident_list}
 
         return gather
 
@@ -573,23 +684,41 @@ class InferenceEngine:
         # a lock across device work (the FF_SANITIZE=1 run asserts it)
         self._apply_pending_swap()
         version = self._applied_version
+        self._lookup_meta = None
         out = self._model.forward_bucket(
             batch, bucket=bucket, host_gather=self._host_gather())
         scores = np.asarray(out)          # device→host sync
+        # shard-tier metadata the gather hook stashed for THIS batch:
+        # the per-shard version vector and which rows degraded to
+        # default embeddings (padding rows beyond n are ignored — a
+        # dead shard owning row 0 must not flag real requests that
+        # never looked anything up)
+        meta = self._lookup_meta
+        self._lookup_meta = None
+        versions = meta["versions"] if meta else None
+        rowdeg = meta["row_degraded"] if meta else None
         t_done = time.monotonic()
         off = 0
+        n_degraded = 0
         for r in live:
+            deg = bool(rowdeg is not None
+                       and rowdeg[off:off + r.rows].any())
+            n_degraded += int(deg)
             r.future.set_result(Prediction(
                 scores[off:off + r.rows], version,
-                1e3 * (t_done - r.t0)))
+                1e3 * (t_done - r.t0), versions=versions,
+                degraded=deg))
             off += r.rows
         with self._stats_lock:
             for r in live:
                 self._lat_ms.append(1e3 * (t_done - r.t0))
             self._n_responses += len(live)
+            self._n_degraded += n_degraded
             self._n_batches += 1
             self._rows_served += n
             self._rows_padded += bucket - n
+            if versions is not None:
+                self._last_versions = versions
 
     # --- hot reload (called by SnapshotWatcher) ------------------------
     def install_snapshot(self, state: Dict[str, Any], version: int,
@@ -678,9 +807,19 @@ class InferenceEngine:
         for kind, state, version, source, applied in pending:
             try:
                 if kind == "full":
+                    host_params = state.get("host_params")
+                    if self._shard_set is not None:
+                        # split tier: host tables belong to the shard
+                        # set (idempotent per version — every ranker's
+                        # watcher routes the same snapshot here); the
+                        # stateless ranker swaps dense params only
+                        if host_params is not None:
+                            self._shard_set.install_full(host_params,
+                                                         int(version))
+                        host_params = None
                     self._model.swap_params(
                         params=state["params"],
-                        host_params=state.get("host_params"),
+                        host_params=host_params,
                         op_state=state.get("op_state"))
                     if self._cache is not None:
                         self._cache.invalidate()
@@ -690,6 +829,20 @@ class InferenceEngine:
                         # are post-swap lookups, so never-mixed holds;
                         # no-op unless --serve-cache-warm is set)
                         self._prewarm_cache()
+                elif self._shard_set is not None:
+                    # delta: host-table rows route to their owning
+                    # shards (per-slice CRC chains, atomic per shard);
+                    # the ranker applies the dense remainder
+                    self._shard_set.apply_delta(state, int(version))
+                    dense = dict(state)
+                    dense["rows"] = {k: v for k, v in
+                                     state.get("rows", {}).items()
+                                     if not k.startswith("hostparams/")}
+                    dense["full"] = {k: v for k, v in
+                                     state.get("full", {}).items()
+                                     if not k.startswith("hostparams/")}
+                    self._model.apply_delta(dense)
+                    self._invalidate_cache_rows(state)
                 else:
                     self._model.apply_delta(state)
                     self._invalidate_cache_rows(state)
@@ -771,6 +924,20 @@ class InferenceEngine:
         return self._applied_any
 
     @property
+    def version_floor(self) -> int:
+        """The oldest version anywhere in this engine's serving path:
+        its own applied version AND (split tier) the oldest live shard.
+        The snapshot watcher keys its catch-up on this — a replacement
+        shard that booted slightly stale keeps the delta chain
+        replaying (idempotent per shard) until the whole tier is at the
+        tip, even though the ranker itself already is."""
+        if self._shard_set is None:
+            return self._version
+        floor = self._shard_set.min_version()
+        return self._version if floor is None \
+            else min(self._version, floor)
+
+    @property
     def model(self):
         return self._model
 
@@ -824,14 +991,20 @@ class InferenceEngine:
         when sending this replica traffic is pointless: the engine is
         draining (close() begun / never started), its batcher thread
         died, or the bounded queue is saturated (submits are being
-        rejected with Overloaded right now)."""
+        rejected with Overloaded right now).
+
+        ``degraded`` is True while the shard tier has a shard out of the
+        routable set: answers are still served (cache hits + default
+        rows, flagged per response) — DEGRADED IS NOT DOWN. A load
+        balancer must keep routing here (HTTP 200 with
+        ``"degraded": true``), reserving 503 for ``ok: false``."""
         depth = len(self._q)
         saturated = depth >= self.config.queue_capacity
         draining = self._closing or not self._started
         t = self._thread
         batcher_alive = bool(t is not None and t.is_alive())
         dead = self._started and not self._closing and not batcher_alive
-        return {
+        out = {
             "ok": not (saturated or draining or dead),
             "version": self._version,
             "draining": draining,
@@ -840,6 +1013,11 @@ class InferenceEngine:
             "queue_depth": depth,
             "queue_capacity": self.config.queue_capacity,
         }
+        if self._shard_set is not None:
+            out["degraded"] = self._shard_set.degraded_now()
+            out["shard_states"] = {r.slot: r.state
+                                   for r in self._shard_set.shards}
+        return out
 
     # --- observability -------------------------------------------------
     def stats(self) -> Dict[str, Any]:
@@ -875,6 +1053,10 @@ class InferenceEngine:
         }
         if self.replica_id is not None:
             out["replica_id"] = self.replica_id
+        if self._shard_set is not None:
+            out["degraded_responses"] = self._n_degraded
+            out["shard_versions"] = dict(self._last_versions)
+            out["shard_set"] = self._shard_set.stats()
         cc = getattr(self._model, "_compile_cache", None)
         if cc is not None:
             out["compile_cache"] = cc.stats()

@@ -43,29 +43,31 @@ EJECTED = "ejected"
 PROBING = "probing"
 
 
-class Replica:
-    """One engine plus its circuit-breaker state.
+class CircuitBreaker:
+    """The eject/probe/re-admit state machine, decoupled from what it
+    guards.
 
-    All transitions happen under the replica's own lock and are driven
-    by the router (request callbacks + health thread); the engine knows
-    nothing about fleet membership beyond its ``replica_id``.
+    One instance wraps one failure-isolatable unit: a fleet
+    :class:`Replica` (an engine), or the shard tier's lookup shards
+    (``serve/shardtier.py`` wraps each :class:`~.shardtier
+    .EmbeddingShard` in the SAME machine) — the whole serving stack
+    speaks one health vocabulary, and a shard outage reads exactly like
+    a replica outage in stats and logs. All transitions happen under the
+    breaker's own lock; ``_on_eject`` is the subclass hook for
+    unit-specific isolation work (a replica drains its queue there).
     """
 
-    def __init__(self, engine: InferenceEngine, rid: int,
-                 cohort: str = "stable", state: str = HEALTHY):
-        self.engine = engine
+    KIND = "unit"
+
+    def __init__(self, rid: int, state: str = HEALTHY):
         self.rid = rid
-        # deployment cohort: "stable" serves normal traffic, "canary"
-        # serves the routed fraction on a candidate snapshot, "shadow"
-        # serves only duplicated traffic and never answers a client
-        self.cohort = cohort
         self.state = state
-        # a freshly-grown replica is born PROBING (`state=PROBING`) and
+        # a freshly-grown unit is born PROBING (`state=PROBING`) and
         # carries this flag: it receives NO client traffic until the
-        # router's end-to-end admission probe succeeds — a replica that
-        # boots broken costs a probe failure, never a client error
+        # end-to-end admission probe succeeds — a unit that boots broken
+        # costs a probe failure, never a client error
         self.awaiting_admission = state == PROBING
-        self._lock = make_lock(f"Replica._lock[{rid}]")
+        self._lock = make_lock(f"{type(self).__name__}._lock[{rid}]")
         self.consecutive_errors = 0
         self.ejected_at = 0.0
         self.last_error = ""
@@ -74,20 +76,6 @@ class Replica:
         self.readmissions = 0
         self.probes = 0
         self.dispatch_errors = 0
-        # pre-deploy state kept while this replica runs a canary/shadow
-        # snapshot: rollback = install this back (the arrays are
-        # immutable JAX trees, so holding references is free)
-        self.rollback_state: Optional[Dict[str, Any]] = None
-        self.rollback_version: int = 0
-
-    # --- routing signals ----------------------------------------------
-    @property
-    def queue_depth(self) -> int:
-        return self.engine.queue_depth
-
-    def routable(self, cohort: str = "stable") -> bool:
-        """Eligible for client traffic of the given cohort."""
-        return self.state == HEALTHY and self.cohort == cohort
 
     # --- circuit breaker ----------------------------------------------
     def record_success(self) -> None:
@@ -104,10 +92,14 @@ class Replica:
             return (self.state == HEALTHY
                     and self.consecutive_errors >= eject_after)
 
+    def _on_eject(self, reason: str) -> int:
+        """Unit-specific isolation work after the state flip; returns a
+        count for the log line (a replica: drained requests)."""
+        return 0
+
     def eject(self, reason: str) -> int:
-        """HEALTHY/PROBING -> EJECTED: stop routing here, drain the
-        queue so every waiting future fails fast with ReplicaDown (the
-        router retries each on a survivor). Returns drained count."""
+        """HEALTHY/PROBING -> EJECTED: stop routing here and run the
+        unit's isolation hook. Returns the hook's count."""
         with self._lock:
             if self.state == EJECTED:
                 return 0
@@ -115,16 +107,15 @@ class Replica:
             self.ejected_at = time.monotonic()
             self.ejections += 1
             self.last_error = reason
-        drained = self.engine.drain_pending(
-            ReplicaDown(self.rid, f"ejected: {reason}"))
+        drained = self._on_eject(reason)
         log_fleet.warning(
-            "ejected replica %d (%s) — drained %d queued request(s) "
-            "onto the surviving replicas", self.rid, reason, drained)
+            "ejected %s %d (%s) — drained %d queued request(s) "
+            "onto the survivors", self.KIND, self.rid, reason, drained)
         return drained
 
     def due_for_probe(self, cooldown_s: float) -> bool:
         with self._lock:
-            if self.awaiting_admission:     # born-PROBING (Fleet.grow):
+            if self.awaiting_admission:     # born-PROBING (grow/replace):
                 return True                 # admission probe runs at the
             return (self.state == EJECTED   # next health tick, no cooldown
                     and time.monotonic() - self.ejected_at >= cooldown_s)
@@ -149,8 +140,59 @@ class Replica:
             self.state = HEALTHY
             self.consecutive_errors = 0
             self.readmissions += 1
-        log_fleet.info("re-admitted replica %d (was %s) after probe "
-                       "success", self.rid, prev)
+        log_fleet.info("re-admitted %s %d (was %s) after probe "
+                       "success", self.KIND, self.rid, prev)
+
+    def breaker_stats(self) -> Dict[str, Any]:
+        return {
+            "state": self.state,
+            "consecutive_errors": self.consecutive_errors,
+            "dispatch_errors": self.dispatch_errors,
+            "ejections": self.ejections,
+            "readmissions": self.readmissions,
+            "probes": self.probes,
+            "last_error": self.last_error,
+        }
+
+
+class Replica(CircuitBreaker):
+    """One engine plus its circuit-breaker state.
+
+    All transitions happen under the replica's own lock and are driven
+    by the router (request callbacks + health thread); the engine knows
+    nothing about fleet membership beyond its ``replica_id``.
+    """
+
+    KIND = "replica"
+
+    def __init__(self, engine: InferenceEngine, rid: int,
+                 cohort: str = "stable", state: str = HEALTHY):
+        super().__init__(rid, state=state)
+        self.engine = engine
+        # deployment cohort: "stable" serves normal traffic, "canary"
+        # serves the routed fraction on a candidate snapshot, "shadow"
+        # serves only duplicated traffic and never answers a client
+        self.cohort = cohort
+        # pre-deploy state kept while this replica runs a canary/shadow
+        # snapshot: rollback = install this back (the arrays are
+        # immutable JAX trees, so holding references is free)
+        self.rollback_state: Optional[Dict[str, Any]] = None
+        self.rollback_version: int = 0
+
+    # --- routing signals ----------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return self.engine.queue_depth
+
+    def routable(self, cohort: str = "stable") -> bool:
+        """Eligible for client traffic of the given cohort."""
+        return self.state == HEALTHY and self.cohort == cohort
+
+    def _on_eject(self, reason: str) -> int:
+        """Drain the queue so every waiting future fails fast with
+        ReplicaDown (the router retries each on a survivor)."""
+        return self.engine.drain_pending(
+            ReplicaDown(self.rid, f"ejected: {reason}"))
 
     # --- deployment helpers (used by the router's canary/shadow) -------
     def capture_rollback_state(self) -> None:
@@ -172,19 +214,14 @@ class Replica:
         self.rollback_state = None
 
     def stats(self) -> Dict[str, Any]:
-        return {
-            "state": self.state,
+        out = self.breaker_stats()
+        out.update({
             "cohort": self.cohort,
             "queue_depth": self.queue_depth,
-            "consecutive_errors": self.consecutive_errors,
-            "dispatch_errors": self.dispatch_errors,
-            "ejections": self.ejections,
-            "readmissions": self.readmissions,
-            "probes": self.probes,
-            "last_error": self.last_error,
             "heartbeat_age_s": round(self.engine.heartbeat_age(), 4),
             "engine": self.engine.stats(),
-        }
+        })
+        return out
 
 
 class Fleet:
@@ -211,13 +248,18 @@ class Fleet:
 
     def __init__(self, engines: List[InferenceEngine],
                  model_factory=None, config=None,
-                 checkpoint_dir: Optional[str] = None):
+                 checkpoint_dir: Optional[str] = None,
+                 shard_set=None):
         if not engines:
             raise ValueError("a fleet needs at least one replica")
         # grow() provisioning recipe (None = fixed-size fleet)
         self._factory = model_factory
         self._config = config
         self._checkpoint_dir = checkpoint_dir
+        # the shared row-sharded lookup tier (serve/shardtier.py) the
+        # ranker replicas resolve sparse ids through; one set serves
+        # every ranker, so it hangs off the FLEET, not a replica
+        self.shard_set = shard_set
         # replicas list is COPY-ON-WRITE under this lock: readers (the
         # router's pick/health loops) grab the current list reference
         # without locking; grow/shrink build a new list and swap it
@@ -236,7 +278,8 @@ class Fleet:
 
     @classmethod
     def build(cls, model_factory, n: int, config=None,
-              checkpoint_dir: Optional[str] = None) -> "Fleet":
+              checkpoint_dir: Optional[str] = None,
+              shard_set=None) -> "Fleet":
         """N engines over N fresh models from ``model_factory(i)``; each
         gets its own SnapshotWatcher when a checkpoint dir is given, so
         the whole fleet follows the trainer's publications.
@@ -250,10 +293,10 @@ class Fleet:
         autoscaler can :meth:`grow` the fleet later."""
         engines = [InferenceEngine(model_factory(i), config,
                                    checkpoint_dir=checkpoint_dir,
-                                   replica_id=i)
+                                   replica_id=i, shard_set=shard_set)
                    for i in range(n)]
         return cls(engines, model_factory=model_factory, config=config,
-                   checkpoint_dir=checkpoint_dir)
+                   checkpoint_dir=checkpoint_dir, shard_set=shard_set)
 
     def __len__(self) -> int:
         return len(self.replicas)
@@ -342,7 +385,8 @@ class Fleet:
             rid = next_rid + k
             eng = InferenceEngine(self._factory(rid), self._config,
                                   checkpoint_dir=self._checkpoint_dir,
-                                  replica_id=rid)
+                                  replica_id=rid,
+                                  shard_set=self.shard_set)
             fresh.append(Replica(eng, rid, state=PROBING))
         self._start_engines(fresh)
         with self._fleet_lock:
@@ -414,7 +458,7 @@ class Fleet:
                             "timeouts", "batches", "queue_depth",
                             "reloads", "reload_rejects")}
         dispatched = sum(p["engine"]["requests"] for p in per.values())
-        return {
+        out = {
             "replicas": per,
             "size": len(self.replicas),
             "healthy": len(self.healthy()),
@@ -426,3 +470,6 @@ class Fleet:
             "grows": self.grows,
             "shrinks": self.shrinks,
         }
+        if self.shard_set is not None:
+            out["shard_set"] = self.shard_set.stats()
+        return out

@@ -159,7 +159,14 @@ class _Timer(threading.Thread):
 
 
 class _Cohort:
-    """Latency window + running score mean for one deployment cohort."""
+    """Latency window + running score mean for one deployment cohort —
+    plus, under the sharded serving tier, the newest per-shard VERSION
+    VECTOR the cohort's responses read. Canary judgement compares
+    vectors, not scalar versions: with tables split over shards there
+    is no single "the version" anymore, and two cohorts mid-publish can
+    legitimately read different shard versions for a tick — comparing
+    their score means then would blame the deploy for a skew the
+    publish caused."""
 
     def __init__(self, maxlen: int):
         self._lock = make_lock("_Cohort._lock")
@@ -167,29 +174,43 @@ class _Cohort:
         self.lat_ms: "deque[float]" = deque(maxlen=maxlen)
         self.score_sum = 0.0
         self.score_n = 0
+        self.versions: Optional[Dict[int, int]] = None
+        self.degraded = 0
 
     def reset(self) -> None:
         with self._lock:
             self.lat_ms = deque(maxlen=self.maxlen)
             self.score_sum = 0.0
             self.score_n = 0
+            self.versions = None
+            self.degraded = 0
 
-    def add(self, ms: float, scores: np.ndarray) -> None:
+    def add(self, ms: float, scores: np.ndarray,
+            versions: Optional[Dict[int, int]] = None,
+            degraded: bool = False) -> None:
         with self._lock:
             self.lat_ms.append(ms)
             self.score_sum += float(np.sum(scores))
             self.score_n += int(scores.size)
+            if versions is not None:
+                self.versions = versions
+            self.degraded += int(degraded)
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             lat = sorted(self.lat_ms)
             s, n = self.score_sum, self.score_n
+            vv = dict(self.versions) if self.versions is not None \
+                else None
+            degraded = self.degraded
         return {
             "n": len(lat),
             "p50_ms": percentile(lat, 50),
             "p99_ms": percentile(lat, 99),
             "score_mean": (s / n) if n else None,
             "score_n": n,
+            "versions": vv,
+            "degraded": degraded,
         }
 
 
@@ -256,6 +277,7 @@ class FleetRouter:
         self._rollbacks = 0
         self._promotions = 0
         self._last_rollback_reason = ""
+        self._vv_skew_skips = 0
         self._shadow_rid: Optional[int] = None
         self._shadow_credit = 0.0
         self._shadow_n = 0
@@ -434,9 +456,14 @@ class FleetRouter:
             if hedge:
                 self._n_hedge_wins += 1
         # cohort metrics feed the canary judgement: client-observed
-        # latency (what an SLO means) + the response score mass
+        # latency (what an SLO means) + the response score mass + the
+        # shard version vector the response read (vector-mismatch gates
+        # the score comparison under the sharded tier)
         cohort = rep.cohort if rep.cohort in self._cohorts else "stable"
-        self._cohorts[cohort].add(ms, np.asarray(pred.scores))
+        self._cohorts[cohort].add(
+            ms, np.asarray(pred.scores),
+            versions=getattr(pred, "versions", None),
+            degraded=bool(getattr(pred, "degraded", False)))
         if shadow_scores is not None:
             self._shadow_compare(pred.scores, shadow_scores)
 
@@ -752,6 +779,17 @@ class FleetRouter:
                 f"{cfg.canary_p99_ratio:g}x stable {s['p99_ms']:.1f} ms")
             return
         if c["score_mean"] is not None and s["score_mean"] is not None:
+            # version-vector gate (sharded tier): when the two cohorts'
+            # responses read DIFFERENT shard versions — a publish
+            # landing shard by shard, or one cohort degraded onto
+            # default rows — their score means are not comparable this
+            # tick. Skip the judgement (counted) rather than roll back
+            # a healthy deploy for skew the embedding tier caused.
+            c_vv, s_vv = c.get("versions"), s.get("versions")
+            if (c_vv is not None and s_vv is not None and c_vv != s_vv):
+                with self._m_lock:
+                    self._vv_skew_skips += 1
+                return
             gap = abs(c["score_mean"] - s["score_mean"])
             # NOT `gap > tol`: a truly garbage canary (params scaled to
             # overflow) scores inf/NaN, and `nan > tol` is False — the
@@ -766,11 +804,15 @@ class FleetRouter:
     # --- observability -------------------------------------------------
     def healthz(self) -> Dict[str, Any]:
         """Fleet readiness: ok while at least one healthy replica can
-        accept a request and the router is not draining."""
+        accept a request and the router is not draining. ``degraded``
+        (sharded tier) means answers are being served from cache +
+        default rows while a lookup shard is out — still ok: a load
+        balancer must keep routing to a degraded-but-answering fleet
+        (HTTP 200 with ``"degraded": true``), not starve it."""
         healthy = self.fleet.healthy()
         accepting = [r for r in healthy
                      if r.engine.healthz()["ok"]]
-        return {
+        out = {
             "ok": bool(accepting) and not self._closed,
             "draining": self._closed,
             "size": len(self.fleet),
@@ -778,6 +820,12 @@ class FleetRouter:
             "accepting": len(accepting),
             "states": {r.rid: r.state for r in self.fleet.replicas},
         }
+        shard_set = getattr(self.fleet, "shard_set", None)
+        if shard_set is not None:
+            out["degraded"] = shard_set.degraded_now()
+            out["shard_states"] = {r.slot: r.state
+                                   for r in shard_set.shards}
+        return out
 
     def stats(self) -> Dict[str, Any]:
         with self._m_lock:
@@ -803,6 +851,7 @@ class FleetRouter:
                 "rollbacks": self._rollbacks,
                 "promotions": self._promotions,
                 "last_rollback_reason": self._last_rollback_reason,
+                "version_vector_skew_skips": self._vv_skew_skips,
             },
             "cohorts": {k: v.snapshot()
                         for k, v in self._cohorts.items()},

@@ -1,0 +1,1129 @@
+"""Row-sharded serving tier: lookup shards behind stateless rankers.
+
+The serving fleet (PR 6/12) is N replicas each holding FULL embedding
+tables — a DLRM-Terabyte model cannot be served at all (ROADMAP item 1),
+even though training row-shards the same tables at pod scale (PR 8,
+``parallel/alltoall.py``). This module splits serving the same way the
+training mesh does:
+
+- **Ranker tier** — :class:`~.engine.InferenceEngine` replicas hold the
+  (small) dense params only and resolve every sparse id through the
+  shard tier; their per-ranker :class:`~.cache.EmbeddingCache` fronts
+  the remote rows. Rankers are stateless with respect to tables
+  (:meth:`EmbeddingShardSet.release_ranker_tables`), so a ranker costs
+  dense params + cache, not tables × replicas.
+- **Lookup tier** — an :class:`EmbeddingShardSet` of N
+  :class:`EmbeddingShard` servers, each owning a contiguous row block
+  of EVERY table's flat row space. The owner math is the training
+  exchange's (``parallel.alltoall.shard_row_ranges`` /
+  ``row_owners`` — the host-side statement of ``owner = id //
+  rows_local``), so a serving plan's placement is by construction the
+  one a row-sharded training mesh uses, and shardcheck's FLX507 audit
+  verifies the tiling statically.
+
+**Consistency is a version vector.** Every shard carries its own
+version (the step of the last publish applied to it); every
+:class:`~.engine.Prediction` is tagged with the per-shard versions its
+lookups read. Old-or-new-never-mixed is enforced PER SHARD structurally:
+all of a request's ops are batched into ONE locked lookup per shard, and
+a publish applies to a shard atomically under the same lock — one
+request can therefore never observe two versions of the same shard.
+PR 10's delta chains publish per-shard: ``utils.delta
+.split_host_rows_by_shard`` cuts a delta along the shard ranges, stamps
+each slice with a CRC the owning shard recomputes before applying, and
+each shard chains those CRCs (``shard_chain_crc``) — a publish touches
+only owning shards; the others pay a version bump.
+
+**Robustness is the headline.** Shard lookups run under a deadline with
+bounded retry + exponential backoff and optional tail-latency hedging
+(duplicate-after-delay, first result wins — the FleetRouter discipline
+applied one tier down). Each shard sits behind the SAME circuit breaker
+the fleet's replicas use (:class:`~.fleet.CircuitBreaker`:
+HEALTHY→EJECTED→PROBING→HEALTHY); an ejected shard triggers **graceful
+degradation** instead of failed requests — rankers serve cache hits
+plus a per-table default row (the table's mean embedding) for misses,
+responses are explicitly flagged ``degraded=True``, degraded answers
+are counted in ``stats()``, and nothing degraded is ever inserted into
+the cache (a shard outage must not outlive itself as poisoned cache
+entries). ``degrade="fail"`` opts into failing instead. The
+autoscaler's replace-dead path boots a replacement shard from the warm
+cache (``utils.warmcache.ShardCache``), replays any publishes it missed
+from the set's recent history, and re-admits it only on probe success.
+
+Shards carry a **failure domain** label (``fd<k>``, round-robin over
+``failure_domains``): stats group outages by domain so a rack-level
+event reads as one domain dark, not N unrelated shard deaths.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from dataclasses import dataclass
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..analysis.sanitizer import make_lock
+from ..parallel.alltoall import row_owners, shard_row_ranges
+from ..utils import faults
+from ..utils.delta import (ChainError, shard_chain_crc, shard_slice_crc,
+                           split_host_rows_by_shard)
+from ..utils.logging import get_logger
+from ..utils.watchdog import Deadline
+from .fleet import EJECTED, HEALTHY, PROBING, CircuitBreaker
+
+log_shard = get_logger("serve.shardtier")
+
+
+class ShardDown(RuntimeError):
+    """This lookup shard is gone — a crash (``FF_FAULT_SHARD_DOWN``) or
+    the circuit breaker refusing an ejected shard. Retryable up to the
+    lookup budget; exhaustion degrades the response (or fails it under
+    ``degrade="fail"``)."""
+
+    def __init__(self, shard_id: Optional[int] = None, detail: str = ""):
+        sid = "?" if shard_id is None else shard_id
+        super().__init__(f"embedding shard {sid} is down"
+                         + (f": {detail}" if detail else ""))
+        self.shard_id = shard_id
+
+
+class ShardLookupTimeout(TimeoutError):
+    """A shard lookup missed its deadline (slow host, injected delay).
+    Counts against the shard's circuit breaker like any other error."""
+
+
+class ShardTierUnavailable(RuntimeError):
+    """``degrade="fail"`` and a shard's lookup budget is spent — the
+    request cannot be answered at full fidelity and the policy forbids
+    default rows. The router retries / sheds like FleetUnavailable."""
+
+
+@dataclass
+class ShardTierConfig:
+    """Lookup-tier knobs; ``from_config`` lifts the ``--serve-*``
+    flags."""
+
+    nshards: int = 2
+    lookup_deadline_ms: float = 50.0  # per-shard-lookup budget
+    #                                   (retries included)
+    retries: int = 1                  # re-lookups after the first try
+    backoff_ms: float = 2.0           # exponential retry backoff base
+    hedge_ms: float = 0.0             # duplicate-after delay; 0 = off
+    eject_after: int = 3              # consecutive errors -> ejection
+    cooldown_s: float = 1.0           # ejection -> first probe
+    probe_deadline_s: float = 5.0     # end-to-end probe budget
+    replace_after: int = 2            # failed probes -> replace-dead
+    degrade: str = "cache"            # cache (default rows) | fail
+    failure_domains: int = 0          # spread shards over N domains
+
+    def __post_init__(self):
+        if self.nshards < 1:
+            raise ValueError(f"nshards must be >= 1, got {self.nshards}")
+        if self.degrade not in ("cache", "fail"):
+            raise ValueError(
+                f"degrade must be 'cache' or 'fail', got "
+                f"{self.degrade!r}")
+
+    @staticmethod
+    def from_config(cfg) -> "ShardTierConfig":
+        return ShardTierConfig(
+            nshards=max(int(getattr(cfg, "serve_shards", 0)), 1),
+            lookup_deadline_ms=float(
+                getattr(cfg, "serve_lookup_deadline_ms", 50.0)),
+            hedge_ms=float(getattr(cfg, "serve_hedge_ms", 0.0)),
+            degrade=str(getattr(cfg, "serve_degrade", "cache")))
+
+
+class FetchResult(NamedTuple):
+    """One batched lookup's outcome: per-op row matrices aligned with
+    the requested unique ids, which of those rows are degradation
+    defaults, and the per-shard version vector actually read."""
+
+    rows: Dict[str, np.ndarray]          # op -> (U, d) float32
+    default_mask: Dict[str, np.ndarray]  # op -> (U,) bool
+    versions: Dict[int, int]             # shard slot -> version read
+    degraded: bool
+    defaults_used: int
+
+
+def _table_bounds(op, flat_rows: int) -> List[Tuple[int, int]]:
+    """Per-TABLE [lo, hi) regions of the op's flat row space (the
+    per-table default rows are means over these regions)."""
+    sizes = getattr(op, "table_sizes", None)
+    if sizes is not None:                       # concat: ragged tables
+        offs = list(op._offsets)
+        return [(o, o + s) for o, s in zip(offs, sizes)]
+    tables = int(getattr(op, "num_tables", 1))
+    rows = flat_rows // max(tables, 1)
+    return [(t * rows, (t + 1) * rows) for t in range(tables)]
+
+
+class EmbeddingShard:
+    """One lookup server: a contiguous row block of every table.
+
+    ``sid`` is the shard's unique identity (fault hooks and logs key on
+    it; a replacement gets a fresh one); ``slot`` is the row-range it
+    owns (stable across replacement — the version vector is keyed by
+    slot). All reads and writes serialize on the shard's own lock, so a
+    lookup observes exactly one version and a publish applies atomically
+    between lookups — the per-shard never-mixed contract is structural,
+    not cooperative.
+    """
+
+    def __init__(self, sid: int, slot: int,
+                 blocks: Dict[str, np.ndarray],
+                 ranges: Dict[str, Tuple[int, int]],
+                 version: int = 0, chain_crc: int = 0,
+                 domain: str = ""):
+        self.sid = int(sid)
+        self.slot = int(slot)
+        self.domain = domain
+        self._blocks = blocks
+        self._ranges = {k: (int(lo), int(hi))
+                        for k, (lo, hi) in ranges.items()}
+        self._lock = make_lock(f"EmbeddingShard._lock[{sid}]",
+                               no_dispatch=True)
+        self._version = int(version)
+        self._chain_crc = int(chain_crc) & 0xFFFFFFFF
+        self.lookups = 0
+        self.rows_served = 0
+        self.publishes_applied = 0
+        self.apply_rejects = 0
+        self.last_reject = ""
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    @property
+    def chain_crc(self) -> int:
+        return self._chain_crc
+
+    def hbm_bytes(self) -> int:
+        return int(sum(b.nbytes for b in self._blocks.values()))
+
+    def owned_range(self, op_name: str) -> Tuple[int, int]:
+        return self._ranges[op_name]
+
+    # --- read path -----------------------------------------------------
+    def lookup(self, requests: Dict[str, np.ndarray]
+               ) -> Tuple[Dict[str, np.ndarray], int]:
+        """Serve every op's requested rows in ONE locked read; returns
+        ``({op: (k, d) rows}, version)``. The whole request sees one
+        version of this shard — a concurrent publish lands entirely
+        before or entirely after it."""
+        # fault hooks OUTSIDE the lock: an injected slow lookup must
+        # stall this caller, never a concurrent publish
+        faults.maybe_lookup_delay(self.sid)
+        if faults.take_shard_down(self.sid):
+            raise ShardDown(self.sid, "fault injection")
+        out = {}
+        served = 0
+        with self._lock:
+            ver = self._version
+            for op_name, ids in requests.items():
+                lo, hi = self._ranges[op_name]
+                g = np.asarray(ids, np.int64)
+                if g.size and (int(g.min()) < lo or int(g.max()) >= hi):
+                    raise ValueError(
+                        f"shard {self.sid} (slot {self.slot}) asked for "
+                        f"rows outside its [{lo}, {hi}) range of "
+                        f"{op_name!r}")
+                out[op_name] = self._blocks[op_name][g - lo]
+                served += int(g.size)
+            self.lookups += 1
+            self.rows_served += served
+        return out, ver
+
+    # --- write path (publishes) ----------------------------------------
+    def apply_publish(self, sub: Optional[Dict[str, Any]],
+                      version: int,
+                      expect_crc: Optional[int] = None) -> bool:
+        """Apply one publish's slice for this shard atomically. ``sub``
+        None = the publish touched no row this shard owns (version bump
+        + chain link only). The slice CRC is recomputed here and must
+        match ``expect_crc`` (split-time): corruption between the
+        publisher and this shard is a reject-with-reason — the shard
+        keeps its old (consistent) version and LAGS, which the watcher's
+        catch-up path repairs. Idempotent: a version at or below the
+        shard's is a no-op (every ranker's watcher routes the same
+        publish here)."""
+        slice_crc = 0
+        if sub is not None:
+            slice_crc = shard_slice_crc(sub)
+            if expect_crc is not None and slice_crc != expect_crc:
+                reason = (
+                    f"publish {version} slice CRC {slice_crc} != "
+                    f"declared {expect_crc} (corrupt in transit)")
+                with self._lock:
+                    self.apply_rejects += 1
+                    self.last_reject = reason
+                raise ChainError(reason)
+        with self._lock:
+            if int(version) <= self._version:
+                return False
+            if sub is not None:
+                for key, (idx, vals) in sub.get("rows", {}).items():
+                    op_name = key.split("/")[1]
+                    lo, hi = self._ranges[op_name]
+                    g = np.asarray(idx, np.int64)
+                    if g.size and (int(g.min()) < lo
+                                   or int(g.max()) >= hi):
+                        self.apply_rejects += 1
+                        self.last_reject = (
+                            f"publish {version} routes rows outside "
+                            f"this shard's [{lo}, {hi}) range of "
+                            f"{op_name!r}")
+                        raise ChainError(self.last_reject)
+                    self._blocks[op_name][g - lo] = vals
+                for key, arr in sub.get("full", {}).items():
+                    op_name = key.split("/")[1]
+                    lo, hi = self._ranges[op_name]
+                    block = self._blocks[op_name]
+                    if arr.shape != block.shape:
+                        self.apply_rejects += 1
+                        self.last_reject = (
+                            f"publish {version} full slice for "
+                            f"{op_name!r} has shape {arr.shape}, shard "
+                            f"block is {block.shape}")
+                        raise ChainError(self.last_reject)
+                    block[...] = arr
+            self._chain_crc = shard_chain_crc(self._chain_crc,
+                                              int(version), slice_crc)
+            self._version = int(version)
+            self.publishes_applied += 1
+        return True
+
+    def install_blocks(self, blocks: Dict[str, np.ndarray],
+                       version: int, chain_crc: int = 0) -> bool:
+        """Full replacement (a full-snapshot reload / warm-cache boot):
+        new blocks, fresh chain anchor. No-op below the current
+        version."""
+        with self._lock:
+            if int(version) < self._version:
+                return False
+            for k, v in blocks.items():
+                if k not in self._ranges:
+                    raise ValueError(f"shard {self.sid} owns no range "
+                                     f"of {k!r}")
+            self._blocks = {k: np.ascontiguousarray(v, np.float32)
+                            for k, v in blocks.items()}
+            self._version = int(version)
+            self._chain_crc = int(chain_crc) & 0xFFFFFFFF
+        return True
+
+    def blocks_copy(self) -> Tuple[Dict[str, np.ndarray], int, int]:
+        """(blocks copy, version, chain crc) — one consistent snapshot
+        for the warm cache."""
+        with self._lock:
+            return ({k: v.copy() for k, v in self._blocks.items()},
+                    self._version, self._chain_crc)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "sid": self.sid,
+            "slot": self.slot,
+            "domain": self.domain,
+            "version": self._version,
+            "chain_crc": self._chain_crc,
+            "lookups": self.lookups,
+            "rows_served": self.rows_served,
+            "publishes_applied": self.publishes_applied,
+            "apply_rejects": self.apply_rejects,
+            "last_reject": self.last_reject,
+            "hbm_bytes": self.hbm_bytes(),
+        }
+
+
+class ShardReplica(CircuitBreaker):
+    """One :class:`EmbeddingShard` behind the fleet's circuit-breaker
+    state machine — a shard outage reads exactly like a replica outage:
+    eject on consecutive errors, probe after cooldown, re-admit only on
+    probe success. ``rid`` is the shard's unique sid."""
+
+    KIND = "shard"
+
+    def __init__(self, shard: EmbeddingShard, state: str = HEALTHY):
+        super().__init__(shard.sid, state=state)
+        self.shard = shard
+        # consecutive failed probes since ejection — the replace-dead
+        # trigger (a shard that keeps failing probes is gone, not slow)
+        self.probe_failures = 0
+
+    @property
+    def sid(self) -> int:
+        return self.shard.sid
+
+    @property
+    def slot(self) -> int:
+        return self.shard.slot
+
+    def stats(self) -> Dict[str, Any]:
+        out = self.breaker_stats()
+        out["probe_failures"] = self.probe_failures
+        out.update(self.shard.stats())
+        return out
+
+
+class EmbeddingShardSet:
+    """The lookup tier: N shards tiling every host table's flat row
+    space, plus the routing, retry/hedging, degradation, publish
+    fan-out, and replace-dead machinery over them. One set serves every
+    ranker in the fleet."""
+
+    # recent publishes retained for replacement catch-up: a replacement
+    # shard booting from a slightly-stale warm-cache entry replays what
+    # it missed from here instead of forcing a full reload
+    HISTORY = 64
+
+    def __init__(self, shards: List[ShardReplica],
+                 config: ShardTierConfig,
+                 ranges_by_op: Dict[str, list],
+                 flat_rows: Dict[str, int],
+                 defaults: Dict[str, np.ndarray],
+                 bounds: Dict[str, List[Tuple[int, int]]],
+                 dims: Dict[str, int],
+                 fingerprint: str = "",
+                 cache=None):
+        if not shards:
+            raise ValueError("a shard set needs at least one shard")
+        self.config = config
+        self.shards = shards                 # copy-on-write list
+        self.nshards = len(shards)
+        self._ranges = ranges_by_op          # op -> [(lo, hi)] per slot
+        self._flat_rows = flat_rows          # op -> total flat rows
+        self._defaults = defaults            # op -> (tables, d) mean rows
+        self._bounds = bounds                # op -> per-table [lo, hi)
+        self._dims = dims                    # op -> row width
+        self.fingerprint = fingerprint
+        self._cache = cache                  # utils.warmcache.ShardCache
+        self._set_lock = make_lock("EmbeddingShardSet._set_lock")
+        # publishes serialize here so every shard sees the same order
+        # (the chain CRC is order-sensitive by design)
+        self._apply_lock = make_lock("EmbeddingShardSet._apply_lock",
+                                     no_dispatch=True)
+        self._version = max(r.shard.version for r in shards)
+        self._installed_any = False
+        self._history: List[Tuple[int, Dict[int, Optional[dict]]]] = []
+        self._next_sid = max(r.sid for r in shards) + 1
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * self.nshards),
+            thread_name_prefix="ff-shard-lookup")
+        self._closed = False
+        # counters (stats lock — fetch runs on every batcher thread)
+        self._m_lock = make_lock("EmbeddingShardSet._m_lock")
+        self._fetches = 0
+        self._degraded_fetches = 0
+        self._defaults_used = 0
+        self._retries = 0
+        self._hedges = 0
+        self._timeouts = 0
+        self._failed_fetches = 0
+        self.replacements = 0
+        self.replace_rejects = 0
+        self.last_replace_reject = ""
+
+    # --- construction --------------------------------------------------
+    @classmethod
+    def build(cls, model, nshards: int,
+              config: Optional[ShardTierConfig] = None,
+              cache_dir: Optional[str] = None) -> "EmbeddingShardSet":
+        """Slice ``model``'s host-resident tables into ``nshards`` row
+        shards (the training exchange's owner math). The model keeps its
+        tables until :meth:`release_ranker_tables` frees them."""
+        host_ops = getattr(model, "_host_resident_list", None)
+        if not host_ops:
+            raise ValueError(
+                "the shard tier serves host-resident embedding tables; "
+                "compile the model with host_resident_tables=True "
+                "(--host-tables). Device-resident tables already "
+                "row-shard on the training mesh (param_degree)")
+        config = config or ShardTierConfig(nshards=nshards)
+        if config.nshards != nshards:
+            config.nshards = nshards
+        version = int(getattr(model, "_step", 0))
+        ranges_by_op: Dict[str, list] = {}
+        flat_rows: Dict[str, int] = {}
+        defaults: Dict[str, np.ndarray] = {}
+        bounds: Dict[str, List[Tuple[int, int]]] = {}
+        dims: Dict[str, int] = {}
+        slot_blocks: List[Dict[str, np.ndarray]] = \
+            [dict() for _ in range(nshards)]
+        for op in host_ops:
+            kern = model.host_params[op.name]["kernel"]
+            flat = np.ascontiguousarray(
+                kern.reshape(-1, kern.shape[-1]), np.float32)
+            R = int(flat.shape[0])
+            ranges = shard_row_ranges(R, nshards)
+            ranges_by_op[op.name] = ranges
+            flat_rows[op.name] = R
+            dims[op.name] = int(flat.shape[1])
+            tb = _table_bounds(op, R)
+            bounds[op.name] = tb
+            # the degradation fallback: each table's mean embedding —
+            # a neutral "average row" answer, not zeros (zeros shift a
+            # trained model's score distribution far more)
+            defaults[op.name] = np.stack(
+                [flat[lo:hi].mean(axis=0) if hi > lo
+                 else np.zeros(flat.shape[1], np.float32)
+                 for lo, hi in tb]).astype(np.float32)
+            for slot, (lo, hi) in enumerate(ranges):
+                slot_blocks[slot][op.name] = flat[lo:hi].copy()
+        from ..utils.checkpoint import config_fingerprint
+        fingerprint = config_fingerprint(model)
+        cache = None
+        if cache_dir:
+            from ..utils.warmcache import ShardCache
+            cache = ShardCache(cache_dir, fingerprint=fingerprint)
+        domains = max(int(config.failure_domains), 0)
+        shards = []
+        for slot in range(nshards):
+            domain = f"fd{slot % domains}" if domains else ""
+            shard = EmbeddingShard(
+                slot, slot, slot_blocks[slot],
+                {name: ranges_by_op[name][slot] for name in ranges_by_op},
+                version=version, domain=domain)
+            shards.append(ShardReplica(shard))
+        out = cls(shards, config, ranges_by_op, flat_rows, defaults,
+                  bounds, dims, fingerprint=fingerprint, cache=cache)
+        out._persist_all()
+        log_shard.info(
+            "shard set built: %d shard(s) x %d table op(s), "
+            "%.1f MB/shard (largest), version %d", nshards,
+            len(ranges_by_op),
+            max(r.shard.hbm_bytes() for r in shards) / 1e6, version)
+        return out
+
+    @staticmethod
+    def release_ranker_tables(model) -> int:
+        """Free a ranker model's host tables (the point of the split:
+        rankers are stateless, tables live once, in the shard tier).
+        Returns the bytes released. The serving gather never touches
+        ``host_params`` once a shard set is attached; training such a
+        model again requires a fresh restore."""
+        freed = 0
+        for op in getattr(model, "_host_resident_list", []) or []:
+            tbl = model.host_params.get(op.name)
+            if not tbl:
+                continue
+            for name, arr in list(tbl.items()):
+                freed += int(getattr(arr, "nbytes", 0))
+                tbl[name] = np.zeros((0,) + arr.shape[1:], arr.dtype)
+        model._host_tables_released = True
+        return freed
+
+    # --- lifecycle -----------------------------------------------------
+    def close(self) -> None:
+        self._closed = True
+        # wait=False: an abandoned (injected-delay) lookup must not
+        # wedge close; the worker threads exit when their task returns
+        self._pool.shutdown(wait=False)
+
+    def __enter__(self) -> "EmbeddingShardSet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- routing helpers -----------------------------------------------
+    def _by_slot(self) -> Dict[int, ShardReplica]:
+        return {r.slot: r for r in self.shards}
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def min_version(self) -> Optional[int]:
+        """Oldest version among non-ejected shards — the serving
+        version FLOOR the watcher's catch-up path keys on (a lagging
+        replacement keeps the chain replaying until it has caught up).
+        None when every shard is ejected."""
+        alive = [r.shard.version for r in self.shards
+                 if r.state != EJECTED]
+        return min(alive) if alive else None
+
+    def degraded_now(self) -> bool:
+        """True while any shard is out of the routable set — responses
+        may be carrying default rows right now."""
+        return any(r.state != HEALTHY for r in self.shards)
+
+    def _default_rows(self, op_name: str, ids: np.ndarray) -> np.ndarray:
+        """Per-table default rows for flat ids (the degradation fill)."""
+        tb = self._bounds[op_name]
+        starts = np.asarray([lo for lo, _ in tb], np.int64)
+        t = np.clip(np.searchsorted(starts, np.asarray(ids, np.int64),
+                                    side="right") - 1,
+                    0, len(tb) - 1)
+        return self._defaults[op_name][t]
+
+    # --- the lookup path -----------------------------------------------
+    def fetch(self, plan: Dict[str, np.ndarray],
+              deadline_s: Optional[float] = None,
+              degrade: Optional[str] = None) -> FetchResult:
+        """Resolve every op's UNIQUE flat row ids in one round: group by
+        owning shard, one deadline-bounded lookup per shard (all ops
+        batched — the per-shard consistency unit), retry + hedge per
+        policy, degrade to per-table default rows where the budget is
+        spent. The deadline bounds EACH shard's lookup (retries
+        included), not the whole fetch — one slow shard must degrade
+        itself, never burn the budget of the shards behind it in the
+        iteration order. ``plan`` maps op name -> 1-D unique flat
+        ids."""
+        cfg = self.config
+        if deadline_s is None:
+            deadline_s = cfg.lookup_deadline_ms / 1e3
+        degrade = degrade or cfg.degrade
+        rows: Dict[str, np.ndarray] = {}
+        mask: Dict[str, np.ndarray] = {}
+        per_slot: Dict[int, Dict[str, Tuple[np.ndarray, np.ndarray]]] = {}
+        for op_name, u in plan.items():
+            u = np.asarray(u, np.int64)
+            rows[op_name] = np.empty((u.size, self._dims[op_name]),
+                                     np.float32)
+            mask[op_name] = np.zeros(u.size, bool)
+            owners = row_owners(u, self._flat_rows[op_name], self.nshards)
+            for slot in np.unique(owners):
+                m = owners == slot
+                per_slot.setdefault(int(slot), {})[op_name] = \
+                    (np.flatnonzero(m), u[m])
+        versions: Dict[int, int] = {}
+        degraded = False
+        defaults_used = 0
+        by_slot = self._by_slot()
+        # hedging needs a duplicate lookup RACING the first — that (and
+        # only that) is worth the worker-pool hand-off. Without it the
+        # lookups run inline on the caller: an in-process gather is
+        # microseconds, and the pool's submit/wait round trip would BE
+        # the latency (the deadline is still enforced — a lookup that
+        # returns past it is discarded as a timeout, exactly as the
+        # pool path would have abandoned it)
+        use_pool = self.config.hedge_ms > 0
+        first = {}
+        if use_pool:
+            # first attempts for every involved healthy shard go out
+            # together — one parallel round trip in the common case
+            for slot, reqs in per_slot.items():
+                rep = by_slot.get(slot)
+                if rep is not None and rep.state == HEALTHY \
+                        and not self._closed:
+                    first[slot] = self._pool.submit(
+                        rep.shard.lookup, {k: ids for k, (_, ids) in
+                                           reqs.items()})
+        for slot, reqs in per_slot.items():
+            rep = by_slot.get(slot)
+            got = None
+            if rep is not None and rep.state == HEALTHY \
+                    and not self._closed:
+                dl = Deadline(deadline_s)
+                try:
+                    if use_pool:
+                        got = self._await_lookup(rep, reqs,
+                                                 first.get(slot), dl)
+                    else:
+                        got = self._lookup_inline(rep, reqs, dl)
+                except Exception as e:   # noqa: BLE001 — budget spent
+                    if degrade == "fail":
+                        with self._m_lock:
+                            self._failed_fetches += 1
+                        raise ShardTierUnavailable(
+                            f"shard {rep.sid} (slot {slot}, domain "
+                            f"{rep.shard.domain or 'n/a'}) lookup "
+                            f"failed and --serve-degrade=fail: "
+                            f"{type(e).__name__}: {e}") from e
+            elif degrade == "fail":
+                with self._m_lock:
+                    self._failed_fetches += 1
+                raise ShardTierUnavailable(
+                    f"shard slot {slot} is "
+                    f"{rep.state if rep else 'missing'} and "
+                    f"--serve-degrade=fail")
+            if got is not None:
+                resp, ver = got
+                versions[slot] = ver
+                for op_name, (pos, _ids) in reqs.items():
+                    rows[op_name][pos] = resp[op_name]
+            else:
+                # graceful degradation: per-table default rows, flagged
+                degraded = True
+                for op_name, (pos, ids) in reqs.items():
+                    rows[op_name][pos] = self._default_rows(op_name, ids)
+                    mask[op_name][pos] = True
+                    defaults_used += int(ids.size)
+        with self._m_lock:
+            self._fetches += 1
+            if degraded:
+                self._degraded_fetches += 1
+                self._defaults_used += defaults_used
+        return FetchResult(rows, mask, versions, degraded, defaults_used)
+
+    def _lookup_inline(self, rep: ShardReplica, reqs, dl: Deadline):
+        """The no-hedge lookup path: call the shard on THIS thread with
+        the same deadline/retry/breaker semantics as the pooled path. A
+        result arriving after the deadline is discarded as a timeout —
+        rows a deadline-bound caller would never have waited for must
+        not sneak in just because the call happened to return."""
+        cfg = self.config
+        request = {k: ids for k, (_, ids) in reqs.items()}
+        attempt = 0
+        while True:
+            err: Optional[BaseException] = None
+            try:
+                got = rep.shard.lookup(request)
+                if dl.expired():
+                    with self._m_lock:
+                        self._timeouts += 1
+                    err = ShardLookupTimeout(
+                        f"shard {rep.sid} lookup returned after its "
+                        f"{dl.seconds * 1e3:.0f} ms deadline "
+                        f"({dl.elapsed() * 1e3:.0f} ms)")
+                else:
+                    rep.record_success()
+                    return got
+            except Exception as e:   # noqa: BLE001 — ShardDown etc.
+                err = e
+            if rep.record_error(err, cfg.eject_after):
+                rep.eject(f"{cfg.eject_after} consecutive lookup "
+                          f"errors, last: {err}")
+            attempt += 1
+            if (attempt > cfg.retries or dl.expired()
+                    or rep.state != HEALTHY or self._closed):
+                raise err
+            with self._m_lock:
+                self._retries += 1
+            time.sleep(min((cfg.backoff_ms / 1e3) * (2 ** (attempt - 1)),
+                           max(dl.remaining(), 0.0)))
+
+    def _await_lookup(self, rep: ShardReplica, reqs, fut, dl: Deadline):
+        """Wait on one shard's lookup under the shared deadline, with
+        bounded retry (exponential backoff) and optional hedging
+        (duplicate-after-delay, first result wins). Every failure feeds
+        the shard's circuit breaker; crossing the threshold ejects it."""
+        cfg = self.config
+        request = {k: ids for k, (_, ids) in reqs.items()}
+        attempt = 0
+        while True:
+            futs = [fut] if fut is not None else \
+                [self._pool.submit(rep.shard.lookup, request)]
+            fut = None
+            if cfg.hedge_ms > 0:
+                done, _ = wait(futs, timeout=min(
+                    cfg.hedge_ms / 1e3, max(dl.remaining(), 0.0)))
+                if not done and not self._closed:
+                    futs.append(self._pool.submit(rep.shard.lookup,
+                                                  request))
+                    with self._m_lock:
+                        self._hedges += 1
+            done, _ = wait(futs, timeout=max(dl.remaining(), 0.0),
+                           return_when=FIRST_COMPLETED)
+            err: Optional[BaseException] = None
+            for f in done:
+                e = f.exception()
+                if e is None:
+                    rep.record_success()
+                    return f.result()
+                err = e
+            if err is None:
+                with self._m_lock:
+                    self._timeouts += 1
+                err = ShardLookupTimeout(
+                    f"shard {rep.sid} lookup missed its "
+                    f"{dl.seconds * 1e3:.0f} ms deadline "
+                    f"(waited {dl.elapsed() * 1e3:.0f} ms)")
+            if rep.record_error(err, cfg.eject_after):
+                rep.eject(f"{cfg.eject_after} consecutive lookup "
+                          f"errors, last: {err}")
+            attempt += 1
+            if (attempt > cfg.retries or dl.expired()
+                    or rep.state != HEALTHY or self._closed):
+                raise err
+            with self._m_lock:
+                self._retries += 1
+            time.sleep(min((cfg.backoff_ms / 1e3) * (2 ** (attempt - 1)),
+                           max(dl.remaining(), 0.0)))
+
+    # --- publish fan-out (driven by the rankers' install paths) --------
+    def apply_delta(self, payload: Dict[str, Any], version: int) -> int:
+        """Route one delta publish's host-table updates to their owning
+        shards (``split_host_rows_by_shard``), each slice CRC-validated
+        by its shard and applied atomically; shards the publish does not
+        touch get the version bump + chain link only. Idempotent per
+        shard (every ranker's watcher calls this for the same publish).
+        Returns how many shards applied row work."""
+        with self._apply_lock:
+            if int(version) <= self._version and self._installed_any:
+                # fast path: the whole set already has this publish
+                # (another ranker routed it) UNLESS a replacement lags
+                if not self.lagging_slots():
+                    return 0
+            subs = split_host_rows_by_shard(payload, self._ranges)
+            applied = 0
+            for rep in list(self.shards):
+                if rep.state == EJECTED:
+                    # a crashed lookup server receives nothing; it
+                    # comes back STALE and the probe refuses admission
+                    # until the watcher's catch-up (or replace-dead)
+                    # has brought it to the tip
+                    continue
+                sub = subs.get(rep.slot)
+                try:
+                    if rep.shard.apply_publish(
+                            sub, version,
+                            None if sub is None else sub.get("crc")):
+                        applied += 1 if sub is not None else 0
+                except ChainError as e:
+                    # the shard keeps its old consistent version and
+                    # LAGS; min_version() drops and the watcher's
+                    # catch-up replays the chain until it heals
+                    log_shard.warning(
+                        "shard %d rejected publish %d: %s — shard "
+                        "lags at version %d", rep.sid, version, e,
+                        rep.shard.version)
+            self._version = max(self._version, int(version))
+            self._installed_any = True
+            self._history.append((int(version), subs))
+            del self._history[:-self.HISTORY]
+            self._persist_all()
+        return applied
+
+    def install_full(self, host_params: Dict[str, Dict[str, np.ndarray]],
+                     version: int) -> bool:
+        """Full-snapshot reload: reslice every table onto its shards.
+        Resets each shard's chain anchor (a full IS a new base).
+        Idempotent per version."""
+        with self._apply_lock:
+            if int(version) <= self._version and self._installed_any \
+                    and not self.lagging_slots():
+                return False
+            for rep in list(self.shards):
+                if rep.state == EJECTED:
+                    continue   # same skip as apply_delta
+                blocks = {}
+                for op_name, ranges in self._ranges.items():
+                    tbl = host_params.get(op_name)
+                    if tbl is None:
+                        continue
+                    kern = tbl["kernel"]
+                    flat = np.asarray(kern).reshape(-1, kern.shape[-1])
+                    if flat.shape[0] != self._flat_rows[op_name]:
+                        # a released ranker's 0-row stub (canary
+                        # rollback state) or a foreign geometry: never
+                        # slice THAT over real shard blocks
+                        log_shard.warning(
+                            "install_full: %r has %d flat rows, the "
+                            "shard tier serves %d — table skipped "
+                            "(released-ranker stub or foreign "
+                            "snapshot)", op_name, flat.shape[0],
+                            self._flat_rows[op_name])
+                        continue
+                    lo, hi = ranges[rep.slot]
+                    blocks[op_name] = flat[lo:hi].copy()
+                if blocks:
+                    rep.shard.install_blocks(blocks, version)
+                else:
+                    # nothing of the shard's in this snapshot (stub /
+                    # foreign tables): version bump only, rows stand
+                    rep.shard.apply_publish(None, version)
+            self._version = max(self._version, int(version))
+            self._installed_any = True
+            self._history.clear()
+            self._persist_all()
+        return True
+
+    def lagging_slots(self) -> List[int]:
+        """Slots whose shard version trails the set tip (a rejected
+        slice or a stale replacement) — what the watcher's catch-up
+        repairs."""
+        return [r.slot for r in self.shards
+                if r.state != EJECTED and r.shard.version < self._version]
+
+    def _persist_all(self) -> None:
+        """Warm-cache every shard's current blocks (the replace-dead
+        boot source). Best-effort; a failed put costs a replacement a
+        cold rebuild, nothing else."""
+        if self._cache is None:
+            return
+        for rep in self.shards:
+            if rep.state == EJECTED:
+                continue   # don't clobber the entry with stale blocks
+            blocks, ver, crc = rep.shard.blocks_copy()
+            self._cache.put(self.nshards, rep.slot, blocks, ver, crc)
+
+    # --- health: probe, re-admit, replace-dead -------------------------
+    def probe(self, rep: ShardReplica) -> bool:
+        """End-to-end admission probe: a real lookup of each table's
+        first owned row through the real path, under the probe deadline,
+        PLUS a freshness check — a shard is only re-admitted at the
+        set's current version (serving stale-but-consistent rows from a
+        re-admitted shard would silently rewind the version vector)."""
+        cfg = self.config
+        rep.begin_probe()
+        request = {}
+        for op_name, ranges in self._ranges.items():
+            lo, hi = ranges[rep.slot]
+            if hi > lo:
+                request[op_name] = np.asarray([lo], np.int64)
+        try:
+            fut = self._pool.submit(rep.shard.lookup, request)
+            _resp, ver = fut.result(cfg.probe_deadline_s)
+            if ver < self._version:
+                raise ChainError(
+                    f"shard is at version {ver}, set tip is "
+                    f"{self._version} (stale — needs catch-up before "
+                    f"admission)")
+        except Exception as e:   # noqa: BLE001 — stay ejected
+            rep.probe_failed(f"{type(e).__name__}: {e}")
+            rep.probe_failures += 1
+            return False
+        rep.readmit()
+        rep.probe_failures = 0
+        return True
+
+    def replace(self, slot: int) -> Optional[int]:
+        """Replace-dead: boot a fresh shard for ``slot`` from the warm
+        cache (``utils.warmcache.ShardCache``), replay any publishes the
+        cached blocks predate from the set's history, and swap it in
+        born-PROBING — it serves nothing until its admission probe
+        succeeds. Returns the new sid, or None with the reject reason
+        recorded (the set keeps degrading; nothing got worse)."""
+        def _reject(reason: str) -> None:
+            self.replace_rejects += 1
+            self.last_replace_reject = reason
+            log_shard.warning("shard replace(slot=%d) rejected: %s — "
+                              "continuing degraded", slot, reason)
+
+        if self._cache is None:
+            _reject("no shard warm cache configured "
+                    "(--compile-cache-dir)")
+            return None
+        got = self._cache.get(self.nshards, slot)
+        if got is None:
+            _reject(f"warm cache miss: "
+                    f"{self._cache.last_reject or 'no entry'}")
+            return None
+        blocks, ver, chain_crc = got
+        for op_name, ranges in self._ranges.items():
+            lo, hi = ranges[slot]
+            blk = blocks.get(op_name)
+            if blk is None or blk.shape[0] != hi - lo:
+                _reject(f"cached blocks have wrong geometry for "
+                        f"{op_name!r} (got "
+                        f"{None if blk is None else blk.shape}, "
+                        f"want {hi - lo} rows)")
+                return None
+        with self._set_lock:
+            sid = self._next_sid
+            self._next_sid += 1
+        old = self._by_slot().get(slot)
+        domain = old.shard.domain if old is not None else ""
+        shard = EmbeddingShard(
+            sid, slot, blocks,
+            {name: self._ranges[name][slot] for name in self._ranges},
+            version=ver, chain_crc=chain_crc, domain=domain)
+        with self._apply_lock:
+            # replay what the cached blocks missed; the slice CRCs
+            # re-validate each replayed publish
+            for v, subs in self._history:
+                if v > shard.version:
+                    try:
+                        sub = subs.get(slot)
+                        shard.apply_publish(
+                            sub, v, None if sub is None
+                            else sub.get("crc"))
+                    except ChainError as e:
+                        _reject(f"catch-up replay of publish {v} "
+                                f"failed: {e}")
+                        return None
+            if shard.version < self._version:
+                _reject(f"cached blocks at version {shard.version} "
+                        f"predate the retained history (tip "
+                        f"{self._version}) — needs a full reload")
+                return None
+            fresh = ShardReplica(shard, state=PROBING)
+            with self._set_lock:
+                self.shards = [fresh if r.slot == slot else r
+                               for r in self.shards]
+                self.replacements += 1
+        log_shard.warning(
+            "shard slot %d replaced (%s -> sid %d) from the warm cache "
+            "at version %d; awaiting admission probe",
+            slot, "sid %d" % old.sid if old else "none", sid,
+            shard.version)
+        return sid
+
+    def health_tick(self) -> List[Dict[str, Any]]:
+        """One health pass (the autoscaler drives this, or the set's
+        own health thread when serving without one): probe shards due
+        for one, replace shards whose probes keep failing. Returns the
+        actions taken."""
+        cfg = self.config
+        actions: List[Dict[str, Any]] = []
+        for rep in list(self.shards):
+            if rep.state == HEALTHY:
+                continue
+            if not rep.due_for_probe(cfg.cooldown_s):
+                continue
+            if rep.probe_failures >= cfg.replace_after \
+                    and not rep.awaiting_admission:
+                new_sid = self.replace(rep.slot)
+                actions.append({"action": "shard-replace",
+                                "slot": rep.slot, "old_sid": rep.sid,
+                                "new_sid": new_sid})
+                continue
+            ok = self.probe(rep)
+            actions.append({"action": "shard-probe", "slot": rep.slot,
+                            "sid": rep.sid, "ok": ok})
+        return actions
+
+    def start_health(self, interval_s: float = 0.25
+                     ) -> "EmbeddingShardSet":
+        """Own health thread for shard-set deployments without an
+        autoscaler (serve_dlrm single-engine mode). ff-named, daemon,
+        stop-signalled and joined by :meth:`stop_health`."""
+        if getattr(self, "_health_thread", None) is not None:
+            return self
+        self._health_stop = threading.Event()
+
+        def _loop():
+            while not self._health_stop.wait(interval_s):
+                try:
+                    self.health_tick()
+                except Exception:   # noqa: BLE001 — health must outlive
+                    log_shard.exception("shard health tick failed")
+
+        self._health_thread = threading.Thread(
+            target=_loop, daemon=True, name="ff-shard-health")
+        self._health_thread.start()
+        return self
+
+    def stop_health(self) -> None:
+        t = getattr(self, "_health_thread", None)
+        if t is None:
+            return
+        self._health_stop.set()
+        t.join(5.0)
+        self._health_thread = None
+
+    # --- plans + observability -----------------------------------------
+    def serving_plan(self) -> Dict[str, Any]:
+        """The static description shardcheck's FLX507 audit consumes:
+        shard count, per-op flat row counts and ranges, per-shard
+        residency, and whether rankers still hold full tables."""
+        return {
+            "nshards": self.nshards,
+            "flat_rows": dict(self._flat_rows),
+            "ranges": {k: list(v) for k, v in self._ranges.items()},
+            "shard_hbm_bytes": max(r.shard.hbm_bytes()
+                                   for r in self.shards),
+            "domains": sorted({r.shard.domain for r in self.shards
+                               if r.shard.domain}),
+        }
+
+    def version_vector(self) -> Dict[int, int]:
+        return {r.slot: r.shard.version for r in self.shards}
+
+    def stats(self) -> Dict[str, Any]:
+        with self._m_lock:
+            out = {
+                "nshards": self.nshards,
+                "version": self._version,
+                "versions": self.version_vector(),
+                "states": {r.slot: r.state for r in self.shards},
+                "degraded_now": self.degraded_now(),
+                "fetches": self._fetches,
+                "degraded_fetches": self._degraded_fetches,
+                "defaults_used": self._defaults_used,
+                "retries": self._retries,
+                "hedges": self._hedges,
+                "timeouts": self._timeouts,
+                "failed_fetches": self._failed_fetches,
+                "replacements": self.replacements,
+                "replace_rejects": self.replace_rejects,
+                "last_replace_reject": self.last_replace_reject,
+                "lagging_slots": self.lagging_slots(),
+                "shards": {r.slot: r.stats() for r in self.shards},
+            }
+        domains = {}
+        for r in self.shards:
+            if r.shard.domain:
+                d = domains.setdefault(r.shard.domain,
+                                       {"shards": 0, "healthy": 0})
+                d["shards"] += 1
+                d["healthy"] += int(r.state == HEALTHY)
+        if domains:
+            out["failure_domains"] = domains
+        if self._cache is not None:
+            out["shard_cache"] = self._cache.stats()
+        return out
+
+
+# ---------------------------------------------------------------------
+# feasibility accounting (the bench + shardcheck FLX507 share this)
+# ---------------------------------------------------------------------
+def serving_footprint(model, replicas: int, nshards: int = 0,
+                      ranker_holds_tables: Optional[bool] = None
+                      ) -> Dict[str, Any]:
+    """Static per-process residency of a serving deployment: what one
+    RANKER replica and (when sharded) one LOOKUP SHARD must hold. The
+    replicated fleet's per-replica bytes include every table; the
+    sharded tier's rankers drop to dense-only and each shard holds
+    ~1/nshards of the tables — the terabyte-serving argument, stated in
+    bytes."""
+    dense = 0
+    tables = 0
+    host_ops = set(op.name for op in
+                   getattr(model, "_host_resident_list", []) or [])
+    for op in getattr(model, "ops", []):
+        try:
+            pb = float(op.param_bytes())
+        except Exception:   # noqa: BLE001 — param-less ops
+            continue
+        if op.name in host_ops or hasattr(op, "host_lookup"):
+            tables += pb
+        else:
+            dense += pb
+    if ranker_holds_tables is None:
+        ranker_holds_tables = nshards <= 0 \
+            and not getattr(model, "_host_tables_released", False)
+    per_shard = (-(-int(tables) // nshards)) if nshards > 0 else 0
+    ranker = dense + (tables if ranker_holds_tables else 0)
+    return {
+        "replicas": int(replicas),
+        "nshards": int(nshards),
+        "dense_bytes": int(dense),
+        "table_bytes": int(tables),
+        "ranker_bytes": int(ranker),
+        "shard_bytes": int(per_shard),
+        "fleet_table_bytes": int(tables * replicas
+                                 if ranker_holds_tables else tables),
+    }
+
+
+def check_serving_feasible(model, replicas: int, hbm_bytes: float,
+                           nshards: int = 0) -> Dict[str, Any]:
+    """Admission check a serving launcher runs before boot: does each
+    process fit its budget? Returns the footprint report augmented with
+    ``feasible`` and ``reason``; the replicated fleet REJECTS a model
+    whose tables exceed the per-replica budget, the sharded tier admits
+    it as long as dense params and one shard's rows fit."""
+    fp = serving_footprint(model, replicas, nshards)
+    worst = max(fp["ranker_bytes"], fp["shard_bytes"])
+    fp["hbm_bytes"] = int(hbm_bytes)
+    fp["feasible"] = worst <= hbm_bytes
+    if fp["feasible"]:
+        fp["reason"] = ""
+    elif nshards <= 0:
+        fp["reason"] = (
+            f"replicated fleet infeasible: each replica must hold "
+            f"{fp['ranker_bytes'] / 1e6:.1f} MB (tables "
+            f"{fp['table_bytes'] / 1e6:.1f} MB) against a "
+            f"{hbm_bytes / 1e6:.1f} MB budget — shard the lookup tier "
+            f"(--serve-shards)")
+    else:
+        fp["reason"] = (
+            f"sharded tier infeasible at {nshards} shard(s): worst "
+            f"process holds {worst / 1e6:.1f} MB against "
+            f"{hbm_bytes / 1e6:.1f} MB — raise --serve-shards")
+    return fp

@@ -222,7 +222,15 @@ class SnapshotWatcher:
         if not isinstance(deltas, list) or not deltas:
             return False
         tip_step = max(int(e.get("step", -1)) for e in deltas)
-        if tip_step <= self._engine.version:
+        # the trigger is the version FLOOR, not the engine's own
+        # version: under the sharded serving tier a replacement lookup
+        # shard can boot slightly stale while the ranker is already at
+        # the tip — the chain then keeps replaying (installs are
+        # idempotent per shard AND for the ranker's absolute row
+        # values) until every shard has caught up
+        floor = getattr(self._engine, "version_floor",
+                        self._engine.version)
+        if tip_step <= floor:
             return False
         key = ("chain", tip_step)
         if key in self._rejected:
@@ -245,14 +253,15 @@ class SnapshotWatcher:
         # constructor-time version can coincide with a published step
         # without being that state, and patching delta rows onto it
         # would silently mix lineages
-        if self._engine.has_applied_snapshot and applied in on_chain:
+        if (self._engine.has_applied_snapshot and applied in on_chain
+                and floor >= base_step and floor in on_chain):
             need_base = False
             pending = [e for e in chain
-                       if int(e.get("step", -1)) > applied]
+                       if int(e.get("step", -1)) > floor]
         elif (not self._engine.has_applied_snapshot
-                or applied < base_step):
-            need_base = True      # cold engine: base + whole chain
-            pending = chain
+                or applied < base_step or floor < base_step):
+            need_base = True      # cold engine (or a shard staler than
+            pending = chain       # the base): base full + whole chain
         else:
             # the served version is between base and tip but NOT a
             # chain node (e.g. a snapshot from a retired chain):
@@ -349,6 +358,8 @@ class SnapshotWatcher:
 
     def stats(self) -> Dict[str, Any]:
         return {"directory": self.directory, "polls": self._polls,
+                "version_floor": getattr(self._engine, "version_floor",
+                                         self._engine.version),
                 "poll_s": self.poll_s,
                 "next_poll_s": self._next_poll_s,
                 "consecutive_failures": self._consecutive_failures,

@@ -287,6 +287,104 @@ def stage_delta_rows(model, payload: Dict[str, Any]) -> Dict[str, Any]:
 
 
 # ---------------------------------------------------------------------
+# per-shard routing (the serving shard tier, serve/shardtier.py)
+# ---------------------------------------------------------------------
+#
+# A row-sharded serving tier splits every host table's flat row space
+# over N lookup shards; a delta publish must then touch ONLY the shards
+# that own its rows, and each shard must be able to validate exactly its
+# own slice. ``split_host_rows_by_shard`` is the router: it cuts a
+# loaded delta payload's ``hostparams/`` updates along the shard ranges
+# (the same owner math as ``parallel.alltoall.row_owners``) and stamps
+# each slice with a CRC the owning shard recomputes before applying —
+# the per-shard half of the chain discipline above. Slices for shards an
+# interval never touched are ``None`` (the publish costs them a version
+# bump, no row work).
+
+
+def shard_slice_crc(sub: Dict[str, Any]) -> int:
+    """Deterministic CRC-32 over one shard's delta slice (sorted keys,
+    index bytes, row bytes). Computed at split time and recomputed by
+    the shard at apply time: corruption anywhere between the two is a
+    reject-with-reason, never silently-wrong rows."""
+    import zlib
+    crc = 0
+    for key in sorted(sub.get("rows", {})):
+        idx, vals = sub["rows"][key]
+        crc = zlib.crc32(key.encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(idx, np.int64), crc)
+        crc = zlib.crc32(np.ascontiguousarray(vals), crc)
+    for key in sorted(sub.get("full", {})):
+        crc = zlib.crc32(key.encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(sub["full"][key]), crc)
+    return crc
+
+
+def shard_chain_crc(prev_crc: int, step: int, slice_crc: int) -> int:
+    """One link of a shard's publish chain: CRC over (previous link,
+    step, this slice's CRC). Two shards that applied the same publishes
+    in the same order agree on it; a replacement shard booting from the
+    warm cache proves lineage by matching it."""
+    import zlib
+    blob = np.asarray([prev_crc, step, slice_crc], np.int64)
+    return zlib.crc32(blob.tobytes())
+
+
+def split_host_rows_by_shard(payload: Dict[str, Any],
+                             ranges_by_op: Dict[str, list],
+                             ) -> Dict[int, Optional[Dict[str, Any]]]:
+    """Split an ``apply_delta`` payload's host-table updates into
+    per-shard slices.
+
+    ``ranges_by_op`` maps op name -> the shard tier's ``[(lo, hi), ...]``
+    flat-row ranges (``EmbeddingShardSet.ranges``). Row updates
+    (``rows["hostparams/<op>/kernel"]``) are routed by owner; full-array
+    host replacements (small tables below the row-delta threshold) are
+    sliced along the same ranges. Returns ``{slot: slice | None}`` where
+    each non-None slice carries its ``crc`` (:func:`shard_slice_crc`);
+    ``None`` means this publish has nothing for that shard. Non-host
+    keys are the ranker tier's business and are ignored here."""
+    from ..parallel.alltoall import row_owners
+    nshards = max((len(r) for r in ranges_by_op.values()), default=0)
+    subs: Dict[int, Dict[str, Any]] = {}
+
+    def _sub(slot):
+        return subs.setdefault(slot, {"rows": {}, "full": {}})
+
+    for key, (idx, vals) in (payload.get("rows") or {}).items():
+        if not key.startswith("hostparams/"):
+            continue
+        op_name = key.split("/")[1]
+        ranges = ranges_by_op.get(op_name)
+        if ranges is None:
+            continue
+        rows_total = ranges[-1][1]
+        owners = row_owners(idx, rows_total, len(ranges))
+        for slot in np.unique(owners):
+            m = owners == slot
+            _sub(int(slot))["rows"][key] = (np.asarray(idx)[m],
+                                            np.asarray(vals)[m])
+    for key, arr in (payload.get("full") or {}).items():
+        if not key.startswith("hostparams/"):
+            continue
+        op_name = key.split("/")[1]
+        ranges = ranges_by_op.get(op_name)
+        if ranges is None:
+            continue
+        flat = np.asarray(arr).reshape(-1, arr.shape[-1])
+        for slot, (lo, hi) in enumerate(ranges):
+            if hi > lo:
+                _sub(slot)["full"][key] = flat[lo:hi]
+    out: Dict[int, Optional[Dict[str, Any]]] = {}
+    for slot in range(nshards):
+        sub = subs.get(slot)
+        if sub is not None:
+            sub["crc"] = shard_slice_crc(sub)
+        out[slot] = sub
+    return out
+
+
+# ---------------------------------------------------------------------
 # chain validation (shared: publisher sanity + watcher read-only path)
 # ---------------------------------------------------------------------
 def resolve_chain(manifest: Dict[str, Any], fingerprint: Optional[str],

@@ -183,6 +183,17 @@ class FaultPlan:
     # -1 = dead forever (a crashed process); N > 0 = the next N attempts
     # fail, then the replica recovers (the probe/re-admit path)
     replica_down: Dict[int, int] = field(default_factory=dict)
+    # embedding-shard id -> remaining failed lookups: every lookup/probe
+    # against that SERVING shard (serve/shardtier.py) reports it dead
+    # (the shard raises ShardDown; rankers degrade to cache + default
+    # rows). Same budget semantics as replica_down: -1 = dead until the
+    # plan clears, N > 0 = the next N attempts fail then it recovers
+    shard_down: Dict[int, int] = field(default_factory=dict)
+    # embedding-shard id -> seconds to sleep inside EVERY lookup against
+    # that shard (NOT consume-once — deadline/hedging tests need a
+    # steadily slow shard); a bare value slows every shard
+    lookup_delay_s: float = 0.0
+    lookup_delay_shard: Dict[int, float] = field(default_factory=dict)
     # number of future hot-reload snapshot loads whose params are scaled
     # by poison_reload_scale: the file is valid, the weights are garbage
     # — the bad deploy a canary must catch by score divergence
@@ -232,7 +243,8 @@ _KNOWN_ENV_KEYS = ("FF_FAULT_NAN_STEPS", "FF_FAULT_TRUNCATE_CKPTS",
                    "FF_FAULT_CORRUPT_RELOAD", "FF_FAULT_REPLICA_DOWN",
                    "FF_FAULT_POISON_RELOAD", "FF_FAULT_DELTA_TORN",
                    "FF_FAULT_PUBLISH_ABORT", "FF_FAULT_DELTA_GAP",
-                   "FF_FAULT_CACHE_CORRUPT")
+                   "FF_FAULT_CACHE_CORRUPT", "FF_FAULT_SHARD_DOWN",
+                   "FF_FAULT_LOOKUP_DELAY")
 
 
 # --- strict env parsing ----------------------------------------------
@@ -319,10 +331,13 @@ def plan_from_env() -> Optional[FaultPlan]:
     delta_torn = os.environ.get("FF_FAULT_DELTA_TORN", "")
     publish_abort = os.environ.get("FF_FAULT_PUBLISH_ABORT", "")
     delta_gap = os.environ.get("FF_FAULT_DELTA_GAP", "")
+    shard_down = os.environ.get("FF_FAULT_SHARD_DOWN", "")
+    lookup_delay = os.environ.get("FF_FAULT_LOOKUP_DELAY", "")
     if not any((nan, trunc, aborts, delay, ioerrs, drop, ret,
                 cache_corrupt, stall_coll,
                 serve_delay, corrupt_reload, replica_down,
-                poison_reload, delta_torn, publish_abort, delta_gap)):
+                poison_reload, delta_torn, publish_abort, delta_gap,
+                shard_down, lookup_delay)):
         return None
     plan = FaultPlan()
     if nan:
@@ -375,6 +390,18 @@ def plan_from_env() -> Optional[FaultPlan]:
             plan.replica_down[n] = -1
         else:                                 # "rid:N" — N failures
             plan.replica_down[rid] = n
+    for sid, n in _env_pairs("FF_FAULT_SHARD_DOWN", shard_down,
+                             _env_int, bare=_env_int):
+        if sid is None:                       # bare sid — dead forever
+            plan.shard_down[n] = -1
+        else:                                 # "sid:N" — N failed lookups
+            plan.shard_down[sid] = n
+    for sid, secs in _env_pairs("FF_FAULT_LOOKUP_DELAY", lookup_delay,
+                                _env_float, bare=_env_float):
+        if sid is None:                       # bare seconds — every shard
+            plan.lookup_delay_s = secs
+        else:                                 # "sid:secs" — one shard
+            plan.lookup_delay_shard[sid] = secs
     if corrupt_reload:
         plan.corrupt_reloads = _env_int("FF_FAULT_CORRUPT_RELOAD",
                                         corrupt_reload)
@@ -592,6 +619,42 @@ def take_replica_down(replica_id: Optional[int]) -> bool:
         if ("replica_down", replica_id) not in plan.fired:
             plan._record("replica_down", replica_id)
     return True
+
+
+def take_shard_down(shard_id: Optional[int]) -> bool:
+    """True while a serving EMBEDDING SHARD is scheduled dead: the shard
+    raises a typed ``ShardDown`` from its lookup (and from admission
+    probes), which the shard tier's circuit breaker must absorb — the
+    ranker degrades to cache hits + per-table default rows instead of
+    failing the request. Budget semantics mirror
+    :func:`take_replica_down`: ``-1`` = dead until the plan clears,
+    ``N > 0`` = the next N lookups fail then the shard recovers."""
+    plan = active()
+    if plan is None or shard_id is None:
+        return False
+    with plan._lock:
+        left = plan.shard_down.get(shard_id)
+        if left is None or left == 0:
+            return False
+        if left > 0:
+            plan.shard_down[shard_id] = left - 1
+        if ("shard_down", shard_id) not in plan.fired:
+            plan._record("shard_down", shard_id)
+    return True
+
+
+def maybe_lookup_delay(shard_id: Optional[int] = None) -> None:
+    """Sleep inside a shard lookup (EVERY lookup while the plan is
+    active — deadline/retry/hedging tests need a steadily slow shard).
+    A per-shard entry overrides the global delay for that shard."""
+    plan = active()
+    if plan is None:
+        return
+    secs = plan.lookup_delay_s
+    if shard_id is not None:
+        secs = plan.lookup_delay_shard.get(shard_id, secs)
+    if secs > 0:
+        time.sleep(secs)
 
 
 def maybe_poison_reload(state: dict) -> dict:

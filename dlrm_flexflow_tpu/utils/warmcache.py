@@ -21,6 +21,12 @@ executables that can serve it travel together):
   keyed by (kind, code fingerprint, strategy signature, mesh signature,
   shape signature). One file per entry, written atomically.
 
+A third cache, :class:`ShardCache`, serves the SERVING shard tier
+(serve/shardtier.py): per-shard embedding row blocks persisted on every
+publish so the autoscaler's replace-dead path can boot a replacement
+lookup shard warm (version + chain-CRC validated) instead of re-slicing
+a full checkpoint.
+
 Both caches fail OPEN with a named reason: a corrupt, truncated, stale
 (code-fingerprint mismatch), or wrong-topology entry is rejected and the
 caller falls back to a fresh search/compile — the same
@@ -384,6 +390,139 @@ class CompileCache:
             return False
         self.puts += 1
         return True
+
+    def stats(self) -> Dict[str, Any]:
+        return {"hits": self.hits, "misses": self.misses,
+                "rejects": self.rejects, "puts": self.puts,
+                "put_errors": self.put_errors,
+                "last_reject": self.last_reject}
+
+
+# ---------------------------------------------------------------------
+# serving shard cache
+# ---------------------------------------------------------------------
+class ShardCache:
+    """Persisted embedding-shard row blocks for the serving shard tier
+    (serve/shardtier.py): one npz per (nshards, slot) carrying the
+    shard's per-op row blocks, its applied version, and its publish
+    chain CRC.
+
+    This is the shard tier's replace-dead warm start: when a lookup
+    shard is ejected and replaced, the replacement boots from its slot's
+    cached blocks (milliseconds) instead of re-slicing a full checkpoint
+    — and is re-admitted only when its version + chain CRC match what
+    the live set expects AND its admission probe succeeds. Every failure
+    mode (missing, torn, CRC mismatch, foreign fingerprint, wrong slot
+    geometry) is a miss with a recorded reason, exactly like the
+    plan/compile caches above."""
+
+    def __init__(self, directory: str, fingerprint: str = ""):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.fingerprint = fingerprint
+        self.hits = 0
+        self.misses = 0
+        self.rejects = 0
+        self.puts = 0
+        self.put_errors = 0
+        self.last_reject = ""
+
+    def _path(self, nshards: int, slot: int) -> str:
+        return os.path.join(self.directory,
+                            f"shard-{nshards}x-{slot}.npz")
+
+    def _reject(self, reason: str) -> None:
+        self.rejects += 1
+        self.last_reject = reason
+        log_cache.warning("shard cache: %s — replacement shard must "
+                          "rebuild cold", reason)
+
+    def put(self, nshards: int, slot: int, blocks: Dict[str, "np.ndarray"],
+            version: int, chain_crc: int) -> bool:
+        """Atomically persist one shard's blocks (temp + fsync +
+        os.replace, the checkpoint discipline). Best-effort: a failed
+        put costs the next replacement a cold rebuild, nothing else."""
+        import numpy as np
+        flat = {f"block/{k}": np.ascontiguousarray(v)
+                for k, v in blocks.items()}
+        flat["meta/version"] = np.asarray(version, np.int64)
+        flat["meta/chain_crc"] = np.asarray(chain_crc & 0xFFFFFFFF,
+                                            np.int64)
+        flat["meta/nshards"] = np.asarray(nshards, np.int64)
+        flat["meta/slot"] = np.asarray(slot, np.int64)
+        if self.fingerprint:
+            flat["meta/fingerprint"] = np.frombuffer(
+                self.fingerprint.encode(), np.uint8)
+        crc = 0
+        for k in sorted(flat):
+            crc = zlib.crc32(k.encode(), crc)
+            crc = zlib.crc32(np.ascontiguousarray(flat[k]), crc)
+        flat["meta/crc32"] = np.asarray(crc, np.int64)
+        path = self._path(nshards, slot)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **flat)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except Exception as e:   # noqa: BLE001 — full disk, perms
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            self.put_errors += 1
+            log_cache.warning("shard cache write failed (%s)", e)
+            return False
+        self.puts += 1
+        return True
+
+    def get(self, nshards: int, slot: int):
+        """(blocks, version, chain_crc) or None with the reason
+        recorded. The corrupt-cache fault hook fires here so chaos tests
+        can prove a torn entry degrades to a cold rebuild."""
+        import numpy as np
+
+        from . import faults
+        path = self._path(nshards, slot)
+        if not os.path.isfile(path):
+            self.misses += 1
+            return None
+        name = os.path.basename(path)
+        try:
+            faults.maybe_corrupt_cache(path)
+            data = np.load(path)
+            files = set(data.files)
+            stored_crc = int(data["meta/crc32"])
+            crc = 0
+            for k in sorted(files - {"meta/crc32"}):
+                crc = zlib.crc32(k.encode(), crc)
+                crc = zlib.crc32(np.ascontiguousarray(data[k]), crc)
+            if crc != stored_crc:
+                raise ValueError("entry CRC mismatch (torn write / "
+                                 "bit rot)")
+            if self.fingerprint and "meta/fingerprint" in files:
+                fp = bytes(data["meta/fingerprint"]).decode()
+                if fp != self.fingerprint:
+                    raise ValueError(
+                        f"foreign fingerprint {fp} != "
+                        f"{self.fingerprint} (differently-built model)")
+            if (int(data["meta/nshards"]) != nshards
+                    or int(data["meta/slot"]) != slot):
+                raise ValueError(
+                    f"geometry mismatch: entry is shard "
+                    f"{int(data['meta/slot'])}/{int(data['meta/nshards'])}"
+                    f", wanted {slot}/{nshards}")
+            blocks = {k[len("block/"):]: np.array(data[k])
+                      for k in files if k.startswith("block/")}
+            version = int(data["meta/version"])
+            chain_crc = int(data["meta/chain_crc"])
+        except Exception as e:   # noqa: BLE001 — torn npz, bad meta
+            self._reject(f"{name}: {e}")
+            self.misses += 1
+            return None
+        self.hits += 1
+        return blocks, version, chain_crc
 
     def stats(self) -> Dict[str, Any]:
         return {"hits": self.hits, "misses": self.misses,

@@ -19,6 +19,18 @@ snapshots independently (cross-mesh reshard is automatic in fleet
 mode: per-device replicas consume the multi-device trainer's
 checkpoints).
 
+``--serve-shards N`` (host-table models) splits serving into a
+SHARDED TIER: the engine/replicas become stateless rankers and the
+embedding tables live once, row-sharded over N lookup shards
+(``serve/shardtier.py``) — a model whose tables exceed one replica's
+memory serves anyway. Responses then carry a per-shard version vector
+and, while a shard is out, are served DEGRADED (cache hits + per-table
+default rows, ``"degraded": true`` in the response and in /healthz —
+still HTTP 200: degraded is not down, and a load balancer that treated
+it as down would turn one dark shard into a full outage). Knobs:
+``--serve-lookup-deadline-ms`` (per-fetch budget) and
+``--serve-degrade {cache,fail}``.
+
 No framework webserver: a stdlib ``http.server`` ThreadingHTTPServer is
 all the engine needs — every handler thread just submits into the
 engine's queue and blocks on its future, the batcher coalesces across
@@ -130,10 +142,20 @@ def make_handler(serve, input_names):
                 return
             try:
                 pred = serve.predict(feats)
-                self._reply(200, {
+                body = {
                     "scores": np.asarray(pred.scores).reshape(-1).tolist(),
                     "version": pred.version,
-                    "latency_ms": round(pred.latency_ms, 3)})
+                    "latency_ms": round(pred.latency_ms, 3)}
+                versions = getattr(pred, "versions", None)
+                if versions is not None:
+                    # sharded tier: the per-shard version vector this
+                    # answer read, plus the degraded flag (default-row
+                    # answers are honest about being approximate)
+                    body["versions"] = {str(k): int(v)
+                                        for k, v in versions.items()}
+                    body["degraded"] = bool(getattr(pred, "degraded",
+                                                    False))
+                self._reply(200, body)
             except Overloaded as e:
                 self._reply(429, {"error": str(e)})
             except FleetUnavailable as e:
@@ -165,12 +187,50 @@ def _replica_mesh(i, n):
     return make_mesh(devices=devs[lo:lo + per])
 
 
+def _shard_cache_dir(cfg, ckpt_dir):
+    from dlrm_flexflow_tpu.utils.warmcache import cache_dir_for
+    return cache_dir_for(ckpt_dir,
+                         getattr(cfg, "compile_cache_dir", ""))
+
+
+def _build_shard_set(cfg, model, ckpt_dir):
+    """Row-shard the model's host tables into the lookup tier and
+    release the ranker's own copies (the point of the split)."""
+    n_shards = int(getattr(cfg, "serve_shards", 0))
+    shard_set = ff.EmbeddingShardSet.build(
+        model, n_shards, config=ff.ShardTierConfig.from_config(cfg),
+        cache_dir=_shard_cache_dir(cfg, ckpt_dir))
+    freed = ff.EmbeddingShardSet.release_ranker_tables(model)
+    log_app.info(
+        "sharded serving tier: %d lookup shard(s), ranker released "
+        "%.1f MB of tables", n_shards, freed / 1e6)
+    return shard_set
+
+
 def _build_fleet(cfg, dcfg, n, ckpt_dir):
     """N replicas on disjoint device slices behind a FleetRouter."""
     scfg = ff.ServeConfig.from_config(cfg)
-    fleet = ff.Fleet.build(
-        lambda i: build_server_model(cfg, dcfg, mesh=_replica_mesh(i, n)),
-        n, scfg, checkpoint_dir=ckpt_dir)
+    shard_holder = {}
+
+    def factory(i):
+        model = build_server_model(cfg, dcfg, mesh=_replica_mesh(i, n))
+        if int(getattr(cfg, "serve_shards", 0)) > 0:
+            # the FIRST model built seeds the (single, shared) shard
+            # set; every ranker — this one included — then releases its
+            # own tables and resolves ids through the set
+            if "set" not in shard_holder:
+                shard_holder["set"] = _build_shard_set(cfg, model,
+                                                       ckpt_dir)
+            else:
+                ff.EmbeddingShardSet.release_ranker_tables(model)
+        return model
+
+    fleet = ff.Fleet.build(factory, n, scfg, checkpoint_dir=ckpt_dir,
+                           shard_set=None)
+    if shard_holder:
+        fleet.shard_set = shard_holder["set"]
+        for rep in fleet:
+            rep.engine.attach_shard_set(fleet.shard_set)
     if ckpt_dir:
         for rep in fleet:
             # initial restore through the watcher's READ-ONLY manifest
@@ -204,12 +264,17 @@ def main(argv=None):
 
     ckpt_dir = cfg.checkpoint_dir or None
     n = int(getattr(cfg, "serve_replicas", 1))
+    shard_set = None
     if n > 1:
         serve = _build_fleet(cfg, dcfg, n, ckpt_dir)
         model = serve.fleet.replicas[0].engine.model
+        shard_set = serve.fleet.shard_set
     else:
         model = build_server_model(cfg, dcfg)
-        serve = ff.InferenceEngine(model, checkpoint_dir=ckpt_dir)
+        if int(getattr(cfg, "serve_shards", 0)) > 0:
+            shard_set = _build_shard_set(cfg, model, ckpt_dir)
+        serve = ff.InferenceEngine(model, checkpoint_dir=ckpt_dir,
+                                   shard_set=shard_set)
         if ckpt_dir:
             # initial load through the watcher's READ-ONLY manifest
             # scan (a CheckpointManager here would sweep tmp files
@@ -234,6 +299,10 @@ def main(argv=None):
             "autoscaler on: SLO %.0f ms, %d..%d replicas",
             cfg.serve_slo_ms, cfg.serve_min_replicas,
             cfg.serve_max_replicas)
+    if shard_set is not None and scaler is None:
+        # no autoscaler to drive shard health ticks — the set runs its
+        # own probe/replace loop so an ejected shard still heals
+        shard_set.start_health()
 
     from http.server import ThreadingHTTPServer
     with serve:
@@ -253,6 +322,9 @@ def main(argv=None):
         finally:
             if scaler is not None:
                 scaler.close()
+            if shard_set is not None:
+                shard_set.stop_health()
+                shard_set.close()
             httpd.server_close()
     return 0
 

@@ -185,7 +185,7 @@ class TestPlanVerifier:
 
     def test_rules_registered(self):
         for rid in ("FLX501", "FLX502", "FLX503", "FLX504", "FLX505",
-                    "FLX511", "FLX512", "FLX513"):
+                    "FLX507", "FLX511", "FLX512", "FLX513"):
             name, sev, doc = RULES[rid]
             assert name and doc and sev in ("info", "low", "medium",
                                             "high")
@@ -591,3 +591,117 @@ class TestCli:
             "emb_concat": ParallelConfig((8, 1, 1), param_degree=5)})
         assert shardcheck.main([path, "--fail-on", "high"]) == 1
         assert "FLX504" in capsys.readouterr().out
+
+
+# =====================================================================
+# FLX507: serving-plan audit (ISSUE 13 — the read path gets the same
+# treatment as training plans)
+# =====================================================================
+class TestServingPlanAudit:
+    def _plan(self, nshards=4, rows=ROWS * TABLES, op="emb_stack",
+              **over):
+        from dlrm_flexflow_tpu.parallel.alltoall import shard_row_ranges
+        plan = {"nshards": nshards,
+                "flat_rows": {op: rows},
+                "ranges": {op: shard_row_ranges(rows, nshards)},
+                "ranker_holds_tables": False}
+        plan.update(over)
+        return plan
+
+    def test_replicated_serving_flagged(self):
+        model = _graph()
+        fs = shardcheck.verify_serving_plan(model, replicas=4)
+        assert "FLX507" in _rules(fs)
+        f = next(f for f in fs if f.token == "replicated-serving")
+        assert "--serve-shards" in f.message
+
+    def test_sharded_serving_audits_clean(self):
+        model = _graph()
+        fs = shardcheck.verify_serving_plan(model, replicas=4,
+                                            serving_plan=self._plan())
+        assert fs == []
+
+    def test_ranker_still_holding_tables_flagged_high(self):
+        model = _graph()
+        fs = shardcheck.verify_serving_plan(
+            model, replicas=4,
+            serving_plan=self._plan(ranker_holds_tables=True))
+        assert [f.token for f in fs] == ["ranker-holds-tables"]
+        assert fs[0].severity == "high"
+
+    def test_hbm_budget_makes_it_infeasible(self):
+        model = _graph()
+        from dlrm_flexflow_tpu.serve.shardtier import serving_footprint
+        fp = serving_footprint(model, 4)
+        budget = fp["dense_bytes"] + fp["table_bytes"] // 2
+        fs = shardcheck.verify_serving_plan(model, replicas=4,
+                                            hbm_bytes=budget)
+        assert any(f.token == "ranker-hbm" and f.severity == "high"
+                   for f in fs)
+        # the sharded deployment fits the same budget
+        fs2 = shardcheck.verify_serving_plan(
+            model, replicas=4, serving_plan=self._plan(),
+            hbm_bytes=budget)
+        assert fs2 == []
+
+    def test_tiling_gap_flagged(self):
+        model = _graph()
+        plan = self._plan()
+        plan["ranges"]["emb_stack"] = [(0, 100), (200, ROWS * TABLES)]
+        plan["nshards"] = 2
+        fs = shardcheck.verify_serving_plan(model, replicas=1,
+                                            serving_plan=plan)
+        assert any("GAP" in f.message for f in fs)
+
+    def test_tiling_overlap_flagged(self):
+        model = _graph()
+        plan = self._plan()
+        plan["ranges"]["emb_stack"] = [(0, 300), (200, ROWS * TABLES)]
+        plan["nshards"] = 2
+        fs = shardcheck.verify_serving_plan(model, replicas=1,
+                                            serving_plan=plan)
+        assert any("OVERLAP" in f.message for f in fs)
+
+    def test_tiling_short_extent_flagged(self):
+        model = _graph()
+        plan = self._plan()
+        plan["ranges"]["emb_stack"] = [(0, 100), (100, 200)]
+        plan["nshards"] = 2
+        fs = shardcheck.verify_serving_plan(model, replicas=1,
+                                            serving_plan=plan)
+        assert any(f.token == "extent" for f in fs)
+
+    def test_live_shard_set_plan_audits_clean(self):
+        """The plan an actual EmbeddingShardSet emits passes its own
+        audit — the owner math can never produce a bad tiling."""
+        import dlrm_flexflow_tpu as ff_mod
+        from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+        from dlrm_flexflow_tpu.serve.shardtier import EmbeddingShardSet
+        dcfg = DLRMConfig(embedding_size=[64] * 4,
+                          sparse_feature_size=8,
+                          mlp_bot=[4, 16, 8], mlp_top=[40, 16, 1])
+        model = ff_mod.FFModel(ff_mod.FFConfig(
+            batch_size=16, seed=0, host_resident_tables=True))
+        build_dlrm(model, dcfg)
+        model.compile(ff_mod.SGDOptimizer(lr=0.1),
+                      "mean_squared_error", ["mse"])
+        model.init_layers()
+        sset = EmbeddingShardSet.build(model, 3)
+        EmbeddingShardSet.release_ranker_tables(model)
+        plan = sset.serving_plan()
+        plan["ranker_holds_tables"] = False
+        fs = shardcheck.verify_serving_plan(model, replicas=2,
+                                            serving_plan=plan)
+        assert fs == []
+        sset.close()
+
+    def test_cli_serving_flags(self, capsys):
+        rc = shardcheck.main(["--serving-replicas", "4", "--model",
+                              "dlrm_terabyte", "--hbm-gb", "16"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "FLX507" in out
+        rc = shardcheck.main(["--serving-replicas", "4",
+                              "--serving-shards", "8", "--model",
+                              "dlrm_terabyte", "--hbm-gb", "16"])
+        assert rc == 0

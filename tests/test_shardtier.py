@@ -1,0 +1,828 @@
+"""Sharded serving tier tests (ISSUE 13): row-sharded lookup shards,
+version-vector consistency, graceful degradation, and replace-dead.
+
+Pinned contracts (the acceptance bar):
+
+- sharded lookups are BIT-IDENTICAL to the local host-table path (the
+  shard tier routes through the op's own ``host_lookup_rows``);
+- every response carries the per-shard version vector it read, and a
+  read within one shard is NEVER mixed-version, even under concurrent
+  per-shard delta publishes (one locked lookup per shard per request);
+- a dead shard degrades — responses flagged ``degraded=True``, served
+  from cache hits + per-table default rows, ZERO failed requests,
+  nothing degraded ever cached — and degradation disappears after the
+  replacement shard is probed back in (warm-cache boot, admission
+  probe);
+- delta publishes route per shard with per-slice CRC validation; a
+  corrupt slice makes the shard LAG (consistent, old), never serve
+  garbage, and the watcher's version-floor catch-up heals it;
+- ``FF_FAULT_SHARD_DOWN`` / ``FF_FAULT_LOOKUP_DELAY`` parse strictly
+  (bad values raise naming the variable — the FLX401 convention);
+- a model whose tables exceed the per-replica budget is REJECTED by the
+  replicated fleet's feasibility check and admitted by the sharded
+  tier's.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                           synthetic_batch)
+from dlrm_flexflow_tpu.parallel.alltoall import (row_owners,
+                                                 shard_row_ranges,
+                                                 shard_rows_local)
+from dlrm_flexflow_tpu.serve import (EmbeddingShardSet, InferenceEngine,
+                                     ServeConfig, ShardDown,
+                                     ShardTierConfig,
+                                     ShardTierUnavailable,
+                                     SnapshotWatcher)
+from dlrm_flexflow_tpu.serve.fleet import EJECTED, HEALTHY, PROBING
+from dlrm_flexflow_tpu.serve.shardtier import (check_serving_feasible,
+                                               serving_footprint)
+from dlrm_flexflow_tpu.utils import faults
+from dlrm_flexflow_tpu.utils.delta import (DeltaPublisher,
+                                           shard_slice_crc,
+                                           split_host_rows_by_shard)
+
+DCFG = DLRMConfig(embedding_size=[64] * 4, sparse_feature_size=8,
+                  mlp_bot=[4, 16, 8], mlp_top=[40, 16, 1])
+BS = 16
+
+
+def _build(seed=2, **cfg_kw):
+    cfg_kw.setdefault("host_resident_tables", True)
+    cfg_kw.setdefault("host_tables_async", False)
+    model = ff.FFModel(ff.FFConfig(batch_size=BS, seed=seed, **cfg_kw))
+    build_dlrm(model, DCFG)
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"])
+    model.init_layers()
+    return model
+
+
+def _rows(n, seed=0):
+    x, _ = synthetic_batch(DCFG, n, seed=seed)
+    return x
+
+
+def _tier_cfg(**kw):
+    kw.setdefault("nshards", 2)
+    kw.setdefault("eject_after", 2)
+    kw.setdefault("retries", 1)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("replace_after", 2)
+    kw.setdefault("lookup_deadline_ms", 500.0)
+    return ShardTierConfig(**kw)
+
+
+def _engine(model, sset, **scfg_kw):
+    scfg_kw.setdefault("max_batch", BS)
+    eng = InferenceEngine(model, ServeConfig(**scfg_kw), shard_set=sset)
+    return eng.start()
+
+
+def _shard_down(sid, n=-1):
+    plan = faults.FaultPlan()
+    plan.shard_down[sid] = n
+    return faults.active_plan(plan)
+
+
+# ---------------------------------------------------------------------
+# owner math (shared with parallel/alltoall.py)
+# ---------------------------------------------------------------------
+class TestOwnerMath:
+    @pytest.mark.parametrize("rows,n", [(256, 1), (256, 2), (256, 3),
+                                        (100, 7), (5, 8)])
+    def test_ranges_tile_exactly(self, rows, n):
+        ranges = shard_row_ranges(rows, n)
+        assert len(ranges) == n
+        cur = 0
+        for lo, hi in ranges:
+            assert lo == cur and hi >= lo
+            cur = hi
+        assert cur == rows
+
+    @pytest.mark.parametrize("rows,n", [(256, 2), (100, 7)])
+    def test_owners_match_ranges(self, rows, n):
+        ranges = shard_row_ranges(rows, n)
+        owners = row_owners(np.arange(rows), rows, n)
+        for slot, (lo, hi) in enumerate(ranges):
+            assert np.all(owners[lo:hi] == slot)
+
+    def test_divisible_matches_training_block_math(self):
+        # when rows % n == 0 the serving blocks are exactly the
+        # exchange's rows_local blocks (owner = id // rows_local)
+        rows, n = 256, 4
+        per = shard_rows_local(rows, n)
+        assert per == rows // n
+        assert shard_row_ranges(rows, n) == \
+            [(s * per, (s + 1) * per) for s in range(n)]
+
+    def test_bad_nshards_raises(self):
+        with pytest.raises(ValueError, match="nshards"):
+            shard_row_ranges(10, 0)
+
+
+# ---------------------------------------------------------------------
+# lookup bit-identity + basic wiring
+# ---------------------------------------------------------------------
+class TestShardedLookup:
+    @pytest.mark.parametrize("nshards", [1, 2, 3])
+    def test_bit_identical_to_direct_forward(self, nshards):
+        m = _build()
+        x = _rows(8)
+        direct = np.asarray(m.forward_bucket(x, bucket=BS))
+        sset = EmbeddingShardSet.build(m, nshards)
+        eng = _engine(m, sset)
+        try:
+            pred = eng.predict({k: v[:8] for k, v in x.items()})
+            np.testing.assert_array_equal(np.asarray(pred.scores),
+                                          direct[:8])
+            assert pred.degraded is False
+            assert set(pred.versions) == set(range(nshards))
+        finally:
+            eng.close()
+            sset.close()
+
+    def test_bit_identical_with_cache(self):
+        m = _build()
+        x = _rows(8)
+        direct = np.asarray(m.forward_bucket(x, bucket=BS))
+        sset = EmbeddingShardSet.build(m, 2)
+        eng = _engine(m, sset, cache_rows=128)
+        try:
+            for _ in range(2):   # second pass is all cache hits
+                pred = eng.predict({k: v[:8] for k, v in x.items()})
+                np.testing.assert_array_equal(np.asarray(pred.scores),
+                                              direct[:8])
+            assert eng.stats()["embedding_cache"]["hits"] > 0
+        finally:
+            eng.close()
+            sset.close()
+
+    def test_released_ranker_tables_still_serve(self):
+        m = _build()
+        x = _rows(4)
+        direct = np.asarray(m.forward_bucket(x, bucket=BS))
+        sset = EmbeddingShardSet.build(m, 2)
+        freed = EmbeddingShardSet.release_ranker_tables(m)
+        assert freed > 0
+        assert m.host_params["emb_stack"]["kernel"].shape[0] == 0
+        eng = _engine(m, sset)
+        try:
+            pred = eng.predict({k: v[:4] for k, v in x.items()})
+            np.testing.assert_array_equal(np.asarray(pred.scores),
+                                          direct[:4])
+        finally:
+            eng.close()
+            sset.close()
+
+    def test_build_rejects_device_resident_model(self):
+        m = _build(host_resident_tables=False)
+        with pytest.raises(ValueError, match="host-resident"):
+            EmbeddingShardSet.build(m, 2)
+
+    def test_out_of_range_lookup_rejected(self):
+        m = _build()
+        sset = EmbeddingShardSet.build(m, 2)
+        rep = sset.shards[0]
+        with pytest.raises(ValueError, match="outside its"):
+            rep.shard.lookup({"emb_stack": np.asarray([999], np.int64)})
+        sset.close()
+
+
+# ---------------------------------------------------------------------
+# graceful degradation + circuit breaker + re-admission
+# ---------------------------------------------------------------------
+class TestDegradation:
+    def test_dead_shard_degrades_never_fails(self):
+        m = _build()
+        x = _rows(8)
+        sset = EmbeddingShardSet.build(m, 2, config=_tier_cfg())
+        eng = _engine(m, sset)
+        try:
+            with _shard_down(0):
+                preds = [eng.predict({k: v[:4] for k, v in x.items()})
+                         for _ in range(3)]
+            assert all(p.degraded for p in preds)
+            # the dead shard appears in NO response's version vector
+            # (its rows were defaults, not reads)
+            assert all(0 not in p.versions for p in preds)
+            assert sset.shards[0].state == EJECTED
+            st = eng.stats()
+            assert st["degraded_responses"] >= 3
+            assert st["shard_set"]["degraded_fetches"] >= 1
+            assert st["shard_set"]["defaults_used"] > 0
+            assert eng.healthz()["ok"] is True          # degraded != down
+            assert eng.healthz()["degraded"] is True
+        finally:
+            eng.close()
+            sset.close()
+
+    def test_degraded_samples_never_cached(self):
+        m = _build()
+        x = _rows(4)
+        sset = EmbeddingShardSet.build(m, 2, config=_tier_cfg())
+        eng = _engine(m, sset, cache_rows=128)
+        try:
+            with _shard_down(0):
+                p = eng.predict({k: v[:4] for k, v in x.items()})
+                assert p.degraded
+            # nothing from the degraded batch may have been inserted:
+            # a later healthy lookup must produce the REAL rows
+            for r in sset.shards:
+                if r.state != HEALTHY:
+                    r.begin_probe()
+                    r.readmit()
+            direct = np.asarray(m.forward_bucket(x, bucket=BS))
+            p2 = eng.predict({k: v[:4] for k, v in x.items()})
+            assert not p2.degraded
+            np.testing.assert_array_equal(np.asarray(p2.scores),
+                                          direct[:4])
+        finally:
+            eng.close()
+            sset.close()
+
+    def test_cache_hits_serve_real_values_while_degraded(self):
+        m = _build()
+        x = _rows(4)
+        direct = np.asarray(m.forward_bucket(x, bucket=BS))
+        sset = EmbeddingShardSet.build(m, 2, config=_tier_cfg())
+        eng = _engine(m, sset, cache_rows=128)
+        try:
+            warm = eng.predict({k: v[:4] for k, v in x.items()})
+            assert not warm.degraded
+            with _shard_down(0):
+                # same samples: every lookup is a cache hit — the dead
+                # shard is never consulted, the answer stays exact
+                p = eng.predict({k: v[:4] for k, v in x.items()})
+                assert not p.degraded
+                np.testing.assert_array_equal(np.asarray(p.scores),
+                                              direct[:4])
+        finally:
+            eng.close()
+            sset.close()
+
+    def test_degrade_fail_policy_raises(self):
+        m = _build()
+        x = _rows(4)
+        sset = EmbeddingShardSet.build(m, 2,
+                                       config=_tier_cfg(degrade="fail"))
+        eng = _engine(m, sset)
+        try:
+            with _shard_down(0):
+                with pytest.raises(ShardTierUnavailable):
+                    eng.predict({k: v[:4] for k, v in x.items()})
+        finally:
+            eng.close()
+            sset.close()
+
+    def test_probe_readmits_after_recovery(self):
+        m = _build()
+        x = _rows(4)
+        sset = EmbeddingShardSet.build(m, 2, config=_tier_cfg())
+        eng = _engine(m, sset)
+        try:
+            with _shard_down(0):
+                p = eng.predict({k: v[:4] for k, v in x.items()})
+                assert p.degraded
+                assert sset.shards[0].state == EJECTED
+                # probe under the fault fails — stays ejected
+                acts = sset.health_tick()
+                assert any(a["action"] == "shard-probe"
+                           and not a["ok"] for a in acts)
+                assert sset.shards[0].state == EJECTED
+            # fault cleared: next probe succeeds, degradation ends
+            acts = sset.health_tick()
+            assert any(a["action"] == "shard-probe" and a["ok"]
+                       for a in acts)
+            assert sset.shards[0].state == HEALTHY
+            p2 = eng.predict({k: v[:4] for k, v in x.items()})
+            assert not p2.degraded
+            assert set(p2.versions) == {0, 1}
+        finally:
+            eng.close()
+            sset.close()
+
+    def test_lookup_deadline_times_out_slow_shard(self):
+        m = _build()
+        cfg = _tier_cfg(lookup_deadline_ms=60.0, retries=0,
+                        eject_after=1)
+        sset = EmbeddingShardSet.build(m, 2, config=cfg)
+        plan = faults.FaultPlan()
+        plan.lookup_delay_shard[0] = 0.5
+        try:
+            with faults.active_plan(plan):
+                r = sset.fetch({"emb_stack":
+                                np.asarray([0, 200], np.int64)})
+            assert r.degraded
+            assert r.default_mask["emb_stack"][0]      # slot 0 timed out
+            assert not r.default_mask["emb_stack"][1]  # slot 1 answered
+            assert sset.stats()["timeouts"] >= 1
+            assert sset.shards[0].state == EJECTED     # eject_after=1
+        finally:
+            sset.close()
+
+    def test_hedged_lookup_counted(self):
+        m = _build()
+        cfg = _tier_cfg(hedge_ms=10.0, lookup_deadline_ms=2000.0)
+        sset = EmbeddingShardSet.build(m, 2, config=cfg)
+        plan = faults.FaultPlan()
+        plan.lookup_delay_shard[1] = 0.05   # slow, not dead
+        try:
+            with faults.active_plan(plan):
+                r = sset.fetch({"emb_stack":
+                                np.asarray([0, 200], np.int64)})
+            assert not r.degraded
+            assert sset.stats()["hedges"] >= 1
+        finally:
+            sset.close()
+
+
+# ---------------------------------------------------------------------
+# version vectors + per-shard publishes
+# ---------------------------------------------------------------------
+class TestVersionVector:
+    def _payload(self, key, idx, val, d=8):
+        vals = np.full((len(idx), d), val, np.float32)
+        return {"rows": {key: (np.asarray(idx, np.int64), vals)},
+                "full": {}}
+
+    def test_delta_routes_to_owners_only(self):
+        m = _build()
+        sset = EmbeddingShardSet.build(m, 2)
+        key = "hostparams/emb_stack/kernel"
+        before1 = sset.shards[1].shard.blocks_copy()[0]["emb_stack"]
+        sset.apply_delta(self._payload(key, [3, 7], 5.5), 10)
+        # owner (slot 0) got the rows, slot 1 only the version bump
+        r = sset.fetch({"emb_stack": np.asarray([3, 7], np.int64)})
+        assert np.all(r.rows["emb_stack"] == 5.5)
+        after1 = sset.shards[1].shard.blocks_copy()[0]["emb_stack"]
+        np.testing.assert_array_equal(before1, after1)
+        assert sset.version_vector() == {0: 10, 1: 10}
+        assert sset.shards[0].shard.publishes_applied == 1
+        assert sset.shards[1].shard.publishes_applied == 1
+
+    def test_publish_idempotent_across_rankers(self):
+        m = _build()
+        sset = EmbeddingShardSet.build(m, 2)
+        key = "hostparams/emb_stack/kernel"
+        p = self._payload(key, [3], 5.5)
+        assert sset.apply_delta(p, 10) == 1
+        assert sset.apply_delta(p, 10) == 0   # second ranker: no-op
+        assert sset.version_vector() == {0: 10, 1: 10}
+
+    def test_corrupt_slice_lags_shard_not_garbage(self):
+        m = _build()
+        sset = EmbeddingShardSet.build(m, 2)
+        key = "hostparams/emb_stack/kernel"
+        sub = split_host_rows_by_shard(
+            self._payload(key, [3], 1.0), sset._ranges)[0]
+        good_crc = sub["crc"]
+        # corrupt the payload AFTER the crc was stamped
+        sub["rows"][key][1][...] = 999.0
+        rep = sset.shards[0]
+        before = rep.shard.blocks_copy()[0]["emb_stack"].copy()
+        from dlrm_flexflow_tpu.utils.delta import ChainError
+        with pytest.raises(ChainError, match="CRC"):
+            rep.shard.apply_publish(sub, 10, good_crc)
+        after = rep.shard.blocks_copy()[0]["emb_stack"]
+        np.testing.assert_array_equal(before, after)  # nothing applied
+        assert rep.shard.version == 0                  # lags, consistent
+        assert rep.shard.apply_rejects == 1
+
+    def test_chain_crc_orders_publishes(self):
+        m = _build()
+        sset = EmbeddingShardSet.build(m, 2)
+        key = "hostparams/emb_stack/kernel"
+        sset.apply_delta(self._payload(key, [3], 1.0), 10)
+        c1 = sset.shards[0].shard.chain_crc
+        sset.apply_delta(self._payload(key, [3], 2.0), 11)
+        c2 = sset.shards[0].shard.chain_crc
+        assert c1 != c2    # every publish extends the chain
+
+    def test_never_mixed_within_one_shard_under_publish_storm(self):
+        """The acceptance criterion: concurrent per-shard publishes
+        under live lookups never produce a mixed-version read within
+        one shard. Each publish rewrites EVERY row of each shard to the
+        publish's step value, so any torn read would show two values
+        for one shard — and the reported version must match the value
+        read."""
+        m = _build()
+        sset = EmbeddingShardSet.build(m, 2)
+        key = "hostparams/emb_stack/kernel"
+        R = sset._flat_rows["emb_stack"]
+        stop = threading.Event()
+        errs = []
+
+        def publisher():
+            step = 1
+            while not stop.is_set():
+                flat = np.full((R, 8), float(step), np.float32)
+                sset.apply_delta({"rows": {}, "full": {key: flat}},
+                                 step)
+                step += 1
+
+        t = threading.Thread(target=publisher, daemon=True,
+                             name="ff-test-publisher")
+        t.start()
+        ids = np.asarray([0, 1, 100, 200, 255], np.int64)
+        owners = row_owners(ids, R, 2)
+        try:
+            for _ in range(300):
+                r = sset.fetch({"emb_stack": ids})
+                for slot in (0, 1):
+                    ver = r.versions[slot]
+                    if ver < 1:
+                        continue   # still the (random) init table —
+                    #                constants can't witness mixing yet
+                    vals = r.rows["emb_stack"][owners == slot]
+                    uniq = np.unique(vals)
+                    if uniq.size != 1:
+                        errs.append(f"mixed read in shard {slot}: "
+                                    f"{uniq}")
+                    elif uniq[0] != float(ver):
+                        errs.append(
+                            f"shard {slot} reported version {ver} but "
+                            f"served rows from {uniq[0]}")
+        finally:
+            stop.set()
+            t.join(5.0)
+            sset.close()
+        assert not errs, errs[:5]
+
+    def test_prediction_version_vector_monotonic(self):
+        m = _build()
+        x = _rows(4)
+        sset = EmbeddingShardSet.build(m, 2)
+        eng = _engine(m, sset)
+        key = "hostparams/emb_stack/kernel"
+        try:
+            p1 = eng.predict({k: v[:4] for k, v in x.items()})
+            sset.apply_delta(self._payload(key, [3], 1.0), 10)
+            p2 = eng.predict({k: v[:4] for k, v in x.items()})
+            for slot in p1.versions:
+                assert p2.versions[slot] >= p1.versions[slot]
+            assert p2.versions == {0: 10, 1: 10}
+        finally:
+            eng.close()
+            sset.close()
+
+
+# ---------------------------------------------------------------------
+# warm-cache replace-dead
+# ---------------------------------------------------------------------
+class TestReplaceDead:
+    def test_replacement_boots_from_cache_and_probes_in(self, tmp_path):
+        m = _build()
+        x = _rows(4)
+        direct = np.asarray(m.forward_bucket(x, bucket=BS))
+        sset = EmbeddingShardSet.build(m, 2, config=_tier_cfg(),
+                                       cache_dir=str(tmp_path))
+        eng = _engine(m, sset)
+        try:
+            with _shard_down(0):
+                p = eng.predict({k: v[:4] for k, v in x.items()})
+                assert p.degraded
+                # probes fail until replace_after, then replace-dead
+                replaced = False
+                for _ in range(6):
+                    acts = sset.health_tick()
+                    if any(a["action"] == "shard-replace"
+                           and a["new_sid"] is not None for a in acts):
+                        replaced = True
+                        break
+                assert replaced
+                # fresh sid: the fault (keyed on the old sid) no longer
+                # applies; the admission probe re-admits it
+                acts = sset.health_tick()
+                assert any(a["action"] == "shard-probe" and a["ok"]
+                           for a in acts)
+            assert all(r.state == HEALTHY for r in sset.shards)
+            assert sset.replacements == 1
+            p2 = eng.predict({k: v[:4] for k, v in x.items()})
+            assert not p2.degraded
+            np.testing.assert_array_equal(np.asarray(p2.scores),
+                                          direct[:4])
+        finally:
+            eng.close()
+            sset.close()
+
+    def test_replacement_catches_up_from_history(self, tmp_path):
+        m = _build()
+        sset = EmbeddingShardSet.build(m, 2, config=_tier_cfg(),
+                                       cache_dir=str(tmp_path))
+        key = "hostparams/emb_stack/kernel"
+        # persist at version 0, then publish past it WITHOUT the cache
+        # (sabotage the persist so the cached entry goes stale)
+        cache = sset._cache
+        sset._cache = None
+        vals = np.full((1, 8), 4.25, np.float32)
+        sset.apply_delta({"rows": {key: (np.asarray([3], np.int64),
+                                         vals)}, "full": {}}, 10)
+        sset._cache = cache
+        sset.shards[0].eject("test")
+        new_sid = sset.replace(0)
+        assert new_sid is not None
+        rep = next(r for r in sset.shards if r.slot == 0)
+        assert rep.shard.version == 10     # replayed from history
+        assert rep.state == PROBING
+        assert sset.probe(rep)
+        r = sset.fetch({"emb_stack": np.asarray([3], np.int64)})
+        assert np.all(r.rows["emb_stack"] == 4.25)
+        sset.close()
+
+    def test_corrupt_cache_entry_rejects_with_reason(self, tmp_path):
+        m = _build()
+        sset = EmbeddingShardSet.build(m, 2, config=_tier_cfg(),
+                                       cache_dir=str(tmp_path))
+        sset.shards[0].eject("test")
+        plan = faults.FaultPlan()
+        plan.corrupt_cache_entries = 1
+        with faults.active_plan(plan):
+            assert sset.replace(0) is None
+        assert sset.replace_rejects == 1
+        assert "cache" in sset.last_replace_reject
+        # the set keeps serving (degraded) — nothing got worse
+        r = sset.fetch({"emb_stack": np.asarray([3], np.int64)})
+        assert r.degraded
+        sset.close()
+
+    def test_stale_probe_rejected_until_caught_up(self, tmp_path):
+        m = _build()
+        sset = EmbeddingShardSet.build(m, 2, config=_tier_cfg())
+        key = "hostparams/emb_stack/kernel"
+        rep = sset.shards[0]
+        rep.eject("test")
+        # set moves on while the shard is out (ejected shards skip
+        # publishes entirely)
+        vals = np.full((1, 8), 1.0, np.float32)
+        with sset._apply_lock:
+            pass
+        sset.apply_delta({"rows": {key: (np.asarray([200], np.int64),
+                                         vals)}, "full": {}}, 10)
+        # the ejected shard is stale: probe must refuse admission
+        assert rep.shard.version < sset.version
+        assert not sset.probe(rep)
+        assert "stale" in rep.last_error
+        sset.close()
+
+
+# ---------------------------------------------------------------------
+# watcher integration: per-shard publishes through the real chain
+# ---------------------------------------------------------------------
+class TestWatcherIntegration:
+    def test_chain_applies_per_shard_and_matches_trainer(self, tmp_path):
+        from dlrm_flexflow_tpu.data.stream import ArrayStream
+        trainer = _build(seed=2)
+        d = str(tmp_path)
+        X, Y = synthetic_batch(DCFG, 64, seed=1)
+        pub = DeltaPublisher(trainer, d, row_delta_min_elems=0,
+                             compact_frac=100.0)
+        trainer.fit_stream(ArrayStream(X, Y, BS, seed=1), steps=12,
+                           publisher=pub, publish_every=4,
+                           verbose=False)
+        server = _build(seed=2)
+        sset = EmbeddingShardSet.build(server, 2)
+        eng = InferenceEngine(server, ServeConfig(max_batch=BS),
+                              shard_set=sset).start()
+        try:
+            w = SnapshotWatcher(eng, d)
+            assert w.poll_once()
+            assert eng.version == 12
+            assert sset.version_vector() == {0: 12, 1: 12}
+            x = {k: v[:8] for k, v in X.items() if k != "label"}
+            got = np.asarray(eng.predict(x).scores)
+            want = np.asarray(trainer.forward_bucket(x, bucket=BS))[:8]
+            np.testing.assert_array_equal(got, want)
+        finally:
+            eng.close()
+            sset.close()
+
+    def test_version_floor_drives_catch_up(self, tmp_path):
+        """A replacement shard that boots one publish behind is healed
+        by the watcher's next poll: version_floor < tip keeps the chain
+        replaying (idempotent) until the whole tier is at the tip."""
+        from dlrm_flexflow_tpu.data.stream import ArrayStream
+        trainer = _build(seed=2)
+        d = str(tmp_path)
+        X, Y = synthetic_batch(DCFG, 64, seed=1)
+        pub = DeltaPublisher(trainer, d, row_delta_min_elems=0,
+                             compact_frac=100.0)
+        trainer.fit_stream(ArrayStream(X, Y, BS, seed=1), steps=12,
+                           publisher=pub, publish_every=4,
+                           verbose=False)
+        server = _build(seed=2)
+        sset = EmbeddingShardSet.build(server, 2)
+        eng = InferenceEngine(server, ServeConfig(max_batch=BS),
+                              shard_set=sset).start()
+        try:
+            w = SnapshotWatcher(eng, d)
+            assert w.poll_once()
+            assert eng.version_floor == 12
+            # wind shard 0 back to the chain's base (a stale-but-valid
+            # replacement): floor drops, watcher catches it up
+            rep = sset.shards[0]
+            blocks, _, _ = rep.shard.blocks_copy()
+            rep.shard._version = 4
+            assert eng.version_floor == 4
+            assert w.poll_once()
+            assert sset.version_vector() == {0: 12, 1: 12}
+            assert eng.version_floor == 12
+        finally:
+            eng.close()
+            sset.close()
+
+
+# ---------------------------------------------------------------------
+# chaos: kill one shard under traffic (the acceptance bar)
+# ---------------------------------------------------------------------
+class TestChaos:
+    def test_kill_one_shard_under_traffic_zero_failed(self, tmp_path):
+        m = _build()
+        sset = EmbeddingShardSet.build(
+            m, 2, config=_tier_cfg(lookup_deadline_ms=1000.0),
+            cache_dir=str(tmp_path))
+        # request pool much larger than the cache so the shard tier is
+        # consulted throughout (a pool that fits the cache would ride
+        # out the outage on hits alone — nice, but not what this test
+        # is pinning)
+        eng = _engine(m, sset, cache_rows=8, queue_capacity=4096)
+        reqs = [_rows(2, seed=s) for s in range(48)]
+        results = []
+        errors = []
+        stop = threading.Event()
+
+        def client(i):
+            k = 0
+            while not stop.is_set():
+                try:
+                    p = eng.predict(
+                        {kk: v for kk, v in
+                         reqs[(i * 13 + k) % len(reqs)].items()},
+                        timeout=10.0)
+                    results.append((p.degraded, dict(p.versions)))
+                except Exception as e:   # noqa: BLE001
+                    errors.append(e)
+                k += 1
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True,
+                                    name=f"ff-test-client-{i}")
+                   for i in range(4)]
+        plan = faults.FaultPlan()
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.2)                       # healthy phase
+            plan.shard_down[0] = -1               # kill shard 0
+            with faults.active_plan(plan):
+                deadline = time.monotonic() + 10.0
+                replaced = False
+                while time.monotonic() < deadline and not replaced:
+                    time.sleep(0.05)
+                    replaced = any(
+                        a["action"] == "shard-replace"
+                        and a["new_sid"] is not None
+                        for a in sset.health_tick())
+                assert replaced, "replacement never booted"
+                # admission probe re-admits the fresh sid while the old
+                # one stays dead
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline and \
+                        any(r.state != HEALTHY for r in sset.shards):
+                    sset.health_tick()
+                    time.sleep(0.05)
+            assert all(r.state == HEALTHY for r in sset.shards)
+            n_before = len(results)
+            time.sleep(0.3)                       # recovered phase
+            stop.set()
+            for t in threads:
+                t.join(5.0)
+            # ZERO failed requests across all three phases
+            assert not errors, errors[:3]
+            # degraded answers happened during the outage...
+            assert any(deg for deg, _ in results)
+            # ...and stop after re-admission
+            tail = results[n_before:]
+            assert tail and not any(deg for deg, _ in tail)
+            # every response's version vector has one version per shard
+            # (structural) and versions never regress per slot
+            last = {}
+            for _, vv in results:
+                for slot, ver in vv.items():
+                    assert ver >= last.get(slot, 0)
+                    last[slot] = ver
+        finally:
+            stop.set()
+            eng.close()
+            sset.close()
+
+
+# ---------------------------------------------------------------------
+# fault-injection env parsing (FLX401 convention)
+# ---------------------------------------------------------------------
+class TestFaultEnvParsing:
+    def _parse(self, **env):
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            return faults.plan_from_env()
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def test_shard_down_forms(self):
+        plan = self._parse(FF_FAULT_SHARD_DOWN="1")
+        assert plan.shard_down == {1: -1}
+        plan = self._parse(FF_FAULT_SHARD_DOWN="0:3,2:1")
+        assert plan.shard_down == {0: 3, 2: 1}
+
+    def test_lookup_delay_forms(self):
+        plan = self._parse(FF_FAULT_LOOKUP_DELAY="0:0.25")
+        assert plan.lookup_delay_shard == {0: 0.25}
+        plan = self._parse(FF_FAULT_LOOKUP_DELAY="0.1")
+        assert plan.lookup_delay_s == 0.1
+
+    def test_bad_values_raise_naming_the_variable(self):
+        with pytest.raises(ValueError, match="FF_FAULT_SHARD_DOWN"):
+            self._parse(FF_FAULT_SHARD_DOWN="zero")
+        with pytest.raises(ValueError, match="FF_FAULT_LOOKUP_DELAY"):
+            self._parse(FF_FAULT_LOOKUP_DELAY="0:fast")
+        with pytest.raises(ValueError, match="more than one"):
+            self._parse(FF_FAULT_LOOKUP_DELAY="0:1:2")
+
+    def test_hooks_fire(self):
+        plan = faults.FaultPlan()
+        plan.shard_down[3] = 1
+        with faults.active_plan(plan):
+            assert faults.take_shard_down(3) is True
+            assert faults.take_shard_down(3) is False   # budget spent
+            assert ("shard_down", 3) in plan.fired
+
+
+# ---------------------------------------------------------------------
+# feasibility: tables-exceed-one-replica boards only via the shard tier
+# ---------------------------------------------------------------------
+class TestServingFeasibility:
+    def test_replicated_rejected_sharded_admitted(self):
+        m = _build()
+        fp = serving_footprint(m, replicas=4)
+        budget = fp["dense_bytes"] + fp["table_bytes"] // 2
+        rep = check_serving_feasible(m, 4, budget, nshards=0)
+        assert not rep["feasible"]
+        assert "--serve-shards" in rep["reason"]
+        m2 = _build(seed=3)
+        sset = EmbeddingShardSet.build(m2, 4)
+        EmbeddingShardSet.release_ranker_tables(m2)
+        shd = check_serving_feasible(m2, 4, budget, nshards=4)
+        assert shd["feasible"]
+        assert shd["ranker_bytes"] == shd["dense_bytes"]
+        assert shd["shard_bytes"] <= fp["table_bytes"] // 2
+        sset.close()
+
+    def test_install_full_ignores_released_stub(self):
+        """A released ranker's 0-row host-param stub (e.g. a canary
+        rollback state) must never be sliced over real shard blocks."""
+        m = _build()
+        x = _rows(4)
+        direct = np.asarray(m.forward_bucket(x, bucket=BS))
+        sset = EmbeddingShardSet.build(m, 2)
+        stub = {"emb_stack":
+                {"kernel": np.zeros((0, 8), np.float32)}}
+        assert sset.install_full(stub, version=99)
+        assert sset.version_vector() == {0: 99, 1: 99}
+        eng = _engine(m, sset)
+        try:
+            p = eng.predict({k: v[:4] for k, v in x.items()})
+            np.testing.assert_array_equal(np.asarray(p.scores),
+                                          direct[:4])
+        finally:
+            eng.close()
+            sset.close()
+
+    def test_split_host_rows_crc_deterministic(self):
+        m = _build()
+        sset = EmbeddingShardSet.build(m, 2)
+        key = "hostparams/emb_stack/kernel"
+        payload = {"rows": {key: (np.asarray([3, 200], np.int64),
+                                  np.ones((2, 8), np.float32))},
+                   "full": {}}
+        a = split_host_rows_by_shard(payload, sset._ranges)
+        b = split_host_rows_by_shard(payload, sset._ranges)
+        assert a[0]["crc"] == b[0]["crc"] == shard_slice_crc(a[0])
+        assert set(a) == {0, 1}
+        # routed by owner: slot 0 owns row 3, slot 1 owns row 200
+        assert a[0]["rows"][key][0].tolist() == [3]
+        assert a[1]["rows"][key][0].tolist() == [200]
+        sset.close()
