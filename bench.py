@@ -290,6 +290,21 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             freshness = {"error": str(exc)[:200]}
 
+    # opt-in quantized-storage smoke (BENCH_QUANT=1): footprint /
+    # exchange / delta-publish / cache byte ratios under the int8 row
+    # policy, plus the AUC cost on a kaggle-shaped model
+    quant = None
+    if os.environ.get("BENCH_QUANT"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_quant import measure as _quant_measure
+            quant = _quant_measure(
+                auc_epochs=int(os.environ.get("BENCH_QUANT_EPOCHS",
+                                              "2")))
+        except Exception as exc:
+            quant = {"error": str(exc)[:200]}
+
     vs = 1.0
     base_file = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE")
     if os.path.exists(base_file):
@@ -327,6 +342,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         out["audit"] = audit
     if freshness is not None:
         out["freshness"] = freshness
+    if quant is not None:
+        out["quant"] = quant
     print(json.dumps(out))
     return 0
 

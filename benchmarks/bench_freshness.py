@@ -152,7 +152,49 @@ def measure(publishes=12, steps_per_publish=4, poll_s=0.005):
         "p99_ratio_delta_vs_full": round(ratio, 4),
         "bar": "delta p99 <= 0.25 x full p99",
         "pass": bool(ratio <= 0.25),
+        "quant_publish": _quant_publish_bytes(),
     }
+
+
+def _quant_publish_bytes(steps=8):
+    """ISSUE 14 rider: measured on-disk delta-publish bytes under the
+    int8 row policy vs fp32, identical training on the tables-dominated
+    shape — the publish-bytes half of the quantized-storage bar (the
+    row payload is the term the policy shrinks; the total is diluted by
+    the dense fulls both modes ship)."""
+    import tempfile as _tf
+
+    import numpy as np
+
+    from dlrm_flexflow_tpu.models.dlrm import synthetic_batch
+    from dlrm_flexflow_tpu.utils.delta import DeltaPublisher
+    out = {}
+    for tag, kw in (("fp32", {}), ("int8", {"emb_dtype": "int8"})):
+        import dlrm_flexflow_tpu as ff
+        from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+        dcfg = DLRMConfig(embedding_size=[120_000] * 4,
+                          sparse_feature_size=64,
+                          mlp_bot=[8, 32, 64], mlp_top=[320, 32, 1])
+        model = ff.FFModel(ff.FFConfig(batch_size=64, seed=3, **kw))
+        build_dlrm(model, dcfg)
+        model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                      ["mse"])
+        model.init_layers()
+        with _tf.TemporaryDirectory() as tmp2:
+            pub = DeltaPublisher(model, tmp2, keep_last=2)
+            pub.publish_full()
+            x, y = synthetic_batch(dcfg, 64 * steps, seed=0)
+            model.fit(x, y, epochs=1, verbose=False)
+            entry = pub.publish()
+            out[f"bytes_{tag}"] = int(entry["bytes"])
+            data = np.load(os.path.join(tmp2, entry["file"]))
+            out[f"row_payload_{tag}"] = int(sum(
+                data[k].nbytes for k in data.files
+                if k.split("/")[0] in ("rows", "scl")))
+    out["ratio"] = round(out["bytes_fp32"] / max(out["bytes_int8"], 1), 2)
+    out["ratio_rows"] = round(
+        out["row_payload_fp32"] / max(out["row_payload_int8"], 1), 2)
+    return out
 
 
 if __name__ == "__main__":

@@ -298,6 +298,20 @@ def measure(steps: int = 12):
             out["steps_per_s_row_sharded"]
             / out["steps_per_s_replicated"], 3)
 
+    # quantized-storage exchange payload (ISSUE 14): the row-sharded
+    # all-to-all's ROW payload under the int8 policy vs fp32 — ids
+    # route unchanged, rows ship as codes + one fp32 scale each
+    if dcfg is not None:
+        from dlrm_flexflow_tpu.quant.policy import QuantPolicy
+        lookups_dev = batch * TABLES * dcfg.embedding_bag_size / ndev
+        fp32_rows = lookups_dev * DIM * 4.0
+        int8_rows = lookups_dev * QuantPolicy("int8").row_bytes(DIM)
+        out["quant_exchange"] = {
+            "rows_payload_fp32_kb": round(fp32_rows / 1e3, 1),
+            "rows_payload_int8_kb": round(int8_rows / 1e3, 1),
+            "ratio": round(fp32_rows / int8_rows, 2),
+        }
+
     out["sim_pod_sweep"] = _sim_pod_sweep(ndev)
     out["skew_sweep"] = _skew_sweep(ndev, steps)
     out["sim_skew_dcn"] = _sim_skew_dcn()

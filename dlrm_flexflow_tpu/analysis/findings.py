@@ -103,6 +103,13 @@ RULES = {
                "replicas of memory — or a gap/overlap serves wrong "
                "rows — where a row-sharded lookup tier stores each "
                "table once"),
+    "FLX508": ("quant-policy-mismatch", "high",
+               "a strategy file's quantized-storage policy (quant_dtype"
+               "/quant_update) disagrees with the policy a checkpoint "
+               "manifest records its snapshots under — serving int8 "
+               "rows through an fp32-planned deployment (or vice "
+               "versa) mis-prices every byte term 4x and breaks the "
+               "payload codec at the first delta apply"),
     # --- lowered-HLO audit (analysis/hlo_audit.py) ----------------------
     "FLX511": ("hlo-table-collective", "high",
                "lowered HLO moves a table-scale buffer through an "

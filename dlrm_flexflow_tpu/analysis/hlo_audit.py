@@ -178,9 +178,15 @@ def predicted_collective_bytes(model) -> Dict[str, float]:
         replicas = pc.degrees[0] if pc.degrees else 1
         if replicas <= 1:
             continue
+        # predicted at each param's DECLARED dtype (what the lowering
+        # actually moves — a bf16 table's gradient all-reduce is half
+        # the fp32 bytes; the old flat 4 B/elem over-billed it)
+        import jax.numpy as jnp
+        defs = op.param_defs()
         shard_bytes = sum(
-            math.prod(shape) * 4.0
-            for shape in op.param_shard_shapes(pc, ndev).values())
+            math.prod(shape)
+            * float(jnp.dtype(defs[p].dtype).itemsize if p in defs else 4)
+            for p, shape in op.param_shard_shapes(pc, ndev).items())
         touched = op.param_bytes_touched_per_step(max(pc.num_parts, 1))
         out["all-reduce"] += min(shard_bytes, touched)
     return out

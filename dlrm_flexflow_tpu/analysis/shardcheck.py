@@ -43,6 +43,11 @@ baseline machinery):
   degrees that cannot assign on the recorded mesh. The runtime cache
   rejects such entries too; the static audit (``--plan-cache DIR``)
   finds them before a recovery is on the clock.
+- FLX508 quant-policy-mismatch: a strategy file's quantized-storage
+  policy (``quant_dtype``/``quant_update``, quant/) disagrees with the
+  policy a checkpoint manifest records its snapshots under
+  (``--manifest DIR`` / :func:`verify_quant_policies`) — byte terms
+  mis-priced ~4x, quantized payloads undecodable against the plan.
 - FLX507 serving-plan-overreplicated: the SERVING deployment audited
   the same way (``--serving-replicas N [--serving-shards M]`` /
   :func:`verify_serving_plan`) — table-scale params replicated across
@@ -247,8 +252,12 @@ def verify_plan(model, strategies, ndev: Optional[int] = None,
         if pd > 1 or replicas <= 1:
             continue
         full = float(op.param_bytes())
-        shard = sum(math.prod(s) * 4.0 for s in
-                    op.param_shard_shapes(pc, ndev).values())
+        defs = op.param_defs()
+        import numpy as _np
+        shard = sum(
+            math.prod(s)
+            * float(_np.dtype(defs[p].dtype).itemsize if p in defs else 4)
+            for p, s in op.param_shard_shapes(pc, ndev).items())
         if shard < full:          # table/width sharding holds real shards
             continue
         if tscale is None or full < tscale:
@@ -537,6 +546,81 @@ def verify_file(path: str, model_name: Optional[str] = None,
                        path=rel)
 
 
+# --------------------------------------------------------------------------
+# FLX508: strategy quant policy vs checkpoint-manifest quant policy
+# --------------------------------------------------------------------------
+def _manifest_quant(manifest_path: str) -> Tuple[Dict[str, Dict], str]:
+    """Load the quant-policy record of the NEWEST manifest entry.
+    Accepts a checkpoint directory or a manifest.json path. Returns
+    ({op: {"dtype", "update_rule"}}, display name)."""
+    import json
+    path = manifest_path
+    if os.path.isdir(path):
+        path = os.path.join(path, "manifest.json")
+    with open(path) as f:
+        manifest = json.load(f)
+    entries = manifest.get("entries") or []
+    if not entries:
+        return {}, os.path.basename(path)
+    newest = max(entries, key=lambda e: e.get("step", -1))
+    return (dict((newest.get("mesh") or {}).get("quant") or {}),
+            os.path.basename(path))
+
+
+def verify_quant_policies(strategies, manifest_quant: Dict[str, Dict],
+                          *, default_dtype: str = "fp32",
+                          default_update: str = "master_weight",
+                          path: str = "<plan>") -> List[Finding]:
+    """FLX508: the strategy's per-op quantized-storage policy must agree
+    with what the checkpoint manifest says its snapshots were written
+    under. A disagreement is silent until the worst moment: every byte
+    term (HBM footprint, exchange payloads, delta sizes) is mis-priced
+    ~4x, and the first quantized delta payload applied to an
+    fp32-planned serving table (or vice versa) is garbage rows.
+
+    ``manifest_quant`` is the manifest's ``mesh.quant`` record
+    ({op: {"dtype", "update_rule"}} — :func:`_manifest_quant` loads it);
+    ``default_dtype``/``default_update`` fill strategy entries that are
+    silent (the model-wide --emb-dtype default the deployment runs
+    with)."""
+    findings: List[Finding] = []
+    names = set(manifest_quant) | set(strategies)
+    for name in sorted(names):
+        pc = strategies.get(name)
+        s_dt = (getattr(pc, "quant_dtype", "") or default_dtype) \
+            if pc is not None else default_dtype
+        s_up = (getattr(pc, "quant_update", "") or default_update) \
+            if pc is not None else default_update
+        rec = manifest_quant.get(name) or {}
+        m_dt = rec.get("dtype", "fp32")
+        m_up = rec.get("update_rule", "master_weight")
+        if name not in manifest_quant and pc is not None \
+                and not getattr(pc, "quant_dtype", ""):
+            # neither side says anything about this op — nothing to
+            # disagree on (non-table ops land here)
+            continue
+        if s_dt != m_dt:
+            findings.append(make_finding(
+                "FLX508", path, 0,
+                f"{name!r}: strategy stores {s_dt} rows but the "
+                f"manifest's snapshots were written under "
+                f"quant dtype {m_dt} — every byte term is mis-priced "
+                f"(~4x for int8/fp8 vs fp32) and quantized payloads "
+                f"will not decode against this plan",
+                scope=name, token=f"dtype:{s_dt}!={m_dt}"))
+        elif s_up != m_up:
+            findings.append(make_finding(
+                "FLX508", path, 0,
+                f"{name!r}: strategy update rule {s_up} disagrees with "
+                f"the manifest's {m_up} — master-weight snapshots hold "
+                f"the exact fp32 master, stochastic-rounding snapshots "
+                f"hold quantized fixed points; restoring across the "
+                f"rules silently changes training numerics",
+                scope=name, token=f"update:{s_up}!={m_up}",
+                severity="medium"))
+    return findings
+
+
 def audit_plan_cache(cache_dir: str) -> List[Finding]:
     """FLX506: re-verify every entry of a persistent plan cache
     (``utils/warmcache.PlanCache``) against the mesh its own key names.
@@ -663,6 +747,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "what elastic recover()/expand() warm-start "
                          "from) against its recorded mesh signature "
                          "(FLX506)")
+    ap.add_argument("--manifest", default=None, metavar="PATH",
+                    help="checkpoint directory (or manifest.json) whose "
+                         "recorded quantized-storage policies every "
+                         "strategy file must agree with (FLX508 "
+                         "quant-policy-mismatch)")
+    ap.add_argument("--emb-dtype", default="fp32", metavar="DT",
+                    help="model-wide default quant dtype the deployment "
+                         "runs with, for strategy entries that are "
+                         "silent (FLX508; default fp32)")
     ap.add_argument("--audit", action="store_true",
                     help="additionally AOT-lower the train step on the "
                          "attached devices and audit the compiled HLO "
@@ -710,12 +803,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"shardcheck: plan-cache audit failed: {e}",
                   file=sys.stderr)
             return 2
+    mquant = mname = None
+    if args.manifest:
+        try:
+            mquant, mname = _manifest_quant(args.manifest)
+        except (OSError, ValueError) as e:
+            print(f"shardcheck: cannot read manifest "
+                  f"{args.manifest}: {e}", file=sys.stderr)
+            return 2
     for path in args.paths:
         try:
             findings.extend(verify_file(
                 path, model_name=args.model, ndev=args.ndev,
                 batch=args.batch, hbm_bytes=hbm,
                 survivor_ndev=args.survivor_ndev, topology=topology))
+            if mquant is not None:
+                from ..parallel.strategy_io import load_strategies
+                findings.extend(verify_quant_policies(
+                    load_strategies(path), mquant,
+                    default_dtype=args.emb_dtype,
+                    path=f"{os.path.basename(path)}~{mname}"))
         except (ValueError, OSError) as e:
             print(f"shardcheck: {e}", file=sys.stderr)
             return 2

@@ -113,6 +113,19 @@ class FFConfig:
     # avoids streaming the full tables through HBM every step). Disable
     # with --dense-embedding-update.
     sparse_embedding_update: bool = True
+    # model-wide default QUANTIZED STORAGE policy for embedding tables
+    # (quant/: "fp32" | "bf16" | "int8" | "fp8"): int8/fp8 rows store
+    # one fp32 scale per row and cut per-table HBM, exchange payloads,
+    # delta publishes, and cache bytes ~4x. Per-table overrides ride the
+    # strategy file (ParallelConfig.quant_dtype). Set with --emb-dtype.
+    emb_dtype: str = "fp32"
+    # the quantized update rule: "master_weight" keeps an exact fp32
+    # master (updates bit-identical to fp32 training; the quantized
+    # representation ships at storage boundaries) — the safe default;
+    # "stochastic_rounding" drops the master and re-quantizes after
+    # every update (unbiased rounding; full training-memory win, small
+    # accuracy tolerance). Set with --emb-update-rule.
+    emb_update_rule: str = "master_weight"
     # VMEM-resident pallas LSTM scan kernel (weights pinned in VMEM
     # across the time loop — the lax.scan cell is weight-stream-bound,
     # BENCHMARKS.md r4). Disable with --no-pallas-lstm.
@@ -413,6 +426,20 @@ class FFConfig:
                     if cfg.superstep < 1:
                         raise ValueError(
                             f"--superstep expects K >= 1, got {v}")
+            elif a == "--emb-dtype":
+                v = take()
+                if v not in ("fp32", "bf16", "int8", "fp8"):
+                    raise ValueError(
+                        f"--emb-dtype expects fp32|bf16|int8|fp8, "
+                        f"got {v!r}")
+                cfg.emb_dtype = v
+            elif a == "--emb-update-rule":
+                v = take()
+                if v not in ("master_weight", "stochastic_rounding"):
+                    raise ValueError(
+                        f"--emb-update-rule expects "
+                        f"master_weight|stochastic_rounding, got {v!r}")
+                cfg.emb_update_rule = v
             elif a == "--serve-max-batch":
                 cfg.serve_max_batch = int(take())
             elif a == "--serve-max-delay-ms":

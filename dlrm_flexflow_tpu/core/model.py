@@ -627,6 +627,9 @@ class FFModel:
                               and "ZCM" in raw.memory_types):
                 hres.add(op.name)
         self._host_resident_ops = hres
+        # per-op quantized-storage policies (quant/), re-resolved per
+        # compile (configure_quant fills it; non-default policies only)
+        self._quant_policies = {}
 
         def spec_from_axes(axes_per_dim):
             return NamedSharding(self.mesh,
@@ -644,6 +647,12 @@ class FFModel:
             if hasattr(op, "_row_shard_geometry"):
                 from ..ops.embedding import configure_row_shard
                 configure_row_shard(op, self.strategies.get(op.name))
+            # quantized-storage policy for embedding tables (strategy
+            # quant_dtype / --emb-dtype): resolved beside the row-shard
+            # plan so search, serving, and the publisher read one policy
+            if hasattr(op, "host_lookup"):
+                from ..ops.embedding import configure_quant
+                configure_quant(op, self.strategies.get(op.name))
             try:
                 out_axes = op.output_axes(
                     pc, asn, raw_pc=self.strategies.get(op.name, pc))
@@ -1066,6 +1075,11 @@ class FFModel:
                 grad_leaves = jax.tree.leaves(grads)
                 new_params, new_opt = self.optimizer.update(params, grads,
                                                             opt_state)
+            # quantized storage, stochastic_rounding rule: re-quantize
+            # the updated tables IN the step (master_weight keeps the
+            # exact fp32 master — no requant, bit-identical to fp32
+            # training; quantization happens at storage boundaries)
+            new_params = self._requant_sr_params(new_params, rng)
             # anomaly sentinel: ONE on-device finiteness predicate over the
             # loss and the global gradient norm. Under any active policy
             # the non-finite update is suppressed ON DEVICE (jnp.where
@@ -1171,6 +1185,80 @@ class FFModel:
     # ------------------------------------------------------------------
     # runtime verbs (reference model.cc:942-993)
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # quantized embedding storage (quant/)
+    # ------------------------------------------------------------------
+    def quant_policies(self):
+        """Per-op NON-DEFAULT quantized-storage policies resolved at
+        compile ({op name: QuantPolicy}) — what the delta publisher, the
+        serving caches/shard tier, and the checkpoint manifest consume."""
+        return dict(getattr(self, "_quant_policies", {}) or {})
+
+    def _sr_quant_ops(self):
+        """Ops whose policy re-quantizes in the training step
+        (stochastic_rounding with a non-fp32 storage dtype), in
+        deterministic order for the per-op RNG fold."""
+        return sorted(
+            (name, pol) for name, pol in self.quant_policies().items()
+            if pol.update_rule == "stochastic_rounding"
+            and pol.dtype != "fp32"
+            and name not in getattr(self, "_host_resident_ops", set()))
+
+    def _requant_sr_params(self, new_params, rng):
+        """The in-step stochastic-rounding hook: re-quantize every
+        updated table of an SR-policy op (kernel + hybrid hot_kernel) so
+        the stored parameter is always the exact fp32 image of its
+        quantized representation. Runs inside the jitted step (and thus
+        inside the superstep scan body) with a per-(step, op, param)
+        folded key — deterministic per seed."""
+        sr = self._sr_quant_ops()
+        if not sr:
+            return new_params
+        from ..quant.codec import fake_quant_stochastic
+        for i, (name, pol) in enumerate(sr):
+            if name not in new_params:
+                continue
+            sub = dict(new_params[name])
+            for j, pname in enumerate(("kernel", "hot_kernel")):
+                if pname in sub:
+                    k = jax.random.fold_in(rng, 0x51 + 2 * i + j)
+                    sub[pname] = fake_quant_stochastic(
+                        sub[pname], pol.dtype, k)
+            new_params[name] = sub
+        return new_params
+
+    def _sr_policy_of(self, op_name: str):
+        pol = self.quant_policies().get(op_name)
+        if pol is None or pol.dtype == "fp32" \
+                or pol.update_rule != "stochastic_rounding":
+            return None
+        return pol
+
+    def _quant_init_device(self, op, p):
+        """Under stochastic_rounding, training starts FROM the stored
+        (quantized) representation: quantize-dequantize the fresh table
+        once at init (nearest — SR at init would just add noise).
+        master_weight inits stay exact fp32."""
+        pol = self._sr_policy_of(op.name)
+        if pol is None:
+            return p
+        from ..quant.codec import fake_quant
+        return {n: (fake_quant(v, pol.dtype)
+                    if n in ("kernel", "hot_kernel") else v)
+                for n, v in p.items()}
+
+    def _quant_init_host(self, op):
+        pol = self._sr_policy_of(op.name)
+        if pol is None:
+            return
+        from ..quant.codec import fake_quant_np
+        tbl = self.host_params[op.name]
+        if "kernel" in tbl:
+            k = tbl["kernel"]
+            tbl["kernel"] = fake_quant_np(
+                k.reshape(-1, k.shape[-1]), pol.dtype).reshape(
+                    k.shape).astype(np.float32)
+
     def init_layers(self, seed: Optional[int] = None):
         """Initialize parameters/optimizer/op state, sharded per strategy
         (reference init_layers launches per-op init tasks; initializer GPU
@@ -1194,6 +1282,7 @@ class FFModel:
                     # table lives in host RAM, filled there (numpy) —
                     # never device_put (reference embedding_avx2.cc path)
                     self.host_params[op.name] = op.host_init(seed + i)
+                    self._quant_init_host(op)
                     # stateful optimizers keep their table-shaped state
                     # slabs on the host too (lazy touched-rows update)
                     for slab in self.optimizer.sparse_slab_names():
@@ -1204,6 +1293,7 @@ class FFModel:
                 if op.param_defs():
                     key, sub = jax.random.split(key)
                     p = op.init_params(sub)
+                    p = self._quant_init_device(op, p)
                     shards = self._param_sharding.get(op.name, {})
                     rep = NamedSharding(self.mesh, PartitionSpec())
                     params[op.name] = {
@@ -1997,6 +2087,20 @@ class FFModel:
                     op.host_sgd_update(self.host_params[op.name],
                                        host_idx[op.name],
                                        cts_np[op.name], opt.lr)
+                pol = self._sr_policy_of(op.name)
+                if pol is not None:
+                    # stochastic_rounding: re-quantize exactly the rows
+                    # this scatter touched (deterministic per step)
+                    from ..quant.codec import fake_quant_stochastic_np
+                    rows = np.unique(np.asarray(
+                        op.host_delta_touched_rows(host_idx[op.name])))
+                    kern = self.host_params[op.name]["kernel"]
+                    v = kern.reshape(-1, kern.shape[-1])
+                    rng = np.random.RandomState(
+                        (self.config.seed ^ (int(step) * 2654435761))
+                        & 0x7FFFFFFF)
+                    v[rows] = fake_quant_stochastic_np(v[rows], pol.dtype,
+                                                       rng)
 
     @staticmethod
     def to_logical(value, tensor):

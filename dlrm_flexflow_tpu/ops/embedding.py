@@ -118,10 +118,16 @@ def _table_sizes(op):
     return sizes
 
 
-def _table_itemsize(op) -> float:
-    """Bytes per table element from the op's ACTUAL param dtype — a bf16
-    table has half the fp32 footprint against the 2 MB streaming
-    threshold, and hardcoding 4 B would misclassify it as large."""
+def _table_itemsize(op, pc=None) -> float:
+    """Bytes per STORED table element: the op's effective quantized-
+    storage policy when one is set (int8 rows stream 1 B/elem against
+    the 2 MB threshold), else the actual param dtype — a bf16 table has
+    half the fp32 footprint, and hardcoding 4 B would misclassify it as
+    large."""
+    from ..quant.policy import effective_policy
+    pol = effective_policy(op, pc)
+    if not pol.is_default:
+        return float(pol.itemsize)
     try:
         pd = op.param_defs().get("kernel")
         return float(jnp.dtype(pd.dtype).itemsize)
@@ -367,12 +373,15 @@ def _host_stateful_update(table, g, ct, opt, slabs, step, aggr):
 
 
 def _touched_bytes_factor(op) -> float:
-    """Bytes-per-touched-element multiplier: gather read + update
-    read/write of the weights (3), plus read+write per optimizer state
-    slab on the stateful sparse path."""
+    """Bytes-per-touched-element / 4: gather read + update read/write of
+    the weights (3 accesses at the table's effective STORED width — an
+    int8-policy table streams a quarter of the weight bytes), plus
+    read+write per optimizer state slab (always fp32) on the stateful
+    sparse path. Returned in fp32-element units so callers keep
+    multiplying by ``elements * 4``."""
     opt = getattr(op.model, "optimizer", None)
     nslabs = len(opt.sparse_slab_names()) if opt is not None else 0
-    return 3.0 + 2.0 * nslabs
+    return 3.0 * (_table_itemsize(op) / 4.0) + 2.0 * nslabs
 
 
 def _sparse_update_active(op) -> bool:
@@ -759,6 +768,32 @@ def configure_row_shard(op, raw_pc) -> None:
         "executing with replicated rows", pd, op.name, reason)
 
 
+def configure_quant(op, raw_pc) -> None:
+    """Resolve the quantized-storage policy for ``op`` from its RAW
+    strategy entry (``quant_dtype``/``quant_update``) with the model's
+    ``--emb-dtype``/``--emb-update-rule`` as the default. Sets
+    ``op._quant_policy`` — THE per-op policy every byte-accounting and
+    storage-boundary site reads via ``quant.effective_policy`` — and
+    registers it in ``model._quant_policies`` (non-default policies
+    only) for the publisher/serving/manifest consumers."""
+    from ..quant.policy import FP32, policy_from_config, policy_from_pc
+    pol = policy_from_pc(raw_pc) \
+        or policy_from_config(op.model.config) or FP32
+    op._quant_policy = pol
+    reg = getattr(op.model, "_quant_policies", None)
+    if reg is None:
+        reg = {}
+        op.model._quant_policies = reg
+    if pol.is_default:
+        reg.pop(op.name, None)
+        return
+    reg[op.name] = pol
+    log_emb.info(
+        "quantized storage for %r: dtype=%s update_rule=%s "
+        "(row-wise scales%s)", op.name, pol.dtype, pol.update_rule,
+        "" if pol.is_quantized else " n/a")
+
+
 def _row_plan(op):
     return getattr(op, "_row_plan", None)
 
@@ -821,7 +856,15 @@ def _a2a_payload_bytes(op, ndev: int, itemsize: int, pc=None):
         n_dev = expected_routed_lookups(op, pc, n_dev)
     d = op.out_dim
     req = n_dev * 4.0                      # int32 row ids
-    rows = n_dev * d * float(itemsize)     # embedded rows, compute dtype
+    # embedded rows back: at the table's STORED width under a quantized
+    # policy (int8/fp8 rows + one fp32 scale each ride the exchange —
+    # ids route unchanged, the payload shrinks ~4x), else compute dtype
+    from ..quant.policy import effective_policy
+    pol = effective_policy(op, pc)
+    if pol.is_default:
+        rows = n_dev * d * float(itemsize)
+    else:
+        rows = n_dev * pol.row_bytes(d)
     grad = n_dev * (4.0 + d * 4.0)         # fp32 grad rows + positions
     return req, rows, grad
 

@@ -61,6 +61,16 @@ class ParallelConfig:
     #   Adnan 2021). 0 = every row routed.
     exchange: str = "dense"
     hot_fraction: float = 0.0
+    # per-table quantized STORAGE policy (quant/policy.py): element
+    # dtype of the stored rows ("" = inherit the model-wide
+    # FFConfig.emb_dtype default; "fp32"/"bf16"/"int8"/"fp8" pin it per
+    # table) and the update rule ("master_weight" keeps an exact fp32
+    # master beside the optimizer state; "stochastic_rounding" re-
+    # quantizes after every update). int8/fp8 rows carry one fp32 scale
+    # per row; every byte-accounting site resolves sizes through
+    # quant.effective_policy so search, shardcheck, and serving agree.
+    quant_dtype: str = ""
+    quant_update: str = ""
 
     def __post_init__(self):
         object.__setattr__(self, "degrees", tuple(int(d) for d in self.degrees))
@@ -80,6 +90,23 @@ class ParallelConfig:
             raise ValueError(
                 f"invalid hot_fraction {self.hot_fraction} "
                 f"(expected 0 <= f < 1)")
+        # vocab literals kept in sync with quant.policy.DTYPES /
+        # UPDATE_RULES (pconfig stays import-cycle-free; the quant tests
+        # pin the agreement)
+        if self.quant_dtype not in ("", "fp32", "bf16", "int8", "fp8"):
+            raise ValueError(
+                f"invalid quant_dtype {self.quant_dtype!r} (expected "
+                f"'', 'fp32', 'bf16', 'int8', or 'fp8')")
+        if self.quant_update not in ("", "master_weight",
+                                     "stochastic_rounding"):
+            raise ValueError(
+                f"invalid quant_update {self.quant_update!r} (expected "
+                f"'', 'master_weight', or 'stochastic_rounding')")
+        if self.quant_update and not self.quant_dtype:
+            raise ValueError(
+                f"quant_update={self.quant_update!r} without a "
+                f"quant_dtype — the update rule refines a storage "
+                f"dtype, it cannot stand alone")
 
     @property
     def num_parts(self) -> int:

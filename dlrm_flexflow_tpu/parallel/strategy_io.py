@@ -65,10 +65,20 @@ def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
             raise ValueError("malformed varint")
 
 
+# proto enum for the quantized-storage policy (field 9/10 below):
+# 0 = unset (inherit the model default) — never written, so legacy
+# files stay byte-identical
+_QUANT_DTYPE_ENUM = {"": 0, "fp32": 1, "bf16": 2, "int8": 3, "fp8": 4}
+_QUANT_DTYPE_NAME = {v: k for k, v in _QUANT_DTYPE_ENUM.items()}
+_QUANT_UPDATE_ENUM = {"": 0, "master_weight": 1, "stochastic_rounding": 2}
+_QUANT_UPDATE_NAME = {v: k for k, v in _QUANT_UPDATE_ENUM.items()}
+
+
 def _encode_op(name: str, device_type: int, dims: List[int],
                device_ids: List[int],
                memory_types: List[int], param_dim: int = 1,
-               hot_ppm: int = 0, exchange: int = 0) -> bytes:
+               hot_ppm: int = 0, exchange: int = 0,
+               quant_dtype: int = 0, quant_update: int = 0) -> bytes:
     msg = bytearray()
     nb = name.encode()
     msg += b"\x0a" + _varint(len(nb)) + nb          # 1: name (len-delim)
@@ -90,6 +100,13 @@ def _encode_op(name: str, device_type: int, dims: List[int],
         msg += b"\x38" + _varint(hot_ppm)
     if exchange > 0:                                # 8: exchange mode
         msg += b"\x40" + _varint(exchange)          # 1 = dedup
+    if quant_dtype > 0:                             # 9: quantized storage
+        # extension fields like 6-8: unknown to the reference's proto2
+        # parser (skipped), omitted when unset so legacy files stay
+        # byte-identical
+        msg += b"\x48" + _varint(quant_dtype)
+    if quant_update > 0:                            # 10: quant update rule
+        msg += b"\x50" + _varint(quant_update)
     return bytes(msg)
 
 
@@ -140,7 +157,11 @@ def save_strategies_pb(path: str, strategies: StrategyMap) -> None:
             param_dim=getattr(pc, "param_degree", 1),
             hot_ppm=int(round(getattr(pc, "hot_fraction", 0.0) * 1e6)),
             exchange=1 if getattr(pc, "exchange",
-                                  "dense") == "dedup" else 0)
+                                  "dense") == "dedup" else 0,
+            quant_dtype=_QUANT_DTYPE_ENUM[
+                getattr(pc, "quant_dtype", "") or ""],
+            quant_update=_QUANT_UPDATE_ENUM[
+                getattr(pc, "quant_update", "") or ""])
         body += b"\x0a" + _varint(len(op)) + op     # Strategy.ops = 1
     with open(path, "wb") as f:
         f.write(bytes(body))
@@ -163,7 +184,7 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
         if field != 1 or wt != 2:
             continue
         name, dt, dims, dev_ids, mts, pd = "", 0, [], [], [], 1
-        hot_ppm, exch = 0, 0
+        hot_ppm, exch, qdt, qup = 0, 0, 0, 0
         for f2, wt2, v2 in _decode_message(v):
             if f2 == 1:
                 name = v2.decode()
@@ -181,6 +202,10 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
                 hot_ppm = v2               # hybrid hot fraction, ppm
             elif f2 == 8:
                 exch = v2                  # exchange mode (1 = dedup)
+            elif f2 == 9:
+                qdt = v2                   # quantized storage dtype
+            elif f2 == 10:
+                qup = v2                   # quant update rule
         if pd < 1:
             raise ValueError(
                 f"op {name!r}: parameter-axis degree {pd} < 1")
@@ -191,12 +216,20 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
         if exch not in (0, 1):
             raise ValueError(
                 f"op {name!r}: unknown exchange mode {exch}")
+        if qdt not in _QUANT_DTYPE_NAME:
+            raise ValueError(
+                f"op {name!r}: unknown quant dtype enum {qdt}")
+        if qup not in _QUANT_UPDATE_NAME:
+            raise ValueError(
+                f"op {name!r}: unknown quant update-rule enum {qup}")
         out[name] = ParallelConfig(
             tuple(reversed(dims)), device_type="CPU" if dt == 1 else "TPU",
             device_ids=tuple(dev_ids),
             memory_types=tuple("ZCM" if m == 1 else "FBM" for m in mts),
             param_degree=pd, hot_fraction=hot_ppm / 1e6,
-            exchange="dedup" if exch == 1 else "dense")
+            exchange="dedup" if exch == 1 else "dense",
+            quant_dtype=_QUANT_DTYPE_NAME[qdt],
+            quant_update=_QUANT_UPDATE_NAME[qup])
     return out
 
 
@@ -282,6 +315,16 @@ def validate_strategies(strategies: StrategyMap,
                 f"hot_fraction/exchange set on an op with no row-shard "
                 f"support (not one of the model's embedding ops: "
                 f"{sorted(row_shard_ops)[:8]}...)")
+        if getattr(pc, "quant_dtype", "") and row_shard_ops is not None \
+                and name not in row_shard_ops \
+                and not _GENERIC_KEY_RE.match(str(name)):
+            # quantized row storage is a TABLE policy; on a Linear it is
+            # a corrupt or mis-keyed file, not a strategy
+            raise StrategyValidationError(
+                path, str(name),
+                f"quant_dtype={pc.quant_dtype!r} set on an op with no "
+                f"embedding-table storage (not one of the model's "
+                f"embedding ops: {sorted(row_shard_ops)[:8]}...)")
         if not name or not isinstance(name, str):
             raise StrategyValidationError(
                 path, repr(name), "empty/non-string op name")
@@ -366,6 +409,12 @@ def save_strategies(path: str, strategies: StrategyMap) -> None:
             entry["hot_frac"] = float(pc.hot_fraction)
         if getattr(pc, "exchange", "dense") != "dense":
             entry["exchange"] = pc.exchange
+        if getattr(pc, "quant_dtype", ""):
+            # quantized-storage policy (omitted when unset so legacy
+            # files stay diff-identical)
+            entry["quant_dtype"] = pc.quant_dtype
+        if getattr(pc, "quant_update", ""):
+            entry["quant_update"] = pc.quant_update
         ops.append(entry)
     doc = {"ops": ops}
     with open(path, "w") as f:
@@ -397,7 +446,9 @@ def load_strategies(path: str, num_devices: Optional[int] = None,
                     memory_types=tuple(entry.get("memory_types", ())),
                     param_degree=int(entry.get("param_dim", 1)),
                     hot_fraction=float(entry.get("hot_frac", 0.0)),
-                    exchange=str(entry.get("exchange", "dense")))
+                    exchange=str(entry.get("exchange", "dense")),
+                    quant_dtype=str(entry.get("quant_dtype", "")),
+                    quant_update=str(entry.get("quant_update", "")))
             except (KeyError, TypeError, ValueError) as e:
                 raise StrategyValidationError(
                     path, str(entry.get("name", "?")),

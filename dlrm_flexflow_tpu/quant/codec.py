@@ -1,0 +1,193 @@
+"""Row-wise symmetric quantize/dequantize codecs (numpy + jax).
+
+One codec, two hosts: the numpy half runs on storage boundaries (delta
+publishes, shard-tier blocks, warm-cache entries, the serving row
+cache), the jax half runs inside the jitted train step (init-time
+quantize + the stochastic-rounding re-quantize hook) and in the Pallas
+gather's reference oracle.
+
+Layout: the LAST axis is the row; every leading axis multiplies into
+the row count (a stacked (T, rows, d) table carries T*rows scales).
+Codes are symmetric — ``scale = amax / QMAX`` per row, zero-point 0 —
+so the row max always maps to the top code. Consequence (pinned in
+tests/test_quant.py): re-quantizing a dequantized payload reproduces
+the CODES bit-exactly (the recomputed scale can differ from the stored
+one by at most 1 ulp, which moves ``q*s/s'`` by ~1e-5 of a code — far
+from any rounding boundary), so fp32 arrays can flow between
+subsystems while quantized storage round-trips losslessly.
+
+fp8 uses the e4m3 format (max 448) via ml_dtypes; its codes are stored
+on disk as uint8 bit patterns (``encode_q``/``decode_q``) because npz
+cannot serialize the extension dtype portably.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+# quantized-code ranges: int8 symmetric uses +-127 (not -128: symmetry
+# keeps dequantization zero-point-free); fp8 e4m3's largest finite is 448
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
+def _f8_dtype():
+    import ml_dtypes
+    return ml_dtypes.float8_e4m3fn
+
+
+def _row_amax_np(arr: np.ndarray) -> np.ndarray:
+    return np.max(np.abs(arr), axis=-1)
+
+
+def _scales_from_amax(amax, qmax: float):
+    # all-zero rows get scale 0 (codes are 0, dequant is exact 0)
+    return np.where(amax > 0, amax / qmax, 0.0).astype(np.float32)
+
+
+def quantize_rows_np(arr: np.ndarray, dtype: str
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """fp32 rows -> (codes, scales). ``codes`` has ``arr``'s shape in
+    the storage dtype (int8, or ml_dtypes float8_e4m3fn); ``scales`` is
+    fp32 with the leading (row) shape."""
+    if dtype not in _QMAX:
+        raise ValueError(f"quantize_rows_np: {dtype!r} is not a "
+                         f"quantized dtype (int8/fp8)")
+    arr = np.asarray(arr, np.float32)
+    qmax = _QMAX[dtype]
+    scales = _scales_from_amax(_row_amax_np(arr), qmax)
+    safe = np.where(scales > 0, scales, 1.0)[..., None]
+    scaled = arr / safe
+    if dtype == "int8":
+        q = np.clip(np.rint(scaled), -127, 127).astype(np.int8)
+    else:
+        q = np.clip(scaled, -qmax, qmax).astype(_f8_dtype())
+    return q, scales
+
+
+def dequantize_rows_np(q: np.ndarray, scales: np.ndarray,
+                       dtype: str) -> np.ndarray:
+    """(codes, scales) -> fp32 rows."""
+    if dtype not in _QMAX:
+        raise ValueError(f"dequantize_rows_np: {dtype!r} is not a "
+                         f"quantized dtype (int8/fp8)")
+    return (np.asarray(q, np.float32)
+            * np.asarray(scales, np.float32)[..., None])
+
+
+def fake_quant_np(arr: np.ndarray, dtype: str) -> np.ndarray:
+    """Quantize-dequantize in one hop: the exact fp32 image of the
+    stored representation (what the master-resident simulated path
+    keeps as the parameter value). fp32 is the identity; bf16 is a
+    precision round-trip with no scales."""
+    if dtype == "fp32":
+        return np.asarray(arr, np.float32)
+    if dtype == "bf16":
+        import ml_dtypes
+        return np.asarray(arr, np.float32).astype(
+            ml_dtypes.bfloat16).astype(np.float32)
+    q, s = quantize_rows_np(arr, dtype)
+    return dequantize_rows_np(q, s, dtype)
+
+
+def fake_quant_stochastic_np(arr: np.ndarray, dtype: str,
+                             rng: np.random.RandomState) -> np.ndarray:
+    """Numpy twin of :func:`fake_quant_stochastic` for HOST-resident
+    tables (the touched-rows re-quantize after a host scatter)."""
+    if dtype != "int8":
+        return fake_quant_np(arr, dtype)
+    arr = np.asarray(arr, np.float32)
+    amax = _row_amax_np(arr)
+    scales = _scales_from_amax(amax, _QMAX["int8"])
+    safe = np.where(scales > 0, scales, 1.0)[..., None]
+    u = rng.random_sample(arr.shape).astype(np.float32)
+    q = np.clip(np.floor(arr / safe + u), -127, 127)
+    return q * scales[..., None]
+
+
+# --- npz-portable code encoding ---------------------------------------
+def encode_q(q: np.ndarray, dtype: str) -> np.ndarray:
+    """Codes -> an npz-portable array (fp8 bit patterns as uint8)."""
+    if dtype == "fp8":
+        return np.ascontiguousarray(q).view(np.uint8)
+    return np.ascontiguousarray(q, np.int8)
+
+
+def decode_q(raw: np.ndarray, dtype: str) -> np.ndarray:
+    """Inverse of :func:`encode_q`."""
+    if dtype == "fp8":
+        return np.ascontiguousarray(raw, np.uint8).view(_f8_dtype())
+    return np.ascontiguousarray(raw, np.int8)
+
+
+# --- scale validation (the serving reject-with-reason gate) -----------
+def validate_scales(key: str, scales: np.ndarray,
+                    bound: Optional[float] = None) -> None:
+    """Reject garbage scales BEFORE they are served: every scale must be
+    finite, non-negative, and (when the payload recorded its publish-time
+    bound) at most a whisker above it. A corrupt scale is silent score
+    garbage — amplitudes blow up by the corruption factor with no NaN to
+    trip the anomaly sentinel — so the load path must refuse the payload
+    with a reason, not serve it (FF_FAULT_QUANT_SCALE drills this)."""
+    s = np.asarray(scales)
+    if s.size == 0:
+        return
+    if not np.all(np.isfinite(s)):
+        raise ValueError(
+            f"quantized payload {key!r}: non-finite row scale(s) — "
+            f"corrupt scales would serve garbage rows; payload rejected")
+    if float(s.min()) < 0:
+        raise ValueError(
+            f"quantized payload {key!r}: negative row scale "
+            f"{float(s.min()):g} — symmetric codes never store one; "
+            f"payload rejected")
+    if bound is not None and float(s.max()) > float(bound) * 1.001:
+        raise ValueError(
+            f"quantized payload {key!r}: max row scale "
+            f"{float(s.max()):g} exceeds the publish-time bound "
+            f"{float(bound):g} — scales corrupted after publish; "
+            f"payload rejected")
+
+
+# --- jax half ---------------------------------------------------------
+def fake_quant(x, dtype: str):
+    """jnp quantize-dequantize (nearest), same semantics as
+    :func:`fake_quant_np`. Elementwise + a last-axis reduce — safe under
+    any GSPMD sharding of the leading (row) axes."""
+    import jax.numpy as jnp
+    if dtype == "fp32":
+        return x.astype(jnp.float32)
+    if dtype == "bf16":
+        return x.astype(jnp.bfloat16).astype(jnp.float32)
+    qmax = _QMAX[dtype]
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / qmax, 0.0)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    if dtype == "int8":
+        q = jnp.clip(jnp.round(xf / safe), -127, 127)
+    else:
+        q = jnp.clip(xf / safe, -qmax, qmax).astype(
+            jnp.float8_e4m3fn).astype(jnp.float32)
+    return q * scale
+
+
+def fake_quant_stochastic(x, dtype: str, key):
+    """jnp quantize-dequantize with STOCHASTIC rounding for the integer
+    code (int8): ``floor(x/s + u)``, u ~ U[0,1) — unbiased, so repeated
+    small updates accumulate in expectation instead of rounding away
+    (the classic low-precision-training fix). bf16/fp8 round to nearest
+    (their rounding error is already below the update noise at these
+    widths); fp32 is the identity."""
+    import jax.numpy as jnp
+    if dtype != "int8":
+        return fake_quant(x, dtype)
+    import jax
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / _QMAX["int8"], 0.0)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    u = jax.random.uniform(key, xf.shape, jnp.float32)
+    q = jnp.clip(jnp.floor(xf / safe + u), -127, 127)
+    return q * scale

@@ -1,0 +1,104 @@
+"""Quantized row-block storage for the serving tier.
+
+A :class:`QuantTable` is the in-memory form of one quantized table (or
+one shard's row block of it): the codes at the storage dtype plus one
+fp32 scale per row. It is what an :class:`~..serve.shardtier.
+EmbeddingShard` holds under an int8/fp8 policy (the rows-per-MB win),
+what its lookups ship to the ranker (payload bytes at the storage
+width; the ranker dequantizes), and what the warm cache persists
+(codes + scales round-trip npz bit-exactly).
+
+Writes quantize per row (`set_rows`) — each row's scale is recomputed
+from the incoming fp32 values, independent of its neighbours, so a
+delta publish routed across shards produces the same stored rows on
+every shard that owns them.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from .codec import (decode_q, dequantize_rows_np, encode_q,
+                    quantize_rows_np)
+
+
+class QuantTable:
+    """(rows, dim) quantized storage: ``q`` codes + ``(rows,)`` fp32
+    scales. Not thread-safe — callers hold their own lock (the shard's
+    lock already serializes all block access)."""
+
+    __slots__ = ("q", "scales", "dtype")
+
+    def __init__(self, q: np.ndarray, scales: np.ndarray, dtype: str):
+        self.q = q
+        self.scales = np.ascontiguousarray(scales, np.float32)
+        self.dtype = dtype
+
+    @classmethod
+    def from_dense(cls, arr: np.ndarray, dtype: str) -> "QuantTable":
+        arr = np.asarray(arr, np.float32)
+        q, s = quantize_rows_np(arr.reshape(-1, arr.shape[-1]), dtype)
+        return cls(q, s, dtype)
+
+    # --- geometry / accounting ----------------------------------------
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return tuple(self.q.shape)
+
+    @property
+    def nbytes(self) -> int:
+        """Stored bytes: codes + scales — what ``hbm_bytes``/rows-per-MB
+        report (the fp32 equivalent is 4x the code bytes)."""
+        return int(np.asarray(self.q).view(np.uint8).nbytes
+                   + self.scales.nbytes)
+
+    # --- reads ---------------------------------------------------------
+    def take(self, idx: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """The QUANTIZED row payload for ``idx`` — what ships to the
+        ranker: (codes, scales)."""
+        idx = np.asarray(idx, np.int64)
+        return self.q[idx], self.scales[idx]
+
+    def dense_rows(self, idx: np.ndarray) -> np.ndarray:
+        q, s = self.take(idx)
+        return dequantize_rows_np(q, s, self.dtype)
+
+    def to_dense(self) -> np.ndarray:
+        return dequantize_rows_np(self.q, self.scales, self.dtype)
+
+    # --- writes --------------------------------------------------------
+    def set_rows(self, idx: np.ndarray, vals: np.ndarray) -> None:
+        """Quantize-and-store fp32 rows at ``idx`` (a delta publish's
+        slice). Per-row scales — neighbours are untouched."""
+        idx = np.asarray(idx, np.int64)
+        q, s = quantize_rows_np(np.asarray(vals, np.float32), self.dtype)
+        self.q[idx] = q
+        self.scales[idx] = s
+
+    def set_all(self, arr: np.ndarray) -> None:
+        q, s = quantize_rows_np(
+            np.asarray(arr, np.float32).reshape(-1, arr.shape[-1]),
+            self.dtype)
+        self.q = q
+        self.scales = s
+
+    def copy(self) -> "QuantTable":
+        return QuantTable(self.q.copy(), self.scales.copy(), self.dtype)
+
+    # --- npz round trip (warm cache) -----------------------------------
+    def encoded(self) -> np.ndarray:
+        """npz-portable codes (fp8 bit patterns as uint8)."""
+        return encode_q(self.q, self.dtype)
+
+    @classmethod
+    def from_encoded(cls, raw: np.ndarray, scales: np.ndarray,
+                     dtype: str) -> "QuantTable":
+        return cls(decode_q(raw, dtype), scales, dtype)
+
+
+def dequantize_payload(q_rows, scales, dtype: str) -> np.ndarray:
+    """The RANKER-boundary dequant: turn a shipped (codes, scales)
+    lookup payload back into fp32 rows."""
+    return dequantize_rows_np(q_rows, scales, dtype)

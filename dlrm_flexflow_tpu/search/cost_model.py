@@ -346,9 +346,12 @@ class CostModel:
             return (self.spec.hbm_random_fixed_s
                     + rows * self.spec.host_random_row_s)
         # dense fallback (momentum/Adam without sparse state): stream the
-        # FULL table read+write+state through host DDR
-        full_bytes = sum(math.prod(d.shape) * 4.0
-                         for d in op.param_defs().values())
+        # FULL table read+write+state through host DDR, at each param's
+        # DECLARED dtype (a bf16 table streams half the fp32 bytes —
+        # hardcoding 4 B over-billed it)
+        full_bytes = sum(
+            math.prod(d.shape) * jnp.dtype(d.dtype).itemsize
+            for d in op.param_defs().values())
         return full_bytes * 3.0 / self.spec.host_bytes_per_s
 
     def dedup_overhead_time(self, op, ndev: int) -> float:

@@ -110,7 +110,11 @@ def _project_op(op, pc: ParallelConfig, axis_sizes,
         exchange=(getattr(pc, "exchange", "dense") if pd_new > 1
                   else "dense"),
         hot_fraction=(getattr(pc, "hot_fraction", 0.0) if pd_new > 1
-                      else 0.0))
+                      else 0.0),
+        # the quantized-storage policy is layout-independent — it
+        # survives ANY clamp (the stored rows just reshard)
+        quant_dtype=getattr(pc, "quant_dtype", ""),
+        quant_update=getattr(pc, "quant_update", ""))
     hazard: Optional[Tuple[str, bool]] = None
     if pd_old > 1 and new_pc.param_degree == 1:
         table_bytes = float(op.param_bytes()) if op.param_defs() else 0.0

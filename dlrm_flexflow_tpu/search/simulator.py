@@ -73,17 +73,26 @@ def hbm_footprint_report(model, cost: CostModel, strategies: StrategyMap,
         parts = max(pc.num_parts, 1)
         total = cost.tensor_bytes(op.outputs[0]) / parts
         if op.param_defs() and not cost._host_resident(op, pc):
-            param_bytes = sum(math.prod(shape) * 4.0 for shape in
-                              op.param_shard_shapes(pc, ndev).values())
-            # momentum/Adam keep param-shaped state slabs (lazy sparse
-            # state is table-shaped too); a dense-updated param also
-            # materializes a param-shaped fp32 gradient before its
+            shapes = op.param_shard_shapes(pc, ndev)
+            # stored params at their EFFECTIVE storage bytes: embedding
+            # tables under an int8/fp8 policy hold quantized rows + one
+            # fp32 scale per row (quant/policy.py — the ~4x HBM lever);
+            # the master_weight fp32 master lives host-side beside the
+            # optimizer state, not in HBM. Non-table params price at
+            # their declared dtype (bf16 tables stop being billed 4 B)
+            from ..quant.policy import param_storage_bytes
+            param_bytes = param_storage_bytes(op, pc, shapes)
+            # momentum/Adam keep param-shaped fp32 state slabs (lazy
+            # sparse state is table-shaped too); a dense-updated param
+            # also materializes a param-shaped fp32 gradient before its
             # update, while a touched-rows update's gradient is
             # negligible next to the table
+            fp32_bytes = sum(math.prod(shape) * 4.0
+                             for shape in shapes.values())
             dense_grad = (op.param_bytes_touched_per_step(parts)
                           >= op.param_bytes())
-            total += param_bytes * (1.0 + nslabs + (1.0 if dense_grad
-                                                    else 0.0))
+            total += param_bytes + fp32_bytes * (nslabs + (1.0 if
+                                                 dense_grad else 0.0))
         report[op.name] = total
     return report
 
@@ -513,10 +522,13 @@ class Simulator:
                 if (pd != getattr(pc, "param_degree", 1)
                         or exch != getattr(pc, "exchange", "dense")
                         or frac != getattr(pc, "hot_fraction", 0.0)):
-                    pc = ParallelConfig(pc.degrees, pc.device_type,
-                                        pc.device_ids, pc.memory_types,
-                                        param_degree=pd, exchange=exch,
-                                        hot_fraction=frac)
+                    pc = ParallelConfig(
+                        pc.degrees, pc.device_type,
+                        pc.device_ids, pc.memory_types,
+                        param_degree=pd, exchange=exch,
+                        hot_fraction=frac,
+                        quant_dtype=getattr(pc, "quant_dtype", ""),
+                        quant_update=getattr(pc, "quant_update", ""))
                 out[name] = pc
                 continue
             shape = op.outputs[0].shape
@@ -532,10 +544,13 @@ class Simulator:
                 if d != degs[i]:
                     changed = True
                 degs[i] = max(d, 1)
-            out[name] = (ParallelConfig(tuple(degs), pc.device_type,
-                                        pc.device_ids, pc.memory_types,
-                                        param_degree=pd, exchange=exch,
-                                        hot_fraction=frac)
+            out[name] = (ParallelConfig(
+                             tuple(degs), pc.device_type,
+                             pc.device_ids, pc.memory_types,
+                             param_degree=pd, exchange=exch,
+                             hot_fraction=frac,
+                             quant_dtype=getattr(pc, "quant_dtype", ""),
+                             quant_update=getattr(pc, "quant_update", ""))
                          if changed else pc)
         return out
 

@@ -44,18 +44,50 @@ class EmbeddingCache:
     ``_host_lock`` at the call site, same as training's gather.
     """
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int,
+                 quant: "dict[str, str] | None" = None):
         if capacity < 1:
             raise ValueError(f"cache capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
-        # key -> (value, dependency host-table rows | None)
-        self._d: "OrderedDict[tuple, Tuple[np.ndarray, object]]" = \
+        # key -> (value, dependency host-table rows | None); under a
+        # quantized policy the value is (codes, scales, dtype) — ~4x
+        # more cached rows per MB, dequantized on every hit
+        self._d: "OrderedDict[tuple, Tuple[object, object]]" = \
             OrderedDict()
+        # op name -> storage dtype ("int8"/"fp8", quant/): cached values
+        # for those ops store quantized. insert() CANONICALIZES the miss
+        # values it returns through the same codec, so a hit and the
+        # miss that filled it return the SAME dequantized rows —
+        # hit == miss stays structural, not approximate.
+        self.quant = dict(quant or {})
         self._lock = make_lock("EmbeddingCache._lock", no_dispatch=True)
         self.hits = 0
         self.misses = 0
         self.invalidations = 0
         self.row_invalidations = 0
+
+    @staticmethod
+    def _thaw(stored):
+        """Stored value -> fp32 rows (dequantize when quantized)."""
+        if isinstance(stored, tuple):
+            from ..quant.codec import dequantize_rows_np
+            q, s, dt = stored
+            return dequantize_rows_np(q, s, dt)
+        return stored
+
+    def stored_bytes(self) -> int:
+        """Approximate bytes the cached values occupy — the rows-per-MB
+        accounting the quant bench reports."""
+        with self._lock:
+            total = 0
+            for stored, _deps in self._d.values():
+                if isinstance(stored, tuple):
+                    q, s, _dt = stored
+                    total += (np.asarray(q).view(np.uint8).nbytes
+                              + np.asarray(s).nbytes)
+                else:
+                    total += np.asarray(stored).nbytes
+            return total
 
     def probe(self, op, idx_np: np.ndarray):
         """The read half of :meth:`lookup`: per-sample cache probe over
@@ -77,20 +109,33 @@ class EmbeddingCache:
                     miss.append(i)
                 else:
                     self._d.move_to_end(key)
-                    vals[i] = hit[0]
+                    vals[i] = self._thaw(hit[0])
             self.hits += rows - len(miss)
             self.misses += len(miss)
         return vals, miss
 
     def insert(self, op, idx_np: np.ndarray, miss, sub: np.ndarray,
-               ok=None) -> None:
+               ok=None) -> np.ndarray:
         """The write half of :meth:`lookup`: insert the miss samples'
         freshly-looked-up values. ``ok`` (optional bool per miss
         position) masks out samples that must NOT be cached — the shard
         tier passes False for samples assembled from DEGRADED default
         rows, so a shard outage never poisons the cache with
-        placeholder embeddings that would outlive the outage."""
+        placeholder embeddings that would outlive the outage.
+
+        Returns the CANONICAL miss values callers must hand out: under
+        a quantized policy (``quant[op.name]``) the cached value is
+        codes + scales, so the returned values are the quantize-
+        dequantize image — a later hit returns the same rows bitwise
+        (hit == miss is the pinned contract)."""
         sub = np.asarray(sub)
+        dt = self.quant.get(op.name)
+        if dt:
+            from ..quant.codec import (dequantize_rows_np,
+                                       quantize_rows_np)
+            q_all, s_all = quantize_rows_np(
+                np.asarray(sub, np.float32), dt)
+            sub = dequantize_rows_np(q_all, s_all, dt)
         # which host-table rows each missed sample's bag gathered —
         # recorded so a delta reload can invalidate ONLY the samples
         # a dirtied row feeds (None = unknown -> conservative drop)
@@ -103,23 +148,29 @@ class EmbeddingCache:
             for j, i in enumerate(miss):
                 if ok is not None and not ok[j]:
                     continue
-                v = np.ascontiguousarray(sub[j])
+                if dt:
+                    stored = (np.ascontiguousarray(q_all[j]),
+                              np.ascontiguousarray(s_all[j]), dt)
+                else:
+                    stored = np.ascontiguousarray(sub[j])
                 key = (op.name, idx_np[i].tobytes())
-                self._d[key] = (v, deps.get(i))
+                self._d[key] = (stored, deps.get(i))
                 self._d.move_to_end(key)
             while len(self._d) > self.capacity:
                 self._d.popitem(last=False)
+        return sub
 
     def lookup(self, op, table_params, idx_np: np.ndarray) -> np.ndarray:
         """Per-sample-cached equivalent of
         ``op.host_lookup(table_params, idx_np)``: hit samples come from
         the cache, miss samples go through ONE sub-batch host_lookup and
-        are inserted."""
+        are inserted (canonicalized under a quantized policy, so hits
+        and misses return the same rows)."""
         vals, miss = self.probe(op, idx_np)
         if miss:
             sub = np.asarray(
                 op.host_lookup(table_params, idx_np[np.asarray(miss)]))
-            self.insert(op, idx_np, miss, sub)
+            sub = self.insert(op, idx_np, miss, sub)
             for j, i in enumerate(miss):
                 vals[i] = np.ascontiguousarray(sub[j])
         return np.stack(vals, axis=0)

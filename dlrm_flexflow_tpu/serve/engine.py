@@ -235,7 +235,15 @@ class InferenceEngine:
         self._cache: Optional[EmbeddingCache] = None
         if (self.config.cache_rows > 0
                 and getattr(model, "_host_resident_list", None)):
-            self._cache = EmbeddingCache(self.config.cache_rows)
+            # under a quantized storage policy the cache stores
+            # codes + row scales (~4x more hot rows per MB) and
+            # dequantizes at the ranker boundary on every hit
+            quant = {name: pol.dtype for name, pol in
+                     (getattr(model, "quant_policies", dict)()
+                      or {}).items()
+                     if getattr(pol, "is_quantized", False)}
+            self._cache = EmbeddingCache(self.config.cache_rows,
+                                         quant=quant)
         self._checkpoint_dir = checkpoint_dir
         # persistent compile cache (utils/warmcache.py): when the model
         # config enables one, bucket warmup deserializes stored AOT
@@ -631,8 +639,11 @@ class InferenceEngine:
                         g3.shape)
                     sample_deg = dm.reshape(dm.shape[0], -1).any(axis=1)
                     if cache is not None:
-                        cache.insert(op, entry["idx"], miss, sub,
-                                     ok=~sample_deg)
+                        # insert returns the CANONICAL values (the
+                        # quantize-dequantize image under a quantized
+                        # policy) so a later hit equals this miss
+                        sub = cache.insert(op, entry["idx"], miss, sub,
+                                           ok=~sample_deg)
                     for j, i in enumerate(miss):
                         vals[i] = np.ascontiguousarray(sub[j])
                     row_degraded[np.asarray(miss)[sample_deg]] = True

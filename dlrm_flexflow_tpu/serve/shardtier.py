@@ -177,11 +177,17 @@ class EmbeddingShard:
                  blocks: Dict[str, np.ndarray],
                  ranges: Dict[str, Tuple[int, int]],
                  version: int = 0, chain_crc: int = 0,
-                 domain: str = ""):
+                 domain: str = "", quant: Optional[Dict[str, str]] = None):
         self.sid = int(sid)
         self.slot = int(slot)
         self.domain = domain
-        self._blocks = blocks
+        # quantized storage policy (quant/): ops listed here hold their
+        # block as a QuantTable (codes + row scales, ~4x rows per MB)
+        # and their lookups SHIP the quantized payload — the ranker
+        # dequantizes (EmbeddingShardSet.fetch)
+        self.quant = dict(quant or {})
+        self._blocks = {k: self._wrap_block(k, v)
+                        for k, v in blocks.items()}
         self._ranges = {k: (int(lo), int(hi))
                         for k, (lo, hi) in ranges.items()}
         self._lock = make_lock(f"EmbeddingShard._lock[{sid}]",
@@ -194,6 +200,17 @@ class EmbeddingShard:
         self.apply_rejects = 0
         self.last_reject = ""
 
+    def _wrap_block(self, op_name: str, arr):
+        """fp32 array -> QuantTable under the op's policy (arrays
+        already quantized — a warm-cache boot — pass through)."""
+        from ..quant.store import QuantTable
+        if isinstance(arr, QuantTable):
+            return arr
+        dt = self.quant.get(op_name)
+        if dt:
+            return QuantTable.from_dense(np.asarray(arr, np.float32), dt)
+        return np.ascontiguousarray(arr, np.float32)
+
     @property
     def version(self) -> int:
         return self._version
@@ -203,6 +220,7 @@ class EmbeddingShard:
         return self._chain_crc
 
     def hbm_bytes(self) -> int:
+        # QuantTable.nbytes counts codes + scales — the stored bytes
         return int(sum(b.nbytes for b in self._blocks.values()))
 
     def owned_range(self, op_name: str) -> Tuple[int, int]:
@@ -220,6 +238,7 @@ class EmbeddingShard:
         faults.maybe_lookup_delay(self.sid)
         if faults.take_shard_down(self.sid):
             raise ShardDown(self.sid, "fault injection")
+        from ..quant.store import QuantTable
         out = {}
         served = 0
         with self._lock:
@@ -232,7 +251,14 @@ class EmbeddingShard:
                         f"shard {self.sid} (slot {self.slot}) asked for "
                         f"rows outside its [{lo}, {hi}) range of "
                         f"{op_name!r}")
-                out[op_name] = self._blocks[op_name][g - lo]
+                blk = self._blocks[op_name]
+                if isinstance(blk, QuantTable):
+                    # the WIRE payload is quantized — codes + scales +
+                    # dtype; the ranker boundary dequantizes
+                    q, s = blk.take(g - lo)
+                    out[op_name] = (q, s, blk.dtype)
+                else:
+                    out[op_name] = blk[g - lo]
                 served += int(g.size)
             self.lookups += 1
             self.rows_served += served
@@ -265,6 +291,7 @@ class EmbeddingShard:
         with self._lock:
             if int(version) <= self._version:
                 return False
+            from ..quant.store import QuantTable
             if sub is not None:
                 for key, (idx, vals) in sub.get("rows", {}).items():
                     op_name = key.split("/")[1]
@@ -278,19 +305,29 @@ class EmbeddingShard:
                             f"this shard's [{lo}, {hi}) range of "
                             f"{op_name!r}")
                         raise ChainError(self.last_reject)
-                    self._blocks[op_name][g - lo] = vals
+                    block = self._blocks[op_name]
+                    if isinstance(block, QuantTable):
+                        # re-quantize per row — the codec is
+                        # idempotent, so rows published from quantized
+                        # storage land bit-identically
+                        block.set_rows(g - lo, vals)
+                    else:
+                        block[g - lo] = vals
                 for key, arr in sub.get("full", {}).items():
                     op_name = key.split("/")[1]
                     lo, hi = self._ranges[op_name]
                     block = self._blocks[op_name]
-                    if arr.shape != block.shape:
+                    if tuple(arr.shape) != tuple(block.shape):
                         self.apply_rejects += 1
                         self.last_reject = (
                             f"publish {version} full slice for "
                             f"{op_name!r} has shape {arr.shape}, shard "
                             f"block is {block.shape}")
                         raise ChainError(self.last_reject)
-                    block[...] = arr
+                    if isinstance(block, QuantTable):
+                        block.set_all(arr)
+                    else:
+                        block[...] = arr
             self._chain_crc = shard_chain_crc(self._chain_crc,
                                               int(version), slice_crc)
             self._version = int(version)
@@ -309,7 +346,7 @@ class EmbeddingShard:
                 if k not in self._ranges:
                     raise ValueError(f"shard {self.sid} owns no range "
                                      f"of {k!r}")
-            self._blocks = {k: np.ascontiguousarray(v, np.float32)
+            self._blocks = {k: self._wrap_block(k, v)
                             for k, v in blocks.items()}
             self._version = int(version)
             self._chain_crc = int(chain_crc) & 0xFFFFFFFF
@@ -317,7 +354,8 @@ class EmbeddingShard:
 
     def blocks_copy(self) -> Tuple[Dict[str, np.ndarray], int, int]:
         """(blocks copy, version, chain crc) — one consistent snapshot
-        for the warm cache."""
+        for the warm cache (QuantTable blocks stay quantized: the cache
+        persists codes + scales bit-exactly)."""
         with self._lock:
             return ({k: v.copy() for k, v in self._blocks.items()},
                     self._version, self._chain_crc)
@@ -398,6 +436,11 @@ class EmbeddingShardSet:
         self._defaults = defaults            # op -> (tables, d) mean rows
         self._bounds = bounds                # op -> per-table [lo, hi)
         self._dims = dims                    # op -> row width
+        # op -> quantized storage dtype (set by build(); replacements
+        # re-wrap their warm-cache blocks under the same policy)
+        self._quant: Dict[str, str] = {
+            k: v for r in shards
+            for k, v in getattr(r.shard, "quant", {}).items()}
         self.fingerprint = fingerprint
         self._cache = cache                  # utils.warmcache.ShardCache
         self._set_lock = make_lock("EmbeddingShardSet._set_lock")
@@ -452,10 +495,20 @@ class EmbeddingShardSet:
         dims: Dict[str, int] = {}
         slot_blocks: List[Dict[str, np.ndarray]] = \
             [dict() for _ in range(nshards)]
+        # quantized storage policies: the shard tier stores the
+        # QUANTIZED representation (codes + row scales) of policy ops —
+        # the rows-per-MB lever; defaults/means come from the same
+        # dequantized image every lookup serves
+        qmap = {name: pol.dtype for name, pol in
+                (getattr(model, "quant_policies", dict)() or {}).items()
+                if getattr(pol, "is_quantized", False)}
+        from ..quant.codec import fake_quant_np
         for op in host_ops:
             kern = model.host_params[op.name]["kernel"]
             flat = np.ascontiguousarray(
                 kern.reshape(-1, kern.shape[-1]), np.float32)
+            if op.name in qmap:
+                flat = fake_quant_np(flat, qmap[op.name])
             R = int(flat.shape[0])
             ranges = shard_row_ranges(R, nshards)
             ranges_by_op[op.name] = ranges
@@ -485,10 +538,11 @@ class EmbeddingShardSet:
             shard = EmbeddingShard(
                 slot, slot, slot_blocks[slot],
                 {name: ranges_by_op[name][slot] for name in ranges_by_op},
-                version=version, domain=domain)
+                version=version, domain=domain, quant=qmap)
             shards.append(ShardReplica(shard))
         out = cls(shards, config, ranges_by_op, flat_rows, defaults,
                   bounds, dims, fingerprint=fingerprint, cache=cache)
+        out._quant = qmap
         out._persist_all()
         log_shard.info(
             "shard set built: %d shard(s) x %d table op(s), "
@@ -644,7 +698,14 @@ class EmbeddingShardSet:
                 resp, ver = got
                 versions[slot] = ver
                 for op_name, (pos, _ids) in reqs.items():
-                    rows[op_name][pos] = resp[op_name]
+                    val = resp[op_name]
+                    if isinstance(val, tuple):
+                        # THE ranker-boundary dequant: the shard
+                        # shipped codes + row scales (the quantized
+                        # wire payload, ~1/4 the fp32 bytes)
+                        from ..quant.store import dequantize_payload
+                        val = dequantize_payload(*val)
+                    rows[op_name][pos] = val
             else:
                 # graceful degradation: per-table default rows, flagged
                 degraded = True
@@ -921,7 +982,8 @@ class EmbeddingShardSet:
         shard = EmbeddingShard(
             sid, slot, blocks,
             {name: self._ranges[name][slot] for name in self._ranges},
-            version=ver, chain_crc=chain_crc, domain=domain)
+            version=ver, chain_crc=chain_crc, domain=domain,
+            quant=self._quant)
         with self._apply_lock:
             # replay what the cached blocks missed; the slice CRCs
             # re-validate each replayed publish
@@ -1081,6 +1143,17 @@ def serving_footprint(model, replicas: int, nshards: int = 0,
         except Exception:   # noqa: BLE001 — param-less ops
             continue
         if op.name in host_ops or hasattr(op, "host_lookup"):
+            # tables at their effective STORED bytes: the shard tier
+            # (and a replicated fleet's serving snapshot) holds the
+            # quantized representation — int8 rows + fp32 row scales —
+            # not the trainer's fp32 master (quant/policy.py)
+            from ..quant.policy import param_storage_bytes
+            try:
+                shapes = {n: d.shape
+                          for n, d in op.param_defs().items()}
+                pb = float(param_storage_bytes(op, None, shapes))
+            except Exception:   # noqa: BLE001 — keep the fp32 estimate
+                pass
             tables += pb
         else:
             dense += pb

@@ -138,6 +138,16 @@ def mesh_meta(model) -> Dict[str, Any]:
             if getattr(pc, "exchange", "dense") != "dense"}
     if exch:
         meta["exchanges"] = exch
+    # quantized-storage policies RESOLVED at compile (strategy override
+    # OR --emb-dtype default), only where non-default — what shardcheck
+    # FLX508 compares a strategy file against: a snapshot written under
+    # int8 policy served by an fp32-planned deployment (or vice versa)
+    # is a silent 4x byte-accounting lie
+    quant = {name: {"dtype": pol.dtype, "update_rule": pol.update_rule}
+             for name, pol in (getattr(model, "_quant_policies", {})
+                               or {}).items()}
+    if quant:
+        meta["quant"] = quant
     return meta
 
 

@@ -230,12 +230,22 @@ def _diff_flat(prev: Dict[str, np.ndarray], cur: Dict[str, np.ndarray],
 # delta file round trip
 # ---------------------------------------------------------------------
 def write_delta_file(path: str, step: int, prev_step: int, base_step: int,
-                     rows, full) -> int:
+                     rows, full,
+                     quant: Optional[Dict[str, str]] = None) -> int:
     """Atomically write one delta npz; returns its CRC-32. The
     publish-abort injection fires inside the atomic writer (before the
     rename — exactly the mid-publish crash window), the torn-delta
     injection truncates AFTER the rename (bit rot on a published
-    file)."""
+    file).
+
+    ``quant`` maps flat keys to a quantized storage dtype (quant/): row
+    payloads for those keys ship as codes + per-row fp32 scales
+    (``rows/`` at 1 B/elem, ``scl/`` beside it, ``qdt/`` the dtype,
+    ``sbd/`` the publish-time max-scale bound the loader validates
+    against) — the ~4x delta-publish-bytes lever. Unlisted keys keep the
+    legacy fp32 layout, so unquantized models write byte-identical
+    files."""
+    from ..quant.codec import encode_q, quantize_rows_np
     flat: Dict[str, np.ndarray] = {
         "meta/step": np.asarray(step, np.int64),
         "meta/prev_step": np.asarray(prev_step, np.int64),
@@ -243,7 +253,16 @@ def write_delta_file(path: str, step: int, prev_step: int, base_step: int,
     }
     for key, (idx, vals) in rows.items():
         flat[f"idx/{key}"] = idx
-        flat[f"rows/{key}"] = vals
+        dt = (quant or {}).get(key)
+        if dt:
+            q, scales = quantize_rows_np(vals, dt)
+            flat[f"rows/{key}"] = encode_q(q, dt)
+            flat[f"scl/{key}"] = scales
+            flat[f"qdt/{key}"] = np.asarray(dt)
+            flat[f"sbd/{key}"] = np.asarray(
+                float(scales.max()) if scales.size else 0.0, np.float32)
+        else:
+            flat[f"rows/{key}"] = vals
     for key, v in full.items():
         flat[f"full/{key}"] = v
     faults.maybe_abort_publish(path)
@@ -255,20 +274,48 @@ def write_delta_file(path: str, step: int, prev_step: int, base_step: int,
 
 def load_delta_file(path: str) -> Dict[str, Any]:
     """Read a delta npz into an apply_delta payload (host arrays; the
-    caller device_puts the row payloads outside any dispatch lock)."""
+    caller device_puts the row payloads outside any dispatch lock).
+
+    Quantized row payloads are VALIDATED (scales finite, non-negative,
+    within the publish-time bound — a garbage scale is a
+    reject-with-reason :class:`ChainError`, and the watcher degrades to
+    the newest valid full snapshot instead of serving amplified rows)
+    then dequantized into ``rows`` for the fp32 appliers; the raw
+    codes + scales stay available under ``qrows`` for consumers that
+    store quantized (the shard tier, bit-exact round-trip tests)."""
+    from ..quant.codec import (decode_q, dequantize_rows_np,
+                               validate_scales)
     data = np.load(path)
     rows: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    qrows: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray, str]] = {}
     full: Dict[str, np.ndarray] = {}
     for k in data.files:
         if k.startswith("idx/"):
             key = k[len("idx/"):]
-            rows[key] = (data[k], data[f"rows/{key}"])
+            vals = data[f"rows/{key}"]
+            if f"scl/{key}" in data.files:
+                dt = str(data[f"qdt/{key}"])
+                scales = faults.maybe_corrupt_quant_scale(
+                    key, data[f"scl/{key}"])
+                bound = float(data[f"sbd/{key}"]) \
+                    if f"sbd/{key}" in data.files else None
+                try:
+                    validate_scales(key, scales, bound)
+                except ValueError as e:
+                    raise ChainError(str(e)) from None
+                q = decode_q(vals, dt)
+                qrows[key] = (data[k], q, scales, dt)
+                vals = dequantize_rows_np(q, scales, dt)
+            rows[key] = (data[k], vals)
         elif k.startswith("full/"):
             full[k[len("full/"):]] = data[k]
-    return {"step": int(data["meta/step"]),
-            "prev_step": int(data["meta/prev_step"]),
-            "base_step": int(data["meta/base_step"]),
-            "rows": rows, "full": full}
+    out = {"step": int(data["meta/step"]),
+           "prev_step": int(data["meta/prev_step"]),
+           "base_step": int(data["meta/base_step"]),
+           "rows": rows, "full": full}
+    if qrows:
+        out["qrows"] = qrows
+    return out
 
 
 def stage_delta_rows(model, payload: Dict[str, Any]) -> Dict[str, Any]:
@@ -500,6 +547,19 @@ class DeltaPublisher:
         self.max_chain = int(max_chain)
         self.row_delta_min_elems = int(row_delta_min_elems)
         self.tracker = TouchedRowTracker(model)
+        # quantized-storage policies (quant/): flat keys whose row
+        # payloads publish as codes + row scales instead of fp32 — the
+        # ~4x delta-bytes lever; empty for unquantized models (legacy
+        # file layout, byte-identical)
+        self._quant_keys: Dict[str, str] = {}
+        for op_name, pol in (getattr(model, "quant_policies", dict)()
+                             or {}).items():
+            if getattr(pol, "is_quantized", False):
+                for pname in ("kernel", "hot_kernel"):
+                    self._quant_keys[f"params/{op_name}/{pname}"] = \
+                        pol.dtype
+                    self._quant_keys[f"hostparams/{op_name}/{pname}"] = \
+                        pol.dtype
         # candidates are trustworthy only if the tracker saw every batch
         # trained after this point (fit_stream observes at staging time)
         self._track_origin = int(getattr(model, "_step", 0) or 0)
@@ -648,7 +708,8 @@ class DeltaPublisher:
             fname = f"delta-{step:08d}.npz"
             path = os.path.join(self.mgr.directory, fname)
             crc = write_delta_file(path, step, self._last_step,
-                                   self._base_step, rows, full)
+                                   self._base_step, rows, full,
+                                   quant=self._quant_keys)
         except (IOError, OSError) as e:
             # non-fatal: the atomic writer left no torn file and the
             # manifest never saw an entry; the cumulative tracker still

@@ -107,6 +107,9 @@ subprocess kill-test needs):
                                    snapshot after its rename (torn chain)
 - ``FF_FAULT_PUBLISH_ABORT=2``     abort the next 2 delta publishes
                                    before the rename (mid-publish crash)
+- ``FF_FAULT_QUANT_SCALE=emb:1e3`` corrupt op ``emb``'s quantized-row
+  scales by 1e3 on the next load/reload (the serving path must
+  reject-with-reason, never serve the amplified rows)
 - ``FF_FAULT_DELTA_GAP=1``         drop the next 1 delta's manifest
                                    entry (chain gap the watcher must
                                    reject)
@@ -219,6 +222,11 @@ class FaultPlan:
     # next delta still chains to the unlisted step, so the watcher sees
     # a chain GAP and must degrade to a full reload)
     delta_gaps: int = 0
+    # op name -> scale factor: corrupt ONE table's quantized-row scales
+    # on the next load/reload touching that op (consume-once per op) —
+    # the serving path must reject-with-reason, never serve garbage
+    # amplitudes (quant/codec.validate_scales is the gate)
+    quant_scale: Dict[str, float] = field(default_factory=dict)
     # record of (hook, detail) actually fired, for test assertions
     fired: List[tuple] = field(default_factory=list)
 
@@ -244,7 +252,7 @@ _KNOWN_ENV_KEYS = ("FF_FAULT_NAN_STEPS", "FF_FAULT_TRUNCATE_CKPTS",
                    "FF_FAULT_POISON_RELOAD", "FF_FAULT_DELTA_TORN",
                    "FF_FAULT_PUBLISH_ABORT", "FF_FAULT_DELTA_GAP",
                    "FF_FAULT_CACHE_CORRUPT", "FF_FAULT_SHARD_DOWN",
-                   "FF_FAULT_LOOKUP_DELAY")
+                   "FF_FAULT_LOOKUP_DELAY", "FF_FAULT_QUANT_SCALE")
 
 
 # --- strict env parsing ----------------------------------------------
@@ -333,11 +341,12 @@ def plan_from_env() -> Optional[FaultPlan]:
     delta_gap = os.environ.get("FF_FAULT_DELTA_GAP", "")
     shard_down = os.environ.get("FF_FAULT_SHARD_DOWN", "")
     lookup_delay = os.environ.get("FF_FAULT_LOOKUP_DELAY", "")
+    quant_scale = os.environ.get("FF_FAULT_QUANT_SCALE", "")
     if not any((nan, trunc, aborts, delay, ioerrs, drop, ret,
                 cache_corrupt, stall_coll,
                 serve_delay, corrupt_reload, replica_down,
                 poison_reload, delta_torn, publish_abort, delta_gap,
-                shard_down, lookup_delay)):
+                shard_down, lookup_delay, quant_scale)):
         return None
     plan = FaultPlan()
     if nan:
@@ -402,6 +411,21 @@ def plan_from_env() -> Optional[FaultPlan]:
             plan.lookup_delay_s = secs
         else:                                 # "sid:secs" — one shard
             plan.lookup_delay_shard[sid] = secs
+    for part in quant_scale.split(","):
+        # 'op:factor' — op names are strings, so this cannot reuse
+        # _env_pairs' int heads; strict all the same (missing ':' or a
+        # non-numeric factor names the variable)
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError(
+                f"FF_FAULT_QUANT_SCALE={quant_scale!r}: item {part!r} "
+                f"is missing its ':' (expected 'op:factor', e.g. "
+                f"emb_stack:1e3)")
+        op_name, factor = part.rsplit(":", 1)
+        plan.quant_scale[op_name.strip()] = _env_float(
+            "FF_FAULT_QUANT_SCALE", factor)
     if corrupt_reload:
         plan.corrupt_reloads = _env_int("FF_FAULT_CORRUPT_RELOAD",
                                         corrupt_reload)
@@ -655,6 +679,31 @@ def maybe_lookup_delay(shard_id: Optional[int] = None) -> None:
         secs = plan.lookup_delay_shard.get(shard_id, secs)
     if secs > 0:
         time.sleep(secs)
+
+
+def maybe_corrupt_quant_scale(key: str, scales):
+    """Corrupt a quantized payload's row scales at load/reload time
+    (``FF_FAULT_QUANT_SCALE=op:factor``): the key is matched by op name
+    (any flat key mentioning the op fires), the budget is consume-once
+    per op. The caller's validation (quant/codec.validate_scales) must
+    reject the payload with a reason — a corrupted scale serves rows
+    amplified by `factor` with no NaN to trip any sentinel, the
+    quantized analog of the poison-reload drill."""
+    plan = active()
+    if plan is None or not plan.quant_scale:
+        return scales
+    with plan._lock:
+        hit = None
+        for op_name, factor in plan.quant_scale.items():
+            if op_name and op_name in key:
+                hit = (op_name, factor)
+                break
+        if hit is None:
+            return scales
+        del plan.quant_scale[hit[0]]
+        plan._record("quant_scale", f"{key}:{hit[1]:g}")
+    import numpy as np
+    return np.asarray(scales, np.float32) * np.float32(hit[1])
 
 
 def maybe_poison_reload(state: dict) -> dict:

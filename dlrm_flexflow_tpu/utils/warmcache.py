@@ -443,8 +443,23 @@ class ShardCache:
         os.replace, the checkpoint discipline). Best-effort: a failed
         put costs the next replacement a cold rebuild, nothing else."""
         import numpy as np
-        flat = {f"block/{k}": np.ascontiguousarray(v)
-                for k, v in blocks.items()}
+
+        from ..quant.store import QuantTable
+        flat = {}
+        for k, v in blocks.items():
+            if isinstance(v, QuantTable):
+                # quantized blocks persist as codes + row scales +
+                # dtype — bit-exact round trip at ~1/4 the fp32 bytes;
+                # the max-scale bound lets get() reject in-memory
+                # scale corruption the file CRC cannot see
+                flat[f"block/{k}"] = v.encoded()
+                flat[f"scale/{k}"] = v.scales
+                flat[f"qdt/{k}"] = np.asarray(v.dtype)
+                flat[f"sbd/{k}"] = np.asarray(
+                    float(v.scales.max()) if v.scales.size else 0.0,
+                    np.float32)
+            else:
+                flat[f"block/{k}"] = np.ascontiguousarray(v)
         flat["meta/version"] = np.asarray(version, np.int64)
         flat["meta/chain_crc"] = np.asarray(chain_crc & 0xFFFFFFFF,
                                             np.int64)
@@ -513,8 +528,27 @@ class ShardCache:
                     f"geometry mismatch: entry is shard "
                     f"{int(data['meta/slot'])}/{int(data['meta/nshards'])}"
                     f", wanted {slot}/{nshards}")
-            blocks = {k[len("block/"):]: np.array(data[k])
-                      for k in files if k.startswith("block/")}
+            from ..quant.codec import validate_scales
+            from ..quant.store import QuantTable
+            blocks = {}
+            for k in files:
+                if not k.startswith("block/"):
+                    continue
+                op = k[len("block/"):]
+                if f"scale/{op}" in files:
+                    dt = str(data[f"qdt/{op}"])
+                    scales = faults.maybe_corrupt_quant_scale(
+                        op, np.array(data[f"scale/{op}"]))
+                    # a corrupt scale must reject the ENTRY (cold
+                    # rebuild), never boot a shard serving amplified
+                    # rows (FF_FAULT_QUANT_SCALE drills this)
+                    bound = float(data[f"sbd/{op}"]) \
+                        if f"sbd/{op}" in files else None
+                    validate_scales(op, scales, bound)
+                    blocks[op] = QuantTable.from_encoded(
+                        np.array(data[k]), scales, dt)
+                else:
+                    blocks[op] = np.array(data[k])
             version = int(data["meta/version"])
             chain_crc = int(data["meta/chain_crc"])
         except Exception as e:   # noqa: BLE001 — torn npz, bad meta
