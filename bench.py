@@ -305,6 +305,20 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             quant = {"error": str(exc)[:200]}
 
+    # opt-in observability-overhead smoke (BENCH_OBS=1): train steps/s
+    # and serve p99 with --obs off vs on (bar: <= 2% on both) plus the
+    # trace-export size/latency for a 200-step run
+    obs = None
+    if os.environ.get("BENCH_OBS"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_obs import measure as _obs_measure
+            obs = _obs_measure(
+                steps=int(os.environ.get("BENCH_OBS_STEPS", "200")))
+        except Exception as exc:
+            obs = {"error": str(exc)[:200]}
+
     vs = 1.0
     base_file = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE")
     if os.path.exists(base_file):
@@ -344,6 +358,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         out["freshness"] = freshness
     if quant is not None:
         out["quant"] = quant
+    if obs is not None:
+        out["obs"] = obs
     print(json.dumps(out))
     return 0
 

@@ -38,6 +38,12 @@ RULES = {
                "health, watcher) is joined without a stop Event being "
                "set on any close path — the join waits out a full "
                "sleep interval, or forever on a non-waiting loop"),
+    "FLX109": ("unbounded-sample-list", "medium",
+               "latency/size samples appended to a self.* list with no "
+               "bound or rotation anywhere in the class: a long-lived "
+               "server grows it forever — use a bounded window "
+               "(obs.metrics.Reservoir / deque(maxlen=...)) or rotate "
+               "(del x[:-N])"),
     # --- lock discipline ----------------------------------------------
     "FLX201": ("racy-attribute", "medium",
                "attribute written both inside and outside `with <lock>` "

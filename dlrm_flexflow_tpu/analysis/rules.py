@@ -280,6 +280,96 @@ def check_policy_loops(idx: PackageIndex,
 
 
 # ---------------------------------------------------------------------
+# FLX109 — unbounded latency/size sample lists
+# ---------------------------------------------------------------------
+# attribute names that smell like a measurement window: latency/size
+# samples a long-lived server appends per request/step. Deliberately
+# narrow — a work queue or a pending-install list is someone's bounded-
+# by-protocol state, not a sample window.
+SAMPLE_ATTR_RE = re.compile(
+    r"(^|_)(lat|lats|latency|latencies|sample|samples|ms|bytes|sizes|"
+    r"times|durations|p99|p50)($|_)")
+
+# constructors that ARE the bound: the obs reservoir and any
+# deque(maxlen=...)-shaped ring
+_BOUNDED_CTORS = {"Reservoir", "latency_reservoir"}
+
+
+def _self_attr_of(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> "X" (None for anything else)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def check_sample_lists(idx: PackageIndex,
+                       findings: List[Finding]) -> None:
+    """FLX109: ``self.X.append(sample)`` where X smells like a
+    latency/size window and NOTHING in the class bounds it — no
+    ``deque(maxlen=...)``/``Reservoir`` construction, no ``del
+    self.X[:-N]`` / ``self.X = self.X[-N:]`` rotation, no
+    ``pop``/``popleft``/``clear`` drain. A serving process appending
+    per-request samples to a plain list leaks until OOM; the fix is the
+    bounded ``obs.metrics.Reservoir`` every stats() window now uses."""
+    for file, tree in idx.modules.items():
+        for cnode in ast.walk(tree):
+            if not isinstance(cnode, ast.ClassDef):
+                continue
+            appends: Dict[str, int] = {}
+            bounded: Set[str] = set()
+            for node in ast.walk(cnode):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute):
+                    attr = _self_attr_of(node.func.value)
+                    if attr is None:
+                        continue
+                    if node.func.attr == "append":
+                        appends.setdefault(attr, node.lineno)
+                    elif node.func.attr in ("pop", "popleft", "clear"):
+                        bounded.add(attr)
+                elif isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        attr = _self_attr_of(tgt)
+                        if attr is None:
+                            continue
+                        v = node.value
+                        if isinstance(v, ast.Call):
+                            leaf = dotted(v.func).rsplit(".", 1)[-1]
+                            if leaf in _BOUNDED_CTORS:
+                                bounded.add(attr)
+                            elif leaf == "deque" and any(
+                                    k.arg == "maxlen"
+                                    for k in v.keywords):
+                                bounded.add(attr)
+                        elif (isinstance(v, ast.Subscript)
+                              and _self_attr_of(v.value) == attr
+                              and isinstance(v.slice, ast.Slice)):
+                            bounded.add(attr)   # self.X = self.X[-N:]
+                elif isinstance(node, ast.Delete):
+                    for tgt in node.targets:
+                        if (isinstance(tgt, ast.Subscript)
+                                and isinstance(tgt.slice, ast.Slice)):
+                            attr = _self_attr_of(tgt.value)
+                            if attr is not None:
+                                bounded.add(attr)   # del self.X[:-N]
+            for attr, line in sorted(appends.items()):
+                if not SAMPLE_ATTR_RE.search(attr.lower()):
+                    continue
+                if attr in bounded:
+                    continue
+                findings.append(make_finding(
+                    "FLX109", file, line,
+                    f"self.{attr} collects samples via append() with no "
+                    f"bound or rotation in {cnode.name}: a long-lived "
+                    f"process grows it without limit — use obs.metrics."
+                    f"Reservoir / deque(maxlen=...) or rotate with "
+                    f"del self.{attr}[:-N]",
+                    scope=cnode.name, token=attr))
+
+
+# ---------------------------------------------------------------------
 # FLX201 — attribute written both inside and outside lock scopes
 # ---------------------------------------------------------------------
 _INIT_METHODS = {"__init__", "__post_init__", "__new__"}
@@ -811,6 +901,7 @@ def check_env_parsing(idx: PackageIndex,
                     scope=fn.name, token=ast.unparse(arg)[:40]))
 
 
-ALL_PASSES = (check_threads, check_policy_loops, check_racy_attributes,
-              check_locks, check_manifest_atomicity, check_jax_hazards,
+ALL_PASSES = (check_threads, check_policy_loops, check_sample_lists,
+              check_racy_attributes, check_locks,
+              check_manifest_atomicity, check_jax_hazards,
               check_env_parsing)

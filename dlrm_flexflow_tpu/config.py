@@ -287,6 +287,22 @@ class FFConfig:
     # are counted (FFModel.eval_exec_cache_stats / engine stats()). Set
     # with --eval-exec-cache N.
     eval_exec_cache: int = 32
+    # ---- unified observability (dlrm_flexflow_tpu/obs/) ---------------
+    # "on" enables the process-wide metrics registry (scrapeable at
+    # GET /metrics in serve_dlrm.py), structured span tracing, and the
+    # fit()/fit_stream() drift monitor. "off" (default) keeps every
+    # instrument a no-op singleton — the hot paths pay nothing (type
+    # identity pinned, like FF_SANITIZE=0's plain locks). Set with
+    # --obs {off,on}.
+    obs: str = "off"
+    # directory to export the Chrome-trace/Perfetto JSON span ring into
+    # at the end of fit()/fit_stream() (and on serve_dlrm shutdown);
+    # "" = keep the ring in memory only. Set with --obs-trace-dir DIR.
+    obs_trace_dir: str = ""
+    # drift-monitor alarm threshold: a sustained measured/predicted
+    # step-time (or collective-bytes) ratio above this emits the loud
+    # structured drift warning. Set with --obs-drift-threshold R.
+    obs_drift_threshold: float = 1.5
     unparsed: List[str] = field(default_factory=list)
 
     @property
@@ -501,6 +517,19 @@ class FFConfig:
                 cfg.serve_degrade = v
             elif a == "--eval-exec-cache":
                 cfg.eval_exec_cache = int(take())
+            elif a == "--obs":
+                v = take()
+                if v not in ("off", "on"):
+                    raise ValueError(f"--obs expects off|on, got {v!r}")
+                cfg.obs = v
+            elif a == "--obs-trace-dir":
+                cfg.obs_trace_dir = take()
+            elif a == "--obs-drift-threshold":
+                cfg.obs_drift_threshold = float(take())
+                if cfg.obs_drift_threshold <= 0:
+                    raise ValueError(
+                        f"--obs-drift-threshold expects R > 0, got "
+                        f"{cfg.obs_drift_threshold}")
             elif a == "--stage-dataset":
                 v = take()
                 if v not in ("auto", "always", "never"):

@@ -37,6 +37,7 @@ from ..parallel.mesh import make_mesh
 from ..parallel.pconfig import ParallelConfig, StrategyMap
 from ..parallel.sharding import AxisAssigner
 from ..parallel.distributed import MeshDegraded, MeshReturned, put_global
+from ..obs import trace as obstrace
 from ..utils.profiling import superstep_annotation
 from ..utils.watchdog import StallReport, WorkerStalled
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -89,6 +90,17 @@ class AnomalyError(RuntimeError):
         self.step = step
         self.loss = loss
         self.grad_norm = grad_norm
+        # anomaly-sentinel fires land in the obs layer at the one choke
+        # point every policy passes through (trace instant + counter,
+        # no-op when --obs off) — visible even if the recovery path
+        # that catches this never reports it
+        from ..obs import metrics as _obsm
+        from ..obs import trace as _obstrace
+        _obsm.counter("ff_anomalies_total",
+                      "non-finite training steps the sentinel caught"
+                      ).inc()
+        _obstrace.instant("anomaly", cat="sentinel", step=int(step),
+                          loss=repr(loss), grad_norm=repr(grad_norm))
 
 
 class StagedStep(NamedTuple):
@@ -1762,8 +1774,10 @@ class FFModel:
         if exec_ is None:
             exec_ = execs[key] = self._cached_compile(
                 "superstep", key, lambda: self._superstep_fn.lower(*args))
-        with superstep_annotation(self._step, k,
-                                  enabled=bool(self.config.profile_dir)):
+        with obstrace.span("train/superstep", step=self._step, k=k), \
+                superstep_annotation(self._step, k,
+                                     enabled=bool(
+                                         self.config.profile_dir)):
             try:
                 outs = exec_(*args)
             except ValueError as e:
@@ -1841,19 +1855,21 @@ class FFModel:
         if exec_ is None:
             exec_ = execs[key] = self._cached_compile(
                 "train", key, lambda: self._train_step.lower(*args))
-        try:
-            outs = exec_(*args)
-        except ValueError as e:
-            # GSPMD may give step outputs different shardings than the
-            # initial inputs; one recompile against the propagated
-            # shardings reaches the fixed point (the sharding check runs
-            # before execution, so donated buffers are still intact)
-            if not _sharding_mismatch(e):
-                raise
-            exec_ = execs[key] = self._cached_compile(
-                "train", key, lambda: self._train_step.lower(*args),
-                fresh=True)
-            outs = exec_(*args)
+        with obstrace.span("train/step", step=self._step):
+            try:
+                outs = exec_(*args)
+            except ValueError as e:
+                # GSPMD may give step outputs different shardings than
+                # the initial inputs; one recompile against the
+                # propagated shardings reaches the fixed point (the
+                # sharding check runs before execution, so donated
+                # buffers are still intact)
+                if not _sharding_mismatch(e):
+                    raise
+                exec_ = execs[key] = self._cached_compile(
+                    "train", key, lambda: self._train_step.lower(*args),
+                    fresh=True)
+                outs = exec_(*args)
         (self.params, self.opt_state, self.op_state, self._msums,
          self._step_dev, mets) = outs
         self._step += 1
@@ -2784,6 +2800,19 @@ class FFModel:
             _stage_all()
 
         from ..utils.profiling import TraceContext
+        # --- unified observability (dlrm_flexflow_tpu/obs/) -----------
+        # --obs on: process-wide metrics + span tracing + the drift
+        # monitor comparing measured step time (and lowered collective
+        # bytes, once) against the simulator's predictions — the
+        # runtime twin of shardcheck FLX513. Off (default): drift_mon
+        # stays None and the loop pays one pointer compare per step.
+        from ..obs import configure as _obs_configure
+        from ..obs import trace as _obstrace
+        drift_mon = None
+        if _obs_configure(self.config):
+            from ..obs.drift import DriftMonitor
+            drift_mon = DriftMonitor.from_model(self, name="fit")
+            drift_mon.audit_collectives()
         # bound in-flight async steps: XLA CPU's in-process collectives can
         # starve when many multi-device executions queue up on few host
         # cores (on TPU the device is the bottleneck; a deep pipeline is
@@ -2954,6 +2983,8 @@ class FFModel:
                                          and b + k_super <= num_batches)
                              else 1)
                         cur, step0 = (epoch, b), self._step
+                        _t_drift = (time.perf_counter()
+                                    if drift_mon is not None else 0.0)
                         if k > 1:
                             if staged is not None:
                                 mets = self.train_superstep_device(
@@ -2993,6 +3024,13 @@ class FFModel:
                             batch["label"] = labels[sl]
                             mets = self.train_batch(batch)
                         num_samples += bs * k
+                        if drift_mon is not None:
+                            # per-step wall clock the dispatch loop
+                            # observed (async pipelining amortized by
+                            # the inflight throttle); a superstep
+                            # spreads its window over its K steps
+                            drift_mon.observe_step(
+                                (time.perf_counter() - _t_drift) / k)
                         _maybe_save(epoch, b + k)
                         b += k
                     if rem_ok:
@@ -3140,10 +3178,14 @@ class FFModel:
             # same report format intent as reference dlrm.cc:197-198
             print(f"ELAPSED TIME = {elapsed:.4f}s, "
                   f"THROUGHPUT = {throughput:.2f} samples/s")
-        return {"elapsed": elapsed, "throughput": throughput,
-                "num_samples": num_samples, "rollbacks": rollbacks,
-                "recoveries": recoveries, "expansions": expansions,
-                "metrics": self.perf.report()}
+        out = {"elapsed": elapsed, "throughput": throughput,
+               "num_samples": num_samples, "rollbacks": rollbacks,
+               "recoveries": recoveries, "expansions": expansions,
+               "metrics": self.perf.report()}
+        if drift_mon is not None:
+            out["drift"] = drift_mon.report()
+            _obstrace.export_to_dir()   # no-op without --obs-trace-dir
+        return out
 
     # ------------------------------------------------------------------
     # skew statistics (utils/histogram.py)
@@ -3231,6 +3273,15 @@ class FFModel:
                 publisher.observe_batch(batch)
             return self._stage_step(batch)
 
+        # --obs on: drift monitor + trace export, same wiring as fit()
+        from ..obs import configure as _obs_configure
+        from ..obs import trace as _obstrace
+        drift_mon = None
+        if _obs_configure(self.config):
+            from ..obs.drift import DriftMonitor
+            drift_mon = DriftMonitor.from_model(self, name="fit_stream")
+            drift_mon.audit_collectives()
+
         depth = max(int(getattr(self.config, "prefetch_depth", 2) or 0),
                     1)
         pipe = PrefetchPipeline(
@@ -3249,10 +3300,15 @@ class FFModel:
                     staged = pipe.get()
                 except IndexError:
                     break
+                _t_drift = (time.perf_counter()
+                            if drift_mon is not None else 0.0)
                 mets = self.train_batch_staged(staged)
                 inflight.append(mets["loss"])
                 if len(inflight) > throttle:
                     jax.block_until_ready(inflight.popleft())
+                if drift_mon is not None:
+                    drift_mon.observe_step(
+                        time.perf_counter() - _t_drift)
                 trained += 1
                 if (publisher is not None and publish_every
                         and trained % publish_every == 0):
@@ -3277,8 +3333,12 @@ class FFModel:
                   f"loss={float(mets['loss']):.6f}, "
                   f"{trained * bs / max(elapsed, 1e-9):.2f} samples/s, "
                   f"{publishes} publish(es)")
-        return {"steps": trained, "elapsed": elapsed,
-                "throughput": trained * bs / max(elapsed, 1e-9),
-                "publishes": publishes,
-                "publisher": (publisher.stats()
-                              if publisher is not None else None)}
+        out = {"steps": trained, "elapsed": elapsed,
+               "throughput": trained * bs / max(elapsed, 1e-9),
+               "publishes": publishes,
+               "publisher": (publisher.stats()
+                             if publisher is not None else None)}
+        if drift_mon is not None:
+            out["drift"] = drift_mon.report()
+            _obstrace.export_to_dir()   # no-op without --obs-trace-dir
+        return out

@@ -50,6 +50,8 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..obs import metrics as obsm
+from ..obs import trace as obstrace
 from ..utils.watchdog import StallReport, WorkerStalled
 
 # global ordinal for thread naming: every staging thread in the process
@@ -125,10 +127,22 @@ class PrefetchPipeline:
         self._produce_s = 0.0
         self._wait_s = 0.0
         self.name = name
+        obsm.register_collector(self._obs_collect)
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"ff-prefetch-{next(_PIPE_SEQ)}")
         self._thread.start()
+
+    def _obs_collect(self):
+        """Registry collector: the ring's staging accounting as
+        scrapeable samples (same numbers stats() reports)."""
+        s = self.stats()
+        lab = {"pipeline": self.name}
+        yield "ff_prefetch_items_total", lab, s["items"]
+        yield "ff_prefetch_produce_seconds_total", lab, s["produce_s"]
+        yield "ff_prefetch_wait_seconds_total", lab, s["wait_s"]
+        yield "ff_prefetch_overlap_fraction", lab, s["overlap_fraction"]
+        yield "ff_prefetch_ring_depth", lab, len(self._buf)
 
     # --- producer side -------------------------------------------------
     def _run(self):
@@ -145,10 +159,15 @@ class PrefetchPipeline:
             t0 = time.perf_counter()
             try:
                 faults.maybe_stall("prefetch")   # simulated wedged stager
-                item = read_with_retries(lambda: self._produce(i),
-                                         self._io_site,
-                                         retries=self._io_retries,
-                                         backoff_s=self._io_backoff_s)
+                # span lands on THIS (ff-prefetch-N) thread: staging
+                # time shows as its own trace lane under the consumer's
+                # train/step spans
+                with obstrace.span("prefetch/produce",
+                                   pipeline=self.name, item=i):
+                    item = read_with_retries(lambda: self._produce(i),
+                                             self._io_site,
+                                             retries=self._io_retries,
+                                             backoff_s=self._io_backoff_s)
             except BaseException as e:
                 with self._cond:
                     self._exc = e
@@ -212,6 +231,7 @@ class PrefetchPipeline:
         join is BOUNDED: a wedged staging thread is abandoned (it is a
         daemon, so interpreter shutdown and test teardown never hang on
         it) rather than waited on forever."""
+        obsm.unregister_collector(self._obs_collect)
         with self._cond:
             self._stopped = True
             self._buf.clear()

@@ -1,0 +1,233 @@
+"""Live predicted-vs-measured drift monitor: the runtime twin of the
+static auditors.
+
+shardcheck's FLX513 compares the cost model's collective-bytes
+prediction against the LOWERED HLO — statically, before a step runs.
+This module closes the remaining gap: during ``fit()``/``fit_stream()``
+it watches the numbers the simulator actually promised —
+
+- **step time**: measured per-dispatch wall time vs the simulator's
+  predicted makespan (``Simulator.simulate`` — the same number the MCMC
+  search ranked strategies by). A plan the search blessed at 2 ms that
+  runs at 20 ms means the cost model is mispricing THIS model on THIS
+  hardware, and every future search on the box inherits the error.
+- **collective bytes**: the lowered executable's per-step collective
+  payloads vs the cost model's pricing (reusing
+  ``analysis.hlo_audit``); the replicated-table plan that FLX513 flags
+  statically (full-table gradient all-reduce the search never charged
+  for) is re-found here at runtime, on the program that is actually
+  executing.
+
+Both drifts land as registry gauges
+(``ff_drift_step_time_ratio{loop=...}``,
+``ff_drift_collective_bytes_ratio{kind=...}``), trace instants, and —
+past ``threshold`` for ``sustain`` consecutive steps — ONE loud
+structured warning per breach episode (debounced with the autoscaler's
+:class:`~..utils.watchdog.Sustained`; a single slow step from a GC
+pause must not cry wolf).
+
+When no prediction is available (no compiled strategies, a config-stub
+model, an off-calibration CPU test mesh) the monitor **calibrates**: the
+median of the first ``calibrate_steps`` measured steps becomes the
+baseline, and drift is measured against the run's own steady state —
+quiet at calibration by construction, loud when the run later slows
+down (a leaking host gather, a throttling chip, an injected
+``FF_FAULT_SERVE_DELAY``).
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Any, Dict, Optional
+
+from ..utils.logging import get_logger
+from ..utils.watchdog import Sustained
+from . import metrics, trace
+
+log_drift = get_logger("obs.drift")
+
+
+class DriftMonitor:
+    """Online measured/predicted comparison for one training loop.
+
+    Not thread-safe by design: one loop owns one monitor (the same
+    contract as ``Sustained``).
+    """
+
+    def __init__(self, predicted_step_s: Optional[float] = None,
+                 threshold: float = 1.5, calibrate_steps: int = 16,
+                 sustain: int = 5, name: str = "fit"):
+        if threshold <= 0:
+            raise ValueError(f"drift threshold must be > 0, "
+                             f"got {threshold}")
+        self.name = name
+        self.threshold = float(threshold)
+        self.calibrate_steps = max(int(calibrate_steps), 1)
+        self.predicted_step_s = (float(predicted_step_s)
+                                 if predicted_step_s else None)
+        # where the baseline came from: "simulator" when a prediction
+        # was handed in, "calibration" once self-measured
+        self.baseline_source = ("simulator" if self.predicted_step_s
+                                else None)
+        self._model = None
+        self._cal: list = []
+        self._sustained = Sustained(max(int(sustain), 1))
+        self._in_breach = False
+        self.steps = 0
+        self.fired = 0
+        self.last_ratio: Optional[float] = None
+        self.max_ratio: Optional[float] = None
+        self.collective_drift: Dict[str, Any] = {}
+        self._g_ratio = metrics.gauge(
+            "ff_drift_step_time_ratio",
+            "measured / predicted step wall time", labelnames=("loop",))
+        self._g_bytes = metrics.gauge(
+            "ff_drift_collective_bytes_ratio",
+            "lowered-HLO / cost-model collective bytes per step",
+            labelnames=("loop", "kind"))
+        self._c_warn = metrics.counter(
+            "ff_drift_warnings_total",
+            "sustained drift breaches (one per episode)",
+            labelnames=("loop", "kind"))
+
+    # --- construction ---------------------------------------------------
+    @classmethod
+    def from_model(cls, model, name: str = "fit",
+                   threshold: Optional[float] = None) -> "DriftMonitor":
+        """Monitor for a compiled model: predicted step time from the
+        simulator when the model carries searched/compiled strategies,
+        self-calibrating otherwise. Never raises — a model the
+        simulator cannot price still gets the calibrated monitor."""
+        thr = (float(threshold) if threshold is not None
+               else float(getattr(model.config, "obs_drift_threshold",
+                                  1.5) or 1.5))
+        pred = None
+        try:
+            strategies = getattr(model, "strategies", None)
+            if strategies:
+                from ..search.simulator import Simulator
+                pred = float(Simulator(model).simulate(dict(strategies)))
+                if pred <= 0 or pred != pred or pred == float("inf"):
+                    pred = None
+        except Exception as e:   # noqa: BLE001 — an unpriceable model
+            log_drift.debug("simulator prediction unavailable (%s); "
+                            "drift monitor will self-calibrate", e)
+        mon = cls(predicted_step_s=pred, threshold=thr, name=name)
+        mon._model = model
+        return mon
+
+    # --- one-shot collective-bytes audit (the FLX513 runtime twin) ------
+    def audit_collectives(self) -> Dict[str, Any]:
+        """Lower the train step and compare its collective bytes against
+        the cost model's pricing, once per attach. Emits the per-kind
+        ratio gauges; measured ≫ predicted (the replicated-plan
+        signature) warns loudly. Returns the audit report ({} when the
+        model cannot lower — e.g. not initialized)."""
+        model = self._model
+        if model is None:
+            return {}
+        try:
+            from ..analysis.hlo_audit import audit_model
+            findings, report = audit_model(model, path=f"<{self.name}>")
+        except Exception as e:   # noqa: BLE001 — obs must never take
+            # the training loop down; no audit beats no training
+            log_drift.debug("collective-bytes audit unavailable (%s)", e)
+            return {}
+        measured = report.get("measured_bytes", {})
+        predicted = report.get("predicted_bytes", {})
+        ratios = {}
+        for kind in ("all-to-all", "all-reduce"):
+            pred = float(predicted.get(kind, 0.0))
+            meas = float(measured.get(kind, 0.0))
+            if pred > 0:
+                ratios[kind] = meas / pred
+                self._g_bytes.set(meas / pred, loop=self.name, kind=kind)
+            elif meas > 0:
+                ratios[kind] = float("inf")
+                self._g_bytes.set(float("inf"), loop=self.name,
+                                  kind=kind)
+        self.collective_drift = {
+            "measured_bytes": measured,
+            "predicted_bytes": predicted,
+            "ratios": {k: (round(v, 4) if v != float("inf") else "inf")
+                       for k, v in ratios.items()},
+            "findings": [f.render() for f in findings
+                         if f.rule == "FLX513"],
+        }
+        for f in findings:
+            if f.rule != "FLX513":
+                continue
+            self.fired += 1
+            self._c_warn.inc(loop=self.name, kind="collective-bytes")
+            trace.instant("drift/collective-bytes", cat="drift",
+                          loop=self.name, message=f.message[:200])
+            log_drift.warning(
+                "DRIFT [%s] collective bytes: %s", self.name, f.message)
+        return self.collective_drift
+
+    # --- per-step step-time drift ---------------------------------------
+    def observe_step(self, wall_s: float) -> Optional[float]:
+        """Feed one measured per-step wall time (a superstep caller
+        divides by K first). Returns the measured/predicted ratio, or
+        None while calibrating."""
+        self.steps += 1
+        pred = self.predicted_step_s
+        if pred is None:
+            self._cal.append(float(wall_s))
+            if len(self._cal) >= self.calibrate_steps:
+                self.predicted_step_s = max(
+                    statistics.median(self._cal), 1e-9)
+                self.baseline_source = "calibration"
+                log_drift.info(
+                    "drift monitor [%s] calibrated: baseline step time "
+                    "%.3f ms over %d steps", self.name,
+                    1e3 * self.predicted_step_s, len(self._cal))
+            return None
+        ratio = float(wall_s) / pred
+        self.last_ratio = ratio
+        self.max_ratio = (ratio if self.max_ratio is None
+                          else max(self.max_ratio, ratio))
+        self._g_ratio.set(ratio, loop=self.name)
+        breach = ratio > self.threshold
+        if self._sustained.observe(breach):
+            if not self._in_breach:
+                # one loud report per episode, not one per step
+                self._in_breach = True
+                self.fired += 1
+                self._c_warn.inc(loop=self.name, kind="step-time")
+                trace.instant("drift/step-time", cat="drift",
+                              loop=self.name, ratio=round(ratio, 3),
+                              measured_ms=round(1e3 * wall_s, 3),
+                              predicted_ms=round(
+                                  1e3 * pred, 3),
+                              baseline=self.baseline_source)
+                log_drift.warning(
+                    "DRIFT [%s] step time: measured %.3f ms is %.2fx "
+                    "the %s baseline %.3f ms (> %.2gx for %d "
+                    "consecutive steps) — the %s is mispricing this "
+                    "run", self.name, 1e3 * wall_s, ratio,
+                    self.baseline_source, 1e3 * pred, self.threshold,
+                    self._sustained.periods,
+                    "cost model" if self.baseline_source == "simulator"
+                    else "calibrated steady state")
+        elif not breach:
+            self._in_breach = False
+        return ratio
+
+    def report(self) -> Dict[str, Any]:
+        return {
+            "loop": self.name,
+            "steps": self.steps,
+            "threshold": self.threshold,
+            "baseline_source": self.baseline_source,
+            "predicted_step_ms": (None if self.predicted_step_s is None
+                                  else round(1e3 * self.predicted_step_s,
+                                             4)),
+            "last_ratio": (None if self.last_ratio is None
+                           else round(self.last_ratio, 4)),
+            "max_ratio": (None if self.max_ratio is None
+                          else round(self.max_ratio, 4)),
+            "fired": self.fired,
+            "in_breach": self._in_breach,
+            "collective_drift": self.collective_drift,
+        }

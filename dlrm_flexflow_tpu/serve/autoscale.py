@@ -39,6 +39,8 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 from ..analysis.sanitizer import make_lock
+from ..obs import metrics as obsm
+from ..obs import trace as obstrace
 from ..utils.logging import get_logger
 from ..utils.watchdog import Sustained
 
@@ -158,6 +160,15 @@ class Autoscaler:
             self._decisions.append({"t": time.time(), "action": action,
                                     "reason": reason, "detail": detail})
             del self._decisions[:-64]
+        # labeled decision counter + trace instant: chaos benches (and
+        # a scraper) assert on WHICH actions fired, not just how many
+        obsm.counter(
+            "ff_autoscaler_decisions_total",
+            "scaling decisions by action (grow/shrink/replace/"
+            "shard-replace/shard-readmit)",
+            labelnames=("action",)).inc(action=action)
+        obstrace.instant(f"autoscaler/{action}", cat="autoscale",
+                         reason=reason[:200])
         log_scale.warning("autoscaler %s (%s)", action, reason)
 
     def _cooldown_ok(self) -> bool:

@@ -56,35 +56,20 @@ import numpy as np
 
 from ..analysis.sanitizer import make_lock
 from ..data.dataloader import coalesce_batches
+from ..obs import metrics as obsm
+from ..obs import trace as obstrace
+# THE percentile of the codebase now lives with the other window math
+# in obs.metrics (same semantics: linear interpolation, None on an
+# empty window — never a flawless p99 for a server that answered
+# nothing); re-exported here because the fleet/router/benches have
+# always imported it from serve.engine
+from ..obs.metrics import percentile  # noqa: F401 — re-export
 from ..utils import faults
 from ..utils.logging import get_logger
 from ..utils.watchdog import Deadline, Heartbeat, WorkerStalled
 from .cache import EmbeddingCache
 
 log_serve = get_logger("serve")
-
-
-def percentile(sorted_vals, p: float) -> Optional[float]:
-    """Linear-interpolated percentile over an ASCENDING sequence
-    (numpy's default method), ``None`` on an empty window.
-
-    The previous nearest-index pick (``int(round(p/100*(n-1)))``) was
-    degenerate on tiny windows: an empty window reported 0.0 ms — a
-    flawless p99 for a server that has answered nothing, which reads as
-    healthy to an SLO monitor — and Python's banker's rounding put the
-    p50 of a 2-sample window on the lower sample instead of between
-    them. Shared by the engine's stats() and the fleet router's
-    cohort/SLO comparisons, which must agree on what "p99" means.
-    """
-    n = len(sorted_vals)
-    if n == 0:
-        return None
-    if n == 1:
-        return float(sorted_vals[0])
-    k = (p / 100.0) * (n - 1)
-    f = int(k)
-    c = min(f + 1, n - 1)
-    return float(sorted_vals[f] + (k - f) * (sorted_vals[c] - sorted_vals[f]))
 
 
 class Overloaded(RuntimeError):
@@ -288,7 +273,13 @@ class InferenceEngine:
         # appends — iterating a deque mid-append raises)
         self._stats_lock = make_lock(
             f"InferenceEngine._stats_lock[{replica_id}]")
-        self._lat_ms: "deque[float]" = deque(maxlen=4096)
+        # bounded latency window (obs Reservoir): same deque-shaped API
+        # the fleet merges over, but the window doubles as a scrapeable
+        # registry histogram when --obs on
+        self._lat_ms = obsm.latency_reservoir(
+            "ff_serve_request_latency_ms",
+            "end-to-end request latency at the engine", maxlen=4096,
+            replica="" if replica_id is None else str(replica_id))
         self._n_requests = 0
         self._n_responses = 0
         self._n_overloaded = 0
@@ -333,6 +324,9 @@ class InferenceEngine:
         self._thread = threading.Thread(target=self._batcher, daemon=True,
                                         name=self._thread_name())
         self._thread.start()
+        # registry collector: the stats() counters become scrapeable
+        # time series without double-counting (no-op when --obs off)
+        obsm.register_collector(self._obs_collect)
         if self._checkpoint_dir:
             from .watcher import SnapshotWatcher
             self._watcher = SnapshotWatcher(
@@ -351,6 +345,7 @@ class InferenceEngine:
                 return
             self._closing = True
             self._cond.notify_all()
+        obsm.unregister_collector(self._obs_collect)
         if self._watcher is not None:
             self._watcher.stop()
         t = self._thread
@@ -410,7 +405,7 @@ class InferenceEngine:
                 f"request rows {n} exceed serve max_batch "
                 f"{self.max_batch}; split the request")
         req = _Request(feats, n, self.config.deadline_ms / 1e3)
-        with self._cond:
+        with obstrace.span("serve/enqueue", rows=n), self._cond:
             if self._closing:
                 raise RuntimeError("engine is closed")
             if not self._started:
@@ -438,6 +433,7 @@ class InferenceEngine:
             self._apply_pending_swap()
             take: List[_Request] = []
             flush = "continuous"
+            t_form = time.perf_counter()
             with self._cond:
                 self._heartbeat.beat()
                 while (not self._q and not self._closing
@@ -478,6 +474,11 @@ class InferenceEngine:
                     rows += r.rows
                     take.append(r)
             if take:
+                # the window from waking to a formed batch IS the
+                # coalescing window in continuous mode — a batch-
+                # formation stall shows as a long span here
+                obstrace.complete("serve/batch-form", t_form,
+                                  requests=len(take), flush=flush)
                 with self._stats_lock:
                     self._flushes[flush] += 1
                 try:
@@ -696,9 +697,10 @@ class InferenceEngine:
         self._apply_pending_swap()
         version = self._applied_version
         self._lookup_meta = None
-        out = self._model.forward_bucket(
-            batch, bucket=bucket, host_gather=self._host_gather())
-        scores = np.asarray(out)          # device→host sync
+        with obstrace.span("serve/dispatch", rows=n, bucket=bucket):
+            out = self._model.forward_bucket(
+                batch, bucket=bucket, host_gather=self._host_gather())
+            scores = np.asarray(out)      # device→host sync
         # shard-tier metadata the gather hook stashed for THIS batch:
         # the per-shard version vector and which rows degraded to
         # default embeddings (padding rows beyond n are ignored — a
@@ -816,6 +818,7 @@ class InferenceEngine:
         with self._swap_lock:
             pending, self._pending = self._pending, []
         for kind, state, version, source, applied in pending:
+            t_swap = time.perf_counter()
             try:
                 if kind == "full":
                     host_params = state.get("host_params")
@@ -859,6 +862,8 @@ class InferenceEngine:
                     self._invalidate_cache_rows(state)
                 self._applied_version = version
                 self._applied_any = True
+                obstrace.complete("serve/swap", t_swap, kind=kind,
+                                  version=version)
                 log_serve.info("hot-%s weights to version %d%s",
                                "reloaded" if kind == "full"
                                else "delta-patched", version,
@@ -1031,6 +1036,30 @@ class InferenceEngine:
         return out
 
     # --- observability -------------------------------------------------
+    def _obs_collect(self):
+        """Registry collector (pull-time): the hot stats() counters as
+        scrapeable samples. The stats dict stays the source of truth —
+        the scrape reads through it, so the two can never disagree."""
+        lab = {"replica": ("" if self.replica_id is None
+                           else str(self.replica_id))}
+        yield "ff_serve_requests_total", lab, self._n_requests
+        yield "ff_serve_responses_total", lab, self._n_responses
+        yield "ff_serve_overloaded_total", lab, self._n_overloaded
+        yield "ff_serve_timeouts_total", lab, self._n_timeouts
+        yield "ff_serve_batches_total", lab, self._n_batches
+        yield "ff_serve_queue_depth", lab, len(self._q)
+        yield "ff_serve_reloads_total", lab, self._reloads
+        yield "ff_serve_delta_reloads_total", lab, self._delta_reloads
+        yield "ff_serve_reload_rejects_total", lab, self._reload_rejects
+        yield "ff_serve_version", lab, self._version
+        if self._shard_set is not None:
+            yield "ff_serve_degraded_responses_total", lab, \
+                self._n_degraded
+        if self._cache is not None:
+            cs = self._cache.stats()
+            yield "ff_serve_cache_hits_total", lab, cs.get("hits", 0)
+            yield "ff_serve_cache_misses_total", lab, cs.get("misses", 0)
+
     def stats(self) -> Dict[str, Any]:
         with self._stats_lock:
             lat = sorted(self._lat_ms)

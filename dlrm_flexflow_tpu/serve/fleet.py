@@ -450,8 +450,10 @@ class Fleet:
         per = {r.rid: r.stats() for r in self.replicas}
         lat: List[float] = []
         for r in self.replicas:
-            with r.engine._stats_lock:
-                lat.extend(r.engine._lat_ms)
+            # the engine windows are obs Reservoirs (self-locking;
+            # samples() snapshots) — merge the samples, THEN cut the
+            # percentile: percentiles do not average
+            lat.extend(r.engine._lat_ms.samples())
         lat.sort()
         totals = {k: sum(p["engine"][k] for p in per.values())
                   for k in ("requests", "responses", "overloaded",

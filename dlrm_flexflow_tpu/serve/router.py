@@ -47,7 +47,6 @@ from __future__ import annotations
 import heapq
 import threading
 import time
-from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
@@ -55,6 +54,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..analysis.sanitizer import make_lock
+from ..obs import metrics as obsm
+from ..obs import trace as obstrace
 from ..utils import faults
 from ..utils.checkpoint import load_params_for_swap
 from ..utils.logging import get_logger
@@ -168,10 +169,16 @@ class _Cohort:
     their score means then would blame the deploy for a skew the
     publish caused."""
 
-    def __init__(self, maxlen: int):
+    def __init__(self, maxlen: int, name: str = ""):
         self._lock = make_lock("_Cohort._lock")
         self.maxlen = maxlen
-        self.lat_ms: "deque[float]" = deque(maxlen=maxlen)
+        self.name = name
+        # bounded obs reservoir (scrapeable as
+        # ff_router_cohort_latency_ms{cohort=...} when --obs on)
+        self.lat_ms = obsm.latency_reservoir(
+            "ff_router_cohort_latency_ms",
+            "client-observed latency per deployment cohort",
+            maxlen=maxlen, cohort=name)
         self.score_sum = 0.0
         self.score_n = 0
         self.versions: Optional[Dict[int, int]] = None
@@ -179,7 +186,7 @@ class _Cohort:
 
     def reset(self) -> None:
         with self._lock:
-            self.lat_ms = deque(maxlen=self.maxlen)
+            self.lat_ms.clear()
             self.score_sum = 0.0
             self.score_n = 0
             self.versions = None
@@ -256,15 +263,21 @@ class FleetRouter:
         self._rr_counter = 0
         # metrics (one lock: counters + windows; callbacks are cheap)
         self._m_lock = make_lock("FleetRouter._m_lock")
-        self._lat_ms: "deque[float]" = deque(maxlen=self.config.window)
+        # client-observed latency (includes retries/hedges — the number
+        # an SLO is written against); the obs reservoir doubles as the
+        # ff_router_client_latency_ms scrape when --obs on
+        self._lat_ms = obsm.latency_reservoir(
+            "ff_router_client_latency_ms",
+            "client-observed latency incl. retries and hedges",
+            maxlen=self.config.window)
         self._n_requests = 0
         self._n_responses = 0
         self._n_failed = 0
         self._n_retries = 0
         self._n_hedges = 0
         self._n_hedge_wins = 0
-        self._cohorts = {"stable": _Cohort(self.config.window),
-                         "canary": _Cohort(self.config.window)}
+        self._cohorts = {"stable": _Cohort(self.config.window, "stable"),
+                         "canary": _Cohort(self.config.window, "canary")}
         # deployment state (its own lock: install/rollback swap params
         # replica-by-replica and must not interleave). no_dispatch: the
         # deploy verbs stage snapshot loads + device_puts OUTSIDE it and
@@ -291,6 +304,7 @@ class FleetRouter:
             return self
         self._started = True
         self.fleet.start()
+        obsm.register_collector(self._obs_collect)
         self._timer.start()
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True,
@@ -302,6 +316,7 @@ class FleetRouter:
         if self._closed:
             return
         self._closed = True
+        obsm.unregister_collector(self._obs_collect)
         self._health_stop.set()
         t = self._health_thread
         if t is not None:
@@ -610,6 +625,8 @@ class FleetRouter:
             self._canary_active = False
             self._rollbacks += 1
             self._last_rollback_reason = reason
+            obstrace.instant("router/canary-rollback", cat="deploy",
+                             reason=reason[:200])
             log_router.warning("canary rolled back: %s", reason)
 
     def promote_canary(self) -> None:
@@ -802,6 +819,24 @@ class FleetRouter:
                     f"{cfg.canary_score_tol:g}")
 
     # --- observability -------------------------------------------------
+    def _obs_collect(self):
+        """Registry collector: router totals + fleet shape as scrapeable
+        samples (reads the same counters stats() reports)."""
+        yield "ff_router_requests_total", {}, self._n_requests
+        yield "ff_router_responses_total", {}, self._n_responses
+        yield "ff_router_failed_total", {}, self._n_failed
+        yield "ff_router_retries_total", {}, self._n_retries
+        yield "ff_router_hedges_total", {}, self._n_hedges
+        yield "ff_router_hedge_wins_total", {}, self._n_hedge_wins
+        yield "ff_router_canary_rollbacks_total", {}, self._rollbacks
+        yield "ff_router_canary_promotions_total", {}, self._promotions
+        yield "ff_fleet_size", {}, len(self.fleet)
+        yield "ff_fleet_healthy", {}, len(self.fleet.healthy())
+        for rep in self.fleet.replicas:
+            yield ("ff_fleet_replica_healthy",
+                   {"replica": str(rep.rid)},
+                   1.0 if rep.state == HEALTHY else 0.0)
+
     def healthz(self) -> Dict[str, Any]:
         """Fleet readiness: ok while at least one healthy replica can
         accept a request and the router is not draining. ``degraded``

@@ -66,6 +66,8 @@ from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from ..analysis.sanitizer import make_lock
+from ..obs import metrics as obsm
+from ..obs import trace as obstrace
 from ..parallel.alltoall import row_owners, shard_row_ranges
 from ..utils import faults
 from ..utils.delta import (ChainError, shard_chain_crc, shard_slice_crc,
@@ -458,6 +460,14 @@ class EmbeddingShardSet:
         self._closed = False
         # counters (stats lock — fetch runs on every batcher thread)
         self._m_lock = make_lock("EmbeddingShardSet._m_lock")
+        # bounded fetch-latency window (obs Reservoir; scrapeable as
+        # ff_shard_fetch_latency_ms when --obs on) — the lookup tier's
+        # own p99, distinct from the ranker's end-to-end number
+        self._fetch_ms = obsm.latency_reservoir(
+            "ff_shard_fetch_latency_ms",
+            "one batched lookup round across the owning shards",
+            maxlen=2048)
+        obsm.register_collector(self._obs_collect)
         self._fetches = 0
         self._degraded_fetches = 0
         self._defaults_used = 0
@@ -572,6 +582,7 @@ class EmbeddingShardSet:
     # --- lifecycle -----------------------------------------------------
     def close(self) -> None:
         self._closed = True
+        obsm.unregister_collector(self._obs_collect)
         # wait=False: an abandoned (injected-delay) lookup must not
         # wedge close; the worker threads exit when their task returns
         self._pool.shutdown(wait=False)
@@ -627,6 +638,7 @@ class EmbeddingShardSet:
         iteration order. ``plan`` maps op name -> 1-D unique flat
         ids."""
         cfg = self.config
+        t_fetch = time.perf_counter()
         if deadline_s is None:
             deadline_s = cfg.lookup_deadline_ms / 1e3
         degrade = degrade or cfg.degrade
@@ -718,6 +730,7 @@ class EmbeddingShardSet:
             if degraded:
                 self._degraded_fetches += 1
                 self._defaults_used += defaults_used
+        self._fetch_ms.observe(1e3 * (time.perf_counter() - t_fetch))
         return FetchResult(rows, mask, versions, degraded, defaults_used)
 
     def _lookup_inline(self, rep: ShardReplica, reqs, dl: Deadline):
@@ -813,7 +826,8 @@ class EmbeddingShardSet:
         touch get the version bump + chain link only. Idempotent per
         shard (every ranker's watcher calls this for the same publish).
         Returns how many shards applied row work."""
-        with self._apply_lock:
+        with obstrace.span("publish/shard-apply", version=int(version)), \
+                self._apply_lock:
             if int(version) <= self._version and self._installed_any:
                 # fast path: the whole set already has this publish
                 # (another ranker routed it) UNLESS a replacement lags
@@ -1086,6 +1100,22 @@ class EmbeddingShardSet:
     def version_vector(self) -> Dict[int, int]:
         return {r.slot: r.shard.version for r in self.shards}
 
+    def _obs_collect(self):
+        """Registry collector: lookup-tier counters + per-shard health
+        as scrapeable samples (same numbers stats() reports)."""
+        yield "ff_shard_fetches_total", {}, self._fetches
+        yield "ff_shard_degraded_fetches_total", {}, \
+            self._degraded_fetches
+        yield "ff_shard_defaults_used_total", {}, self._defaults_used
+        yield "ff_shard_retries_total", {}, self._retries
+        yield "ff_shard_timeouts_total", {}, self._timeouts
+        yield "ff_shard_failed_fetches_total", {}, self._failed_fetches
+        yield "ff_shard_replacements_total", {}, self.replacements
+        yield "ff_shard_version_floor", {}, (self.min_version() or 0)
+        for r in self.shards:
+            yield ("ff_shard_healthy", {"slot": str(r.slot)},
+                   1.0 if r.state == HEALTHY else 0.0)
+
     def stats(self) -> Dict[str, Any]:
         with self._m_lock:
             out = {
@@ -1094,6 +1124,8 @@ class EmbeddingShardSet:
                 "versions": self.version_vector(),
                 "states": {r.slot: r.state for r in self.shards},
                 "degraded_now": self.degraded_now(),
+                "fetch_p50_ms": self._fetch_ms.percentile(50),
+                "fetch_p99_ms": self._fetch_ms.percentile(99),
                 "fetches": self._fetches,
                 "degraded_fetches": self._degraded_fetches,
                 "defaults_used": self._defaults_used,

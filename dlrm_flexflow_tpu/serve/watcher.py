@@ -42,9 +42,12 @@ import json
 import os
 import random
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from ..data.dataloader import read_with_retries
+from ..obs import metrics as obsm
+from ..obs import trace as obstrace
 from ..utils import faults
 from ..utils.checkpoint import (_file_crc32, config_fingerprint,
                                 load_params_for_swap)
@@ -105,12 +108,30 @@ class SnapshotWatcher:
     def start(self) -> "SnapshotWatcher":
         if self._thread is not None:
             return self
+        obsm.register_collector(self._obs_collect)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ff-serve-watcher")
         self._thread.start()
         return self
 
+    def _obs_collect(self):
+        """Registry collector: the freshness loop's health as
+        scrapeable samples — a watcher that silently stopped reloading
+        shows up as a flat ff_watcher_polls_total."""
+        rid = getattr(self._engine, "replica_id", None)
+        lab = {"replica": "" if rid is None else str(rid)}
+        yield "ff_watcher_polls_total", lab, self._polls
+        yield "ff_watcher_reload_failures_total", lab, \
+            self._reload_failures
+        yield "ff_watcher_delta_installs_total", lab, \
+            self._delta_installs
+        yield "ff_watcher_chain_fallbacks_total", lab, \
+            self._chain_fallbacks
+        yield "ff_watcher_consecutive_failures", lab, \
+            self._consecutive_failures
+
     def stop(self) -> None:
+        obsm.unregister_collector(self._obs_collect)
         self._stop.set()
         t = self._thread
         if t is not None:
@@ -274,6 +295,7 @@ class SnapshotWatcher:
             return False
         if not pending:
             return False
+        t_apply = time.perf_counter()
         try:
             # slow half on THIS thread, outside any dispatch lock: file
             # reads, validation, and the row payloads' device_put
@@ -302,8 +324,13 @@ class SnapshotWatcher:
                                            int(e.get("step", -1)),
                                            source=e["file"])
             self._delta_installs += len(pending)
+            obstrace.complete("publish/watcher-apply", t_apply,
+                              kind="delta", installs=len(pending),
+                              tip=tip_step)
         except Exception as e:   # noqa: BLE001
             self._chain_fallbacks += 1
+            obstrace.instant("publish/chain-fallback",
+                             reason=str(e)[:200])
             self._reject_once(
                 key, f"delta chain failed to load/apply: {e} — falling "
                      f"back to full reload")
@@ -334,6 +361,7 @@ class SnapshotWatcher:
         # above and BEFORE the load below (a torn replace, bit rot) —
         # the injection truncates it right here and the load must reject
         faults.maybe_corrupt_reload(path)
+        t_apply = time.perf_counter()
         try:
             # slow part (read + validate + device_put) outside the
             # engine's dispatch lock: serving continues on old weights.
@@ -354,6 +382,8 @@ class SnapshotWatcher:
         # score-divergence rollback exists to catch
         state = faults.maybe_poison_reload(state)
         self._engine.install_snapshot(state, step, source=entry["file"])
+        obstrace.complete("publish/watcher-apply", t_apply, kind="full",
+                          step=step)
         return True
 
     def stats(self) -> Dict[str, Any]:

@@ -47,6 +47,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from . import faults
+from ..obs import metrics as obsm
+from ..obs import trace as obstrace
 from .checkpoint import (CheckpointManager, _file_crc32, _model_flat,
                          _write_npz_atomic, config_fingerprint, mesh_meta)
 from .logging import get_logger
@@ -625,6 +627,11 @@ class DeltaPublisher:
     def publish_full(self, loader_state: Optional[Dict[str, Any]] = None
                      ) -> Dict[str, Any]:
         """Blocking full checkpoint; becomes the new chain base."""
+        with obstrace.span("publish/full", step=int(self.model._step)):
+            return self._publish_full(loader_state)
+
+    def _publish_full(self, loader_state: Optional[Dict[str, Any]]
+                      ) -> Dict[str, Any]:
         self.mgr.wait()
         model = self.model
         step = int(model._step)
@@ -653,6 +660,9 @@ class DeltaPublisher:
         self._deltas_since_full = 0
         self.publishes += 1
         self.full_publishes += 1
+        obsm.counter("ff_publishes_total",
+                     "snapshot publications by kind",
+                     labelnames=("kind",)).inc(kind="full")
         self._publish_histograms()
         return entry
 
@@ -686,6 +696,12 @@ class DeltaPublisher:
         step = int(model._step)
         if self._last_flat is None:
             return self.publish_full(loader_state)
+        with obstrace.span("publish/delta", step=step):
+            return self._publish_delta(loader_state, step)
+
+    def _publish_delta(self, loader_state: Optional[Dict[str, Any]],
+                       step: int) -> Optional[Dict[str, Any]]:
+        model = self.model
         if step <= self._last_step:
             return None           # nothing trained since the last publish
         cur = serving_flat(model)
@@ -742,6 +758,9 @@ class DeltaPublisher:
         self._deltas_since_full += 1
         self.publishes += 1
         self.delta_publishes += 1
+        obsm.counter("ff_publishes_total",
+                     "snapshot publications by kind",
+                     labelnames=("kind",)).inc(kind="delta")
         return entry
 
     def stats(self) -> Dict[str, Any]:

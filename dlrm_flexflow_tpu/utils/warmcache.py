@@ -64,6 +64,18 @@ from .logging import get_logger
 
 log_cache = get_logger("warmcache")
 
+
+def _obs_cache_event(cache: str, event: str) -> None:
+    """Warm-cache hit/miss counter for the obs registry (no-op when
+    --obs off): a fleet whose cold boots stopped hitting the compile
+    cache shows up as a climbing miss series, not a mystery."""
+    from ..obs import metrics as obsm
+    obsm.counter("ff_warmcache_events_total",
+                 "plan/compile warm-cache lookups by outcome",
+                 labelnames=("cache", "event")).inc(cache=cache,
+                                                    event=event)
+
+
 # cache-layout version: bump to orphan every existing entry when the
 # on-disk format changes (old files are simply never matched)
 _FORMAT = 1
@@ -213,6 +225,11 @@ class PlanCache:
         recorded device count disagrees with `ndev` (a corrupt or
         hand-edited entry — the silent correctness hazard shardcheck
         FLX506 exists for) is rejected, not returned."""
+        out = self._get(key, ndev)
+        _obs_cache_event("plan", "hit" if out is not None else "miss")
+        return out
+
+    def _get(self, key: str, ndev: int) -> Optional[Dict[str, Any]]:
         entry = self._read()["plans"].get(key)
         if entry is None:
             self.misses += 1
@@ -317,6 +334,11 @@ class CompileCache:
 
     # --- read ----------------------------------------------------------
     def get(self, key: str):
+        out = self._get(key)
+        _obs_cache_event("compile", "hit" if out is not None else "miss")
+        return out
+
+    def _get(self, key: str):
         from . import faults
         path = self._path(key)
         if not os.path.isfile(path):

@@ -56,11 +56,29 @@ class WorkerStalled(RuntimeError):
     Raised at the consumer's wait site (never from the worker thread), so
     the training loop sees it at a step boundary where recovery is
     possible. ``report`` carries the structured :class:`StallReport`.
+
+    Construction also lands the report in the observability layer (a
+    trace instant + an ``ff_stalls_total`` counter labeled by worker) —
+    deliberately HERE, at the one choke point every stall passes
+    through, so a wedged subsystem is visible in the trace ring and the
+    scrape even when the thread that would have reported it never runs
+    again. No-ops when ``--obs off``.
     """
 
     def __init__(self, report: StallReport):
         super().__init__(str(report))
         self.report = report
+        from ..obs import metrics as _obsm
+        from ..obs import trace as _obstrace
+        _obsm.counter(
+            "ff_stalls_total",
+            "worker stalls / missed deadlines by worker name",
+            labelnames=("worker",)).inc(worker=report.worker)
+        _obstrace.instant("stall", cat="watchdog",
+                          worker=report.worker,
+                          waiting_for=report.waiting_for,
+                          waited_s=round(report.waited_s, 4),
+                          alive=report.alive)
 
 
 class Heartbeat:

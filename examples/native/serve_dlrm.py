@@ -61,6 +61,10 @@ Endpoints:
                  the server is draining, or the batcher died — load
                  balancers must stop sending traffic HERE, not learn
                  it from request errors
+  GET  /metrics  Prometheus text exposition of the obs registry
+                 (``--obs on``; with obs off the body is a comment
+                 saying so) — request/latency/reload series from the
+                 engine, router, watcher, and shard tier
 """
 
 import json
@@ -107,6 +111,15 @@ def make_handler(serve, input_names):
             self.end_headers()
             self.wfile.write(body)
 
+        def _reply_text(self, code, text,
+                        ctype="text/plain; version=0.0.4"):
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def log_message(self, fmt, *args):   # route through our logger
             log_app.debug(fmt, *args)
 
@@ -119,6 +132,18 @@ def make_handler(serve, input_names):
                 self._reply(200 if hz["ok"] else 503, hz)
             elif self.path == "/stats":
                 self._reply(200, serve.stats())
+            elif self.path == "/metrics":
+                # Prometheus text exposition of the obs registry; with
+                # --obs off the registry holds no instruments, so the
+                # body is a self-explaining comment instead of silence
+                from dlrm_flexflow_tpu.obs import metrics as obsm
+                if obsm.enabled():
+                    self._reply_text(200,
+                                     obsm.registry().prometheus_text())
+                else:
+                    self._reply_text(
+                        200, "# observability is off — restart with "
+                             "--obs on to populate this endpoint\n")
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
@@ -256,6 +281,14 @@ def main(argv=None):
         from dlrm_flexflow_tpu.utils.testing import ensure_cpu_devices
         ensure_cpu_devices(force_cpu)
     cfg = ff.FFConfig.parse_args(argv)
+    # --obs on must land BEFORE any engine/fleet is built: instruments
+    # resolve at creation time (no-op singletons once off stays off)
+    from dlrm_flexflow_tpu import obs
+    if obs.configure(cfg):
+        log_app.info("observability on: GET /metrics serves the "
+                     "registry%s",
+                     f", traces export to {cfg.obs_trace_dir}"
+                     if cfg.obs_trace_dir else "")
     dcfg = DLRMConfig.parse_args(cfg.unparsed)
     port = 8000
     rest = list(cfg.unparsed)
@@ -326,6 +359,10 @@ def main(argv=None):
                 shard_set.stop_health()
                 shard_set.close()
             httpd.server_close()
+            from dlrm_flexflow_tpu.obs import trace as obstrace
+            path = obstrace.export_to_dir()
+            if path:
+                log_app.info("exported serving trace to %s", path)
     return 0
 
 

@@ -219,6 +219,116 @@ class TestPolicyLoopRule:
         assert [f for f in found if f.rule == "FLX104"] == []
 
 
+class TestSampleListRule:
+    """FLX109: latency/size samples appended to a self.* list with no
+    bound or rotation in the enclosing class (a long-lived server grows
+    it until OOM; the fix is obs.metrics.Reservoir / deque(maxlen))."""
+
+    def test_unbounded_latency_list_flagged(self, tmp_path):
+        found = _findings(tmp_path, """
+            class Server:
+                def __init__(self):
+                    self._lat_ms = []
+
+                def record(self, v):
+                    self._lat_ms.append(v)
+        """)
+        assert _rules(found) == ["FLX109"]
+        f = found[0]
+        assert "self._lat_ms" in f.message and "Reservoir" in f.message
+        assert f.token == "_lat_ms"
+
+    def test_deque_maxlen_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            from collections import deque
+
+            class Server:
+                def __init__(self):
+                    self._lat_ms = deque(maxlen=4096)
+
+                def record(self, v):
+                    self._lat_ms.append(v)
+        """)
+        assert "FLX109" not in _rules(found)
+
+    def test_obs_reservoir_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            from dlrm_flexflow_tpu.obs import metrics as obsm
+
+            class Server:
+                def __init__(self):
+                    self._lat_ms = obsm.latency_reservoir("ff_x_ms")
+                    self._sizes = obsm.Reservoir(128)
+
+                def record(self, v):
+                    self._lat_ms.append(v)
+                    self._sizes.append(v)
+        """)
+        assert "FLX109" not in _rules(found)
+
+    def test_rotation_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            class Server:
+                def __init__(self):
+                    self._durations = []
+
+                def record(self, v):
+                    self._durations.append(v)
+                    del self._durations[:-64]
+        """)
+        assert "FLX109" not in _rules(found)
+
+    def test_slice_reassign_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            class Server:
+                def __init__(self):
+                    self._samples = []
+
+                def record(self, v):
+                    self._samples.append(v)
+                    self._samples = self._samples[-64:]
+        """)
+        assert "FLX109" not in _rules(found)
+
+    def test_non_sample_name_not_flagged(self, tmp_path):
+        # a pending-request queue is bounded-by-protocol state, not a
+        # measurement window — the rule must stay narrow
+        found = _findings(tmp_path, """
+            class Server:
+                def __init__(self):
+                    self._pending = []
+
+                def record(self, v):
+                    self._pending.append(v)
+        """)
+        assert "FLX109" not in _rules(found)
+
+    def test_drained_list_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            class Server:
+                def __init__(self):
+                    self._lat_ms = []
+
+                def record(self, v):
+                    self._lat_ms.append(v)
+
+                def drain(self):
+                    out = list(self._lat_ms)
+                    self._lat_ms.clear()
+                    return out
+        """)
+        assert "FLX109" not in _rules(found)
+
+    def test_package_has_no_unbaselined_sample_lists(self):
+        # the serving stack's windows all moved onto the bounded obs
+        # Reservoir in ISSUE 15 — the package must stay clean
+        found = run_analysis(os.path.join(_REPO, "dlrm_flexflow_tpu"))
+        baseline = load_baseline(DEFAULT_BASELINE)
+        fresh, _, _ = split_by_baseline(
+            [f for f in found if f.rule == "FLX109"], baseline)
+        assert fresh == [], "\n".join(f.render() for f in fresh)
+
+
 class TestLockRules:
     def test_racy_attribute(self, tmp_path):
         found = _findings(tmp_path, """
