@@ -29,6 +29,13 @@ Three questions, matching the ISSUE-6 acceptance bar:
   run killing one embedding shard under open-loop traffic (zero failed
   requests; degraded-flagged answers allowed; warm-cache replacement
   probed in; p99 re-enters the SLO).
+- **Wire protocol** (ISSUE 16): the same sharded tier served over REAL
+  OS-process + socket boundaries — attained QPS at the p99 SLO through
+  ``inproc`` vs ``tcp`` transports for 1/2/4 shard processes, the
+  per-seam RTT distribution the transport measured while doing it, and
+  a chaos run that ``kill -9``s one shard OS process under open-loop
+  traffic (zero failed requests; warm-cache replacement probes in; p99
+  re-enters the SLO).
 - **Continuous vs flush batching**: the same open-loop ladder through
   one engine in continuous (iteration-level) admission vs the
   pre-continuous size/deadline flush cycle. Continuous batching is
@@ -417,6 +424,226 @@ def _measure_shardtier(slo_ms=50.0, nshards=4, requests=256):
     return out
 
 
+def _spawn_shard_procs(cache_dir, nshards):
+    """One ``shard_server`` OS process per slot; returns
+    ``(procs, addresses)`` after every SHARD_SERVER_OK sentinel."""
+    import subprocess
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "dlrm_flexflow_tpu.serve.shard_server",
+         "--cache-dir", cache_dir, "--nshards", str(nshards),
+         "--slot", str(slot), "--port", "0"],
+        env=env, text=True, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT) for slot in range(nshards)]
+    addresses = []
+    try:
+        for p in procs:
+            port = None
+            for line in p.stdout:
+                if line.startswith("SHARD_SERVER_OK"):
+                    kv = dict(i.split("=", 1) for i in line.split()[1:])
+                    port = int(kv["port"])
+                    break
+            if port is None:
+                raise RuntimeError(
+                    f"shard process never booted (exit {p.poll()})")
+            addresses.append(("127.0.0.1", port))
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
+    return procs, addresses
+
+
+def _reap_procs(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+        try:
+            p.wait(5)
+        except Exception:   # noqa: BLE001 — best-effort teardown
+            pass
+        if p.stdout is not None:
+            p.stdout.close()
+
+
+def _measure_wire(slo_ms=50.0, requests=256, proc_counts=(1, 2, 4)):
+    """ISSUE-16 acceptance measurements for the wire protocol:
+
+    - **transport tax** — attained QPS at the p99 SLO through the SAME
+      sharded tier carried by ``inproc`` method calls vs ``tcp`` real
+      sockets to real shard OS processes, for 1/2/4 shard processes;
+    - **per-seam RTT** — the lookup seam's p50/p99 RTT the transport's
+      own telemetry measured while serving the sweep (what FLX509
+      prices the SLO budget against);
+    - **proc-kill chaos** — ``kill -9`` (a real SIGKILL to a real pid)
+      of one of 3 shard processes under open-loop traffic: zero failed
+      requests, warm-cache replacement probes in, p99 re-enters.
+    """
+    import os as _os
+    import signal
+    import tempfile
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.serve import percentile
+    from dlrm_flexflow_tpu.serve import transport as tp
+    from dlrm_flexflow_tpu.serve.shardtier import (EmbeddingShardSet,
+                                                   ShardTierConfig)
+
+    model, dcfg = _build_host()
+    reqs = _requests(dcfg, requests)
+    out = {"slo_ms": slo_ms}
+
+    def _tier_cfg(n, transport):
+        return ShardTierConfig(nshards=n, lookup_deadline_ms=1000.0,
+                               cooldown_s=0.0, replace_after=2,
+                               eject_after=2, transport=transport)
+
+    def _engine(sset):
+        return ff.InferenceEngine(model, ff.ServeConfig(
+            max_batch=64, queue_capacity=4096, cache_rows=32),
+            shard_set=sset).start()
+
+    def _qps(eng, rates):
+        for r in reqs[:16]:
+            eng.predict(r, timeout=60)                  # warm
+        return _qps_at_slo(eng.submit, reqs, slo_ms, rates)
+
+    # rate ladder calibrated off a 1-shard inproc closed-loop probe
+    sset = EmbeddingShardSet.build(model, 1, config=_tier_cfg(1, "inproc"))
+    eng = _engine(sset)
+    try:
+        for r in reqs[:16]:
+            eng.predict(r, timeout=60)
+        t0 = time.perf_counter()
+        for r in reqs[:64]:
+            eng.predict(r, timeout=60)
+        base_qps = 64 / (time.perf_counter() - t0)
+    finally:
+        eng.close()
+        sset.close()
+    # wider-than-usual ladder: a 1-process tcp tier pays a socket round
+    # trip per lookup, so its knee can sit well under the inproc probe
+    rates = [base_qps * f for f in (0.125, 0.25, 0.5, 1.0, 2.0, 4.0)]
+    out["closed_loop_qps"] = round(base_qps, 1)
+
+    transports = {}
+    for n in proc_counts:
+        row = {}
+        # inproc twin (same tier geometry, method-call carriage)
+        sset = EmbeddingShardSet.build(model, n,
+                                       config=_tier_cfg(n, "inproc"))
+        eng = _engine(sset)
+        try:
+            best, _ = _qps(eng, rates)
+            row["inproc_qps_at_slo"] = round(best, 1)
+        finally:
+            eng.close()
+            sset.close()
+        # tcp: real OS processes behind real sockets
+        cache_dir = tempfile.mkdtemp(prefix=f"ff-wire-{n}-")
+        cfg = _tier_cfg(n, "tcp")
+        EmbeddingShardSet.seed_shard_cache(model, n, cache_dir,
+                                           config=cfg)
+        procs, addrs = _spawn_shard_procs(cache_dir, n)
+        tp.reset_wire_stats()
+        try:
+            sset = EmbeddingShardSet.connect(addrs, config=cfg,
+                                             cache_dir=cache_dir)
+            eng = _engine(sset)
+            try:
+                best, _ = _qps(eng, rates)
+                row["tcp_qps_at_slo"] = round(best, 1)
+            finally:
+                eng.close()
+                sset.close()
+            seam = tp.wire_stats().get("lookup", {})
+            row["lookup_rtt_p50_ms"] = round(
+                seam.get("rtt_p50_ms") or 0, 3)
+            row["lookup_rtt_p99_ms"] = round(
+                seam.get("rtt_p99_ms") or 0, 3)
+            row["lookup_frames"] = seam.get("frames_sent", 0)
+        finally:
+            _reap_procs(procs)
+        inp = row.get("inproc_qps_at_slo", 0)
+        row["tcp_vs_inproc"] = (round(row["tcp_qps_at_slo"] / inp, 3)
+                                if inp else None)
+        transports[str(n)] = row
+    out["transports"] = transports
+
+    # --- chaos: SIGKILL one of 3 shard OS processes ---------------------
+    n = 3
+    cache_dir = tempfile.mkdtemp(prefix="ff-wire-chaos-")
+    cfg = ShardTierConfig(nshards=n, lookup_deadline_ms=1000.0,
+                          cooldown_s=0.0, replace_after=2,
+                          eject_after=1, retries=0, transport="tcp")
+    EmbeddingShardSet.seed_shard_cache(model, n, cache_dir, config=cfg)
+    procs, addrs = _spawn_shard_procs(cache_dir, n)
+    try:
+        sset = EmbeddingShardSet.connect(addrs, config=cfg,
+                                         cache_dir=cache_dir)
+        eng = _engine(sset)
+        stop = threading.Event()
+
+        def _health_loop():
+            while not stop.is_set():
+                try:
+                    sset.health_tick()
+                except Exception:   # noqa: BLE001 — keep ticking
+                    pass
+                time.sleep(0.05)
+
+        ht = threading.Thread(target=_health_loop, daemon=True,
+                              name="ff-bench-wire-health")
+        ht.start()
+        try:
+            rate = max(transports["2"].get("tcp_qps_at_slo", 8.0) * 0.5,
+                       8.0)
+            half = len(reqs) // 2
+            lat_before, failed_before, _ = _poisson_drive(
+                eng.submit, reqs[:half], rate)
+            _os.kill(procs[0].pid, signal.SIGKILL)     # the real thing
+            procs[0].wait(10)
+            lat_during, failed_during, _ = _poisson_drive(
+                eng.submit, reqs[half:], rate)
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline and any(
+                    r.state != "healthy" for r in sset.shards):
+                time.sleep(0.05)
+            lat_after, failed_after, _ = _poisson_drive(
+                eng.submit, reqs[:half], rate)
+            st = eng.stats()
+            p99_after = percentile(lat_after, 99)
+            out["proc_kill"] = {
+                "offered_qps": round(rate, 1),
+                "failed_before": failed_before,
+                "failed_during_kill": failed_during,
+                "failed_after": failed_after,
+                "p99_ms_before": round(percentile(lat_before, 99)
+                                       or 0, 2),
+                "p99_ms_during_kill": round(percentile(lat_during, 99)
+                                            or 0, 2),
+                "p99_ms_after": round(p99_after or 0, 2),
+                "p99_reentered_slo": bool(p99_after is not None
+                                          and p99_after <= slo_ms),
+                "degraded_responses": st["degraded_responses"],
+                "shard_replacements": sset.replacements,
+                "all_shards_healthy": all(r.state == "healthy"
+                                          for r in sset.shards),
+            }
+        finally:
+            stop.set()
+            ht.join(2.0)
+            eng.close()
+            sset.close()
+    finally:
+        _reap_procs(procs)
+    return out
+
+
 def measure(requests=256, slo_ms=50.0, replica_counts=(1, 2, 4)):
     import jax
 
@@ -499,6 +726,9 @@ def measure(requests=256, slo_ms=50.0, replica_counts=(1, 2, 4)):
     out["shardtier"] = _measure_shardtier(slo_ms=slo_ms,
                                           requests=requests)
 
+    # --- wire protocol: process + socket boundaries (ISSUE 16) ----------
+    out["wire"] = _measure_wire(slo_ms=slo_ms, requests=requests)
+
     # --- continuous vs flush batching (open-loop ladder each) -----------
     modes = {}
     for continuous in (False, True):
@@ -534,4 +764,8 @@ if __name__ == "__main__":
         n = int(sys.argv[sys.argv.index("--requests") + 1])
     if "--slo-ms" in sys.argv:
         slo = float(sys.argv[sys.argv.index("--slo-ms") + 1])
-    print(json.dumps(measure(requests=n, slo_ms=slo)))
+    if "--wire-only" in sys.argv:
+        print(json.dumps({"wire": _measure_wire(slo_ms=slo,
+                                                requests=n)}))
+    else:
+        print(json.dumps(measure(requests=n, slo_ms=slo)))

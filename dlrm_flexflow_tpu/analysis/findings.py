@@ -38,6 +38,11 @@ RULES = {
                "health, watcher) is joined without a stop Event being "
                "set on any close path — the join waits out a full "
                "sleep interval, or forever on a non-waiting loop"),
+    "FLX105": ("socket-not-closed", "high",
+               "a socket/listener created and stored on self is never "
+               "closed on any close()/shutdown()/__exit__ path of the "
+               "class — a leaked fd per connection, and a bound "
+               "listener port that never frees"),
     "FLX109": ("unbounded-sample-list", "medium",
                "latency/size samples appended to a self.* list with no "
                "bound or rotation anywhere in the class: a long-lived "
@@ -116,6 +121,14 @@ RULES = {
                "rows through an fp32-planned deployment (or vice "
                "versa) mis-prices every byte term 4x and breaks the "
                "payload codec at the first delta apply"),
+    "FLX509": ("lookup-rtt-budget-infeasible", "high",
+               "the per-seam wire RTT budget cannot meet the serve "
+               "SLO: a ranker's shard-fanout lookup is as slow as its "
+               "slowest shard, and a request that survives the "
+               "configured transient retries pays RTT x (1+retries) "
+               "plus exponential backoff SERIALLY — when that floor "
+               "spends the --serve-slo-ms budget before ranker compute "
+               "even starts, the topology cannot make SLO at any load"),
     # --- lowered-HLO audit (analysis/hlo_audit.py) ----------------------
     "FLX511": ("hlo-table-collective", "high",
                "lowered HLO moves a table-scale buffer through an "

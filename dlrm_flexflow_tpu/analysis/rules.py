@@ -134,18 +134,24 @@ def check_threads(idx: PackageIndex, findings: List[Finding]) -> None:
         _check_join(idx, site, findings, tok)
 
 
+def _is_self_attr(node: ast.AST, attr: str) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self" and node.attr == attr)
+
+
 def _joins_attr(tree: ast.AST, attr: str) -> bool:
     """True when the tree joins (or delegates close/stop to) self.attr,
-    directly or via a local alias `t = self.attr` / getattr(self, 'attr')."""
+    directly or via a local alias `t = self.attr` / getattr(self, 'attr')
+    / a snapshot copy `ts = list(self.attr)` / a loop variable
+    `for t in self.attr: t.join()`."""
     aliases = {None}
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name):
             v = node.value
             src = None
-            if (isinstance(v, ast.Attribute)
-                    and isinstance(v.value, ast.Name)
-                    and v.value.id == "self" and v.attr == attr):
+            if _is_self_attr(v, attr):
                 src = True
             elif (isinstance(v, ast.Call) and dotted(v.func) == "getattr"
                   and len(v.args) >= 2
@@ -154,8 +160,23 @@ def _joins_attr(tree: ast.AST, attr: str) -> bool:
                   and isinstance(v.args[1], ast.Constant)
                   and v.args[1].value == attr):
                 src = True
+            elif (isinstance(v, ast.Call)
+                  and dotted(v.func) in ("list", "tuple", "sorted")
+                  and len(v.args) == 1
+                  and _is_self_attr(v.args[0], attr)):
+                # snapshot copy taken under a lock before the joins
+                src = True
             if src:
                 aliases.add(node.targets[0].id)
+    for node in ast.walk(tree):
+        # loop variables over the attr (or an alias of it) inherit it:
+        # `for t in self._threads: t.join()`
+        if (isinstance(node, ast.For)
+                and isinstance(node.target, ast.Name)
+                and (_is_self_attr(node.iter, attr)
+                     or (isinstance(node.iter, ast.Name)
+                         and node.iter.id in aliases))):
+            aliases.add(node.target.id)
     for node in ast.walk(tree):
         if not (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)):
@@ -210,11 +231,70 @@ def _check_join(idx: PackageIndex, site, findings: List[Finding],
                 and isinstance(node.func.value, ast.Name)
                 and (var is None or node.func.value.id == var)):
             return
+    if var is not None and site.cls:
+        # handed to a self-owned registry (`self._threads.append(t)`)
+        # whose members a close path joins — the per-connection worker
+        # pattern
+        _, cnode = idx.classes[site.cls]
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append"
+                    and isinstance(node.func.value, ast.Attribute)
+                    and isinstance(node.func.value.value, ast.Name)
+                    and node.func.value.value.id == "self"
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id == var
+                    and _joins_attr(cnode, node.func.value.attr)):
+                return
     findings.append(make_finding(
         "FLX103", site.file, site.line,
         f"local thread {var or '<anonymous>'} is never joined in "
         f"{site.scope} — the worker outlives the call that spawned it",
         scope=site.scope, token=tok))
+
+
+# ---------------------------------------------------------------------
+# FLX105 — sockets/listeners stored on self must close on a close path
+# ---------------------------------------------------------------------
+SOCKET_CREATORS = {"socket.socket", "socket.create_server",
+                   "socket.create_connection"}
+
+
+def check_sockets(idx: PackageIndex, findings: List[Finding]) -> None:
+    """FLX105: ``self.X = socket.create_server(...)`` (or ``.socket()``/
+    ``.create_connection()``) in a class with no close()/shutdown()/
+    ``__exit__`` path that closes ``self.X``. A leaked client socket is
+    one fd per connection; a leaked LISTENER keeps the port bound until
+    interpreter exit — the next server boot gets EADDRINUSE."""
+    for cls, (rel, cnode) in idx.classes.items():
+        for node in ast.walk(cnode):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            v = node.value
+            if not (isinstance(v, ast.Call)
+                    and dotted(v.func) in SOCKET_CREATORS):
+                continue
+            if _joins_attr(cnode, tgt.attr):
+                continue
+            kind = ("listener"
+                    if dotted(v.func) == "socket.create_server"
+                    else "socket")
+            findings.append(make_finding(
+                "FLX105", rel, node.lineno,
+                f"{kind} stored on self.{tgt.attr} is never closed on "
+                f"any close()/shutdown()/__exit__ path of {cls} — "
+                f"leaked fd"
+                + (", and the bound port stays taken (EADDRINUSE on "
+                   "the next boot)" if kind == "listener" else ""),
+                scope=cls, token=tgt.attr))
 
 
 # ---------------------------------------------------------------------
@@ -901,7 +981,7 @@ def check_env_parsing(idx: PackageIndex,
                     scope=fn.name, token=ast.unparse(arg)[:40]))
 
 
-ALL_PASSES = (check_threads, check_policy_loops, check_sample_lists,
-              check_racy_attributes, check_locks,
+ALL_PASSES = (check_threads, check_policy_loops, check_sockets,
+              check_sample_lists, check_racy_attributes, check_locks,
               check_manifest_atomicity, check_jax_hazards,
               check_env_parsing)

@@ -56,6 +56,13 @@ baseline machinery):
   row-ranges that fail to tile a table exactly (gap/overlap/short —
   the owner math itself, ``parallel.alltoall.shard_row_ranges``, can
   never produce this; a hand-edited plan can).
+- FLX509 lookup-rtt-budget-infeasible: with ``--serve-slo-ms`` set, the
+  per-seam wire RTT budget is audited — a ranker's shard fanout is as
+  slow as its slowest shard, and a request surviving the configured
+  transient retries pays ``rtt x (1 + retries)`` plus exponential
+  backoff serially (``--serving-rtt-ms``, defaulting to the
+  transport's measured floor); a floor past the SLO means no load
+  level can make it.
 
 The lowered-HLO half of the PR lives in :mod:`.hlo_audit` (FLX51x).
 """
@@ -331,6 +338,10 @@ def verify_serving_plan(model, replicas: int,
                         *, ranker_holds_tables: Optional[bool] = None,
                         hbm_bytes: Optional[float] = None,
                         table_scale_bytes: Optional[float] = None,
+                        serve_slo_ms: Optional[float] = None,
+                        serving_rtt_ms: Optional[float] = None,
+                        lookup_retries: int = 2,
+                        backoff_ms: float = 5.0,
                         path: str = "<serving>") -> List[Finding]:
     """Audit a SERVING deployment the way :func:`verify_plan` audits a
     training plan — statically, no devices needed.
@@ -352,6 +363,16 @@ def verify_serving_plan(model, replicas: int,
       a hand-edited or version-skewed plan can, and a gap serves
       default rows for ids nobody owns while an overlap double-serves
       (and double-publishes) rows.
+
+    With ``serve_slo_ms`` set a third hazard is flagged under
+    **FLX509** — an RTT budget the topology cannot meet. ``serving_rtt_ms``
+    is the per-hop wire RTT floor on the lookup seam (when omitted, the
+    transport's measured p50 floor is used if this process has sent
+    wire traffic); a ranker's shard fanout is as slow as its slowest
+    shard, and a request that survives ``lookup_retries`` transient
+    failures pays ``rtt x (1 + retries)`` plus the exponential
+    ``backoff_ms`` chain serially. When that floor spends the SLO
+    before ranker compute even starts, no load level makes SLO.
     """
     from ..serve.shardtier import serving_footprint
     findings: List[Finding] = []
@@ -427,6 +448,50 @@ def verify_serving_plan(model, replicas: int,
                " (a sharded tier would hold "
                f"{_fmt_bytes(fp['dense_bytes'])}/ranker)"),
             scope="<serving>", token="ranker-hbm"))
+
+    # --- FLX509: per-seam RTT budget vs the serve SLO ------------------
+    if serve_slo_ms is not None and float(serve_slo_ms) > 0 \
+            and nshards > 0:
+        rtt, measured = serving_rtt_ms, False
+        if rtt is None:
+            try:
+                from ..serve.transport import measured_rtt_floor
+                rtt = measured_rtt_floor("lookup")
+                measured = rtt is not None
+            except ImportError:  # pragma: no cover - bare CI venv
+                rtt = None
+        if rtt is not None and float(rtt) > 0:
+            retries = max(int(lookup_retries), 0)
+            # the retry chain is SERIAL: every transient burn pays a
+            # full RTT plus its slot of the exponential backoff; the
+            # shard fanout is parallel but waits on its slowest member,
+            # so the per-shard worst case IS the request's floor
+            worst_ms = (float(rtt) * (1 + retries)
+                        + float(backoff_ms) * ((1 << retries) - 1))
+            src = ("transport-measured p50 floor" if measured
+                   else "--serving-rtt-ms")
+            if worst_ms >= float(serve_slo_ms):
+                findings.append(make_finding(
+                    "FLX509", path, 0,
+                    f"lookup RTT budget infeasible: {nshards}-shard "
+                    f"fanout at {float(rtt):.2f} ms/hop ({src}) with "
+                    f"{retries} transient retr{'y' if retries == 1 else 'ies'} "
+                    f"floors a surviving request at {worst_ms:.2f} ms — "
+                    f"past the {float(serve_slo_ms):.0f} ms SLO before "
+                    f"ranker compute starts; cut retries/backoff, move "
+                    f"shards closer, or raise the SLO",
+                    scope="<serving>", token="rtt-budget"))
+            elif worst_ms >= 0.5 * float(serve_slo_ms):
+                findings.append(make_finding(
+                    "FLX509", path, 0,
+                    f"lookup RTT headroom is thin: the worst surviving "
+                    f"request spends {worst_ms:.2f} ms of the "
+                    f"{float(serve_slo_ms):.0f} ms SLO on the wire "
+                    f"({float(rtt):.2f} ms/hop {src}, {retries} "
+                    f"retries) — under {0.5 * float(serve_slo_ms):.0f} "
+                    f"ms is left for batching + ranker compute",
+                    scope="<serving>", token="rtt-headroom",
+                    severity="medium"))
     return sort_findings(findings)
 
 
@@ -773,6 +838,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                     metavar="M",
                     help="row-shard the serving lookup tier M ways in "
                          "the FLX507 audit (0 = replicated tables)")
+    ap.add_argument("--serve-slo-ms", type=float, default=None,
+                    metavar="MS",
+                    help="per-request latency SLO the serving "
+                         "deployment must meet — enables the FLX509 "
+                         "per-seam RTT budget audit")
+    ap.add_argument("--serving-rtt-ms", type=float, default=None,
+                    metavar="MS",
+                    help="per-hop wire RTT floor on the lookup seam "
+                         "for FLX509 (default: the transport's "
+                         "measured p50 floor, when this process has "
+                         "sent wire traffic)")
+    ap.add_argument("--serving-retries", type=int, default=2,
+                    metavar="N",
+                    help="transient-retry budget the wire client is "
+                         "configured with (FLX509 prices the serial "
+                         "retry chain; default 2 = WireClient default)")
     ap.add_argument("--fail-on", default="high",
                     choices=["high", "medium", "low", "info", "never"])
     ap.add_argument("--baseline", default=DEFAULT_PLAN_BASELINE,
@@ -866,6 +947,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "ranker_holds_tables": False}
         findings.extend(verify_serving_plan(
             model, args.serving_replicas, plan, hbm_bytes=hbm,
+            serve_slo_ms=args.serve_slo_ms,
+            serving_rtt_ms=args.serving_rtt_ms,
+            lookup_retries=args.serving_retries,
             path=f"<serving:{name}>"))
     findings = sort_findings(findings)
 

@@ -282,6 +282,16 @@ class FFConfig:
     # beats error), "fail" raises so the router retries/sheds. Set with
     # --serve-degrade {cache,fail}.
     serve_degrade: str = "cache"
+    # serving-seam transport (serve/transport.py): "inproc" keeps
+    # today's method calls (bit-identical fast path), "tcp" carries the
+    # wire protocol over real sockets so shards/replicas can run as
+    # separate OS processes. Set with --serve-transport {inproc,tcp}.
+    serve_transport: str = "inproc"
+    # how many lookup shards to run as their OWN OS processes (spawned
+    # from the seeded shard warm cache; requires
+    # --serve-transport tcp). 0 = all shards in-process. Set with
+    # --serve-shard-procs N.
+    serve_shard_procs: int = 0
     # LRU cap on the eval-path AOT executable cache (_eval_step_execs):
     # serving many ad-hoc shapes must not leak executables. Evictions
     # are counted (FFModel.eval_exec_cache_stats / engine stats()). Set
@@ -515,6 +525,18 @@ class FFConfig:
                     raise ValueError(f"--serve-degrade expects "
                                      f"cache|fail, got {v!r}")
                 cfg.serve_degrade = v
+            elif a == "--serve-transport":
+                v = take()
+                if v not in ("inproc", "tcp"):
+                    raise ValueError(f"--serve-transport expects "
+                                     f"inproc|tcp, got {v!r}")
+                cfg.serve_transport = v
+            elif a == "--serve-shard-procs":
+                cfg.serve_shard_procs = int(take())
+                if cfg.serve_shard_procs < 0:
+                    raise ValueError(
+                        f"--serve-shard-procs expects N >= 0, got "
+                        f"{cfg.serve_shard_procs}")
             elif a == "--eval-exec-cache":
                 cfg.eval_exec_cache = int(take())
             elif a == "--obs":

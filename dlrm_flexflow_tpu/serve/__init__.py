@@ -14,7 +14,13 @@ from .shardtier import (EmbeddingShard, EmbeddingShardSet, ShardDown,
                         ShardLookupTimeout, ShardReplica,
                         ShardTierConfig, ShardTierUnavailable,
                         check_serving_feasible, serving_footprint)
+from .transport import (EngineServer, InprocTransport,
+                        RemoteEngineClient, RemoteShard, ShardServer,
+                        SnapshotServer, SnapshotWireSource, WireClient,
+                        WireError, WireRemoteError, WireServer,
+                        measured_rtt_floor, wire_stats)
 from .watcher import SnapshotWatcher
+from .wire import FrameError
 
 __all__ = ["InferenceEngine", "ServeConfig", "Prediction", "Overloaded",
            "DeadlineExceeded", "ReplicaDown", "EmbeddingCache",
@@ -24,4 +30,9 @@ __all__ = ["InferenceEngine", "ServeConfig", "Prediction", "Overloaded",
            "EmbeddingShardSet", "EmbeddingShard", "ShardReplica",
            "ShardTierConfig", "ShardDown", "ShardLookupTimeout",
            "ShardTierUnavailable", "check_serving_feasible",
-           "serving_footprint"]
+           "serving_footprint",
+           "WireClient", "WireServer", "WireError", "WireRemoteError",
+           "InprocTransport", "FrameError", "ShardServer",
+           "RemoteShard", "EngineServer", "RemoteEngineClient",
+           "SnapshotServer", "SnapshotWireSource", "wire_stats",
+           "measured_rtt_floor"]

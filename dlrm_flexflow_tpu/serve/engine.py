@@ -335,6 +335,23 @@ class InferenceEngine:
             self._watcher.start()
         return self
 
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """Expose this engine's dispatch surface (predict / health /
+        stats / probe) on a wire socket; returns the started
+        :class:`~.transport.EngineServer` (``address`` carries the
+        OS-assigned port when ``port=0``). The engine itself must
+        already be :meth:`start`-ed."""
+        from .transport import EngineServer
+        return EngineServer(self, host=host, port=port).start()
+
+    def serve_forever(self, host: str = "127.0.0.1",
+                      port: int = 0) -> None:
+        """Run this engine as a blocking socket server — the body of a
+        ranker-replica OS process; the router reaches it through
+        :class:`~.transport.RemoteEngineClient`."""
+        from .transport import EngineServer
+        EngineServer(self, host=host, port=port).serve_forever()
+
     def close(self, deadline_s: float = 10.0) -> None:
         """Drain the queue (pending requests still get answers), stop
         the batcher + watcher. A wedged batcher surfaces as a typed

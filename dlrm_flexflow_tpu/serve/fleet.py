@@ -298,6 +298,25 @@ class Fleet:
         return cls(engines, model_factory=model_factory, config=config,
                    checkpoint_dir=checkpoint_dir, shard_set=shard_set)
 
+    @classmethod
+    def connect(cls, addresses, deadline_s: float = 30.0) -> "Fleet":
+        """A fleet over ranker PROCESSES: one
+        :class:`~.transport.RemoteEngineClient` per ``host:port`` (each
+        a replica running ``engine.serve_forever()`` in its own
+        process). The router's dispatch, breaker, and health machinery
+        drive these exactly like in-process engines; canary/shadow
+        snapshot installs are refused by the proxy (deploys stay where
+        the model lives). A fixed-size fleet: no grow()."""
+        from .shardtier import _parse_address
+        from .transport import RemoteEngineClient
+        if not addresses:
+            raise ValueError("connect() needs at least one replica "
+                             "address")
+        engines = [RemoteEngineClient(_parse_address(addr), rid=i,
+                                      deadline_s=deadline_s)
+                   for i, addr in enumerate(addresses)]
+        return cls(engines)
+
     def __len__(self) -> int:
         return len(self.replicas)
 

@@ -552,6 +552,12 @@ class FleetRouter:
         """Resolve a snapshot argument (path or preloaded state dict)
         into (state, version) for one replica's model. Path loads run
         the poison hook — a canary deploy IS a reload."""
+        if getattr(rep.engine, "remote", False):
+            raise RuntimeError(
+                f"replica {rep.rid} runs in another process; "
+                f"canary/shadow deploys mutate replica state in-place "
+                f"and are inproc-only — publish the candidate through "
+                f"that process's own SnapshotWatcher instead")
         if isinstance(snapshot, str):
             state = load_params_for_swap(
                 rep.engine.model, snapshot,

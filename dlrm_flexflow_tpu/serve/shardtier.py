@@ -120,6 +120,7 @@ class ShardTierConfig:
     replace_after: int = 2            # failed probes -> replace-dead
     degrade: str = "cache"            # cache (default rows) | fail
     failure_domains: int = 0          # spread shards over N domains
+    transport: str = "inproc"         # inproc (method calls) | tcp
 
     def __post_init__(self):
         if self.nshards < 1:
@@ -128,6 +129,10 @@ class ShardTierConfig:
             raise ValueError(
                 f"degrade must be 'cache' or 'fail', got "
                 f"{self.degrade!r}")
+        if self.transport not in ("inproc", "tcp"):
+            raise ValueError(
+                f"transport must be 'inproc' or 'tcp', got "
+                f"{self.transport!r}")
 
     @staticmethod
     def from_config(cfg) -> "ShardTierConfig":
@@ -136,7 +141,8 @@ class ShardTierConfig:
             lookup_deadline_ms=float(
                 getattr(cfg, "serve_lookup_deadline_ms", 50.0)),
             hedge_ms=float(getattr(cfg, "serve_hedge_ms", 0.0)),
-            degrade=str(getattr(cfg, "serve_degrade", "cache")))
+            degrade=str(getattr(cfg, "serve_degrade", "cache")),
+            transport=str(getattr(cfg, "serve_transport", "inproc")))
 
 
 class FetchResult(NamedTuple):
@@ -161,6 +167,107 @@ def _table_bounds(op, flat_rows: int) -> List[Tuple[int, int]]:
     tables = int(getattr(op, "num_tables", 1))
     rows = flat_rows // max(tables, 1)
     return [(t * rows, (t + 1) * rows) for t in range(tables)]
+
+
+def _parse_address(addr) -> Tuple[str, int]:
+    """``"host:port"`` / ``(host, port)`` -> ``(host, port)``, loudly."""
+    if isinstance(addr, (tuple, list)) and len(addr) == 2:
+        return str(addr[0]), int(addr[1])
+    s = str(addr)
+    host, sep, port = s.rpartition(":")
+    if not sep or not host or not port.isdigit():
+        raise ValueError(f"shard address {addr!r} is not host:port")
+    return host, int(port)
+
+
+def _tier_layout(model, nshards: int) -> Dict[str, Any]:
+    """Slice ``model``'s host tables into the tier's static layout —
+    everything about the geometry that is NOT a live shard: per-op slot
+    ranges, flat row counts, row widths, per-table bounds + default
+    (mean) rows, the quantized-storage map, the per-slot row blocks,
+    and the model fingerprint. ``build()`` turns this into in-process
+    shards; ``seed_shard_cache()`` persists it so shard worker
+    PROCESSES and ``connect()`` can boot without the model."""
+    host_ops = getattr(model, "_host_resident_list", None)
+    if not host_ops:
+        raise ValueError(
+            "the shard tier serves host-resident embedding tables; "
+            "compile the model with host_resident_tables=True "
+            "(--host-tables). Device-resident tables already "
+            "row-shard on the training mesh (param_degree)")
+    version = int(getattr(model, "_step", 0))
+    ranges_by_op: Dict[str, list] = {}
+    flat_rows: Dict[str, int] = {}
+    defaults: Dict[str, np.ndarray] = {}
+    bounds: Dict[str, List[Tuple[int, int]]] = {}
+    dims: Dict[str, int] = {}
+    slot_blocks: List[Dict[str, np.ndarray]] = \
+        [dict() for _ in range(nshards)]
+    # quantized storage policies: the shard tier stores the QUANTIZED
+    # representation (codes + row scales) of policy ops — the
+    # rows-per-MB lever; defaults/means come from the same dequantized
+    # image every lookup serves
+    qmap = {name: pol.dtype for name, pol in
+            (getattr(model, "quant_policies", dict)() or {}).items()
+            if getattr(pol, "is_quantized", False)}
+    from ..quant.codec import fake_quant_np
+    for op in host_ops:
+        kern = model.host_params[op.name]["kernel"]
+        flat = np.ascontiguousarray(
+            kern.reshape(-1, kern.shape[-1]), np.float32)
+        if op.name in qmap:
+            flat = fake_quant_np(flat, qmap[op.name])
+        R = int(flat.shape[0])
+        ranges = shard_row_ranges(R, nshards)
+        ranges_by_op[op.name] = ranges
+        flat_rows[op.name] = R
+        dims[op.name] = int(flat.shape[1])
+        tb = _table_bounds(op, R)
+        bounds[op.name] = tb
+        # the degradation fallback: each table's mean embedding — a
+        # neutral "average row" answer, not zeros (zeros shift a
+        # trained model's score distribution far more)
+        defaults[op.name] = np.stack(
+            [flat[lo:hi].mean(axis=0) if hi > lo
+             else np.zeros(flat.shape[1], np.float32)
+             for lo, hi in tb]).astype(np.float32)
+        for slot, (lo, hi) in enumerate(ranges):
+            slot_blocks[slot][op.name] = flat[lo:hi].copy()
+    from ..utils.checkpoint import config_fingerprint
+    return {
+        "version": version,
+        "ranges_by_op": ranges_by_op,
+        "flat_rows": flat_rows,
+        "defaults": defaults,
+        "bounds": bounds,
+        "dims": dims,
+        "slot_blocks": slot_blocks,
+        "qmap": qmap,
+        "fingerprint": config_fingerprint(model),
+    }
+
+
+def _layout_meta(layout: Dict[str, Any], nshards: int,
+                 domains: List[str]) -> Dict[str, Any]:
+    """The JSON-safe tier geometry the warm cache's meta sidecar
+    persists (float32 values survive the JSON double round trip
+    exactly)."""
+    return {
+        "nshards": int(nshards),
+        "version": int(layout["version"]),
+        "fingerprint": layout["fingerprint"],
+        "flat_rows": {k: int(v)
+                      for k, v in layout["flat_rows"].items()},
+        "dims": {k: int(v) for k, v in layout["dims"].items()},
+        "ranges": {k: [[int(lo), int(hi)] for lo, hi in v]
+                   for k, v in layout["ranges_by_op"].items()},
+        "bounds": {k: [[int(lo), int(hi)] for lo, hi in v]
+                   for k, v in layout["bounds"].items()},
+        "defaults": {k: [[float(x) for x in row] for row in v]
+                     for k, v in layout["defaults"].items()},
+        "quant": dict(layout["qmap"]),
+        "domains": list(domains),
+    }
 
 
 class EmbeddingShard:
@@ -377,6 +484,23 @@ class EmbeddingShard:
             "hbm_bytes": self.hbm_bytes(),
         }
 
+    # --- the process boundary ------------------------------------------
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """Expose this shard's serving surface (lookup / publish /
+        install / probe / stats) on a wire socket; returns the started
+        :class:`~.transport.ShardServer` (its ``address`` carries the
+        OS-assigned port when ``port=0``)."""
+        from .transport import ShardServer
+        return ShardServer(self, host=host, port=port).start()
+
+    def serve_forever(self, host: str = "127.0.0.1",
+                      port: int = 0) -> None:
+        """Run this shard as a blocking socket server — the body of a
+        shard OS process (``python -m dlrm_flexflow_tpu.serve.
+        shard_server``)."""
+        from .transport import ShardServer
+        ShardServer(self, host=host, port=port).serve_forever()
+
 
 class ShardReplica(CircuitBreaker):
     """One :class:`EmbeddingShard` behind the fleet's circuit-breaker
@@ -487,78 +611,149 @@ class EmbeddingShardSet:
         """Slice ``model``'s host-resident tables into ``nshards`` row
         shards (the training exchange's owner math). The model keeps its
         tables until :meth:`release_ranker_tables` frees them."""
-        host_ops = getattr(model, "_host_resident_list", None)
-        if not host_ops:
-            raise ValueError(
-                "the shard tier serves host-resident embedding tables; "
-                "compile the model with host_resident_tables=True "
-                "(--host-tables). Device-resident tables already "
-                "row-shard on the training mesh (param_degree)")
         config = config or ShardTierConfig(nshards=nshards)
         if config.nshards != nshards:
             config.nshards = nshards
-        version = int(getattr(model, "_step", 0))
-        ranges_by_op: Dict[str, list] = {}
-        flat_rows: Dict[str, int] = {}
-        defaults: Dict[str, np.ndarray] = {}
-        bounds: Dict[str, List[Tuple[int, int]]] = {}
-        dims: Dict[str, int] = {}
-        slot_blocks: List[Dict[str, np.ndarray]] = \
-            [dict() for _ in range(nshards)]
-        # quantized storage policies: the shard tier stores the
-        # QUANTIZED representation (codes + row scales) of policy ops —
-        # the rows-per-MB lever; defaults/means come from the same
-        # dequantized image every lookup serves
-        qmap = {name: pol.dtype for name, pol in
-                (getattr(model, "quant_policies", dict)() or {}).items()
-                if getattr(pol, "is_quantized", False)}
-        from ..quant.codec import fake_quant_np
-        for op in host_ops:
-            kern = model.host_params[op.name]["kernel"]
-            flat = np.ascontiguousarray(
-                kern.reshape(-1, kern.shape[-1]), np.float32)
-            if op.name in qmap:
-                flat = fake_quant_np(flat, qmap[op.name])
-            R = int(flat.shape[0])
-            ranges = shard_row_ranges(R, nshards)
-            ranges_by_op[op.name] = ranges
-            flat_rows[op.name] = R
-            dims[op.name] = int(flat.shape[1])
-            tb = _table_bounds(op, R)
-            bounds[op.name] = tb
-            # the degradation fallback: each table's mean embedding —
-            # a neutral "average row" answer, not zeros (zeros shift a
-            # trained model's score distribution far more)
-            defaults[op.name] = np.stack(
-                [flat[lo:hi].mean(axis=0) if hi > lo
-                 else np.zeros(flat.shape[1], np.float32)
-                 for lo, hi in tb]).astype(np.float32)
-            for slot, (lo, hi) in enumerate(ranges):
-                slot_blocks[slot][op.name] = flat[lo:hi].copy()
-        from ..utils.checkpoint import config_fingerprint
-        fingerprint = config_fingerprint(model)
+        lay = _tier_layout(model, nshards)
+        ranges_by_op = lay["ranges_by_op"]
+        qmap = lay["qmap"]
+        version = lay["version"]
         cache = None
         if cache_dir:
             from ..utils.warmcache import ShardCache
-            cache = ShardCache(cache_dir, fingerprint=fingerprint)
-        domains = max(int(config.failure_domains), 0)
+            cache = ShardCache(cache_dir,
+                               fingerprint=lay["fingerprint"])
+        ndomains = max(int(config.failure_domains), 0)
+        domains = [f"fd{slot % ndomains}" if ndomains else ""
+                   for slot in range(nshards)]
         shards = []
         for slot in range(nshards):
-            domain = f"fd{slot % domains}" if domains else ""
             shard = EmbeddingShard(
-                slot, slot, slot_blocks[slot],
+                slot, slot, lay["slot_blocks"][slot],
                 {name: ranges_by_op[name][slot] for name in ranges_by_op},
-                version=version, domain=domain, quant=qmap)
+                version=version, domain=domains[slot], quant=qmap)
             shards.append(ShardReplica(shard))
-        out = cls(shards, config, ranges_by_op, flat_rows, defaults,
-                  bounds, dims, fingerprint=fingerprint, cache=cache)
+        out = cls(shards, config, ranges_by_op, lay["flat_rows"],
+                  lay["defaults"], lay["bounds"], lay["dims"],
+                  fingerprint=lay["fingerprint"], cache=cache)
         out._quant = qmap
         out._persist_all()
+        if cache is not None:
+            # the meta sidecar lets shard PROCESSES and connect() boot
+            # this geometry without the model
+            cache.put_meta(nshards, _layout_meta(lay, nshards, domains))
         log_shard.info(
             "shard set built: %d shard(s) x %d table op(s), "
             "%.1f MB/shard (largest), version %d", nshards,
             len(ranges_by_op),
             max(r.shard.hbm_bytes() for r in shards) / 1e6, version)
+        return out
+
+    @staticmethod
+    def seed_shard_cache(model, nshards: int, cache_dir: str,
+                         config: Optional[ShardTierConfig] = None):
+        """Slice ``model`` ONCE and persist every slot's blocks plus
+        the tier-geometry meta sidecar into ``cache_dir`` — the boot
+        source for shard worker processes
+        (``python -m dlrm_flexflow_tpu.serve.shard_server``) and for
+        :meth:`connect`, neither of which ever sees the model. Returns
+        the :class:`~..utils.warmcache.ShardCache`."""
+        from ..quant.store import QuantTable
+        from ..utils.warmcache import ShardCache
+        config = config or ShardTierConfig(nshards=nshards)
+        lay = _tier_layout(model, nshards)
+        cache = ShardCache(cache_dir, fingerprint=lay["fingerprint"])
+        qmap = lay["qmap"]
+        for slot in range(nshards):
+            blocks = {}
+            for op_name, arr in lay["slot_blocks"][slot].items():
+                dt = qmap.get(op_name)
+                # persist the same representation a live shard holds:
+                # quantized ops as codes + scales (bit-exact with the
+                # fake-quanted slice), dense ops as fp32
+                blocks[op_name] = (QuantTable.from_dense(arr, dt)
+                                   if dt else arr)
+            cache.put(nshards, slot, blocks, lay["version"], 0)
+        ndomains = max(int(config.failure_domains), 0)
+        domains = [f"fd{slot % ndomains}" if ndomains else ""
+                   for slot in range(nshards)]
+        cache.put_meta(nshards, _layout_meta(lay, nshards, domains))
+        return cache
+
+    @classmethod
+    def connect(cls, addresses: List[Any],
+                config: Optional[ShardTierConfig] = None,
+                cache_dir: Optional[str] = None,
+                meta: Optional[Dict[str, Any]] = None
+                ) -> "EmbeddingShardSet":
+        """Build the lookup tier over shard PROCESSES: one
+        :class:`~.transport.RemoteShard` per ``host:port`` (or
+        ``(host, port)``) address, slot = list position. The tier
+        geometry comes from ``meta`` or the ``cache_dir`` meta sidecar
+        (:meth:`seed_shard_cache`); each shard is probed once at
+        connect time, so an unreachable process fails fast here rather
+        than on the first request. With ``cache_dir``, replace-dead
+        stays available: a killed shard process is replaced by an
+        IN-PROCESS warm-cache boot (a warm standby serving that slot
+        until operations restore the process)."""
+        from .transport import WireClient, RemoteShard
+        if not addresses:
+            raise ValueError("connect() needs at least one shard "
+                             "address")
+        nshards = len(addresses)
+        config = config or ShardTierConfig(nshards=nshards,
+                                           transport="tcp")
+        config.nshards = nshards
+        cache = None
+        if cache_dir:
+            from ..utils.warmcache import ShardCache
+            cache = ShardCache(cache_dir)
+        if meta is None:
+            if cache is None:
+                raise ValueError(
+                    "connect() needs the tier geometry: pass meta= or "
+                    "cache_dir= (seed it with seed_shard_cache)")
+            meta = cache.get_meta(nshards)
+            if meta is None:
+                raise ValueError(
+                    f"no tier meta for {nshards} shard(s) in "
+                    f"{cache_dir!r}: {cache.last_reject or 'missing'} "
+                    f"— run seed_shard_cache first")
+        if cache is not None:
+            cache.fingerprint = str(meta.get("fingerprint", ""))
+        ranges_by_op = {k: [(int(lo), int(hi)) for lo, hi in v]
+                        for k, v in meta["ranges"].items()}
+        flat_rows = {k: int(v) for k, v in meta["flat_rows"].items()}
+        dims = {k: int(v) for k, v in meta["dims"].items()}
+        bounds = {k: [(int(lo), int(hi)) for lo, hi in v]
+                  for k, v in meta["bounds"].items()}
+        defaults = {k: np.asarray(v, np.float32)
+                    for k, v in meta["defaults"].items()}
+        qmap = {str(k): str(v)
+                for k, v in (meta.get("quant") or {}).items()}
+        domains = list(meta.get("domains") or [""] * nshards)
+        lookup_s = max(config.lookup_deadline_ms / 1e3, 0.001)
+        shards = []
+        for slot, addr in enumerate(addresses):
+            host, port = _parse_address(addr)
+            client = WireClient(
+                (host, port), seam="lookup", retries=config.retries,
+                backoff_ms=config.backoff_ms,
+                default_deadline_s=max(10.0, lookup_s),
+                name=f"shard{slot}")
+            remote = RemoteShard(
+                slot, slot, client, domain=domains[slot], quant=qmap,
+                lookup_deadline_s=lookup_s)
+            remote.refresh()   # fail fast on an unreachable process
+            shards.append(ShardReplica(remote))
+        out = cls(shards, config, ranges_by_op, flat_rows, defaults,
+                  bounds, dims,
+                  fingerprint=str(meta.get("fingerprint", "")),
+                  cache=cache)
+        out._quant = qmap
+        log_shard.info(
+            "shard set connected: %d remote shard(s) over tcp, "
+            "version %d", nshards, out.version)
         return out
 
     @staticmethod
@@ -586,6 +781,10 @@ class EmbeddingShardSet:
         # wait=False: an abandoned (injected-delay) lookup must not
         # wedge close; the worker threads exit when their task returns
         self._pool.shutdown(wait=False)
+        for rep in self.shards:
+            closer = getattr(rep.shard, "close", None)
+            if closer is not None:
+                closer()   # a RemoteShard's connection pool
 
     def __enter__(self) -> "EmbeddingShardSet":
         return self
@@ -923,6 +1122,10 @@ class EmbeddingShardSet:
         for rep in self.shards:
             if rep.state == EJECTED:
                 continue   # don't clobber the entry with stale blocks
+            if not getattr(rep.shard, "supports_persist", True):
+                # a REMOTE shard's blocks live in its own process; its
+                # boot source is the seeded cache, not our copy
+                continue
             blocks, ver, crc = rep.shard.blocks_copy()
             self._cache.put(self.nshards, rep.slot, blocks, ver, crc)
 

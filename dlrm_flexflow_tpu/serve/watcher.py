@@ -63,9 +63,19 @@ class SnapshotWatcher:
 
     def __init__(self, engine, directory: str, poll_s: float = 0.5,
                  elastic: bool = False, allow_deltas: bool = True,
-                 backoff_max_s: float = 30.0):
+                 backoff_max_s: float = 30.0, wire=None):
         self._engine = engine
         self.directory = os.path.abspath(directory)
+        # wire mode: manifest polls and snapshot/delta loads go through
+        # a transport.SnapshotWireSource (the publish directory lives in
+        # ANOTHER process); fetched files spool locally so the loaders'
+        # zip validation + chain CRCs run unchanged on local paths. The
+        # source gives wire IO the same retry/backoff treatment
+        # read_with_retries gives file IO, with cumulative
+        # wire_retries/last_wire_error surfaced in stats().
+        self._wire = wire
+        self._fs_dir = (self.directory if wire is None
+                        else os.path.abspath(wire.spool_dir))
         self.poll_s = max(float(poll_s), 0.01)
         # cross-mesh reshard on load: a per-device fleet replica follows
         # a multi-device trainer's snapshots (ServeConfig.reshard)
@@ -129,6 +139,9 @@ class SnapshotWatcher:
             self._chain_fallbacks
         yield "ff_watcher_consecutive_failures", lab, \
             self._consecutive_failures
+        if self._wire is not None:
+            yield "ff_watcher_wire_retries_total", lab, \
+                self._wire.wire_retries
 
     def stop(self) -> None:
         obsm.unregister_collector(self._obs_collect)
@@ -169,6 +182,14 @@ class SnapshotWatcher:
 
     # --- manifest read -------------------------------------------------
     def _read_manifest(self) -> Optional[Dict[str, Any]]:
+        if self._wire is not None:
+            try:
+                m = self._wire.read_manifest()
+            except Exception as e:   # noqa: BLE001 — wire budget spent
+                self._record_failure(
+                    f"manifest unreadable over the wire: {e}")
+                return None
+            return m if isinstance(m, dict) else None
         path = os.path.join(self.directory, self.MANIFEST)
         if not os.path.isfile(path):
             return None   # normal pre-publish state, not a failure
@@ -193,6 +214,22 @@ class SnapshotWatcher:
         entries = m.get("entries")
         return entries if isinstance(entries, list) else []
 
+    def _fetch_local(self, name: str) -> Optional[str]:
+        """A published file's LOCAL path: the publish directory itself
+        normally; in wire mode the spooled copy (fetched with wire
+        retry/backoff — a failed fetch reads as a missing file, which
+        the caller already degrades on)."""
+        if not name:
+            return None
+        if self._wire is None:
+            return os.path.join(self.directory, name)
+        try:
+            return self._wire.fetch_file(name)
+        except Exception as e:   # noqa: BLE001 — wire budget spent
+            self._record_failure(
+                f"fetch of {name} over the wire failed: {e}")
+            return None
+
     def _latest_valid(self, entries: Optional[list] = None
                       ) -> Optional[Dict[str, Any]]:
         """Newest manifest entry that exists on disk, matches this
@@ -202,8 +239,8 @@ class SnapshotWatcher:
             entries = self._read_entries()
         for entry in sorted(entries,
                             key=lambda e: e.get("step", -1), reverse=True):
-            path = os.path.join(self.directory, entry.get("file", ""))
-            if not os.path.isfile(path):
+            path = self._fetch_local(entry.get("file", ""))
+            if path is None or not os.path.isfile(path):
                 continue
             fp = entry.get("fingerprint")
             if fp not in (None, self._fingerprint):
@@ -256,10 +293,28 @@ class SnapshotWatcher:
         key = ("chain", tip_step)
         if key in self._rejected:
             return False   # already degraded for this tip
+        if self._wire is not None:
+            # spool every file the chain could touch (deltas + the
+            # candidate bases) so resolve_chain's presence + CRC
+            # validation runs on local copies; a failed fetch degrades
+            # to the full-snapshot path like any other chain problem
+            try:
+                for e in deltas:
+                    if e.get("file"):
+                        self._wire.fetch_file(e["file"])
+                for e in (manifest.get("entries") or []):
+                    if isinstance(e, dict) and e.get("file"):
+                        self._wire.fetch_file(e["file"])
+            except Exception as e:   # noqa: BLE001 — wire budget spent
+                self._chain_fallbacks += 1
+                self._reject_once(
+                    key, f"delta chain fetch over the wire failed: {e} "
+                         f"— falling back to full reload")
+                return False
         try:
             base_entry, chain = resolve_chain(manifest,
                                               self._fingerprint,
-                                              self.directory)
+                                              self._fs_dir)
         except ChainError as e:
             self._chain_fallbacks += 1
             self._reject_once(
@@ -301,14 +356,14 @@ class SnapshotWatcher:
             # reads, validation, and the row payloads' device_put
             payloads = []
             for e in pending:
-                path = os.path.join(self.directory, e["file"])
+                path = os.path.join(self._fs_dir, e["file"])
                 payload = read_with_retries(
                     lambda p=path: load_delta_file(p),
                     site="delta_reload")
                 payloads.append(stage_delta_rows(self._engine.model,
                                                  payload))
             if need_base:
-                base_path = os.path.join(self.directory,
+                base_path = os.path.join(self._fs_dir,
                                          base_entry["file"])
                 faults.maybe_corrupt_reload(base_path)
                 state = read_with_retries(
@@ -349,14 +404,19 @@ class SnapshotWatcher:
     # --- full-snapshot path ---------------------------------------------
     def _try_full(self, manifest: Dict[str, Any]) -> bool:
         entries = manifest.get("entries")
-        entry = self._latest_valid(entries
-                                   if isinstance(entries, list) else [])
+        entries = entries if isinstance(entries, list) else []
+        if self._wire is not None:
+            # don't spool snapshots that could never install — each
+            # wire fetch re-downloads the file
+            entries = [e for e in entries if isinstance(e, dict)
+                       and int(e.get("step", -1)) > self._engine.version]
+        entry = self._latest_valid(entries)
         if entry is None:
             return False
         step = int(entry.get("step", -1))
         if step <= self._engine.version:
             return False
-        path = os.path.join(self.directory, entry["file"])
+        path = os.path.join(self._fs_dir, entry["file"])
         # fault window: the file can be corrupted AFTER the CRC check
         # above and BEFORE the load below (a torn replace, bit rot) —
         # the injection truncates it right here and the load must reject
@@ -387,13 +447,18 @@ class SnapshotWatcher:
         return True
 
     def stats(self) -> Dict[str, Any]:
-        return {"directory": self.directory, "polls": self._polls,
-                "version_floor": getattr(self._engine, "version_floor",
-                                         self._engine.version),
-                "poll_s": self.poll_s,
-                "next_poll_s": self._next_poll_s,
-                "consecutive_failures": self._consecutive_failures,
-                "delta_installs": self._delta_installs,
-                "chain_fallbacks": self._chain_fallbacks,
-                "reload_failures": self._reload_failures,
-                "last_reload_error": self._last_reload_error}
+        out = {"directory": self.directory, "polls": self._polls,
+               "version_floor": getattr(self._engine, "version_floor",
+                                        self._engine.version),
+               "poll_s": self.poll_s,
+               "next_poll_s": self._next_poll_s,
+               "consecutive_failures": self._consecutive_failures,
+               "delta_installs": self._delta_installs,
+               "chain_fallbacks": self._chain_fallbacks,
+               "reload_failures": self._reload_failures,
+               "last_reload_error": self._last_reload_error,
+               "wire_retries": 0, "last_wire_error": ""}
+        if self._wire is not None:
+            out["wire_retries"] = self._wire.wire_retries
+            out["last_wire_error"] = self._wire.last_wire_error
+        return out

@@ -227,12 +227,35 @@ class FaultPlan:
     # the serving path must reject-with-reason, never serve garbage
     # amplitudes (quant/codec.validate_scales is the gate)
     quant_scale: Dict[str, float] = field(default_factory=dict)
+    # --- network-level injection (serve/transport.py applies these
+    # INSIDE the wire transport, against real frames) ------------------
+    # seam name ("lookup", "dispatch", "publish", "manifest", or "any")
+    # -> probability each frame is dropped before it is sent (the client
+    # sees a transient error and retries within its budget)
+    net_drop: Dict[str, float] = field(default_factory=dict)
+    # seam -> remaining frames to DUPLICATE (consume-once): the client
+    # sends the same request-id twice; the server's request-id dedup
+    # must prove the second delivery a no-op
+    net_dup: Dict[str, int] = field(default_factory=dict)
+    # seam -> remaining frames to REORDER (consume-once): the server
+    # defers the frame until a later arrival has been processed, so a
+    # delta chain is delivered out of order — the version vector must
+    # stay monotonic (a late publish is an idempotent no-op)
+    net_reorder: Dict[str, int] = field(default_factory=dict)
+    # seam -> milliseconds added to EVERY frame on that seam (NOT
+    # consume-once — deadline/RTT-budget tests need a steadily slow
+    # link)
+    net_slow_ms: Dict[str, float] = field(default_factory=dict)
     # record of (hook, detail) actually fired, for test assertions
     fired: List[tuple] = field(default_factory=list)
 
     def __post_init__(self):
         from ..analysis.sanitizer import make_lock
         self._lock = make_lock("FaultPlan._lock")
+        # deterministic drop draws: the same plan drops the same frames
+        # in the same order (seeded, not wall-clock entropy)
+        import random as _random
+        self._net_rng = _random.Random(0xF0F0)
 
     def _record(self, hook: str, detail) -> None:
         self.fired.append((hook, detail))
@@ -252,7 +275,9 @@ _KNOWN_ENV_KEYS = ("FF_FAULT_NAN_STEPS", "FF_FAULT_TRUNCATE_CKPTS",
                    "FF_FAULT_POISON_RELOAD", "FF_FAULT_DELTA_TORN",
                    "FF_FAULT_PUBLISH_ABORT", "FF_FAULT_DELTA_GAP",
                    "FF_FAULT_CACHE_CORRUPT", "FF_FAULT_SHARD_DOWN",
-                   "FF_FAULT_LOOKUP_DELAY", "FF_FAULT_QUANT_SCALE")
+                   "FF_FAULT_LOOKUP_DELAY", "FF_FAULT_QUANT_SCALE",
+                   "FF_FAULT_NET_DROP", "FF_FAULT_NET_DUP",
+                   "FF_FAULT_NET_REORDER", "FF_FAULT_NET_SLOW")
 
 
 # --- strict env parsing ----------------------------------------------
@@ -308,6 +333,44 @@ def _env_pairs(key: str, raw: str, val,
     return out
 
 
+# The serving seams the transport layer tags its frames with.  A typo'd
+# seam head would otherwise parse fine and inject nothing — the chaos
+# test it was driving passes without exercising anything — so the parser
+# rejects unknown heads outright.  (Kept here, not imported from
+# serve.transport: faults must stay import-light so every layer can use
+# it.)
+NET_SEAMS = ("lookup", "dispatch", "publish", "manifest", "any")
+
+
+def _env_seam_pairs(key: str, raw: str, val) -> Dict[str, float]:
+    """Parse 'seam:value,seam:value' lists for the FF_FAULT_NET_* vars.
+    Seam heads are strings (``lookup``, ``dispatch``, ``publish``,
+    ``manifest``, or ``any``), so this cannot reuse ``_env_pairs``' int
+    heads; strict all the same — a missing ':', an empty seam, or an
+    unknown seam names the variable."""
+    out: Dict[str, float] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError(
+                f"{key}={raw!r}: item {part!r} is missing its ':' "
+                f"(expected 'seam:value', e.g. {key}=lookup:0.3)")
+        seam, tail = part.rsplit(":", 1)
+        seam = seam.strip()
+        if not seam:
+            raise ValueError(
+                f"{key}={raw!r}: item {part!r} has an empty seam name "
+                f"(expected 'seam:value', e.g. {key}=lookup:0.3)")
+        if seam not in NET_SEAMS:
+            raise ValueError(
+                f"{key}={raw!r}: unknown seam {seam!r} — valid seams "
+                f"are {', '.join(NET_SEAMS)}")
+        out[seam] = val(key, tail)
+    return out
+
+
 def plan_from_env() -> Optional[FaultPlan]:
     """Build a plan from FF_FAULT_* env vars; None when none are set.
 
@@ -342,11 +405,16 @@ def plan_from_env() -> Optional[FaultPlan]:
     shard_down = os.environ.get("FF_FAULT_SHARD_DOWN", "")
     lookup_delay = os.environ.get("FF_FAULT_LOOKUP_DELAY", "")
     quant_scale = os.environ.get("FF_FAULT_QUANT_SCALE", "")
+    net_drop = os.environ.get("FF_FAULT_NET_DROP", "")
+    net_dup = os.environ.get("FF_FAULT_NET_DUP", "")
+    net_reorder = os.environ.get("FF_FAULT_NET_REORDER", "")
+    net_slow = os.environ.get("FF_FAULT_NET_SLOW", "")
     if not any((nan, trunc, aborts, delay, ioerrs, drop, ret,
                 cache_corrupt, stall_coll,
                 serve_delay, corrupt_reload, replica_down,
                 poison_reload, delta_torn, publish_abort, delta_gap,
-                shard_down, lookup_delay, quant_scale)):
+                shard_down, lookup_delay, quant_scale,
+                net_drop, net_dup, net_reorder, net_slow)):
         return None
     plan = FaultPlan()
     if nan:
@@ -439,6 +507,23 @@ def plan_from_env() -> Optional[FaultPlan]:
                                        publish_abort)
     if delta_gap:
         plan.delta_gaps = _env_int("FF_FAULT_DELTA_GAP", delta_gap)
+    if net_drop:
+        plan.net_drop = _env_seam_pairs("FF_FAULT_NET_DROP", net_drop,
+                                        _env_float)
+        for seam, p in plan.net_drop.items():
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(
+                    f"FF_FAULT_NET_DROP={net_drop!r}: drop probability "
+                    f"for seam {seam!r} is {p} (expected 0..1)")
+    if net_dup:
+        plan.net_dup = _env_seam_pairs("FF_FAULT_NET_DUP", net_dup,
+                                       _env_int)
+    if net_reorder:
+        plan.net_reorder = _env_seam_pairs("FF_FAULT_NET_REORDER",
+                                           net_reorder, _env_int)
+    if net_slow:
+        plan.net_slow_ms = _env_seam_pairs("FF_FAULT_NET_SLOW",
+                                           net_slow, _env_float)
     return plan
 
 
@@ -679,6 +764,87 @@ def maybe_lookup_delay(shard_id: Optional[int] = None) -> None:
         secs = plan.lookup_delay_shard.get(shard_id, secs)
     if secs > 0:
         time.sleep(secs)
+
+
+def _net_value(table: Dict[str, float], seam: str):
+    """Per-seam entry with an ``any`` wildcard fallback (the exact seam
+    wins, mirroring the per-replica/per-shard override pattern)."""
+    if seam in table:
+        return seam, table[seam]
+    if "any" in table:
+        return "any", table["any"]
+    return None, None
+
+
+def take_net_drop(seam: str) -> bool:
+    """True when this seam's next frame should be DROPPED before it is
+    sent (``FF_FAULT_NET_DROP=seam:p``): the transport raises a
+    transient wire error without touching the socket, and its bounded
+    retry/backoff must absorb the loss. Probabilistic per frame, drawn
+    from the plan's seeded RNG (deterministic across runs)."""
+    plan = active()
+    if plan is None or not plan.net_drop:
+        return False
+    with plan._lock:
+        key, p = _net_value(plan.net_drop, seam)
+        if key is None or p <= 0:
+            return False
+        if plan._net_rng.random() >= p:
+            return False
+        if ("net_drop", seam) not in plan.fired:
+            plan._record("net_drop", seam)
+    return True
+
+
+def take_net_dup(seam: str) -> bool:
+    """True when this seam's next frame should be sent TWICE with the
+    same request-id (``FF_FAULT_NET_DUP=seam:n``, consume-once): the
+    server's request-id dedup must answer the duplicate from its cache
+    without re-invoking the handler — delivered-twice proven a no-op."""
+    plan = active()
+    if plan is None or not plan.net_dup:
+        return False
+    with plan._lock:
+        key, left = _net_value(plan.net_dup, seam)
+        if key is None or not left:
+            return False
+        if left > 0:
+            plan.net_dup[key] = left - 1
+        plan._record("net_dup", seam)
+    return True
+
+
+def take_net_reorder(seam: str) -> bool:
+    """True when this seam's next RECEIVED frame should be REORDERED
+    (``FF_FAULT_NET_REORDER=seam:n``, consume-once): the server defers
+    processing it until a later frame has been handled (bounded by a
+    timeout so a lone frame cannot deadlock), delivering e.g. a delta
+    chain out of order — version-vector monotonicity must hold because
+    a late publish is an idempotent no-op."""
+    plan = active()
+    if plan is None or not plan.net_reorder:
+        return False
+    with plan._lock:
+        key, left = _net_value(plan.net_reorder, seam)
+        if key is None or not left:
+            return False
+        if left > 0:
+            plan.net_reorder[key] = left - 1
+        plan._record("net_reorder", seam)
+    return True
+
+
+def maybe_net_slow(seam: str) -> None:
+    """Sleep before sending a frame on this seam
+    (``FF_FAULT_NET_SLOW=seam:ms``, EVERY frame while the plan is
+    active — deadline and RTT-budget tests need a steadily slow
+    link)."""
+    plan = active()
+    if plan is None or not plan.net_slow_ms:
+        return
+    _key, ms = _net_value(plan.net_slow_ms, seam)
+    if ms and ms > 0:
+        time.sleep(ms / 1e3)
 
 
 def maybe_corrupt_quant_scale(key: str, scales):

@@ -580,6 +580,71 @@ class ShardCache:
         self.hits += 1
         return blocks, version, chain_crc
 
+    # --- the tier-geometry meta sidecar --------------------------------
+    # Everything a shard PROCESS (serve/shard_server.py) or a connect()-
+    # built set needs that is NOT row blocks: per-op slot ranges, row
+    # widths, per-table default rows, quant policies, fingerprint. One
+    # JSON per shard count, next to the slot entries.
+
+    def _meta_path(self, nshards: int) -> str:
+        return os.path.join(self.directory,
+                            f"shard-{nshards}x.meta.json")
+
+    def put_meta(self, nshards: int, meta: Dict[str, Any]) -> bool:
+        """Atomically persist the tier geometry (temp + fsync +
+        os.replace). Best-effort, like :meth:`put`."""
+        doc = dict(meta)
+        if self.fingerprint:
+            doc.setdefault("fingerprint", self.fingerprint)
+        path = self._meta_path(nshards)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except Exception as e:   # noqa: BLE001 — full disk, perms
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            self.put_errors += 1
+            log_cache.warning("shard meta write failed (%s)", e)
+            return False
+        self.puts += 1
+        return True
+
+    def get_meta(self, nshards: int) -> Optional[Dict[str, Any]]:
+        """The tier geometry, or None with the reason recorded (torn
+        JSON, foreign fingerprint, wrong shard count — same
+        reject-with-reason contract as :meth:`get`)."""
+        path = self._meta_path(nshards)
+        if not os.path.isfile(path):
+            self.misses += 1
+            return None
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                meta = json.load(f)
+            if not isinstance(meta, dict):
+                raise ValueError("meta is not a JSON object")
+            if int(meta.get("nshards", nshards)) != nshards:
+                raise ValueError(
+                    f"meta is for {meta.get('nshards')} shard(s), "
+                    f"wanted {nshards}")
+            fp = str(meta.get("fingerprint", ""))
+            if self.fingerprint and fp and fp != self.fingerprint:
+                raise ValueError(
+                    f"foreign fingerprint {fp} != {self.fingerprint} "
+                    f"(differently-built model)")
+        except Exception as e:   # noqa: BLE001 — torn/invalid JSON
+            self._reject(f"{name}: {e}")
+            self.misses += 1
+            return None
+        self.hits += 1
+        return meta
+
     def stats(self) -> Dict[str, Any]:
         return {"hits": self.hits, "misses": self.misses,
                 "rejects": self.rejects, "puts": self.puts,

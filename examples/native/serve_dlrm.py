@@ -31,6 +31,18 @@ it as down would turn one dark shard into a full outage). Knobs:
 ``--serve-lookup-deadline-ms`` (per-fetch budget) and
 ``--serve-degrade {cache,fail}``.
 
+``--serve-transport tcp --serve-shard-procs N`` moves the lookup tier
+across a REAL process boundary: the app seeds the warm shard cache,
+spawns N ``serve/shard_server.py`` OS processes (one slot each, wire
+protocol over loopback TCP — ``serve/wire.py``), and the rankers
+resolve ids through ``RemoteShard`` clients with per-request deadlines,
+bounded retry/backoff, and CRC-checked frames. ``kill -9`` a shard
+process and responses degrade (never fail) until the health loop
+replaces it from the warm cache. Fault injection for drills:
+``FF_FAULT_NET_DROP/DUP/REORDER/SLOW`` (see utils/faults.py). The
+default ``--serve-transport inproc`` keeps today's in-process method
+calls bit-for-bit.
+
 No framework webserver: a stdlib ``http.server`` ThreadingHTTPServer is
 all the engine needs — every handler thread just submits into the
 engine's queue and blocks on its future, the batcher coalesces across
@@ -218,17 +230,92 @@ def _shard_cache_dir(cfg, ckpt_dir):
                          getattr(cfg, "compile_cache_dir", ""))
 
 
+_SHARD_PROCS = []  # child shard-server processes, reaped in main()
+
+
+def _wants_shard_tier(cfg):
+    return (int(getattr(cfg, "serve_shards", 0)) > 0
+            or int(getattr(cfg, "serve_shard_procs", 0)) > 0)
+
+
+def _spawn_shard_procs(cfg, model, ckpt_dir):
+    """The tcp path: seed the warm shard cache from the ranker's model,
+    spawn one ``serve/shard_server.py`` OS process per slot, and connect
+    ``RemoteShard`` clients over the wire protocol. The child processes
+    land in ``_SHARD_PROCS`` for shutdown."""
+    import subprocess
+    n_shards = int(getattr(cfg, "serve_shard_procs", 0))
+    tier_cfg = ff.ShardTierConfig.from_config(cfg)
+    cache_dir = _shard_cache_dir(cfg, ckpt_dir)
+    if not cache_dir:
+        raise SystemExit(
+            "--serve-shard-procs needs a shard cache directory to boot "
+            "the child processes from — set --checkpoint-dir or "
+            "--compile-cache-dir")
+    ff.EmbeddingShardSet.seed_shard_cache(model, n_shards, cache_dir,
+                                          config=tier_cfg)
+    repo_root = os.path.join(os.path.dirname(__file__), "..", "..")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (os.path.abspath(repo_root),
+                    env.get("PYTHONPATH", "")) if p)
+    addresses = []
+    for slot in range(n_shards):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dlrm_flexflow_tpu.serve.shard_server",
+             "--cache-dir", cache_dir, "--nshards", str(n_shards),
+             "--slot", str(slot), "--port", "0"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        _SHARD_PROCS.append(proc)
+        line = proc.stdout.readline().strip()
+        if not line.startswith("SHARD_SERVER_OK"):
+            raise SystemExit(
+                f"shard server slot {slot} failed to boot "
+                f"(got {line!r}, exit={proc.poll()})")
+        port = int(dict(kv.split("=", 1)
+                        for kv in line.split()[1:])["port"])
+        addresses.append(("127.0.0.1", port))
+        log_app.info("shard process slot %d up: pid=%d port=%d",
+                     slot, proc.pid, port)
+    return ff.EmbeddingShardSet.connect(addresses, config=tier_cfg,
+                                        cache_dir=cache_dir)
+
+
+def _stop_shard_procs():
+    for proc in _SHARD_PROCS:
+        if proc.poll() is None:
+            proc.terminate()
+    for proc in _SHARD_PROCS:
+        try:
+            proc.wait(timeout=5)
+        except Exception:
+            proc.kill()
+    _SHARD_PROCS.clear()
+
+
 def _build_shard_set(cfg, model, ckpt_dir):
     """Row-shard the model's host tables into the lookup tier and
     release the ranker's own copies (the point of the split)."""
-    n_shards = int(getattr(cfg, "serve_shards", 0))
-    shard_set = ff.EmbeddingShardSet.build(
-        model, n_shards, config=ff.ShardTierConfig.from_config(cfg),
-        cache_dir=_shard_cache_dir(cfg, ckpt_dir))
+    n_procs = int(getattr(cfg, "serve_shard_procs", 0))
+    transport = str(getattr(cfg, "serve_transport", "inproc"))
+    if n_procs > 0 and transport != "tcp":
+        raise SystemExit(
+            "--serve-shard-procs requires --serve-transport tcp "
+            "(separate processes cannot share in-process method calls)")
+    if n_procs > 0:
+        shard_set = _spawn_shard_procs(cfg, model, ckpt_dir)
+        n_shards = n_procs
+    else:
+        n_shards = int(getattr(cfg, "serve_shards", 0))
+        shard_set = ff.EmbeddingShardSet.build(
+            model, n_shards, config=ff.ShardTierConfig.from_config(cfg),
+            cache_dir=_shard_cache_dir(cfg, ckpt_dir))
     freed = ff.EmbeddingShardSet.release_ranker_tables(model)
     log_app.info(
-        "sharded serving tier: %d lookup shard(s), ranker released "
-        "%.1f MB of tables", n_shards, freed / 1e6)
+        "sharded serving tier: %d lookup shard(s) [%s], ranker released "
+        "%.1f MB of tables", n_shards,
+        "tcp, separate processes" if n_procs > 0 else "inproc",
+        freed / 1e6)
     return shard_set
 
 
@@ -239,7 +326,7 @@ def _build_fleet(cfg, dcfg, n, ckpt_dir):
 
     def factory(i):
         model = build_server_model(cfg, dcfg, mesh=_replica_mesh(i, n))
-        if int(getattr(cfg, "serve_shards", 0)) > 0:
+        if _wants_shard_tier(cfg):
             # the FIRST model built seeds the (single, shared) shard
             # set; every ranker — this one included — then releases its
             # own tables and resolves ids through the set
@@ -304,7 +391,7 @@ def main(argv=None):
         shard_set = serve.fleet.shard_set
     else:
         model = build_server_model(cfg, dcfg)
-        if int(getattr(cfg, "serve_shards", 0)) > 0:
+        if _wants_shard_tier(cfg):
             shard_set = _build_shard_set(cfg, model, ckpt_dir)
         serve = ff.InferenceEngine(model, checkpoint_dir=ckpt_dir,
                                    shard_set=shard_set)
@@ -358,6 +445,7 @@ def main(argv=None):
             if shard_set is not None:
                 shard_set.stop_health()
                 shard_set.close()
+            _stop_shard_procs()
             httpd.server_close()
             from dlrm_flexflow_tpu.obs import trace as obstrace
             path = obstrace.export_to_dir()

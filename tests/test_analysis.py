@@ -95,6 +95,45 @@ class TestThreadRules:
         """)
         assert found == []
 
+    def test_thread_registry_joined_in_close_clean(self, tmp_path):
+        # the per-connection worker pattern: each accept() spawns a
+        # thread into self._threads; close() drains the registry
+        found = _findings(tmp_path, """
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._threads = []
+
+                def accept(self):
+                    t = threading.Thread(target=print, daemon=True,
+                                         name="ff-conn")
+                    self._threads.append(t)
+                    t.start()
+
+                def close(self):
+                    threads = list(self._threads)
+                    for t in threads:
+                        t.join(5.0)
+        """)
+        assert found == []
+
+    def test_thread_registry_never_drained(self, tmp_path):
+        found = _findings(tmp_path, """
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._threads = []
+
+                def accept(self):
+                    t = threading.Thread(target=print, daemon=True,
+                                         name="ff-conn")
+                    self._threads.append(t)
+                    t.start()
+        """)
+        assert _rules(found) == ["FLX103"]
+
     def test_self_stored_thread_never_joined(self, tmp_path):
         found = _findings(tmp_path, """
             import threading
@@ -217,6 +256,90 @@ class TestPolicyLoopRule:
         # package-wide run must not gain FLX104 findings
         found = run_analysis(os.path.join(_REPO, "dlrm_flexflow_tpu"))
         assert [f for f in found if f.rule == "FLX104"] == []
+
+
+class TestSocketRule:
+    """FLX105: a socket/listener stored on self must be closed on some
+    close()/shutdown()/__exit__ path of the class — a leaked listener
+    keeps its port bound until interpreter exit."""
+
+    def test_listener_never_closed(self, tmp_path):
+        found = _findings(tmp_path, """
+            import socket
+
+            class Server:
+                def start(self):
+                    self._listener = socket.create_server(("", 0))
+        """)
+        assert _rules(found) == ["FLX105"]
+        assert "listener" in found[0].message
+        assert "EADDRINUSE" in found[0].message
+
+    def test_client_socket_never_closed(self, tmp_path):
+        found = _findings(tmp_path, """
+            import socket
+
+            class Client:
+                def connect(self, addr):
+                    self._sock = socket.create_connection(addr)
+        """)
+        assert _rules(found) == ["FLX105"]
+
+    def test_closed_in_close_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            import socket
+
+            class Server:
+                def start(self):
+                    self._listener = socket.create_server(("", 0))
+
+                def close(self):
+                    self._listener.close()
+        """)
+        assert found == []
+
+    def test_closed_via_alias_clean(self, tmp_path):
+        found = _findings(tmp_path, """
+            import socket
+
+            class Server:
+                def start(self):
+                    self._listener = socket.create_server(("", 0))
+
+                def close(self):
+                    lst = self._listener
+                    lst.close()
+        """)
+        assert found == []
+
+    def test_raw_socket_flagged(self, tmp_path):
+        found = _findings(tmp_path, """
+            import socket
+
+            class Probe:
+                def open(self):
+                    self._s = socket.socket(socket.AF_INET,
+                                            socket.SOCK_STREAM)
+        """)
+        assert _rules(found) == ["FLX105"]
+
+    def test_local_socket_not_in_scope(self, tmp_path):
+        # locals handed to another owner are that owner's problem —
+        # FLX105 audits self-stored sockets only
+        found = _findings(tmp_path, """
+            import socket
+
+            def dial(addr, pool):
+                sock = socket.create_connection(addr)
+                pool.adopt(sock)
+        """)
+        assert found == []
+
+    def test_shipped_transport_is_clean(self):
+        # WireServer/WireClient close their listener, pooled conns,
+        # and per-connection sockets — the package must not gain FLX105
+        found = run_analysis(os.path.join(_REPO, "dlrm_flexflow_tpu"))
+        assert [f for f in found if f.rule == "FLX105"] == []
 
 
 class TestSampleListRule:

@@ -705,3 +705,93 @@ class TestServingPlanAudit:
                               "--serving-shards", "8", "--model",
                               "dlrm_terabyte", "--hbm-gb", "16"])
         assert rc == 0
+
+
+class TestRttBudgetAudit:
+    """FLX509: the per-seam wire RTT floor vs the serve SLO. The retry
+    chain is serial (RTT x (1+retries) + exponential backoff); the
+    shard fanout waits on its slowest member."""
+
+    def _plan(self, nshards=4):
+        from dlrm_flexflow_tpu.parallel.alltoall import shard_row_ranges
+        rows = ROWS * TABLES
+        return {"nshards": nshards,
+                "flat_rows": {"emb_stack": rows},
+                "ranges": {"emb_stack": shard_row_ranges(rows, nshards)},
+                "ranker_holds_tables": False}
+
+    def test_infeasible_budget_flagged_high(self):
+        model = _graph()
+        # 2 ms/hop, 2 retries: 2*3 + 5*(2^2-1) = 21 ms floor vs 5 ms SLO
+        fs = shardcheck.verify_serving_plan(
+            model, replicas=1, serving_plan=self._plan(),
+            serve_slo_ms=5.0, serving_rtt_ms=2.0, lookup_retries=2)
+        assert [f.token for f in fs] == ["rtt-budget"]
+        assert fs[0].rule == "FLX509" and fs[0].severity == "high"
+        assert "21.00 ms" in fs[0].message
+
+    def test_thin_headroom_flagged_medium(self):
+        model = _graph()
+        # 6 ms floor (no retries) inside a 10 ms SLO: feasible but thin
+        fs = shardcheck.verify_serving_plan(
+            model, replicas=1, serving_plan=self._plan(),
+            serve_slo_ms=10.0, serving_rtt_ms=6.0, lookup_retries=0)
+        assert [f.token for f in fs] == ["rtt-headroom"]
+        assert fs[0].rule == "FLX509" and fs[0].severity == "medium"
+
+    def test_loopback_budget_clean(self):
+        model = _graph()
+        fs = shardcheck.verify_serving_plan(
+            model, replicas=1, serving_plan=self._plan(),
+            serve_slo_ms=100.0, serving_rtt_ms=0.2, lookup_retries=2)
+        assert fs == []
+
+    def test_no_slo_no_audit(self):
+        model = _graph()
+        fs = shardcheck.verify_serving_plan(
+            model, replicas=1, serving_plan=self._plan(),
+            serving_rtt_ms=50.0)
+        assert fs == []
+
+    def test_defaults_from_measured_transport_floor(self):
+        """With no --serving-rtt-ms, the audit prices hops at the
+        transport's measured p50 — seed the reservoir through a real
+        wire round trip."""
+        from dlrm_flexflow_tpu.serve import transport as tp
+        from dlrm_flexflow_tpu.serve import wire
+        tp.reset_wire_stats()
+        srv = tp.WireServer(
+            {wire.OP_PROBE: lambda payload: payload},
+            seam=tp.SEAM_LOOKUP, name="rtt-floor").start()
+        try:
+            cli = tp.WireClient(srv.address, seam=tp.SEAM_LOOKUP,
+                                name="rtt-floor")
+            for _ in range(8):
+                cli.request(wire.OP_PROBE, b"")
+            cli.close()
+        finally:
+            srv.close()
+        floor = tp.measured_rtt_floor(tp.SEAM_LOOKUP)
+        assert floor is not None and floor > 0
+        model = _graph()
+        # an SLO below the measured loopback floor must trip FLX509
+        fs = shardcheck.verify_serving_plan(
+            model, replicas=1, serving_plan=self._plan(),
+            serve_slo_ms=floor / 2.0, lookup_retries=0, backoff_ms=0.0)
+        assert any(f.rule == "FLX509" for f in fs)
+        assert any("measured" in f.message for f in fs)
+        tp.reset_wire_stats()
+
+    def test_cli_rtt_flags(self, capsys):
+        rc = shardcheck.main(
+            ["--serving-replicas", "1", "--serving-shards", "8",
+             "--model", "dlrm_terabyte", "--serve-slo-ms", "5",
+             "--serving-rtt-ms", "2"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "FLX509" in out
+        rc = shardcheck.main(
+            ["--serving-replicas", "1", "--serving-shards", "8",
+             "--model", "dlrm_terabyte", "--serve-slo-ms", "100",
+             "--serving-rtt-ms", "0.2"])
+        assert rc == 0

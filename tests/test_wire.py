@@ -1,0 +1,809 @@
+"""Wire protocol tests (ISSUE 16): the length-prefixed binary frame,
+payload codecs, the tcp/inproc transports, network fault injection, and
+the three promoted seams (shard lookup, fleet dispatch, watcher
+subscription) — each pinned against its in-process twin.
+
+Pinned contracts (the acceptance bar):
+
+- frames carry magic/version/request-id/opcode/CRC-32 and a torn or
+  corrupted frame is a transient ``FrameError`` (retried), never a
+  garbage decode;
+- the payload codec is DETERMINISTIC (same dict -> same bytes, the
+  delta chain's CRC discipline depends on it) and round-trips "/" keys
+  (np.savez cannot);
+- ``inproc`` transport is bit-identical to the pre-wire method-call
+  path; ``tcp`` serves the same bytes through real sockets;
+- duplicate delivery is idempotent: the server's request-id dedup
+  window answers a repeated frame from cache WITHOUT re-running the
+  handler;
+- ``FF_FAULT_NET_{DROP,DUP,REORDER,SLOW}`` parse strictly (bad values
+  raise naming the variable) and inject inside the transport, so every
+  retry/backoff/dedup path is drillable;
+- a reordered delta chain NEVER regresses a shard's version vector
+  (monotonic apply: stale versions are no-ops);
+- typed server errors (ShardDown, ChainError, ...) re-raise client-side
+  without retry — the handler ran;
+- the watcher's wire source gets the same retry/backoff treatment
+  ``read_with_retries`` gives file IO, with cumulative
+  ``wire_retries``/``last_wire_error`` surfaced in stats().
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                           synthetic_batch)
+from dlrm_flexflow_tpu.serve import (EmbeddingShardSet, Fleet,
+                                     FleetRouter, InferenceEngine,
+                                     RouterConfig, ServeConfig,
+                                     ShardDown, ShardTierConfig,
+                                     SnapshotWatcher)
+from dlrm_flexflow_tpu.serve import transport as tp
+from dlrm_flexflow_tpu.serve import wire
+from dlrm_flexflow_tpu.serve.shard_server import build_shard
+from dlrm_flexflow_tpu.serve.transport import (EngineServer,
+                                               InprocTransport,
+                                               RemoteEngineClient,
+                                               RemoteShard, ShardServer,
+                                               SnapshotServer,
+                                               SnapshotWireSource,
+                                               WireClient, WireError,
+                                               WireRemoteError,
+                                               WireServer, wire_stats)
+from dlrm_flexflow_tpu.serve.wire import FrameError
+from dlrm_flexflow_tpu.utils import faults
+from dlrm_flexflow_tpu.utils.checkpoint import CheckpointManager
+from dlrm_flexflow_tpu.utils.delta import split_host_rows_by_shard
+
+DCFG = DLRMConfig(embedding_size=[64] * 4, sparse_feature_size=8,
+                  mlp_bot=[4, 16, 8], mlp_top=[40, 16, 1])
+BS = 16
+
+
+def _build(seed=2, **cfg_kw):
+    cfg_kw.setdefault("host_resident_tables", True)
+    cfg_kw.setdefault("host_tables_async", False)
+    model = ff.FFModel(ff.FFConfig(batch_size=BS, seed=seed, **cfg_kw))
+    build_dlrm(model, DCFG)
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"])
+    model.init_layers()
+    return model
+
+
+def _rows(n, seed=0):
+    x, _ = synthetic_batch(DCFG, n, seed=seed)
+    return x
+
+
+def _echo_server(**kw):
+    kw.setdefault("name", "echo")
+    return WireServer({wire.OP_PROBE: lambda p: p}, **kw).start()
+
+
+@pytest.fixture(autouse=True)
+def _clean_wire_telemetry():
+    tp.reset_wire_stats()
+    yield
+    tp.reset_wire_stats()
+
+
+# ---------------------------------------------------------------------
+# frame format
+# ---------------------------------------------------------------------
+class TestFrames:
+    def test_round_trip(self):
+        frame = wire.encode_frame(wire.OP_LOOKUP, 42, b"hello")
+        op, rid, payload = wire.decode_frame(frame)
+        assert (op, rid, payload) == (wire.OP_LOOKUP, 42, b"hello")
+        assert frame[:4] == wire.MAGIC
+        assert len(frame) == wire.HEADER_BYTES + 5
+
+    def test_payload_crc_mismatch_is_frame_error(self):
+        frame = bytearray(wire.encode_frame(wire.OP_LOOKUP, 1, b"data!"))
+        frame[-1] ^= 0xFF   # flip a payload bit after the CRC was stamped
+        with pytest.raises(FrameError, match="CRC"):
+            wire.decode_frame(bytes(frame))
+
+    def test_bad_magic_is_frame_error(self):
+        frame = bytearray(wire.encode_frame(wire.OP_LOOKUP, 1, b""))
+        frame[0] = 0x00
+        with pytest.raises(FrameError, match="magic"):
+            wire.decode_frame(bytes(frame))
+
+    def test_wrong_version_is_frame_error(self):
+        frame = bytearray(wire.encode_frame(wire.OP_LOOKUP, 1, b""))
+        frame[4] = wire.WIRE_VERSION + 1
+        with pytest.raises(FrameError, match="version"):
+            wire.decode_frame(bytes(frame))
+
+    def test_truncated_frame_is_frame_error(self):
+        frame = wire.encode_frame(wire.OP_LOOKUP, 1, b"payload")
+        with pytest.raises(FrameError):
+            wire.decode_frame(frame[:-3])
+
+    def test_opcode_names(self):
+        assert wire.opcode_name(wire.OP_LOOKUP) == "lookup"
+        assert "resp" in wire.opcode_name(wire.OP_LOOKUP | wire.RESP_BIT)
+        assert "0x" in wire.opcode_name(0x7E)
+
+
+# ---------------------------------------------------------------------
+# payload codec
+# ---------------------------------------------------------------------
+class TestPayloadCodec:
+    def test_deterministic_bytes(self):
+        meta = {"version": 3, "b": [1, 2], "a": "x"}
+        arrays = {"w/kernel": np.arange(6, dtype=np.float32),
+                  "ids": np.asarray([5, 1], np.int64)}
+        one = wire.encode_payload(meta, arrays)
+        two = wire.encode_payload(dict(reversed(meta.items())),
+                                  dict(reversed(arrays.items())))
+        assert one == two   # key order and clock must not leak in
+
+    def test_slash_keys_round_trip(self):
+        arrays = {"hostparams/emb_stack/kernel":
+                  np.random.default_rng(0).normal(size=(4, 3))
+                  .astype(np.float32)}
+        meta, out = wire.decode_payload(
+            wire.encode_payload({"v": 1}, arrays))
+        assert meta == {"v": 1}
+        np.testing.assert_array_equal(
+            out["hostparams/emb_stack/kernel"],
+            arrays["hostparams/emb_stack/kernel"])
+
+    def test_lookup_codec_dense(self):
+        req = {"emb_stack": np.asarray([1, 9, 3], np.int64)}
+        back = wire.decode_lookup_request(
+            wire.encode_lookup_request(req))
+        np.testing.assert_array_equal(back["emb_stack"],
+                                      req["emb_stack"])
+        rows = {"emb_stack": np.ones((3, 8), np.float32)}
+        out, ver = wire.decode_lookup_response(
+            wire.encode_lookup_response(rows, version=7))
+        assert ver == 7
+        np.testing.assert_array_equal(out["emb_stack"],
+                                      rows["emb_stack"])
+
+    def test_lookup_codec_quant_tuple(self):
+        # quantized responses ride as codes+scales (the PR 14 encoding)
+        codes = np.asarray([[1, 2], [3, 4]], np.int8)
+        scales = np.asarray([0.5, 0.25], np.float32)
+        out, ver = wire.decode_lookup_response(
+            wire.encode_lookup_response(
+                {"emb_stack": (codes, scales, "int8")}, version=2))
+        q, s, dtype = out["emb_stack"]
+        np.testing.assert_array_equal(q, codes)
+        np.testing.assert_array_equal(s, scales)
+        assert q.dtype == np.int8
+        assert dtype == "int8"
+
+    def test_publish_codec(self):
+        key = "hostparams/emb_stack/kernel"
+        sub = {"rows": {key: (np.asarray([3, 7], np.int64),
+                              np.full((2, 8), 5.5, np.float32))},
+               "full": {}, "crc": 123}
+        data = wire.encode_publish(sub, version=10, expect_crc=99)
+        back, ver, crc = wire.decode_publish(data)
+        assert (ver, crc) == (10, 99)
+        idx, vals = back["rows"][key]
+        np.testing.assert_array_equal(idx, [3, 7])
+        np.testing.assert_array_equal(vals, sub["rows"][key][1])
+        assert back["crc"] == 123
+
+    def test_publish_codec_none_sub(self):
+        back, ver, crc = wire.decode_publish(
+            wire.encode_publish(None, version=4, expect_crc=None))
+        assert back is None and ver == 4 and crc is None
+
+    def test_error_codec_carries_typed_attrs(self):
+        e = ShardDown(3, "injected")
+        meta = wire.decode_error(wire.encode_error(e))
+        assert meta["type"] == "ShardDown"
+        assert meta["shard_id"] == 3
+        assert "injected" in meta["message"]
+
+
+# ---------------------------------------------------------------------
+# tcp transport: pooling, retry, dedup, deadlines, telemetry
+# ---------------------------------------------------------------------
+class TestTcpTransport:
+    def test_echo_round_trip_and_rtt_telemetry(self):
+        with _echo_server() as srv:
+            cli = WireClient(srv.address, name="t")
+            op, payload = cli.request(wire.OP_PROBE, b"ping")
+            assert op == wire.OP_PROBE | wire.RESP_BIT
+            assert payload == b"ping"
+            cli.close()
+        st = wire_stats()["lookup"]
+        assert st["frames_sent"] >= 1 and st["frames_recv"] >= 1
+        assert st["rtt_p50_ms"] > 0
+
+    def test_connection_pool_reuses_sockets(self):
+        with _echo_server() as srv:
+            cli = WireClient(srv.address, pool_size=1, name="t")
+            for _ in range(5):
+                cli.request(wire.OP_PROBE, b"x")
+            assert cli._made == 1   # one socket served all five
+            cli.close()
+
+    def test_unreachable_names_the_address(self):
+        cli = WireClient(("127.0.0.1", 1), retries=0, name="t",
+                         default_deadline_s=2.0)
+        with pytest.raises(WireError, match="unreachable"):
+            cli.request(wire.OP_PROBE, b"")
+        cli.close()
+
+    def test_missing_handler_is_remote_error(self):
+        with _echo_server() as srv:
+            cli = WireClient(srv.address, name="t")
+            with pytest.raises(WireRemoteError, match="no handler"):
+                cli.request(wire.OP_PREDICT, b"")
+            cli.close()
+
+    def test_typed_remote_error_reraise_without_retry(self):
+        calls = []
+
+        def boom(payload):
+            calls.append(1)
+            raise ShardDown(2, "down for the test")
+
+        with WireServer({wire.OP_LOOKUP: boom}, name="t").start() as srv:
+            cli = WireClient(srv.address, retries=3, name="t")
+            with pytest.raises(ShardDown):
+                cli.request(wire.OP_LOOKUP, b"")
+            cli.close()
+        assert len(calls) == 1   # the handler ran once: no retry
+
+    def test_dedup_answers_repeat_rid_from_cache(self):
+        calls = []
+
+        def handler(payload):
+            calls.append(payload)
+            return payload
+
+        with WireServer({wire.OP_PROBE: handler},
+                        name="t").start() as srv:
+            rid = tp.next_request_id()
+            resp1 = srv.dispatch(wire.OP_PROBE, rid, b"once")
+            resp2 = srv.dispatch(wire.OP_PROBE, rid, b"once")
+            assert resp1 == resp2
+            assert len(calls) == 1
+            assert srv.dedup_hits == 1
+
+    def test_deadline_bounds_slow_server(self):
+        def slow(payload):
+            time.sleep(1.0)
+            return payload
+
+        with WireServer({wire.OP_PROBE: slow}, name="t").start() as srv:
+            cli = WireClient(srv.address, retries=0, name="t")
+            t0 = time.monotonic()
+            with pytest.raises(WireError):
+                cli.request(wire.OP_PROBE, b"", deadline_s=0.2)
+            assert time.monotonic() - t0 < 0.9
+            cli.close()
+
+    def test_server_close_is_idempotent_and_frees_port(self):
+        srv = _echo_server()
+        addr = srv.address
+        srv.close()
+        srv.close()
+        # the port is free again: a new listener can bind it
+        srv2 = WireServer({wire.OP_PROBE: lambda p: p},
+                          host=addr[0], port=addr[1],
+                          name="rebind").start()
+        srv2.close()
+
+    def test_request_ids_unique_across_threads(self):
+        got = []
+
+        def mint():
+            got.extend(tp.next_request_id() for _ in range(200))
+
+        ts = [threading.Thread(target=mint, daemon=True,
+                               name=f"ff-test-rid-{i}")
+              for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(5.0)
+        assert len(set(got)) == len(got)
+
+
+# ---------------------------------------------------------------------
+# network fault injection (inside the transport)
+# ---------------------------------------------------------------------
+class TestNetFaults:
+    def _parse(self, **env):
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            return faults.plan_from_env()
+        finally:
+            for k, v in old.items():
+                os.environ.pop(k, None)
+                if v is not None:
+                    os.environ[k] = v
+
+    def test_env_forms_parse(self):
+        plan = self._parse(FF_FAULT_NET_DROP="lookup:0.5",
+                           FF_FAULT_NET_DUP="dispatch:2",
+                           FF_FAULT_NET_REORDER="any:1",
+                           FF_FAULT_NET_SLOW="manifest:25")
+        assert plan.net_drop == {"lookup": 0.5}
+        assert plan.net_dup == {"dispatch": 2}
+        assert plan.net_reorder == {"any": 1}
+        assert plan.net_slow_ms == {"manifest": 25.0}
+
+    @pytest.mark.parametrize("var,val", [
+        ("FF_FAULT_NET_DROP", "lookup"),          # no value
+        ("FF_FAULT_NET_DROP", "lookup:nope"),     # not a float
+        ("FF_FAULT_NET_DROP", "lookup:1.5"),      # p out of range
+        ("FF_FAULT_NET_DUP", "lookup:1.5"),       # not an int
+        ("FF_FAULT_NET_REORDER", "bogus-seam:1"),  # unknown seam
+        ("FF_FAULT_NET_SLOW", "lookup:fast"),     # not a float
+    ])
+    def test_bad_values_raise_naming_the_variable(self, var, val):
+        with pytest.raises(ValueError, match=var):
+            self._parse(**{var: val})
+
+    def test_drop_burns_attempt_then_retry_succeeds(self):
+        plan = faults.FaultPlan()
+        plan.net_drop["lookup"] = 1.0
+        with _echo_server() as srv:
+            cli = WireClient(srv.address, retries=2, backoff_ms=1.0,
+                             name="t")
+            with faults.active_plan(plan):
+                # p=1.0 drops every attempt: the budget spends and the
+                # error names the drop
+                with pytest.raises(WireError, match="drop"):
+                    cli.request(wire.OP_PROBE, b"x", deadline_s=1.0)
+            assert cli.wire_retries >= 1
+            # plan lifted: same client recovers on a fresh connection
+            op, payload = cli.request(wire.OP_PROBE, b"x")
+            assert payload == b"x"
+            cli.close()
+        assert wire_stats()["lookup"]["drops"] >= 1
+
+    def test_duplicate_delivery_is_idempotent(self):
+        calls = []
+
+        def handler(payload):
+            calls.append(payload)
+            return payload
+
+        plan = faults.FaultPlan()
+        plan.net_dup["lookup"] = 1
+        with WireServer({wire.OP_PROBE: handler},
+                        name="t").start() as srv:
+            cli = WireClient(srv.address, name="t")
+            with faults.active_plan(plan):
+                op, payload = cli.request(wire.OP_PROBE, b"dup-me")
+            assert payload == b"dup-me"
+            cli.close()
+            # the frame went twice; the handler ran ONCE; the second
+            # delivery was answered from the dedup window
+            assert len(calls) == 1
+            assert srv.dedup_hits == 1
+        assert wire_stats()["lookup"]["dups"] == 1
+        assert wire_stats()["lookup"]["dedup_hits"] == 1
+
+    def test_slow_adds_measurable_latency(self):
+        plan = faults.FaultPlan()
+        plan.net_slow_ms["lookup"] = 60.0
+        with _echo_server() as srv:
+            cli = WireClient(srv.address, name="t")
+            with faults.active_plan(plan):
+                t0 = time.monotonic()
+                cli.request(wire.OP_PROBE, b"")
+                assert time.monotonic() - t0 >= 0.05
+            cli.close()
+
+    def test_inproc_transport_same_fault_hooks(self):
+        calls = []
+
+        def handler(payload):
+            calls.append(payload)
+            return payload
+
+        srv = WireServer({wire.OP_PROBE: handler}, name="t")
+        it = InprocTransport(srv)
+        plan = faults.FaultPlan()
+        plan.net_dup["lookup"] = 1
+        with faults.active_plan(plan):
+            op, payload = it.request(wire.OP_PROBE, b"x")
+        assert payload == b"x"
+        assert len(calls) == 1 and srv.dedup_hits == 1
+        it.close()
+
+
+# ---------------------------------------------------------------------
+# shard seam over tcp: bit-identity, publishes, reorder, degradation
+# ---------------------------------------------------------------------
+class _TcpTier:
+    """3 in-process ShardServers booted from a seeded cache + an
+    EmbeddingShardSet.connect'ed client tier — the tcp twin of
+    EmbeddingShardSet.build, without OS-process spawn cost."""
+
+    def __init__(self, model, nshards, cache_dir, config=None):
+        self.cache_dir = str(cache_dir)
+        EmbeddingShardSet.seed_shard_cache(model, nshards,
+                                           self.cache_dir,
+                                           config=config)
+        self.servers = []
+        addrs = []
+        for slot in range(nshards):
+            shard = build_shard(self.cache_dir, nshards, slot)
+            srv = ShardServer(shard).start()
+            self.servers.append(srv)
+            addrs.append(srv.address)
+        self.sset = EmbeddingShardSet.connect(addrs, config=config,
+                                              cache_dir=self.cache_dir)
+
+    def close(self):
+        self.sset.close()
+        for srv in self.servers:
+            srv.close()
+
+
+class TestShardSeamTcp:
+    @pytest.mark.parametrize("nshards", [1, 2])
+    def test_bit_identical_to_inproc(self, nshards, tmp_path):
+        m = _build()
+        x = _rows(8)
+        direct = np.asarray(m.forward_bucket(x, bucket=BS))
+        tier = _TcpTier(m, nshards, tmp_path)
+        eng = InferenceEngine(m, ServeConfig(max_batch=BS),
+                              shard_set=tier.sset).start()
+        try:
+            pred = eng.predict({k: v[:8] for k, v in x.items()})
+            np.testing.assert_array_equal(np.asarray(pred.scores),
+                                          direct[:8])
+            assert pred.degraded is False
+            assert set(pred.versions) == set(range(nshards))
+        finally:
+            eng.close()
+            tier.close()
+
+    def test_quantized_tier_bit_identical_over_wire(self, tmp_path):
+        # the lookup payload reuses the quantized codes+scales encoding:
+        # a quantized tier must serve the same (fake-quantized) bytes
+        # over tcp as in-process
+        m = _build(emb_dtype="int8")
+        x = _rows(8)
+        sset_local = EmbeddingShardSet.build(m, 2)
+        local = sset_local.fetch(
+            {"emb_stack": np.asarray([1, 9, 70], np.int64)})
+        sset_local.close()
+        tier = _TcpTier(m, 2, tmp_path)
+        try:
+            remote = tier.sset.fetch(
+                {"emb_stack": np.asarray([1, 9, 70], np.int64)})
+            np.testing.assert_array_equal(remote.rows["emb_stack"],
+                                          local.rows["emb_stack"])
+        finally:
+            tier.close()
+
+    def test_publish_over_wire_idempotent(self, tmp_path):
+        m = _build()
+        tier = _TcpTier(m, 2, tmp_path)
+        key = "hostparams/emb_stack/kernel"
+        payload = {"rows": {key: (np.asarray([3, 7], np.int64),
+                                  np.full((2, 8), 5.5, np.float32))},
+                   "full": {}}
+        try:
+            assert tier.sset.apply_delta(payload, 10) == 1
+            assert tier.sset.apply_delta(payload, 10) == 0   # replay
+            assert tier.sset.version_vector() == {0: 10, 1: 10}
+            r = tier.sset.fetch(
+                {"emb_stack": np.asarray([3, 7], np.int64)})
+            assert np.all(r.rows["emb_stack"] == 5.5)
+        finally:
+            tier.close()
+
+    def test_reordered_delta_chain_version_monotonic(self, tmp_path):
+        """FF_FAULT_NET_REORDER holds a frame server-side until a later
+        one is handled: v11 applies before v10. The version vector must
+        NEVER regress — the stale v10 lands as a no-op."""
+        m = _build()
+        tier = _TcpTier(m, 1, tmp_path)
+        key = "hostparams/emb_stack/kernel"
+
+        def pub(version, val):
+            sub = split_host_rows_by_shard(
+                {"rows": {key: (np.asarray([3], np.int64),
+                                np.full((1, 8), val, np.float32))},
+                 "full": {}}, tier.sset._ranges)[0]
+            tier.sset.shards[0].shard.apply_publish(
+                sub, version, sub["crc"])
+
+        plan = faults.FaultPlan()
+        plan.net_reorder["lookup"] = 1
+        versions = []
+        errors = []
+        with faults.active_plan(plan):
+            ts = [threading.Thread(
+                      target=lambda v=v, x=x: (
+                          pub(v, x)),
+                      daemon=True, name=f"ff-test-pub-{v}")
+                  for v, x in ((10, 1.0), (11, 2.0))]
+            for t in ts:
+                t.start()
+
+            def watch():
+                end = time.monotonic() + 5.0
+                while time.monotonic() < end and \
+                        any(t.is_alive() for t in ts):
+                    versions.append(tier.sset.shards[0].shard.version)
+                    time.sleep(0.005)
+
+            w = threading.Thread(target=watch, daemon=True,
+                                 name="ff-test-watch")
+            w.start()
+            for t in ts:
+                t.join(10.0)
+            w.join(10.0)
+        try:
+            assert not errors
+            assert tier.sset.shards[0].shard.version == 11
+            # monotonic: the observed version sequence never decreases
+            for a, b in zip(versions, versions[1:]):
+                assert b >= a, versions
+            assert wire_stats()["lookup"].get("reorders", 0) >= 1
+        finally:
+            tier.close()
+
+    def test_dead_server_degrades_never_fails(self, tmp_path):
+        m = _build()
+        cfg = ShardTierConfig(nshards=2, eject_after=1, retries=0,
+                              cooldown_s=0.0,
+                              lookup_deadline_ms=300.0)
+        tier = _TcpTier(m, 2, tmp_path, config=cfg)
+        eng = InferenceEngine(m, ServeConfig(max_batch=BS),
+                              shard_set=tier.sset).start()
+        x = _rows(8)
+        try:
+            assert eng.predict(
+                {k: v[:8] for k, v in x.items()}).degraded is False
+            tier.servers[0].close()   # the process "dies"
+            deadline = time.monotonic() + 10.0
+            degraded = False
+            while time.monotonic() < deadline and not degraded:
+                p = eng.predict({k: v[:8] for k, v in x.items()},
+                                timeout=10.0)
+                degraded = p.degraded   # NEVER raises: zero failed
+            assert degraded
+        finally:
+            eng.close()
+            tier.close()
+
+    def test_remote_shard_refresh_caches_meta(self, tmp_path):
+        m = _build()
+        tier = _TcpTier(m, 2, tmp_path)
+        try:
+            rs = tier.sset.shards[1].shard
+            assert isinstance(rs, RemoteShard)
+            meta = rs.refresh()
+            assert meta["slot"] == 1
+            assert rs.version == meta["version"]
+            assert rs.hbm_bytes() > 0
+            assert rs.supports_persist is False
+            st = rs.stats()
+            assert st["remote"] is True
+        finally:
+            tier.close()
+
+    def test_connect_fails_fast_on_dead_address(self, tmp_path):
+        m = _build()
+        EmbeddingShardSet.seed_shard_cache(m, 1, str(tmp_path))
+        cfg = ShardTierConfig(nshards=1, retries=0)
+        with pytest.raises((WireError, OSError)):
+            EmbeddingShardSet.connect([("127.0.0.1", 1)], config=cfg,
+                                      cache_dir=str(tmp_path))
+
+
+# ---------------------------------------------------------------------
+# shard cache meta sidecar (connect() geometry without a live model)
+# ---------------------------------------------------------------------
+class TestShardCacheMeta:
+    def test_seed_writes_meta_and_slices(self, tmp_path):
+        m = _build()
+        cache = EmbeddingShardSet.seed_shard_cache(m, 2, str(tmp_path))
+        meta = cache.get_meta(2)
+        assert meta is not None
+        assert meta["nshards"] == 2
+        assert "emb_stack" in meta["ranges"]
+        for slot in range(2):
+            blocks, ver, crc = cache.get(2, slot)
+            assert blocks is not None
+
+    def test_meta_nshards_mismatch_rejected(self, tmp_path):
+        m = _build()
+        cache = EmbeddingShardSet.seed_shard_cache(m, 2, str(tmp_path))
+        assert cache.get_meta(3) is None
+
+    def test_corrupt_meta_rejected_with_reason(self, tmp_path):
+        m = _build()
+        cache = EmbeddingShardSet.seed_shard_cache(m, 2, str(tmp_path))
+        meta_files = [f for f in os.listdir(tmp_path)
+                      if f.endswith(".meta.json")]
+        assert len(meta_files) == 1
+        with open(os.path.join(tmp_path, meta_files[0]), "w") as f:
+            f.write("{ torn")
+        assert cache.get_meta(2) is None
+        assert "meta" in cache.stats()["last_reject"]
+
+    def test_build_shard_without_meta_exits_with_seed_hint(self,
+                                                           tmp_path):
+        with pytest.raises(SystemExit, match="seed_shard_cache"):
+            build_shard(str(tmp_path), 2, 0)
+
+
+# ---------------------------------------------------------------------
+# dispatch seam: EngineServer / RemoteEngineClient / Fleet.connect
+# ---------------------------------------------------------------------
+class TestDispatchSeam:
+    def _served_engine(self):
+        m = _build()
+        eng = InferenceEngine(m, ServeConfig(max_batch=BS)).start()
+        srv = EngineServer(eng).start()
+        return m, eng, srv
+
+    def test_remote_predict_bit_identical(self):
+        m, eng, srv = self._served_engine()
+        x = _rows(8)
+        try:
+            local = eng.predict({k: v[:8] for k, v in x.items()})
+            remote = RemoteEngineClient(srv.address, rid=0)
+            p = remote.predict({k: v[:8] for k, v in x.items()})
+            np.testing.assert_array_equal(np.asarray(p.scores),
+                                          np.asarray(local.scores))
+            assert p.version == local.version
+            remote.close()
+        finally:
+            srv.close()
+            eng.close()
+
+    def test_healthz_and_stats_over_wire(self):
+        m, eng, srv = self._served_engine()
+        try:
+            remote = RemoteEngineClient(srv.address, rid=3)
+            assert remote.healthz()["ok"] is True
+            st = remote.stats()
+            # the engine-stats shape Fleet.stats() sums over
+            for k in ("requests", "responses", "overloaded", "timeouts",
+                      "batches", "queue_depth", "reloads",
+                      "reload_rejects"):
+                assert k in st
+            assert st["remote"] is True and st["replica_id"] == 3
+            remote.close()
+        finally:
+            srv.close()
+            eng.close()
+
+    def test_unreachable_healthz_reports_not_ok(self):
+        remote = RemoteEngineClient(("127.0.0.1", 1), rid=0,
+                                    retries=0)
+        hz = remote.healthz()
+        assert hz["ok"] is False and hz["reason"]
+        st = remote.stats()
+        assert st["requests"] == 0 and "unreachable" in str(st)
+        remote.close()
+
+    def test_fleet_connect_routes_and_aggregates(self):
+        m, eng, srv = self._served_engine()
+        x = _rows(4)
+        try:
+            fleet = Fleet.connect([srv.address])
+            router = FleetRouter(fleet, RouterConfig(retries=1))
+            router.start()
+            p = router.predict({k: v[:4] for k, v in x.items()})
+            assert p.scores is not None
+            st = fleet.stats()
+            assert st["totals"]["requests"] >= 1
+            assert st["size"] == 1
+            router.close()
+        finally:
+            srv.close()
+            eng.close()
+
+    def test_deploys_are_inproc_only(self):
+        m, eng, srv = self._served_engine()
+        try:
+            # two clients to the same server: enough healthy replicas
+            # that start_canary reaches the remote _load_state guard
+            fleet = Fleet.connect([srv.address, srv.address])
+            router = FleetRouter(fleet, RouterConfig())
+            router.start()
+            with pytest.raises(RuntimeError, match="inproc-only"):
+                router.start_canary(lambda e: None)
+            with pytest.raises(RuntimeError,
+                               match="own process"):
+                fleet.replicas[0].engine.state_snapshot()
+            router.close()
+        finally:
+            srv.close()
+            eng.close()
+
+
+# ---------------------------------------------------------------------
+# manifest seam: watcher over the wire
+# ---------------------------------------------------------------------
+class TestWatcherWire:
+    def _published(self, tmp_path, step=5):
+        trainer = _build(seed=2)
+        trainer._step = step
+        ckpt = tmp_path / "ckpt"
+        mgr = CheckpointManager(str(ckpt), keep_last=3)
+        mgr.save(trainer)
+        return trainer, mgr, str(ckpt)
+
+    def _wire_watcher(self, engine, ckpt, spool, **src_kw):
+        srv = SnapshotServer(ckpt).start()
+        cli = WireClient(srv.address, seam=tp.SEAM_MANIFEST,
+                         name="watch", **src_kw.pop("client_kw", {}))
+        src = SnapshotWireSource(cli, str(spool), **src_kw)
+        return srv, cli, SnapshotWatcher(engine, ckpt, wire=src)
+
+    def test_restore_over_wire(self, tmp_path):
+        _, _, ckpt = self._published(tmp_path)
+        eng = InferenceEngine(_build(seed=2))
+        srv, cli, w = self._wire_watcher(eng, ckpt,
+                                         tmp_path / "spool")
+        try:
+            assert w.poll_once() is True
+            assert eng.version == 5
+            st = w.stats()
+            assert st["wire_retries"] == 0
+            assert st["last_wire_error"] == ""
+        finally:
+            cli.close()
+            srv.close()
+
+    def test_wire_failure_counts_retries_and_surfaces_error(
+            self, tmp_path):
+        _, _, ckpt = self._published(tmp_path)
+        eng = InferenceEngine(_build(seed=2))
+        srv, cli, w = self._wire_watcher(
+            eng, ckpt, tmp_path / "spool", retries=2, backoff_s=0.01,
+            client_kw={"retries": 0, "default_deadline_s": 2.0})
+        srv.close()   # the publisher process is gone
+        try:
+            assert w.poll_once() is False
+            st = w.stats()
+            assert st["wire_retries"] >= 2
+            assert st["last_wire_error"]
+        finally:
+            cli.close()
+
+    def test_delta_chain_applies_over_wire(self, tmp_path):
+        # a trained base + delta chain, fetched entirely over the wire,
+        # restores to the same forward outputs as the live trainer
+        from dlrm_flexflow_tpu.data.stream import ArrayStream
+        from dlrm_flexflow_tpu.utils.delta import DeltaPublisher
+        trainer = _build(seed=2)
+        ckpt = str(tmp_path / "ckpt")
+        pub = DeltaPublisher(trainer, ckpt, row_delta_min_elems=0,
+                             compact_frac=100.0)
+        X, Y = synthetic_batch(DCFG, 64, seed=0)
+        trainer.fit_stream(ArrayStream(X, Y, BS, seed=1), steps=8,
+                           publisher=pub, publish_every=4,
+                           verbose=False)
+        assert pub.stats()["delta_publishes"] >= 1   # has a delta link
+        eng = InferenceEngine(_build(seed=2))
+        srv, cli, w = self._wire_watcher(eng, ckpt,
+                                         tmp_path / "spool")
+        try:
+            assert w.poll_once() is True
+            assert eng.version == 8
+            a = np.asarray(eng.model.forward_batch(X))
+            b = np.asarray(trainer.forward_batch(X))
+            np.testing.assert_array_equal(a, b)
+        finally:
+            cli.close()
+            srv.close()
