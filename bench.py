@@ -319,6 +319,22 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             obs = {"error": str(exc)[:200]}
 
+    # opt-in closed-loop online-learning smoke (BENCH_SCENARIO=1): the
+    # compressed drifting-zipf replay — feedback-spool training, delta
+    # publication, live hot/cold re-placement — reporting AUC / p99 /
+    # fleet size / freshness lag and whether every budget held with
+    # chaos active
+    scenario = None
+    if os.environ.get("BENCH_SCENARIO"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_scenario import measure as _scn_measure
+            scenario = _scn_measure(
+                steps=int(os.environ.get("BENCH_SCENARIO_STEPS", "48")))
+        except Exception as exc:
+            scenario = {"error": str(exc)[:200]}
+
     vs = 1.0
     base_file = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE")
     if os.path.exists(base_file):
@@ -360,6 +376,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         out["quant"] = quant
     if obs is not None:
         out["obs"] = obs
+    if scenario is not None:
+        out["scenario"] = scenario
     print(json.dumps(out))
     return 0
 

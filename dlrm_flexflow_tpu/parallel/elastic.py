@@ -340,3 +340,72 @@ def expand(model, returned: Sequence = (), mode: Optional[str] = None,
         " via plan cache" if report.plan_cache_hit else "",
         1e3 * report.replan_s, 1e3 * report.reshard_s, report.step)
     return report
+
+
+def replace_placement(model, sketches=None, strategies=None,
+                      budget: Optional[int] = None, seed: int = 0,
+                      plan_cache=None) -> RecoveryReport:
+    """Re-place `model` for DRIFTED traffic on its CURRENT devices — the
+    third elastic verb (``serve/replace.py`` drives it per replica when
+    the live id sketch diverges from the searched histogram).
+
+    Same machinery as :func:`recover`/:func:`expand` with neither shrink
+    nor growth: quiesce → re-search hot/cold placement warm-started from
+    the running plan with `sketches` (the live id distribution) attached
+    (``search.replan.replace_strategies`` — its plan-cache key carries a
+    sketch digest so the pre-drift entry cannot satisfy it) → rebuild
+    the mesh over the SAME device set → recompile → restore the gathered
+    in-memory state. Always ``"inplace"`` — there is no lost device to
+    resume around, and the caller is typically a serving engine whose
+    params came from a snapshot watcher, not a manager.
+
+    Callers that already searched (one search fanned out to N replicas)
+    pass `strategies` to skip the per-replica re-search; `sketches` is
+    still attached so the post-swap cost model and any later publish see
+    the distribution this placement was searched with.
+    """
+    t_start = time.perf_counter()
+    budget = _resolve_budget(model, budget)
+    if plan_cache is None:
+        plan_cache = getattr(model, "_plan_cache", None)
+    if model.mesh is None:
+        raise ValueError(
+            "replace_placement() needs a compiled model (no mesh)")
+
+    if hasattr(model, "_host_abandon"):
+        model._host_abandon()
+
+    devices = list(model.mesh.devices.flat)
+    info: Dict[str, float] = {}
+    if strategies is None:
+        from ..search.replan import replace_strategies
+        strategies, info = replace_strategies(
+            model, sketches=sketches, old=model.strategies,
+            ndev=len(devices), budget=budget, seed=seed,
+            plan_cache=plan_cache)
+    elif sketches:
+        model.attach_id_histograms(sketches)
+
+    entry, reshard_s = _reshard_onto(model, devices, strategies,
+                                     "inplace", None)
+
+    report = RecoveryReport(
+        mode="inplace", lost=[], surviving=len(devices),
+        strategies=strategies, step=int(model._step),
+        replan_s=float(info.get("replan_s", 0.0)),
+        reshard_s=reshard_s,
+        total_s=time.perf_counter() - t_start,
+        searched=bool(info.get("searched", False)),
+        greedy_fallback=bool(info.get("greedy_fallback", False)),
+        kind="replace",
+        plan_cache_hit=bool(info.get("plan_cache_hit", False)),
+        entry=entry)
+    log_elastic.warning(
+        "online re-placement: %d devices unchanged, replan %.0f ms "
+        "(%s), reshard %.0f ms, step %d",
+        len(devices), 1e3 * report.replan_s,
+        "caller-searched" if not info else (
+            "plan cache" if report.plan_cache_hit
+            else ("searched" if report.searched else "greedy clamp")),
+        1e3 * report.reshard_s, report.step)
+    return report

@@ -1,0 +1,8 @@
+"""End-to-end online-learning scenarios: a 24h traffic day compressed
+into a budgeted pass/fail run (``python -m dlrm_flexflow_tpu.scenarios
+--scenario drifting_zipf``). See ``runner.py`` for the harness and
+``data/replay.py`` for the traces it drives."""
+
+from .runner import ScenarioBudgets, run_scenario
+
+__all__ = ["ScenarioBudgets", "run_scenario"]

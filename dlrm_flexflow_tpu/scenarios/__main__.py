@@ -1,0 +1,57 @@
+"""CLI for the online-learning scenarios:
+
+    python -m dlrm_flexflow_tpu.scenarios --scenario drifting_zipf
+    python -m dlrm_flexflow_tpu.scenarios --scenario diurnal --fast
+
+Prints one JSON verdict (metrics + budgets + pass/fail) and exits 0
+only when every budget held. ``--fast`` compresses the day to seconds
+(the tier-1 smoke profile); the default profile paces requests by the
+trace's interarrival times. ``--no-chaos`` drops the mid-day fault
+window (replica outage + torn delta + feedback loss) for debugging a
+failing budget without the noise."""
+
+import argparse
+import json
+import sys
+
+from ..data.replay import SCENARIOS
+from .runner import run_scenario
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dlrm_flexflow_tpu.scenarios",
+        description="closed-loop online-learning scenario runner")
+    ap.add_argument("--scenario", choices=SCENARIOS,
+                    default="drifting_zipf")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="trace length (default 240, 48 with --fast)")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="initial fleet size (default 2, 1 with --fast)")
+    ap.add_argument("--fast", action="store_true",
+                    help="seconds-long smoke profile, no pacing sleeps")
+    ap.add_argument("--replace-drift-threshold", type=float,
+                    default=None, metavar="TV",
+                    help="total-variation divergence that triggers an "
+                         "online re-placement (default 0.35, or 0.30 "
+                         "with --fast)")
+    ap.add_argument("--feedback-spool", type=int, default=256,
+                    metavar="N", help="feedback spool capacity in "
+                    "batches (default 256)")
+    ap.add_argument("--no-chaos", action="store_true",
+                    help="skip the mid-scenario fault window")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    verdict = run_scenario(
+        args.scenario, steps=args.steps, fast=args.fast,
+        replicas=args.replicas,
+        drift_threshold=args.replace_drift_threshold,
+        feedback_spool=args.feedback_spool,
+        chaos=not args.no_chaos, seed=args.seed)
+    print(json.dumps(verdict, indent=2, default=str))
+    return 0 if verdict["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

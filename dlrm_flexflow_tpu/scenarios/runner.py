@@ -1,0 +1,389 @@
+"""The closed-loop scenario harness: trainer + publisher + serving
+fleet + autoscaler + re-placement controller driven through one
+compressed traffic day, judged against explicit budgets.
+
+One ``run_scenario`` call wires the WHOLE loop the rest of the repo
+builds piecewise:
+
+    TraceReplay ──requests──▶ FleetRouter ──▶ replicas (engines)
+         │                        │                ▲
+         │ simulated clicks       │ served scores  │ SnapshotWatcher
+         ▼                        ▼                │ (delta chain)
+    FeedbackSpool ──batches──▶ fit_stream ──▶ DeltaPublisher
+                                            (trainer thread)
+
+plus the two control loops riding the traffic: the SLO ``Autoscaler``
+(fleet size) and the ``ReplacementController`` (live sketch vs searched
+histogram → online hot/cold re-placement). Chaos lands mid-day through
+``utils.faults`` (a replica outage, a torn delta, feedback-spool loss)
+— the budgets below must hold WITH the chaos active, that's the point.
+
+The judge is deliberately blunt: a scenario returns one dict with the
+measured metrics, the budgets they were held to, and ``passed``. AUC is
+computed rank-based (Mann–Whitney) over the second half of the day —
+served scores against the simulated clicks the model never saw at
+serve time — so "the model kept learning" is measured at the serving
+edge, not from training loss. Freshness lag is the publisher's tip step
+minus the slowest healthy replica's installed version; spool lag is the
+landed-but-unconsumed feedback debt.
+
+``fast=True`` compresses the day to seconds (tier-1 smoke: one replica,
+no pacing sleeps, tiny model); the full profile paces requests by the
+trace's interarrival times and is exercised by the slow test and the
+``BENCH_SCENARIO=1`` bench gate.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..data.replay import FeedbackSpool, TraceReplay, scenario_spec
+from ..utils import faults
+from ..utils.logging import get_logger
+
+log_scn = get_logger("scenarios")
+
+# one tiny DLRM shape shared by every scenario: 4 × 64-row tables keeps
+# compiles in the hundreds of ms on CPU while still giving the placement
+# search real hot/cold structure to move
+TABLES = 4
+ROWS = 64
+BAG = 2
+DENSE_DIM = 4
+
+
+@dataclass
+class ScenarioBudgets:
+    """What the day must hold to pass, chaos included."""
+
+    auc_floor: float = 0.60          # serving-edge AUC, second half
+    p99_ms: float = 2000.0           # client-observed, CPU-noise wide
+    max_fleet: int = 4               # autoscaler cap = cost ceiling
+    freshness_lag: int = 60          # publisher tip - slowest replica
+    spool_lag: int = 64              # landed-but-unconsumed feedback
+    replacements: Optional[int] = None   # exact count; None = don't judge
+    failed: int = 0                  # client requests that raised. Zero.
+    step_time_ratio: float = 2.0     # post-swap mean / pre-swap mean
+
+    def judge(self, m: Dict[str, Any]) -> List[str]:
+        bad = []
+        if m["auc"] < self.auc_floor:
+            bad.append(f"auc {m['auc']:.3f} < floor {self.auc_floor:g}")
+        if m["p99_ms"] is not None and m["p99_ms"] > self.p99_ms:
+            bad.append(f"p99 {m['p99_ms']:.1f} ms > {self.p99_ms:g} ms")
+        if m["fleet_max"] > self.max_fleet:
+            bad.append(f"fleet grew to {m['fleet_max']} > cap "
+                       f"{self.max_fleet}")
+        if m["freshness_lag"] > self.freshness_lag:
+            bad.append(f"freshness lag {m['freshness_lag']} steps > "
+                       f"{self.freshness_lag}")
+        if m["spool_lag"] > self.spool_lag:
+            bad.append(f"feedback spool lag {m['spool_lag']} > "
+                       f"{self.spool_lag}")
+        if self.replacements is not None and \
+                m["replacements"] != self.replacements:
+            bad.append(f"{m['replacements']} re-placements != expected "
+                       f"{self.replacements}")
+        if m["failed"] > self.failed:
+            bad.append(f"{m['failed']} failed requests (budget "
+                       f"{self.failed})")
+        if m["step_time_ratio"] is not None and \
+                m["step_time_ratio"] > self.step_time_ratio:
+            bad.append(f"step time ratio {m['step_time_ratio']:.2f} > "
+                       f"{self.step_time_ratio:g}")
+        return bad
+
+
+def default_budgets(scenario: str, fast: bool) -> ScenarioBudgets:
+    b = ScenarioBudgets()
+    if scenario == "drifting_zipf":
+        # the churn must trigger EXACTLY one online re-placement
+        b.replacements = 1
+    else:
+        # a QPS wave (diurnal) or flash crowd moves load, not the id
+        # DISTRIBUTION — re-planning placement for it would be thrash
+        b.replacements = 0
+    if fast:
+        b.p99_ms = 5000.0       # tier-1 machines are noisy
+        # sub-ms steps + ~7 post-swap samples make the ratio a coarse
+        # smoke check here; the paced profile holds the real bar
+        b.step_time_ratio = 6.0
+        b.auc_floor = 0.55      # the compressed day trains on ~10x
+        # fewer clicks; untrained serves ~0.50, so this still proves
+        # the loop learned
+    return b
+
+
+def auc_rank(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Rank-based (Mann–Whitney) AUC; 0.5 for degenerate label sets."""
+    y = np.asarray(labels, np.float64).reshape(-1)
+    s = np.asarray(scores, np.float64).reshape(-1)
+    pos = int((y > 0.5).sum())
+    neg = y.size - pos
+    if pos == 0 or neg == 0:
+        return 0.5
+    order = np.argsort(s, kind="mergesort")
+    ranks = np.empty(y.size, np.float64)
+    ranks[order] = np.arange(1, y.size + 1)
+    # midranks over score ties, else AUC depends on sort stability
+    s_sorted = s[order]
+    i = 0
+    while i < y.size:
+        j = i
+        while j + 1 < y.size and s_sorted[j + 1] == s_sorted[i]:
+            j += 1
+        if j > i:
+            ranks[order[i:j + 1]] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    return float((ranks[y > 0.5].sum() - pos * (pos + 1) / 2.0)
+                 / (pos * neg))
+
+
+def _build_model(seed: int):
+    import dlrm_flexflow_tpu as ff
+    from ..models.dlrm import DLRMConfig, build_dlrm
+    from ..parallel.mesh import make_mesh
+
+    dcfg = DLRMConfig(embedding_size=[ROWS] * TABLES,
+                      embedding_bag_size=BAG,
+                      sparse_feature_size=8,
+                      mlp_bot=[DENSE_DIM, 16, 8],
+                      mlp_top=[40, 16, 1])
+    model = ff.FFModel(ff.FFConfig(batch_size=8, seed=seed))
+    build_dlrm(model, dcfg)
+    import jax
+    model.compile(ff.SGDOptimizer(lr=0.3), "mean_squared_error",
+                  ["mse"], mesh=make_mesh(devices=jax.devices()[:1]))
+    model.init_layers()
+    return model
+
+
+def run_scenario(scenario: str, steps: Optional[int] = None,
+                 fast: bool = False, replicas: Optional[int] = None,
+                 drift_threshold: Optional[float] = None,
+                 feedback_spool: int = 256,
+                 budgets: Optional[ScenarioBudgets] = None,
+                 chaos: bool = True, seed: int = 0,
+                 checkpoint_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Run one scenario end to end; returns the verdict dict (see
+    module docstring). Raises only on setup errors — a failing budget
+    is a ``passed: False`` verdict, not an exception."""
+    import dlrm_flexflow_tpu as ff
+    from ..serve.replace import ReplaceConfig, ReplacementController
+
+    steps = int(steps if steps is not None
+                else (48 if fast else 240))
+    replicas = int(replicas if replicas is not None else (1 if fast
+                                                          else 2))
+    budgets = budgets or default_budgets(scenario, fast)
+    spec = scenario_spec(scenario, steps=steps, batch=8, seed=seed,
+                         rows=ROWS)
+    replay = TraceReplay(TABLES, ROWS, BAG, DENSE_DIM, spec)
+    tmp = None
+    if checkpoint_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="ff-scenario-")
+        checkpoint_dir = tmp.name
+
+    trainer = _build_model(seed=3)
+    pub = ff.DeltaPublisher(trainer, checkpoint_dir,
+                            row_delta_min_elems=0)
+
+    # boot-time warm-up: a served model that has never seen the hotness
+    # signal judges ~0.5 AUC no matter how well the loop works later.
+    # An online system starts from SOME trained checkpoint; ours is a
+    # short replay prefix trained synchronously, published as the base.
+    warm_src = max(32, steps // 4)       # distinct prefix batches...
+    warm = 6 * warm_src                  # ...epoched enough to learn
+    trainer.fit_stream(
+        lambda i: {**replay.request(i % warm_src),
+                   "label": replay.labels(i % warm_src)},
+        steps=warm, publisher=pub, publish_every=warm, verbose=False)
+
+    spool = FeedbackSpool(capacity=feedback_spool)
+    publish_every = 5 if fast else 10
+    train_err: List[BaseException] = []
+
+    def _train():
+        try:
+            trainer.fit_stream(spool.source, steps=None, publisher=pub,
+                               publish_every=publish_every,
+                               verbose=False)
+        except BaseException as e:   # noqa: BLE001 — judged, not raised
+            train_err.append(e)
+
+    def _factory(i):
+        return _build_model(seed=3)
+
+    poll_s = 0.05 if fast else 0.25
+    fleet = ff.Fleet.build(_factory, replicas,
+                           ff.ServeConfig(max_batch=8,
+                                          queue_capacity=1024,
+                                          cache_rows=ROWS // 4,
+                                          poll_s=poll_s))
+    router = ff.FleetRouter(
+        fleet, ff.RouterConfig(retries=4, cooldown_s=0.3,
+                               health_interval_s=poll_s,
+                               probe_deadline_s=30.0)).start()
+    watchers = [ff.SnapshotWatcher(rep.engine, checkpoint_dir,
+                                   poll_s=poll_s).start()
+                for rep in fleet.replicas]
+    scaler = ff.Autoscaler(
+        router, ff.AutoscaleConfig(min_replicas=replicas,
+                                   max_replicas=budgets.max_fleet,
+                                   interval_s=poll_s,
+                                   cooldown_s=4 * poll_s)).start()
+    # the TV of two empirical sketches has a sampling-noise floor of
+    # ~0.2 over this id space at a ~1k-draw window; real churn measures
+    # ~0.45+. The compressed day needs the tighter threshold to catch
+    # the churn before the trace ends; the paced day has draws to spare.
+    if drift_threshold is None:
+        drift_threshold = 0.30 if fast else 0.35
+    rcfg = ReplaceConfig(
+        drift_threshold=drift_threshold,
+        sustain=2 if fast else 3,
+        cooldown_s=2.0 if fast else 10.0,
+        min_observations=512 if fast else 2048,
+        window=1024 if fast else 4096,
+        budget=0 if fast else 20, seed=seed)
+    controller = ReplacementController(router, config=rcfg)
+    # the reference distribution IS the warm-up prefix the served
+    # placement was trained on — not a noisy first-live-window guess
+    controller.seed_baseline(replay.request(i) for i in range(warm_src))
+
+    trainer_t = threading.Thread(target=_train, daemon=True,
+                                 name="ff-scenario-trainer")
+    trainer_t.start()
+
+    # chaos lands in one mid-day window: a finite replica outage (the
+    # router must absorb it), one torn delta (the watcher must reject
+    # and recover), and lossy feedback (the spool must keep feeding)
+    chaos_lo, chaos_hi = int(steps * 0.55), int(steps * 0.70)
+    plan = faults.FaultPlan(
+        replica_down={1: 3} if replicas > 1 else {},
+        torn_deltas=1, feedback_loss_p=0.05) if chaos else None
+
+    failed = 0
+    errors: List[str] = []
+    judged: List[Any] = []          # (step, labels, scores)
+    step_ms: List[float] = []
+    fleet_max = replicas
+    swap_step: Optional[int] = None
+    timeout = 60.0 if fast else 30.0
+    t_run = time.monotonic()
+    chaos_ctx = None
+    try:
+        for i in range(steps):
+            if plan is not None and i == chaos_lo:
+                chaos_ctx = faults.active_plan(plan)
+                chaos_ctx.__enter__()
+                log_scn.info("chaos window open at step %d", i)
+            if chaos_ctx is not None and i == chaos_hi:
+                chaos_ctx.__exit__(None, None, None)
+                chaos_ctx = None
+                log_scn.info("chaos window closed at step %d", i)
+            if not fast:
+                time.sleep(min(spec.interarrival_s(i), 0.05))
+            feats = replay.request(i)
+            t0 = time.monotonic()
+            scores = None
+            try:
+                pred = router.predict(feats, timeout=timeout)
+                scores = np.asarray(pred.scores)
+            except Exception as e:   # noqa: BLE001 — budgeted
+                failed += 1
+                errors.append(f"step {i}: {type(e).__name__}: {e}")
+            step_ms.append(1e3 * (time.monotonic() - t0))
+            if scores is not None:
+                controller.observe(feats)
+                if controller.tick() is not None and swap_step is None:
+                    swap_step = i
+                labels = replay.labels(i, feats)
+                judged.append((i, labels, scores))
+                spool.offer(feats, labels, scores=scores, step=i)
+            fleet_max = max(fleet_max, len(fleet.replicas))
+        # drain: let the trainer catch up and the tip propagate
+        spool.close()
+        trainer_t.join(timeout)
+        deadline = time.monotonic() + (5.0 if fast else 15.0)
+        tip = int(pub.stats()["last_step"] or 0)
+        while time.monotonic() < deadline:
+            vers = [int(rep.engine.version) for rep in fleet.replicas]
+            if vers and min(vers) >= tip:
+                break
+            time.sleep(poll_s)
+    finally:
+        if chaos_ctx is not None:
+            chaos_ctx.__exit__(None, None, None)
+        controller.close()
+        scaler.close()
+        for w in watchers:
+            w.stop()
+        router.close()
+        if tmp is not None:
+            tmp.cleanup()
+
+    # ---- the judge ---------------------------------------------------
+    half = [(lab, sc) for s, lab, sc in judged if s >= steps // 2]
+    labels = np.concatenate([l for l, _ in half]) if half else \
+        np.zeros((0, 1))
+    scores = np.concatenate([s.reshape(-1, 1) for _, s in half]) \
+        if half else np.zeros((0, 1))
+    rstats = router.stats()
+    vers = [int(rep.engine.version) for rep in fleet.replicas]
+    tip = int(pub.stats()["last_step"] or 0)
+    sp = spool.stats()
+    cstats = controller.stats()
+    ratio = None
+    if swap_step is not None:
+        pre = step_ms[:swap_step][-20:]
+        # skip the swap itself and the first post-swap dispatches (the
+        # re-placed exec warms its AOT cache there); medians + a 1 ms
+        # denominator floor keep sub-ms CPU steps from turning one
+        # compile blip into a 30x "regression"
+        post = step_ms[swap_step + 4:][:20]
+        if pre and post:
+            ratio = float(np.median(post) / max(np.median(pre), 1.0))
+    metrics = {
+        "auc": auc_rank(labels, scores),
+        "p99_ms": rstats.get("p99_ms"),
+        "fleet_max": fleet_max,
+        "freshness_lag": max(0, tip - min(vers)) if vers else tip,
+        "spool_lag": int(sp["lag"]),
+        "replacements": int(cstats["replacements"]),
+        "replace_report": cstats["last_report"],
+        "failed": failed,
+        "step_time_ratio": ratio,
+        "swap_step": swap_step,
+        "publisher_tip": tip,
+        "replica_versions": vers,
+        "spool": sp,
+        "judged_requests": int(labels.size),
+        "trainer_error": str(train_err[0]) if train_err else None,
+        "wall_s": time.monotonic() - t_run,
+    }
+    failures = budgets.judge(metrics)
+    if train_err:
+        failures.append(f"trainer died: {train_err[0]}")
+    verdict = {
+        "scenario": scenario,
+        "steps": steps,
+        "fast": fast,
+        "chaos": bool(chaos),
+        "passed": not failures,
+        "failures": failures,
+        "metrics": metrics,
+        "budgets": asdict(budgets),
+        "errors": errors[:10],
+    }
+    log_scn.info("scenario %s: %s (%d steps in %.1fs, auc %.3f, "
+                 "%d re-placement(s), %d failed)", scenario,
+                 "PASS" if verdict["passed"] else "FAIL", steps,
+                 metrics["wall_s"], metrics["auc"],
+                 metrics["replacements"], failed)
+    return verdict

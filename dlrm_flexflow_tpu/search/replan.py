@@ -198,29 +198,33 @@ def clamp_strategies(model, strategies: Optional[StrategyMap],
 
 
 def _plan_cache_key(model, intent: StrategyMap, ndev: int, budget: int,
-                    seed: int) -> str:
+                    seed: int, key_extra: str = "") -> str:
     from ..parallel.mesh import structural_axis_sizes as _sas
     from ..utils.warmcache import (PlanCache, graph_fingerprint,
                                    strategy_signature)
     return (PlanCache.key(graph_fingerprint(model), ndev, _sas(ndev),
                           budget, seed)
-            + f"|start={strategy_signature(intent)}")
+            + f"|start={strategy_signature(intent)}" + key_extra)
 
 
 def _searched_plan(model, intent: StrategyMap, ndev: int, budget: int,
                    seed: int, cost_model, plan_cache,
-                   hbm_bytes=None) -> Tuple[StrategyMap, Dict[str, float]]:
+                   hbm_bytes=None, key_extra: str = ""
+                   ) -> Tuple[StrategyMap, Dict[str, float]]:
     """Shared shrink/grow core: project `intent` onto `ndev` (may raise
     ClampError), then search from the projection under `budget` —
     consulting/filling the plan cache around the whole thing. The cache
     key pins (graph, topology, warm-start, budget, seed), every input
-    the deterministic result depends on."""
+    the deterministic result depends on; callers whose result depends on
+    MORE (the drift re-planner's observed distribution) extend it via
+    ``key_extra``."""
     t0 = time.perf_counter()
     info: Dict[str, float] = {"searched": False, "greedy_fallback": True,
                               "plan_cache_hit": False}
     key = None
     if plan_cache is not None:
-        key = _plan_cache_key(model, intent, ndev, budget, seed)
+        key = _plan_cache_key(model, intent, ndev, budget, seed,
+                              key_extra)
         hit = plan_cache.get(key, ndev)
         if hit is not None:
             info["searched"] = bool(hit["searched"])
@@ -306,3 +310,40 @@ def expand_strategies(model, ndev: int,
         intent.setdefault(name, pc)
     return _searched_plan(model, intent, ndev, budget, seed, cost_model,
                           plan_cache, hbm_bytes=hbm_bytes)
+
+
+def replace_strategies(model, sketches=None,
+                       old: Optional[StrategyMap] = None,
+                       ndev: Optional[int] = None,
+                       budget: int = 100, seed: int = 0,
+                       cost_model=None, plan_cache=None,
+                       hbm_bytes=None,
+                       ) -> Tuple[StrategyMap, Dict[str, float]]:
+    """Re-plan hot/cold placement for DRIFTED traffic on an UNCHANGED
+    device count (the online re-placement path, ``serve/replace.py``).
+
+    The device topology is the same — what moved is the observed id
+    distribution: `sketches` ({op -> IdFrequencySketch}, the live
+    serving-side counts) is attached to the model so the skew cost terms
+    (dedup pricing, hot-mass pricing — PR 11) see the NEW hot set, then
+    the search runs warm-started from the running plan `old` exactly
+    like a shrink/grow re-plan. Because (graph, topology, budget, seed,
+    warm-start) are all unchanged from the original search, the plan
+    cache key is extended with a digest of the sketches — without it the
+    cache would return the pre-drift plan and online re-placement would
+    be a cache-shaped no-op.
+
+    Returns ``(strategies, info)`` with the :func:`replan_strategies`
+    info keys. Deterministic for fixed (model, sketches, old, budget,
+    seed); with ``budget=0`` the clamp of the running plan onto the same
+    device count is the identity, which callers use as a bitwise-safe
+    rehearsal of the swap machinery.
+    """
+    from ..utils.histogram import sketch_signature
+    n = int(ndev if ndev is not None else model.mesh.size)
+    if sketches:
+        model.attach_id_histograms(sketches)
+    old = old if old is not None else dict(model.strategies or {})
+    return _searched_plan(model, old, n, budget, seed, cost_model,
+                          plan_cache, hbm_bytes=hbm_bytes,
+                          key_extra=f"|sketch={sketch_signature(sketches)}")

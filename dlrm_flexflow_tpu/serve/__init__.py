@@ -9,6 +9,7 @@ from .cache import EmbeddingCache
 from .engine import (DeadlineExceeded, InferenceEngine, Overloaded,
                      Prediction, ReplicaDown, ServeConfig, percentile)
 from .fleet import CircuitBreaker, Fleet, Replica
+from .replace import ReplaceConfig, ReplacementController
 from .router import FleetRouter, FleetUnavailable, RouterConfig
 from .shardtier import (EmbeddingShard, EmbeddingShardSet, ShardDown,
                         ShardLookupTimeout, ShardReplica,
@@ -27,6 +28,7 @@ __all__ = ["InferenceEngine", "ServeConfig", "Prediction", "Overloaded",
            "SnapshotWatcher", "Fleet", "Replica", "CircuitBreaker",
            "FleetRouter", "FleetUnavailable", "RouterConfig",
            "percentile", "Autoscaler", "AutoscaleConfig",
+           "ReplacementController", "ReplaceConfig",
            "EmbeddingShardSet", "EmbeddingShard", "ShardReplica",
            "ShardTierConfig", "ShardDown", "ShardLookupTimeout",
            "ShardTierUnavailable", "check_serving_feasible",

@@ -506,36 +506,49 @@ class InferenceEngine:
                         if not r.future.done():
                             r.future.set_exception(e)
 
-    def _prewarm_cache(self) -> None:
+    def prewarm_cache_from(self, sketches) -> None:
+        """Pre-warm the embedding-row cache from LIVE sketches ({op ->
+        IdFrequencySketch}) instead of a published file — the online
+        re-placement controller calls this right after a placement swap
+        so the cache restarts hot against the NEW distribution."""
+        self._prewarm_cache(hists=sketches)
+
+    def _prewarm_cache(self, hists=None) -> None:
         """Pre-warm the embedding-row cache from a published
         id-frequency histogram (``--serve-cache-warm PATH``: the
         ``id_histogram.npz`` a DeltaPublisher writes next to its
-        snapshots, or the checkpoint directory holding one). Sample
+        snapshots, or the checkpoint directory holding one), or from the
+        in-memory ``hists`` mapping when one is passed. Sample
         index tuples are drawn from the per-table observed marginals —
         zipfian traffic concentrates on few tuples, so a fresh replica
         starts with the hot working set cached instead of paying cold
         host gathers for it. Non-fatal: a missing/foreign histogram
         just starts cold."""
-        if self._cache is None or not self.config.cache_warm:
+        if self._cache is None:
+            return
+        if hists is None and not self.config.cache_warm:
             return
         if getattr(self._model, "_host_tables_released", False):
             log_serve.info("cache pre-warm skipped: ranker tables "
                            "released to the shard tier (warm hits come "
                            "from live traffic instead)")
             return
-        import os
+        if hists is None:
+            import os
 
-        from ..utils.histogram import HISTOGRAM_FILE, load_histograms
-        path = self.config.cache_warm
-        if os.path.isdir(path):
-            path = os.path.join(path, HISTOGRAM_FILE)
-        try:
-            hists = load_histograms(path)
-        except (IOError, OSError, ValueError, KeyError) as e:
-            log_serve.warning(
-                "cache pre-warm skipped: cannot read id histogram "
-                "%s (%s)", path, e)
-            return
+            from ..utils.histogram import HISTOGRAM_FILE, load_histograms
+            path = self.config.cache_warm
+            if os.path.isdir(path):
+                path = os.path.join(path, HISTOGRAM_FILE)
+            try:
+                hists = load_histograms(path)
+            except (IOError, OSError, ValueError, KeyError) as e:
+                log_serve.warning(
+                    "cache pre-warm skipped: cannot read id histogram "
+                    "%s (%s)", path, e)
+                return
+        else:
+            path = "<live sketches>"
         model = self._model
         rng = np.random.RandomState(0)
         n = max(min(self.config.cache_rows, 2048), 1)
@@ -782,14 +795,19 @@ class InferenceEngine:
         with self._swap_lock:
             # a FULL install replaces the whole state: everything queued
             # before it (older fulls, incremental deltas) is superseded —
-            # release their waiters, the engine moves straight past them
+            # release their waiters, the engine moves straight past them.
+            # Parked quiesced CALLS are not state and survive in order (a
+            # re-placement recompile must not be silently dropped by a
+            # concurrent publish).
             superseded = self._pending
-            self._pending = [("full", dict(state), int(version), source,
-                              applied)]
+            self._pending = ([e for e in superseded if e[0] == "call"]
+                             + [("full", dict(state), int(version),
+                                 source, applied)])
             self._version = int(version)
             self._reloads += 1
             for entry in superseded:
-                entry[4].set()
+                if entry[0] != "call":
+                    entry[4].set()
         self._await_applied(applied)
 
     def install_delta(self, payload: Dict[str, Any], version: int,
@@ -811,6 +829,37 @@ class InferenceEngine:
             self._reloads += 1
             self._delta_reloads += 1
         self._await_applied(applied)
+
+    def run_quiesced(self, fn, label: str = ""):
+        """Run ``fn()`` on the batcher thread between dispatches and
+        return its result — the generic form of the parked-install
+        contract: the in-flight batch finishes BEFORE ``fn`` runs, the
+        next dispatch runs entirely AFTER it, and no lock is held across
+        the call. The online re-placement path recompiles the model
+        inside one of these, extending old-or-new-never-a-mix from
+        weight swaps to placement swaps; a failed ``fn`` re-raises here
+        (and shows up as a reload reject), leaving the batcher alive.
+        Incoming requests queue for the duration — on a routed fleet the
+        caller ejects the replica first so traffic drains to siblings
+        instead of aging in this queue."""
+        box: Dict[str, Any] = {}
+
+        def call():
+            try:
+                box["result"] = fn()
+            except BaseException as e:   # noqa: BLE001 — re-raised to
+                box["error"] = e         # the run_quiesced caller below
+                raise
+
+        applied = threading.Event()
+        with self._swap_lock:
+            self._pending.append(
+                ("call", call, self._version,
+                 label or getattr(fn, "__name__", "call"), applied))
+        self._await_applied(applied)
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
 
     def _await_applied(self, applied: threading.Event) -> None:
         t = self._thread
@@ -837,6 +886,15 @@ class InferenceEngine:
         for kind, state, version, source, applied in pending:
             t_swap = time.perf_counter()
             try:
+                if kind == "call":
+                    # quiesced callable (run_quiesced): executes with the
+                    # same atomicity as a weight swap — entirely between
+                    # dispatches on this thread — and installs no
+                    # version, so the bookkeeping below is skipped
+                    state()
+                    obstrace.complete("serve/quiesced", t_swap,
+                                      label=source)
+                    continue
                 if kind == "full":
                     host_params = state.get("host_params")
                     if self._shard_set is not None:

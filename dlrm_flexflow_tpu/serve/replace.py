@@ -1,0 +1,408 @@
+"""Online hot/cold re-placement: re-plan a serving fleet for drifted
+traffic without restarting it.
+
+The skew-aware placement (PR 11) is searched against ONE id
+distribution — the histogram observed at training/publish time. Real
+traffic churns: the zipf head rotates onto different rows, the skew
+exponent drifts, and the searched hot set goes cold while a new one
+pays full exchange + cold-cache prices. This module closes that gap as
+a control loop over the serving fleet:
+
+- the controller keeps a LIVE :class:`~..utils.histogram.
+  IdFrequencySketch` per embedding op, fed by ``observe()`` from the
+  router's request stream (same cheap staging-thread numpy as the
+  trainer's ``TouchedRowTracker``);
+- each ``tick()`` compares live vs the BASELINE sketch the current
+  placement was searched with (total-variation ``divergence``, exposed
+  as the ``ff_replace_divergence`` gauge) and debounces the breach
+  through ``watchdog.Sustained`` + a cooldown — one sustained episode
+  fires exactly one re-placement, because the swap rebases the baseline
+  to the drifted distribution (divergence collapses to ~0) and resets
+  the debounce;
+- :meth:`~ReplacementController.replace_now` performs the swap: ONE
+  warm-started re-search (``search.replan.replace_strategies`` — the
+  plan-cache key carries a sketch digest, so the pre-drift entry cannot
+  answer), then a ROLLING per-replica quiesce → recompile → reshard
+  (``parallel.elastic.replace_placement``) executed on each engine's
+  batcher thread via ``run_quiesced`` — in-flight batches finish on the
+  old placement, the next dispatch runs the new one (old-or-new,
+  never a mix, extended from weight swaps to placement swaps). On a
+  multi-replica fleet each replica is EJECTED first so its queue drains
+  onto siblings (the router retries those futures — zero failed
+  requests) and re-admitted by the router's end-to-end probe after the
+  swap; a single-replica fleet swaps in place (requests queue for the
+  recompile — degraded latency, never a failed or garbage answer).
+  Caches re-warm from the new sketch (``EmbeddingCache`` per engine;
+  the shard tier gets a health tick so a degraded slot surfaces now,
+  not at the next client miss).
+
+Fault hooks: ``FF_FAULT_SKETCH_SKEW=op:factor`` corrupts the live
+sketch the trigger reads (consume-once per op) — a lying sketch may
+fire a spurious re-placement, but every plan it installs still serves
+correct answers, which is the actual safety contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..obs import metrics as obsm
+from ..obs import trace as obstrace
+from ..utils import faults
+from ..utils.histogram import IdFrequencySketch
+from ..utils.logging import get_logger
+from ..utils.watchdog import Sustained
+
+log_replace = get_logger("replace")
+
+
+@dataclass
+class ReplaceConfig:
+    """Knobs for the drift trigger and the swap."""
+
+    drift_threshold: float = 0.35   # total-variation trigger level
+    sustain: int = 3                # consecutive breached ticks to fire
+    cooldown_s: float = 30.0        # min seconds between re-placements
+    interval_s: float = 0.5         # policy-thread evaluation period
+    min_observations: int = 512     # live draws before divergence counts
+    budget: int = 0                 # re-search budget (0 = greedy clamp)
+    seed: int = 0
+    swap_deadline_s: float = 60.0   # per-replica eject->readmit budget
+    prewarm: bool = True            # re-warm EmbeddingCache from sketch
+    # sliding-window size for the live sketch, in observed draws:
+    # counts are halved whenever the total exceeds it, so recent
+    # traffic dominates and a drift can actually reach the threshold
+    # (a cumulative sketch dilutes new traffic under the old mass and
+    # asymptotes BELOW it). 0 = 2 * min_observations.
+    window: int = 0
+
+    def __post_init__(self):
+        if not 0.0 < self.drift_threshold <= 1.0:
+            raise ValueError(
+                f"drift_threshold must be in (0, 1], got "
+                f"{self.drift_threshold}")
+        if self.sustain < 1:
+            raise ValueError(f"sustain must be >= 1, got {self.sustain}")
+
+
+class ReplacementController:
+    """The drift trigger + rolling placement swap over one fleet (see
+    module docstring). Drive it with ``observe()`` per served batch and
+    either ``tick()`` from your own loop or ``start()`` for the policy
+    thread."""
+
+    def __init__(self, router, baseline: Optional[Dict[str, Any]] = None,
+                 config: Optional[ReplaceConfig] = None, plan_cache=None):
+        self.router = router
+        self.fleet = router.fleet
+        self.config = config or ReplaceConfig()
+        self.plan_cache = plan_cache
+        from ..analysis.sanitizer import make_lock
+        self._lock = make_lock("ReplacementController._lock")
+        self._replace_lock = make_lock("ReplacementController._replace")
+        self._sustained = Sustained(self.config.sustain)
+        self._window = int(self.config.window
+                           or 2 * self.config.min_observations)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._in_progress = False
+        self._last_action_t = 0.0
+        self._replacements = 0
+        self._ticks = 0
+        self._last_divergence: Dict[str, float] = {}
+        self._last_report: Optional[Dict[str, Any]] = None
+        self._decisions: List[Dict[str, Any]] = []
+        # live sketches over the ops the trainer's TouchedRowTracker
+        # sketches (same criteria, same flat rows*tables id space), fed
+        # from SERVED batches instead of trained ones
+        model = self.fleet.replicas[0].engine.model
+        self._sketch_ops = []
+        self._live: Dict[str, IdFrequencySketch] = {}
+        for op in getattr(model, "ops", []):
+            if (op.inputs and hasattr(op, "flat_lookup_ids")
+                    and hasattr(op, "_row_shard_geometry")):
+                rows, _pack, tables = op._row_shard_geometry()
+                self._live[op.name] = IdFrequencySketch(rows * tables)
+                self._sketch_ops.append((op, op.inputs[0].name))
+        # the distribution the CURRENT placement was searched with:
+        # explicit > whatever the model carries (attach_id_histograms) >
+        # self-baselined from the first observed window
+        self._baseline: Dict[str, Any] = dict(
+            baseline if baseline is not None
+            else getattr(model, "_id_histograms", None) or {})
+        obsm.register_collector(self._obs_collect)
+
+    # --- lifecycle ----------------------------------------------------
+    def start(self) -> "ReplacementController":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ff-replace-policy")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(5.0)
+        self._thread = None
+        obsm.unregister_collector(self._obs_collect)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.tick()
+            except Exception:   # noqa: BLE001 — the policy thread must
+                log_replace.exception("re-placement tick failed")
+
+    def _obs_collect(self):
+        yield "ff_replace_total", {}, self._replacements
+        yield "ff_replace_ticks_total", {}, self._ticks
+        for name, div in list(self._last_divergence.items()):
+            yield "ff_replace_divergence", {"op": name}, div
+
+    # --- the live sketch ----------------------------------------------
+    def observe(self, features: Dict[str, np.ndarray]) -> None:
+        """Count one served batch's lookup ids into the live sketches
+        (cheap numpy; callers run it off the dispatch path)."""
+        flats = [(op.name, op.flat_lookup_ids(features[in_name]))
+                 for op, in_name in self._sketch_ops
+                 if features.get(in_name) is not None]
+        with self._lock:
+            for name, ids in flats:
+                sk = self._live[name]
+                sk.observe(ids)
+                if sk.total > self._window:
+                    # exponential decay: halve the window so the sketch
+                    # tracks RECENT traffic (see ReplaceConfig.window)
+                    sk.counts //= 2
+                    sk.total = int(sk.counts.sum())
+
+    def seed_baseline(self, feature_batches) -> None:
+        """Build the reference distribution from explicit traffic — the
+        warm-up prefix the placement was actually trained/searched on —
+        instead of self-baselining from the first live window (an
+        empirical TV against a few-batch baseline is mostly sampling
+        noise; give it the same draw count you expect of the live
+        side)."""
+        base: Dict[str, IdFrequencySketch] = {}
+        for op, _in_name in self._sketch_ops:
+            rows, _pack, tables = op._row_shard_geometry()
+            base[op.name] = IdFrequencySketch(rows * tables)
+        for feats in feature_batches:
+            for op, in_name in self._sketch_ops:
+                x = feats.get(in_name)
+                if x is not None:
+                    base[op.name].observe(op.flat_lookup_ids(x))
+        with self._lock:
+            self._baseline = base
+
+    def _apply_sketch_faults(self) -> None:
+        """FF_FAULT_SKETCH_SKEW lands HERE, persistently corrupting the
+        live counts the trigger reads (consume-once per op)."""
+        for name, sk in self._live.items():
+            skewed = faults.maybe_skew_sketch(name, sk.counts)
+            if skewed is not sk.counts:
+                sk.counts = np.asarray(skewed, np.int64)
+                sk.total = int(sk.counts.sum())
+
+    def divergence(self) -> Dict[str, float]:
+        """Per-op total-variation distance live-vs-baseline (0.0 for
+        ops below ``min_observations`` or without a baseline yet)."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            self._apply_sketch_faults()
+            for name, live in self._live.items():
+                base = self._baseline.get(name)
+                if base is None or live.total < \
+                        self.config.min_observations:
+                    out[name] = 0.0
+                    continue
+                try:
+                    out[name] = live.divergence(base)
+                except ValueError as e:
+                    # a baseline from a differently-built model cannot
+                    # gate this op — surface once, never crash the loop
+                    out[name] = 0.0
+                    log_replace.warning(
+                        "divergence for op %r unavailable: %s", name, e)
+        return out
+
+    # --- the policy ----------------------------------------------------
+    def tick(self) -> Optional[Dict[str, Any]]:
+        """One trigger evaluation; returns the swap report when this
+        tick fired a re-placement, else None."""
+        self._ticks += 1
+        cfg = self.config
+        with self._lock:
+            ready = all(sk.total >= cfg.min_observations
+                        for sk in self._live.values()) \
+                and bool(self._live)
+            if ready and not self._baseline:
+                # self-baseline: the first adequately-observed window IS
+                # the reference distribution when none was provided
+                self._baseline = {n: sk.copy()
+                                  for n, sk in self._live.items()}
+                log_replace.info(
+                    "re-placement baseline self-initialized from the "
+                    "first %d+ observed draws", cfg.min_observations)
+                return None
+        div = self.divergence()
+        self._last_divergence = div
+        worst = max(div.values(), default=0.0)
+        breach = worst > cfg.drift_threshold
+        if not self._sustained.observe(breach):
+            return None
+        if self._in_progress:
+            return None
+        if time.monotonic() - self._last_action_t < cfg.cooldown_s:
+            return None
+        worst_op = max(div, key=div.get)
+        return self.replace_now(
+            reason=f"sketch divergence {worst:.3f} > "
+                   f"{cfg.drift_threshold:g} on {worst_op} "
+                   f"({self._sustained.count} sustained periods)")
+
+    # --- the swap -------------------------------------------------------
+    def replace_now(self, reason: str = "manual") -> Dict[str, Any]:
+        """Search once, swap every replica (rolling, zero failed
+        requests), re-warm caches, rebase the trigger. Returns the
+        report; raises only on misuse (concurrent calls serialize)."""
+        cfg = self.config
+        with self._replace_lock:
+            self._in_progress = True
+            t0 = time.monotonic()
+            try:
+                with self._lock:
+                    sketches = {n: sk.copy()
+                                for n, sk in self._live.items()}
+                    # an un-observed live op falls back to its baseline:
+                    # searching a uniform sketch would UNDO a hot/cold
+                    # placement that is still right for it
+                    for n, base in self._baseline.items():
+                        if sketches.get(n) is None or \
+                                sketches[n].total == 0:
+                            sk = base.copy() if hasattr(base, "copy") \
+                                else base
+                            sketches[n] = sk
+                from ..search.replan import replace_strategies
+                from ..utils.warmcache import strategy_signature
+                model0 = self.fleet.replicas[0].engine.model
+                old_sig = strategy_signature(model0.strategies)
+                with obstrace.span("replace/search"):
+                    strategies, info = replace_strategies(
+                        model0, sketches=sketches,
+                        old=model0.strategies,
+                        ndev=model0.mesh.size, budget=cfg.budget,
+                        seed=cfg.seed, plan_cache=self.plan_cache)
+                swapped = self._rolling_swap(sketches, strategies)
+                if self.fleet.shard_set is not None:
+                    # the tier serves the same rows either way; a tick
+                    # surfaces any degraded slot NOW instead of at the
+                    # first post-swap client miss
+                    self.fleet.shard_set.health_tick()
+                with self._lock:
+                    self._baseline = sketches
+                    for sk in self._live.values():
+                        sk.reset()
+                self._sustained.reset()
+                self._last_action_t = time.monotonic()
+                self._replacements += 1
+                report = {
+                    "reason": reason,
+                    "replicas": swapped,
+                    "duration_s": time.monotonic() - t0,
+                    "searched": bool(info.get("searched", False)),
+                    "plan_cache_hit": bool(info.get("plan_cache_hit",
+                                                    False)),
+                    "replan_s": float(info.get("replan_s", 0.0)),
+                    "strategies_changed":
+                        strategy_signature(strategies) != old_sig,
+                }
+                self._last_report = report
+                self._decisions.append(report)
+                obsm.counter(
+                    "ff_replace_swaps_total",
+                    "online placement re-plans executed").inc()
+                obstrace.instant("replace/swap", reason=reason,
+                                 replicas=len(swapped))
+                log_replace.warning(
+                    "online re-placement done in %.0f ms over %d "
+                    "replica(s) (%s; plan %s): %s",
+                    1e3 * report["duration_s"], len(swapped),
+                    "strategies changed" if report["strategies_changed"]
+                    else "strategies unchanged",
+                    "cache" if report["plan_cache_hit"]
+                    else ("searched" if report["searched"]
+                          else "greedy"), reason)
+                return report
+            finally:
+                self._in_progress = False
+
+    def _rolling_swap(self, sketches: Dict[str, Any],
+                      strategies) -> List[Dict[str, Any]]:
+        """Swap each replica's placement on its own batcher thread; on a
+        multi-replica fleet the replica is ejected first (queue drains
+        onto siblings via router retries — zero failed requests) and
+        comes back through the router's end-to-end probe."""
+        from ..parallel.elastic import replace_placement
+        from .fleet import HEALTHY
+        cfg = self.config
+        out: List[Dict[str, Any]] = []
+        for rep in list(self.fleet.replicas):
+            healthy = [r for r in self.fleet.replicas
+                       if r.state == HEALTHY]
+            eject = rep.state == HEALTHY and len(healthy) > 1
+            if eject:
+                rep.eject("placement swap")
+            t0 = time.monotonic()
+            engine = rep.engine
+
+            def _swap(m=engine.model):
+                return replace_placement(m, sketches=sketches,
+                                         strategies=strategies,
+                                         budget=cfg.budget,
+                                         seed=cfg.seed,
+                                         plan_cache=self.plan_cache)
+
+            report = engine.run_quiesced(_swap, label="replace")
+            if cfg.prewarm:
+                engine.prewarm_cache_from(sketches)
+            readmitted = True
+            if eject:
+                deadline = time.monotonic() + cfg.swap_deadline_s
+                while rep.state != HEALTHY and \
+                        time.monotonic() < deadline:
+                    time.sleep(min(self.router.config.health_interval_s,
+                                   0.05))
+                readmitted = rep.state == HEALTHY
+                if not readmitted:
+                    log_replace.warning(
+                        "replica %d not re-admitted within %.0fs after "
+                        "placement swap (stays ejected; the router "
+                        "keeps probing)", rep.rid, cfg.swap_deadline_s)
+            out.append({"rid": rep.rid, "ejected": eject,
+                        "readmitted": readmitted,
+                        "reshard_s": float(getattr(report, "reshard_s",
+                                                   0.0)),
+                        "swap_s": time.monotonic() - t0})
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            live_total = {n: sk.total for n, sk in self._live.items()}
+        return {
+            "replacements": self._replacements,
+            "ticks": self._ticks,
+            "in_progress": self._in_progress,
+            "last_divergence": dict(self._last_divergence),
+            "live_observations": live_total,
+            "baseline_ops": sorted(self._baseline),
+            "sustained": self._sustained.count,
+            "last_report": self._last_report,
+        }

@@ -33,7 +33,10 @@ reject-with-reason'd to the engine once per cause — plus **exponential
 backoff with jitter** on consecutive failures: a permanently-bad
 manifest is re-polled at up to ``backoff_max_s`` instead of hammered at
 the poll interval, and ``stats()["next_poll_s"]`` shows the current
-pace. Any successful poll resets the backoff.
+pace. Any successful poll resets the backoff — including a poll that
+recorded failures before recovering within the same tick (an install
+landed after a CRC reject, or a chain fallback reached a good full
+snapshot): a recovered watcher returns to the base poll interval.
 """
 
 from __future__ import annotations
@@ -153,20 +156,32 @@ class SnapshotWatcher:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            before = self._reload_failures
-            try:
-                self.poll_once()
-            except Exception as e:   # noqa: BLE001 — the watcher must
-                # never die; a failed poll is a reject, not an outage
-                self._record_failure(f"watcher poll error: {e}")
-                self._engine.record_reload_reject(
-                    f"watcher poll error: {e}")
-            if self._reload_failures > before:
-                self._consecutive_failures += 1
-            else:
-                self._consecutive_failures = 0
-            self._next_poll_s = self._backoff_interval()
+            self._poll_tick()
             self._stop.wait(self._next_poll_s)
+
+    def _poll_tick(self) -> bool:
+        """One watcher iteration: poll, then re-pace. A poll that
+        INSTALLED something is a recovery even when the same poll also
+        recorded failures on the way (a CRC-rejected newest entry before
+        an older one installed, a torn delta chain that fell back to a
+        good full reload) — the watcher returns to the base interval
+        instead of compounding backoff forever after a mid-episode
+        recovery."""
+        before = self._reload_failures
+        reloaded = False
+        try:
+            reloaded = self.poll_once()
+        except Exception as e:   # noqa: BLE001 — the watcher must
+            # never die; a failed poll is a reject, not an outage
+            self._record_failure(f"watcher poll error: {e}")
+            self._engine.record_reload_reject(
+                f"watcher poll error: {e}")
+        if reloaded or self._reload_failures == before:
+            self._consecutive_failures = 0
+        else:
+            self._consecutive_failures += 1
+        self._next_poll_s = self._backoff_interval()
+        return reloaded
 
     def _backoff_interval(self) -> float:
         """Next poll delay: the base interval normally; exponential in

@@ -116,6 +116,13 @@ subprocess kill-test needs):
 - ``FF_FAULT_POISON_RELOAD=1``     scale the params of the next 1
   snapshot the hot-reload loads (valid file, garbage weights — the
   canary auto-rollback trigger)
+- ``FF_FAULT_FEEDBACK_LOSS=0.2``   drop 20% of feedback records before
+  they land in the feedback spool (the serve->train loop must keep
+  converging on the surviving stream; probability in 0..1)
+- ``FF_FAULT_SKETCH_SKEW=emb:10``  scale the hot head of op ``emb``'s
+  LIVE id-frequency sketch by 10x (consume-once per op) — the online
+  re-placement trigger reads a lying sketch and must still only ever
+  install correct plans
 
 Unknown ``FF_FAULT_*`` keys are a WARNING, not a silent no-op: a typo'd
 key used to disable injection entirely, which made a passing resilience
@@ -246,6 +253,17 @@ class FaultPlan:
     # consume-once — deadline/RTT-budget tests need a steadily slow
     # link)
     net_slow_ms: Dict[str, float] = field(default_factory=dict)
+    # probability each offered feedback record (a served batch joined
+    # with its click labels) is DROPPED before it lands in the feedback
+    # spool (the serve->train loop loses a slice of its click stream;
+    # the trainer must keep converging on what survives). Probabilistic
+    # per offer, drawn from a dedicated seeded RNG
+    feedback_loss_p: float = 0.0
+    # op name -> scale factor: corrupt the LIVE id-frequency sketch the
+    # online re-placement trigger reads (consume-once per op) — a skewed
+    # trigger may fire a spurious (or miss a due) re-placement, but any
+    # plan it installs must still be correct: never garbage answers
+    sketch_skew: Dict[str, float] = field(default_factory=dict)
     # record of (hook, detail) actually fired, for test assertions
     fired: List[tuple] = field(default_factory=list)
 
@@ -256,6 +274,9 @@ class FaultPlan:
         # in the same order (seeded, not wall-clock entropy)
         import random as _random
         self._net_rng = _random.Random(0xF0F0)
+        # feedback-loss draws get their own stream so wire-level drops
+        # and spool-level drops stay independently deterministic
+        self._fb_rng = _random.Random(0xFEED)
 
     def _record(self, hook: str, detail) -> None:
         self.fired.append((hook, detail))
@@ -277,7 +298,8 @@ _KNOWN_ENV_KEYS = ("FF_FAULT_NAN_STEPS", "FF_FAULT_TRUNCATE_CKPTS",
                    "FF_FAULT_CACHE_CORRUPT", "FF_FAULT_SHARD_DOWN",
                    "FF_FAULT_LOOKUP_DELAY", "FF_FAULT_QUANT_SCALE",
                    "FF_FAULT_NET_DROP", "FF_FAULT_NET_DUP",
-                   "FF_FAULT_NET_REORDER", "FF_FAULT_NET_SLOW")
+                   "FF_FAULT_NET_REORDER", "FF_FAULT_NET_SLOW",
+                   "FF_FAULT_FEEDBACK_LOSS", "FF_FAULT_SKETCH_SKEW")
 
 
 # --- strict env parsing ----------------------------------------------
@@ -409,12 +431,15 @@ def plan_from_env() -> Optional[FaultPlan]:
     net_dup = os.environ.get("FF_FAULT_NET_DUP", "")
     net_reorder = os.environ.get("FF_FAULT_NET_REORDER", "")
     net_slow = os.environ.get("FF_FAULT_NET_SLOW", "")
+    feedback_loss = os.environ.get("FF_FAULT_FEEDBACK_LOSS", "")
+    sketch_skew = os.environ.get("FF_FAULT_SKETCH_SKEW", "")
     if not any((nan, trunc, aborts, delay, ioerrs, drop, ret,
                 cache_corrupt, stall_coll,
                 serve_delay, corrupt_reload, replica_down,
                 poison_reload, delta_torn, publish_abort, delta_gap,
                 shard_down, lookup_delay, quant_scale,
-                net_drop, net_dup, net_reorder, net_slow)):
+                net_drop, net_dup, net_reorder, net_slow,
+                feedback_loss, sketch_skew)):
         return None
     plan = FaultPlan()
     if nan:
@@ -524,6 +549,26 @@ def plan_from_env() -> Optional[FaultPlan]:
     if net_slow:
         plan.net_slow_ms = _env_seam_pairs("FF_FAULT_NET_SLOW",
                                            net_slow, _env_float)
+    if feedback_loss:
+        plan.feedback_loss_p = _env_float("FF_FAULT_FEEDBACK_LOSS",
+                                          feedback_loss)
+        if not 0.0 <= plan.feedback_loss_p <= 1.0:
+            raise ValueError(
+                f"FF_FAULT_FEEDBACK_LOSS={feedback_loss!r}: drop "
+                f"probability is {plan.feedback_loss_p} (expected 0..1)")
+    for part in sketch_skew.split(","):
+        # 'op:factor' — op names are strings, mirroring QUANT_SCALE
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError(
+                f"FF_FAULT_SKETCH_SKEW={sketch_skew!r}: item {part!r} "
+                f"is missing its ':' (expected 'op:factor', e.g. "
+                f"emb_stack:10)")
+        op_name, factor = part.rsplit(":", 1)
+        plan.sketch_skew[op_name.strip()] = _env_float(
+            "FF_FAULT_SKETCH_SKEW", factor)
     return plan
 
 
@@ -870,6 +915,56 @@ def maybe_corrupt_quant_scale(key: str, scales):
         plan._record("quant_scale", f"{key}:{hit[1]:g}")
     import numpy as np
     return np.asarray(scales, np.float32) * np.float32(hit[1])
+
+
+def take_feedback_loss() -> bool:
+    """True when the next offered feedback record should be DROPPED
+    before it lands in the feedback spool
+    (``FF_FAULT_FEEDBACK_LOSS=p``): the serve->train loop loses a slice
+    of its click stream and the trainer must keep converging on what
+    survives. Probabilistic per offer, drawn from a dedicated seeded
+    RNG (deterministic across runs; recorded once in ``fired``)."""
+    plan = active()
+    if plan is None or plan.feedback_loss_p <= 0:
+        return False
+    with plan._lock:
+        if plan._fb_rng.random() >= plan.feedback_loss_p:
+            return False
+        if ("feedback_loss", "spool") not in plan.fired:
+            plan._record("feedback_loss", "spool")
+    return True
+
+
+def maybe_skew_sketch(op_name: str, counts):
+    """Corrupt a LIVE id-frequency sketch's bucket counts
+    (``FF_FAULT_SKETCH_SKEW=op:factor``, matched by op-name substring,
+    consume-once per op): the hot head of the sketch (its first 1% of
+    buckets) is scaled by ``factor``, faking (> 1) or hiding (< 1) hot
+    mass. The online re-placement trigger reads this sketch — a skewed
+    trigger may fire a spurious (or miss a due) re-placement, but any
+    plan it installs must still serve correct answers. Returns the
+    (possibly skewed) counts in the caller's dtype."""
+    plan = active()
+    if plan is None or not plan.sketch_skew:
+        return counts
+    with plan._lock:
+        hit = None
+        for name, factor in plan.sketch_skew.items():
+            if name and name in op_name:
+                hit = (name, factor)
+                break
+        if hit is None:
+            return counts
+        del plan.sketch_skew[hit[0]]
+        plan._record("sketch_skew", f"{op_name}:{hit[1]:g}")
+    import numpy as np
+    arr = np.asarray(counts)
+    out = arr.astype(np.float64, copy=True)
+    head = max(1, out.size // 100)
+    out[:head] *= float(hit[1])
+    if np.issubdtype(arr.dtype, np.integer):
+        out = np.maximum(np.rint(out), 0).astype(arr.dtype)
+    return out
 
 
 def maybe_poison_reload(state: dict) -> dict:

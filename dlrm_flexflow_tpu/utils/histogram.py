@@ -78,6 +78,54 @@ class IdFrequencySketch:
         self.counts += other.counts
         self.total += other.total
 
+    def copy(self) -> "IdFrequencySketch":
+        """Deep copy (the re-placement controller snapshots live
+        sketches as the new search baseline at swap time)."""
+        return IdFrequencySketch(self.rows, max_buckets=self.buckets,
+                                 counts=self.counts.copy(),
+                                 total=self.total)
+
+    def reset(self) -> None:
+        """Zero the observations in place (the live sketch rebases after
+        an online re-placement so the drift gauge measures divergence
+        from the NEW placement's baseline, not history)."""
+        self.counts[:] = 0
+        self.total = 0
+
+    def _folded_probs(self, buckets: int) -> np.ndarray:
+        """probs() folded down to ``buckets`` entries (mod fold, the
+        same aliasing observe() applies) so two sketches over the same
+        row space but different bucket budgets stay comparable."""
+        p = self.probs()
+        if p.size == buckets:
+            return p
+        if p.size < buckets or buckets < 1:
+            raise ValueError(
+                f"cannot fold {p.size} buckets down to {buckets}")
+        idx = np.arange(p.size, dtype=np.int64) % buckets
+        return np.bincount(idx, weights=p, minlength=buckets)
+
+    def divergence(self, other: "IdFrequencySketch") -> float:
+        """Total-variation distance between the two empirical
+        distributions, in [0, 1] — THE online re-placement trigger: the
+        live sketch diverging from the histogram the placement was
+        searched with means the hot set moved. Zero while either side is
+        unobserved (no evidence of drift is not drift: an empty live
+        sketch reads uniform, and uniform-vs-zipf would otherwise fire
+        the trigger before the first batch lands). Mismatched bucket
+        budgets compare at the coarser fold; mismatched row spaces are
+        structurally different ops and refuse."""
+        if self.rows != other.rows:
+            raise ValueError(
+                f"cannot compare sketch over {self.rows} rows with one "
+                f"over {other.rows}")
+        if self.total <= 0 or other.total <= 0:
+            return 0.0
+        m = min(self.buckets, other.buckets)
+        p = self._folded_probs(m)
+        q = other._folded_probs(m)
+        return float(0.5 * np.abs(p - q).sum())
+
     # --- the two quantities the cost model consumes --------------------
     def probs(self) -> np.ndarray:
         """Per-bucket empirical probabilities (uniform when unobserved —
@@ -221,6 +269,25 @@ def save_histograms(path: str, sketches: Dict[str, IdFrequencySketch]
         except OSError:
             pass
         raise
+
+
+def sketch_signature(sketches: Optional[Dict[str, IdFrequencySketch]]
+                     ) -> str:
+    """Short stable digest of a {op -> sketch} mapping, for plan-cache
+    keys: a placement searched against drifted traffic must not collide
+    with the pre-drift entry (same graph, topology, budget, and
+    warm-start — only the observed distribution moved)."""
+    import zlib
+    if not sketches:
+        return "none"
+    crc = 0
+    for name in sorted(sketches):
+        sk = sketches[name]
+        head = np.asarray([sk.rows, sk.buckets, sk.total], np.int64)
+        crc = zlib.crc32(name.encode(), crc)
+        crc = zlib.crc32(head.tobytes(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(sk.counts).tobytes(), crc)
+    return f"{crc:08x}"
 
 
 def load_histograms(path: str) -> Dict[str, IdFrequencySketch]:

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Subprocess target for the slow closed-loop replay chaos test.
+
+The full (paced-profile shapes, compressed pacing) drifting-zipf
+replay through the REAL process boundaries: a trainer thread feeding
+off the feedback spool, delta publication, a serving engine whose
+embedding tier is three ``shard_server`` OS processes, and a
+``SIGKILL`` to one of them mid-replay. The bar, printed as one JSON
+verdict line for the parent test:
+
+- ZERO failed client requests across the whole replay (degraded
+  answers allowed during the outage, exceptions are not);
+- the tier replaces the killed shard process (``shard-replace``
+  appears in the health-tick actions);
+- the loop stays closed: feedback keeps landing, the trainer keeps
+  publishing, and every shard converges back to the publisher's tip.
+
+Run directly (never under pytest):
+    python _scenario_worker.py
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import dlrm_flexflow_tpu as ff  # noqa: E402
+from dlrm_flexflow_tpu.data.replay import (FeedbackSpool,  # noqa: E402
+                                           TraceReplay, scenario_spec)
+from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm  # noqa: E402
+from dlrm_flexflow_tpu.serve import (EmbeddingShardSet,  # noqa: E402
+                                     InferenceEngine, ServeConfig,
+                                     ShardTierConfig, SnapshotWatcher)
+
+DCFG = DLRMConfig(embedding_size=[64] * 4, sparse_feature_size=8,
+                  mlp_bot=[4, 16, 8], mlp_top=[40, 16, 1])
+NSHARDS = 3
+STEPS = 120
+KILL_AT = 60
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build(seed=2):
+    model = ff.FFModel(ff.FFConfig(batch_size=8, seed=seed,
+                                   host_resident_tables=True,
+                                   host_tables_async=False))
+    build_dlrm(model, DCFG)
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"])
+    model.init_layers()
+    return model
+
+
+def _spawn_shard_procs(cache_dir, nshards):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs = []
+    for slot in range(nshards):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "dlrm_flexflow_tpu.serve.shard_server",
+             "--cache-dir", cache_dir, "--nshards", str(nshards),
+             "--slot", str(slot), "--port", "0"],
+            env=env, text=True, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT))
+    addresses = []
+    for slot, p in enumerate(procs):
+        port = None
+        for line in p.stdout:
+            if line.startswith("SHARD_SERVER_OK"):
+                kv = dict(item.split("=", 1) for item in line.split()[1:])
+                port = int(kv["port"])
+                break
+        assert port is not None, f"shard {slot} never booted"
+        addresses.append(("127.0.0.1", port))
+    return procs, addresses
+
+
+def main() -> int:
+    import tempfile
+    workdir = tempfile.mkdtemp(prefix="ff-scenario-chaos-")
+    ckpt = os.path.join(workdir, "ckpt")
+    cache_dir = os.path.join(workdir, "shards")
+
+    spec = scenario_spec("drifting_zipf", steps=STEPS, batch=8, seed=0,
+                         rows=DCFG.embedding_size[0])
+    replay = TraceReplay(len(DCFG.embedding_size),
+                         DCFG.embedding_size[0],
+                         DCFG.embedding_bag_size, DCFG.mlp_bot[0], spec)
+
+    trainer = _build(seed=2)
+    pub = ff.DeltaPublisher(trainer, ckpt, row_delta_min_elems=0)
+    # warm-up prefix, published as the chain base the shards boot from
+    trainer.fit_stream(
+        lambda i: {**replay.request(i % 32),
+                   "label": replay.labels(i % 32)},
+        steps=96, publisher=pub, publish_every=96, verbose=False)
+
+    server = _build(seed=2)
+    cfg = ShardTierConfig(nshards=NSHARDS, eject_after=1, retries=0,
+                          cooldown_s=0.0, replace_after=2,
+                          lookup_deadline_ms=1000.0)
+    EmbeddingShardSet.seed_shard_cache(server, NSHARDS, cache_dir,
+                                       config=cfg)
+    procs, addresses = _spawn_shard_procs(cache_dir, NSHARDS)
+
+    spool = FeedbackSpool(capacity=256)
+    train_err = []
+
+    def _train():
+        try:
+            trainer.fit_stream(spool.source, steps=None, publisher=pub,
+                               publish_every=10, verbose=False)
+        except BaseException as e:   # noqa: BLE001 — judged below
+            train_err.append(repr(e))
+
+    sset = None
+    eng = None
+    w = None
+    failed = 0
+    degraded = 0
+    actions = []
+    try:
+        sset = EmbeddingShardSet.connect(addresses, config=cfg,
+                                         cache_dir=cache_dir)
+        eng = InferenceEngine(server,
+                              ServeConfig(max_batch=8, cache_rows=8,
+                                          queue_capacity=4096),
+                              shard_set=sset).start()
+        w = SnapshotWatcher(eng, ckpt, poll_s=0.1).start()
+        deadline = time.time() + 30
+        while eng.version < 96 and time.time() < deadline:
+            time.sleep(0.1)
+
+        t = threading.Thread(target=_train, daemon=True)
+        t.start()
+        for i in range(STEPS):
+            if i == KILL_AT:
+                os.kill(procs[0].pid, signal.SIGKILL)   # the real thing
+            feats = replay.request(i)
+            try:
+                pred = eng.predict(feats, timeout=60)
+                degraded += bool(pred.degraded)
+                spool.offer(feats, replay.labels(i, feats),
+                            scores=np.asarray(pred.scores), step=i)
+            except Exception as e:   # noqa: BLE001 — counted
+                failed += 1
+                print(f"request failed at {i}: {e}", file=sys.stderr)
+            actions.extend(a["action"] for a in sset.health_tick())
+            time.sleep(0.01)
+        spool.close()
+        t.join(60)
+        # convergence: watcher + health ticks bring every shard to tip
+        tip = int(pub.stats()["last_step"] or 0)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            actions.extend(a["action"] for a in sset.health_tick())
+            if eng.version_floor >= tip:
+                break
+            time.sleep(0.2)
+        print(json.dumps({
+            "failed": failed,
+            "degraded": degraded,
+            "shard_replaced": any("replace" in a for a in actions),
+            "tip": tip,
+            "engine_version": int(eng.version),
+            "version_floor": int(eng.version_floor),
+            "spool": spool.stats(),
+            "trainer_error": train_err[0] if train_err else None,
+            "steps": STEPS,
+        }))
+        return 0
+    finally:
+        if w is not None:
+            w.stop()
+        if eng is not None:
+            eng.close()
+        if sset is not None:
+            sset.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            try:
+                p.wait(5)
+            except subprocess.TimeoutExpired:
+                pass
+            if p.stdout is not None:
+                p.stdout.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,378 @@
+"""Online hot/cold re-placement (serve/replace.py): the divergence
+trigger, the sketch digest in the re-plan cache key, controller
+behavior (fires once per sustained episode, never on steady traffic,
+bit-consistent swaps under concurrent load), and the watcher
+backoff-reset pin (a poll that installs resets the backoff even when
+it also recorded failures on the way)."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+import dlrm_flexflow_tpu as ff  # noqa: E402
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig,  # noqa: E402
+                                           build_dlrm, synthetic_batch)
+from dlrm_flexflow_tpu.parallel.mesh import make_mesh  # noqa: E402
+from dlrm_flexflow_tpu.search.replan import replace_strategies  # noqa: E402
+from dlrm_flexflow_tpu.serve import (InferenceEngine,  # noqa: E402
+                                     ServeConfig, SnapshotWatcher)
+from dlrm_flexflow_tpu.serve.replace import (ReplaceConfig,  # noqa: E402
+                                             ReplacementController)
+from dlrm_flexflow_tpu.utils import faults  # noqa: E402
+from dlrm_flexflow_tpu.utils.checkpoint import CheckpointManager  # noqa: E402
+from dlrm_flexflow_tpu.utils.histogram import (IdFrequencySketch,  # noqa: E402
+                                               sketch_signature)
+from dlrm_flexflow_tpu.utils.warmcache import (PlanCache,  # noqa: E402
+                                               strategy_signature)
+
+TABLES, ROWS, BAG = 4, 64, 2
+DCFG = DLRMConfig(embedding_size=[ROWS] * TABLES, embedding_bag_size=BAG,
+                  sparse_feature_size=8, mlp_bot=[4, 16, 8],
+                  mlp_top=[40, 16, 1])
+BS = 8
+
+
+def _build(seed=3):
+    model = ff.FFModel(ff.FFConfig(batch_size=BS, seed=seed))
+    build_dlrm(model, DCFG)
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"],
+                  mesh=make_mesh(devices=jax.devices()[:1]))
+    model.init_layers()
+    return model
+
+
+def _uniform(rng):
+    return {"sparse": rng.integers(0, ROWS, (BS, TABLES, BAG),
+                                   dtype=np.int64).astype(np.int32),
+            "dense": rng.random((BS, 4), dtype=np.float32)}
+
+
+def _hot(row):
+    """Every lookup hits one row per table: a point-mass hot set."""
+    return {"sparse": np.full((BS, TABLES, BAG), row, np.int32),
+            "dense": np.zeros((BS, 4), np.float32)}
+
+
+def _router(n):
+    fleet = ff.Fleet.build(lambda i: _build(3), n,
+                           ff.ServeConfig(max_batch=BS, max_delay_ms=1.0,
+                                          queue_capacity=256,
+                                          poll_s=0.02))
+    return ff.FleetRouter(
+        fleet, ff.RouterConfig(retries=4, cooldown_s=0.1,
+                               health_interval_s=0.02,
+                               probe_deadline_s=30.0)).start()
+
+
+def _emb_sketches(model, hot_row):
+    out = {}
+    for op in model.ops:
+        if (op.inputs and hasattr(op, "flat_lookup_ids")
+                and hasattr(op, "_row_shard_geometry")):
+            rows, _pack, tables = op._row_shard_geometry()
+            sk = IdFrequencySketch(rows * tables)
+            sk.observe(np.full(512, hot_row, np.int64))
+            out[op.name] = sk
+    return out
+
+
+# =====================================================================
+# the divergence the trigger reads
+# =====================================================================
+class TestSketchDivergence:
+    def test_identical_sketches_read_zero(self):
+        a, b = IdFrequencySketch(256), IdFrequencySketch(256)
+        ids = np.arange(512) % 256
+        a.observe(ids)
+        b.observe(ids)
+        assert a.divergence(b) == 0.0
+
+    def test_disjoint_hot_sets_read_near_one(self):
+        a, b = IdFrequencySketch(256), IdFrequencySketch(256)
+        a.observe(np.zeros(512, np.int64))
+        b.observe(np.full(512, 128, np.int64))
+        assert a.divergence(b) > 0.99
+
+    def test_unobserved_side_reads_zero_not_uniform_vs_zipf(self):
+        a, b = IdFrequencySketch(256), IdFrequencySketch(256)
+        a.observe(np.zeros(512, np.int64))
+        assert a.divergence(b) == 0.0
+        assert b.divergence(a) == 0.0
+
+    def test_mismatched_row_spaces_refuse(self):
+        a, b = IdFrequencySketch(256), IdFrequencySketch(128)
+        a.observe(np.zeros(8, np.int64))
+        b.observe(np.zeros(8, np.int64))
+        with pytest.raises(ValueError, match="rows"):
+            a.divergence(b)
+
+    def test_mismatched_bucket_budgets_compare_at_coarser_fold(self):
+        full = IdFrequencySketch(256)
+        folded = IdFrequencySketch(256, max_buckets=64)
+        ids = np.arange(1024) % 256
+        full.observe(ids)
+        folded.observe(ids)
+        # same uniform traffic folded mod 64 stays uniform: ~0 TV
+        assert full.divergence(folded) < 1e-9
+
+    def test_copy_is_independent_and_reset_zeroes(self):
+        a = IdFrequencySketch(64)
+        a.observe(np.arange(64))
+        c = a.copy()
+        a.reset()
+        assert a.total == 0 and int(a.counts.sum()) == 0
+        assert c.total == 64 and int(c.counts.sum()) == 64
+
+    def test_sketch_signature_stable_and_sensitive(self):
+        a = IdFrequencySketch(64)
+        a.observe(np.arange(32))
+        assert sketch_signature({"op": a}) == \
+            sketch_signature({"op": a.copy()})
+        b = a.copy()
+        b.observe(np.zeros(8, np.int64))
+        assert sketch_signature({"op": a}) != sketch_signature({"op": b})
+        assert sketch_signature(None) == "none"
+        assert sketch_signature({}) == "none"
+
+
+# =====================================================================
+# the re-search and its cache key
+# =====================================================================
+class TestReplaceStrategies:
+    def test_cache_key_carries_the_sketch_digest(self, tmp_path):
+        """Same (graph, topology, budget, seed, warm-start) but a
+        DRIFTED sketch must not be answered by the pre-drift cache
+        entry — otherwise online re-placement is a cache-shaped no-op."""
+        model = _build()
+        pc = PlanCache(str(tmp_path))
+        hot5 = _emb_sketches(model, 5)
+        s1, i1 = replace_strategies(model, sketches=hot5,
+                                    old=model.strategies, ndev=1,
+                                    budget=0, seed=0, plan_cache=pc)
+        assert not i1["plan_cache_hit"]
+        s2, i2 = replace_strategies(model, sketches=hot5,
+                                    old=model.strategies, ndev=1,
+                                    budget=0, seed=0, plan_cache=pc)
+        assert i2["plan_cache_hit"]
+        assert strategy_signature(s1) == strategy_signature(s2)
+        _s3, i3 = replace_strategies(model,
+                                     sketches=_emb_sketches(model, 37),
+                                     old=model.strategies, ndev=1,
+                                     budget=0, seed=0, plan_cache=pc)
+        assert not i3["plan_cache_hit"]
+
+
+# =====================================================================
+# the watcher backoff-reset pin (a poll that installs is a recovery)
+# =====================================================================
+class TestWatcherBackoffReset:
+    def _published(self, d, steps):
+        x, y = synthetic_batch(DCFG, BS, seed=0)
+        trainer = _build()
+        mgr = CheckpointManager(d, keep_last=3)
+        xb = dict(x)
+        xb["label"] = y
+        for _ in range(steps):
+            trainer.train_batch(xb)
+            mgr.save(trainer, {"epoch": 0, "batch": trainer._step})
+        return trainer
+
+    def test_crc_rejected_newest_plus_good_older_resets_backoff(
+            self, tmp_path):
+        """One poll CRC-rejects the torn newest snapshot (failure
+        recorded) and falls through to the good older one (installed).
+        That poll is a RECOVERY: the watcher must return to its base
+        interval, not compound backoff forever."""
+        d = str(tmp_path)
+        self._published(d, steps=2)
+        # tear the newest snapshot on disk; its manifest CRC now lies
+        newest = os.path.join(d, "ckpt-00000002.npz")
+        size = os.path.getsize(newest)
+        with open(newest, "r+b") as f:
+            f.seek(size // 2)
+            f.write(b"\x00" * 64)
+
+        eng = InferenceEngine(_build(), ServeConfig(
+            max_batch=BS, max_delay_ms=1.0, poll_s=5.0))
+        with eng:
+            w = SnapshotWatcher(eng, d, poll_s=0.05)
+            assert w._poll_tick() is True
+            st = w.stats()
+            assert st["reload_failures"] >= 1
+            assert "CRC" in st["last_reload_error"]
+            # the pin: installed-something wins over recorded-failures
+            assert st["consecutive_failures"] == 0
+            assert st["next_poll_s"] == 0.05
+            assert eng.version == 1
+
+    def test_pure_failures_back_off_then_recovery_resets(self, tmp_path):
+        d = str(tmp_path)
+        self._published(d, steps=1)
+        eng = InferenceEngine(_build(), ServeConfig(
+            max_batch=BS, max_delay_ms=1.0, poll_s=5.0))
+        with eng:
+            w = SnapshotWatcher(eng, d, poll_s=0.05)
+            with faults.active_plan(
+                    faults.FaultPlan(io_errors={"snapshot_reload": 64})):
+                assert w._poll_tick() is False
+                assert w._poll_tick() is False
+                st = w.stats()
+                assert st["consecutive_failures"] == 2
+                assert st["next_poll_s"] > 0.05
+            # fault cleared: the next poll installs and re-paces
+            assert w._poll_tick() is True
+            st = w.stats()
+            assert st["consecutive_failures"] == 0
+            assert st["next_poll_s"] == 0.05
+            assert eng.version == 1
+
+
+# =====================================================================
+# the controller
+# =====================================================================
+class TestReplaceConfig:
+    @pytest.mark.parametrize("kw", [{"drift_threshold": 0.0},
+                                    {"drift_threshold": 1.5},
+                                    {"sustain": 0}])
+    def test_rejects_nonsense(self, kw):
+        with pytest.raises(ValueError):
+            ReplaceConfig(**kw)
+
+
+def _controller(router, **kw):
+    cfg = ReplaceConfig(drift_threshold=0.5, sustain=2, cooldown_s=0.0,
+                        min_observations=1024, window=2048, budget=0,
+                        prewarm=False, **kw)
+    return ReplacementController(router, config=cfg)
+
+
+class TestReplacementController:
+    def test_steady_traffic_never_fires(self):
+        router = _router(1)
+        ctrl = _controller(router)
+        try:
+            rng = np.random.default_rng(0)
+            ctrl.seed_baseline(_uniform(rng) for _ in range(20))
+            for _ in range(40):
+                ctrl.observe(_uniform(rng))
+                assert ctrl.tick() is None
+            st = ctrl.stats()
+            assert st["replacements"] == 0
+            # the gauge is live even when it never breaches
+            assert max(st["last_divergence"].values()) < 0.5
+        finally:
+            ctrl.close()
+            router.close()
+
+    def test_fires_exactly_once_per_sustained_episode(self):
+        """A sustained drift fires ONE re-placement; the swap rebases
+        the baseline so the same drift cannot re-fire; a second,
+        different drift episode fires again."""
+        router = _router(1)
+        ctrl = _controller(router)
+        reports = []
+        try:
+            rng = np.random.default_rng(1)
+            ctrl.seed_baseline(_uniform(rng) for _ in range(20))
+
+            def drive(feats, n=60):
+                for _ in range(n):
+                    ctrl.observe(feats)
+                    r = ctrl.tick()
+                    if r is not None:
+                        reports.append(r)
+
+            drive(_hot(5))                      # episode 1: fires once
+            assert ctrl.stats()["replacements"] == 1
+            drive(_hot(5))                      # same drift: rebased
+            assert ctrl.stats()["replacements"] == 1
+            drive(_hot(37))                     # episode 2: fires again
+            assert ctrl.stats()["replacements"] == 2
+            assert len(reports) == 2
+            for r in reports:
+                assert "divergence" in r["reason"]
+                # a single-replica fleet swaps in place, never ejects
+                assert r["replicas"][0]["ejected"] is False
+                assert r["replicas"][0]["readmitted"] is True
+        finally:
+            ctrl.close()
+            router.close()
+
+    def test_swap_is_bit_consistent_under_concurrent_traffic(self):
+        """budget=0 re-clamps the running plan onto the same device
+        count (the identity): scores before and after the rolling swap
+        must be bitwise equal, with zero failed requests while threads
+        hammer the fleet through the swap."""
+        router = _router(2)
+        ctrl = _controller(router, swap_deadline_s=60.0)
+        errors = []
+        stop = threading.Event()
+        rng = np.random.default_rng(2)
+        probe = _uniform(rng)
+        try:
+            for _ in range(20):
+                ctrl.observe(_uniform(rng))
+            before = np.asarray(router.predict(probe, timeout=60).scores)
+
+            def hammer(tid):
+                r = np.random.default_rng(100 + tid)
+                while not stop.is_set():
+                    try:
+                        router.predict(_uniform(r), timeout=60)
+                    except Exception as e:   # noqa: BLE001 — the bar
+                        errors.append(repr(e))
+
+            threads = [threading.Thread(target=hammer, args=(t,))
+                       for t in range(3)]
+            for t in threads:
+                t.start()
+            time.sleep(0.2)
+            report = ctrl.replace_now(reason="test swap")
+            time.sleep(0.2)
+            stop.set()
+            for t in threads:
+                t.join(30)
+            assert not errors, f"failed requests: {errors[:5]}"
+            assert len(report["replicas"]) == 2
+            assert all(r["readmitted"] for r in report["replicas"])
+            # both replicas were ejected one at a time (rolling), the
+            # sibling covered the queue
+            assert all(r["ejected"] for r in report["replicas"])
+            after = np.asarray(router.predict(probe, timeout=60).scores)
+            np.testing.assert_array_equal(before, after)
+            assert ctrl.stats()["replacements"] == 1
+        finally:
+            stop.set()
+            ctrl.close()
+            router.close()
+
+    def test_sketch_skew_fault_persistently_corrupts_live_counts(self):
+        router = _router(1)
+        ctrl = _controller(router)
+        try:
+            rng = np.random.default_rng(3)
+            ctrl.seed_baseline(_uniform(rng) for _ in range(20))
+            for _ in range(40):
+                ctrl.observe(_uniform(rng))
+            name = next(iter(ctrl._live))
+            clean = ctrl._live[name].counts.copy()
+            with faults.active_plan(
+                    faults.FaultPlan(sketch_skew={name: 100.0})):
+                ctrl.divergence()
+            skewed = ctrl._live[name].counts
+            assert not np.array_equal(skewed, clean)
+            # consume-once, but the corruption STAYS in the live sketch
+            ctrl.divergence()
+            np.testing.assert_array_equal(ctrl._live[name].counts,
+                                          skewed)
+        finally:
+            ctrl.close()
+            router.close()

@@ -1,0 +1,219 @@
+"""Trace replay + feedback spool (data/replay.py) and the two new
+fault hooks (FF_FAULT_FEEDBACK_LOSS / FF_FAULT_SKETCH_SKEW)."""
+
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlrm_flexflow_tpu.data.replay import (FeedbackSpool, ReplaySpec,
+                                           TraceReplay, scenario_spec)
+from dlrm_flexflow_tpu.utils import faults
+
+T, R, BAG, D = 4, 64, 2, 4
+
+
+def _replay(name="drifting_zipf", steps=48, seed=0):
+    return TraceReplay(T, R, BAG, D,
+                       scenario_spec(name, steps=steps, seed=seed,
+                                     rows=R))
+
+
+# =====================================================================
+# the trace
+# =====================================================================
+class TestTraceReplay:
+    def test_deterministic_per_seed(self):
+        a, b = _replay(seed=1), _replay(seed=1)
+        for i in (0, 7, 31):
+            np.testing.assert_array_equal(a.request(i)["sparse"],
+                                          b.request(i)["sparse"])
+            np.testing.assert_array_equal(a.request(i)["dense"],
+                                          b.request(i)["dense"])
+            np.testing.assert_array_equal(a.labels(i), b.labels(i))
+        c = _replay(seed=2)
+        assert not np.array_equal(a.request(3)["sparse"],
+                                  c.request(3)["sparse"])
+
+    def test_shapes_match_dlrm_inputs(self):
+        f = _replay().request(0)
+        assert f["sparse"].shape == (8, T, BAG)
+        assert f["sparse"].dtype == np.int32
+        assert f["dense"].shape == (8, D)
+        assert f["dense"].dtype == np.float32
+        lab = _replay().labels(0)
+        assert lab.shape == (8, 1) and lab.dtype == np.float32
+
+    def test_alpha_drift_raises_skew(self):
+        """drifting_zipf ramps alpha up — late traffic concentrates
+        more mass on the head than early traffic."""
+        rp = _replay(steps=200)
+
+        def top_mass(lo, hi):
+            # top-8 rows by count, whichever rows they are — the churn
+            # rotates WHICH rows are hot, the alpha ramp decides how hot
+            ids = np.concatenate([rp.request(i)["sparse"].ravel()
+                                  for i in range(lo, hi)]) % R
+            c = np.sort(np.bincount(ids, minlength=R))[::-1]
+            return float(c[:R // 8].sum() / c.sum())
+
+        assert top_mass(150, 190) > top_mass(0, 40) + 0.05
+
+    def test_churn_rotates_the_hot_set(self):
+        """Post-churn ids are exactly the pre-churn draws rotated by
+        churn_stride — same skew, different rows are hot."""
+        spec = scenario_spec("drifting_zipf", steps=48, seed=0, rows=R)
+        churned = TraceReplay(T, R, BAG, D, spec)
+        flat = ReplaySpec(name=spec.name, steps=spec.steps,
+                          batch=spec.batch, alpha0=spec.alpha0,
+                          alpha1=spec.alpha1, seed=spec.seed)
+        base = TraceReplay(T, R, BAG, D, flat)
+        i = spec.churn_step() + 3
+        np.testing.assert_array_equal(
+            churned.request(i)["sparse"],
+            (base.request(i)["sparse"] + spec.churn_stride) % R)
+        j = spec.churn_step() - 3
+        np.testing.assert_array_equal(churned.request(j)["sparse"],
+                                      base.request(j)["sparse"])
+
+    def test_diurnal_qps_wave_and_flash_mult(self):
+        spec = scenario_spec("diurnal", steps=100)
+        qps = [spec.qps_at(i) for i in range(100)]
+        # trough at the edges, peak mid-day
+        assert max(qps[40:60]) > 2.5 * min(qps[:5] + qps[-5:])
+        fspec = scenario_spec("flash_crowd", steps=100)
+        inside = [i for i in range(100) if fspec.in_flash(i)]
+        assert inside, "flash window must cover some steps"
+        out = inside[0] - 2
+        assert fspec.qps_at(inside[0]) > 3.0 * fspec.qps_at(out)
+
+    def test_labels_are_stationary_across_churn(self):
+        """Drift moves WHICH ids are drawn, never what an id is worth:
+        identical features get identical label probabilities regardless
+        of when they occur."""
+        rp = _replay()
+        f = rp.request(2)
+        a = rp.labels(5, f)
+        b = rp.labels(5, dict(f))
+        np.testing.assert_array_equal(a, b)
+
+    def test_unknown_scenario_names_the_valid_ones(self):
+        with pytest.raises(ValueError, match="drifting_zipf"):
+            scenario_spec("nope")
+
+
+# =====================================================================
+# the feedback spool
+# =====================================================================
+class TestFeedbackSpool:
+    def test_roundtrip_strips_judge_keys(self):
+        sp = FeedbackSpool(capacity=8)
+        rp = _replay()
+        f = rp.request(0)
+        lab = rp.labels(0, f)
+        assert sp.offer(f, lab, scores=np.ones((8, 1)), step=0)
+        batch = sp.source(0, timeout_s=5)
+        assert set(batch) == {"dense", "sparse", "label"}
+        np.testing.assert_array_equal(batch["label"], lab)
+        served = sp.served(0)
+        assert "_served_scores" in served and "_trace_step" in served
+
+    def test_source_blocks_until_offered_then_drains_in_order(self):
+        sp = FeedbackSpool(capacity=8)
+        rp = _replay()
+        got = []
+
+        def consume():
+            for i in range(3):
+                got.append(sp.source(i, timeout_s=10))
+
+        t = threading.Thread(target=consume)
+        t.start()
+        for i in range(3):
+            sp.offer(rp.request(i), rp.labels(i), step=i)
+        t.join(10)
+        assert len(got) == 3
+        for i, b in enumerate(got):
+            np.testing.assert_array_equal(
+                b["sparse"], rp.request(i)["sparse"])
+        assert sp.lag() == 0
+
+    def test_overflow_drops_and_counts(self):
+        sp = FeedbackSpool(capacity=2)
+        rp = _replay()
+        assert sp.offer(rp.request(0), rp.labels(0))
+        assert sp.offer(rp.request(1), rp.labels(1))
+        assert not sp.offer(rp.request(2), rp.labels(2))
+        st = sp.stats()
+        assert st["dropped_overflow"] == 1 and st["landed"] == 2
+        assert sp.lag() == 2
+
+    def test_close_ends_the_stream(self):
+        sp = FeedbackSpool(capacity=4)
+        sp.close()
+        assert sp.source(0, timeout_s=5) is None
+
+    def test_feedback_loss_fault_drops_offers(self):
+        sp = FeedbackSpool(capacity=64)
+        rp = _replay()
+        with faults.active_plan(faults.FaultPlan(feedback_loss_p=1.0)):
+            for i in range(8):
+                assert not sp.offer(rp.request(i), rp.labels(i))
+        st = sp.stats()
+        assert st["dropped_faults"] == 8 and st["landed"] == 0
+        # no active plan -> no drops
+        assert sp.offer(rp.request(9), rp.labels(9))
+
+
+# =====================================================================
+# the new FF_FAULT_* knobs
+# =====================================================================
+class TestNewFaultKnobs:
+    def test_feedback_loss_env_parses(self, monkeypatch):
+        monkeypatch.setenv("FF_FAULT_FEEDBACK_LOSS", "0.25")
+        assert faults.plan_from_env().feedback_loss_p == 0.25
+
+    @pytest.mark.parametrize("val", ["1.5", "-0.1", "lossy"])
+    def test_feedback_loss_env_rejects_and_names_var(self, monkeypatch,
+                                                     val):
+        monkeypatch.setenv("FF_FAULT_FEEDBACK_LOSS", val)
+        with pytest.raises(ValueError, match="FF_FAULT_FEEDBACK_LOSS"):
+            faults.plan_from_env()
+
+    def test_sketch_skew_env_parses(self, monkeypatch):
+        monkeypatch.setenv("FF_FAULT_SKETCH_SKEW", "emb_stack:10")
+        plan = faults.plan_from_env()
+        assert plan.sketch_skew == {"emb_stack": 10.0}
+
+    @pytest.mark.parametrize("val,frag", [
+        ("nocolon", "FF_FAULT_SKETCH_SKEW"),
+        ("emb:x", "FF_FAULT_SKETCH_SKEW"),
+    ])
+    def test_sketch_skew_env_rejects(self, monkeypatch, val, frag):
+        monkeypatch.setenv("FF_FAULT_SKETCH_SKEW", val)
+        with pytest.raises(ValueError, match=frag):
+            faults.plan_from_env()
+
+    def test_maybe_skew_sketch_consumes_once(self):
+        counts = np.full(200, 10, np.int64)
+        with faults.active_plan(
+                faults.FaultPlan(sketch_skew={"emb": 5.0})):
+            out = faults.maybe_skew_sketch("emb_stack", counts)
+            assert out is not counts
+            head = max(1, out.size // 100)
+            assert (out[:head] == 50).all()
+            assert (out[head:] == 10).all()
+            # consumed: the second call is a pass-through
+            again = faults.maybe_skew_sketch("emb_stack", counts)
+            assert again is counts
+
+    def test_maybe_skew_sketch_ignores_other_ops(self):
+        counts = np.ones(10, np.int64)
+        with faults.active_plan(
+                faults.FaultPlan(sketch_skew={"other": 2.0})):
+            assert faults.maybe_skew_sketch("emb_stack",
+                                            counts) is counts

@@ -1,0 +1,71 @@
+"""The closed-loop scenario harness (scenarios/runner.py).
+
+Tier-1: the compressed drifting-zipf day in-process — the churn costs
+exactly one online re-placement, the budgets hold, zero requests fail.
+A diurnal QPS wave moves LOAD, not the id distribution — it must never
+re-plan placement (that would be thrash).
+
+Slow: the full replay through REAL process boundaries with a SIGKILL'd
+embedding-shard process mid-day (tests/_scenario_worker.py), judged on
+zero failed requests + shard replacement + convergence back to the
+publisher's tip.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlrm_flexflow_tpu.scenarios import run_scenario  # noqa: E402
+
+
+class TestFastScenarios:
+    def test_drifting_zipf_fires_one_replacement_and_passes(self):
+        v = run_scenario("drifting_zipf", fast=True, seed=0)
+        m = v["metrics"]
+        assert v["passed"], v["failures"]
+        assert m["failed"] == 0
+        assert m["replacements"] == 1
+        assert m["auc"] >= 0.55
+        assert not v["errors"]
+        # the trigger report says WHY it fired
+        rep = m["replace_report"]
+        assert rep is not None and "divergence" in rep["reason"]
+
+    def test_diurnal_wave_never_replans(self):
+        v = run_scenario("diurnal", fast=True, seed=0)
+        m = v["metrics"]
+        assert v["passed"], v["failures"]
+        assert m["replacements"] == 0
+        assert m["failed"] == 0
+
+
+# ---------------------------------------------------------------------
+# chaos: full replay with a SIGKILL'd shard process (subprocess, slow)
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.skipif(os.environ.get("FF_SKIP_MULTIPROCESS") == "1",
+                    reason="multiprocess tests disabled")
+def test_slow_replay_survives_shard_process_kill():
+    """kill -9 one of three shard_server processes mid-replay: the tier
+    must replace it, no client request may raise, feedback keeps
+    landing, and every shard converges back to the publisher's tip.
+    Run in a subprocess so a hang fails the test, not the session."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "_scenario_worker.py")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["failed"] == 0, verdict
+    assert verdict["shard_replaced"], verdict
+    assert verdict["trainer_error"] is None, verdict
+    assert verdict["version_floor"] >= verdict["tip"], verdict
+    assert verdict["spool"]["consumed"] == verdict["spool"]["landed"], \
+        verdict
