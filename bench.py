@@ -242,6 +242,20 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             shard = {"error": str(exc)[:200]}
 
+    # opt-in pipelined-exchange smoke (BENCH_OVERLAP=1): row-shard
+    # all-to-all overlap on/off step time, the trace-span-derived
+    # exposed-comm fraction, and the simulated DCN-topology bar
+    overlap = None
+    if os.environ.get("BENCH_OVERLAP"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_overlap import measure as _ovl_measure
+            overlap = _ovl_measure(
+                steps=int(os.environ.get("BENCH_OVERLAP_STEPS", "8")))
+        except Exception as exc:
+            overlap = {"error": str(exc)[:200]}
+
     # opt-in lowered-HLO collective audit (BENCH_AUDIT=1): predicted-vs-
     # lowered collective-bytes drift for the bench_shard row-sharded and
     # replicated plans (shardcheck FLX51x over the real bench model)
@@ -368,6 +382,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         out["serve_fleet"] = serve_fleet
     if shard is not None:
         out["shard"] = shard
+    if overlap is not None:
+        out["overlap"] = overlap
     if audit is not None:
         out["audit"] = audit
     if freshness is not None:

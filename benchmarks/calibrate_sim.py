@@ -251,6 +251,112 @@ def measure_skew_distinct(alphas=(0.0, 0.8, 1.0, 1.2),
     return out
 
 
+def measure_overlap_window(steps=60):
+    """Calibrate the cost model's OVERLAP TERM (ISSUE 19): run the same
+    row-sharded DLRM with the exchange serial and pipelined on the
+    attached mesh, and solve the hidden fraction of the exchange window
+    from the step-time delta:
+
+        eff = (t_serial - t_overlap + rounds * per_round)
+              / min(window, exchange)
+
+    where `exchange` is the cost model's predicted all-to-all transfer
+    time, `window` is the predicted exposed-compute window the exchange
+    can hide under (every other op's fwd+bwd compute), and the
+    per-round handoff overhead stays pinned at the spec default (the
+    two are not separable from one scalar observation; the pinned term
+    is what keeps zero-window plans from pricing overlap as free).
+    Written to benchmarks/overlap_calibration.json — the artifact
+    cost_model.load_overlap_calibration() serves back to the search as
+    overlap_efficiency / round_overhead_s."""
+    import jax
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                               synthetic_batch)
+    from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+    from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
+    from dlrm_flexflow_tpu.parallel.sharding import param_axis_indices
+    from dlrm_flexflow_tpu.search.cost_model import CostModel
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        return None
+    batch = 256 * ndev
+    # exchange-heavy shape: wide rows, deep-enough dense stack that a
+    # real compute window exists to hide the exchange under
+    dcfg = DLRMConfig(embedding_size=[262144] * 8,
+                      sparse_feature_size=128,
+                      mlp_bot=[64, 512, 128],
+                      mlp_top=[128 * 9, 512, 256, 1])
+    times = {}
+    model = None
+    for label, overlap in (("serial", False), ("overlap", True)):
+        model = ff.FFModel(ff.FFConfig(batch_size=batch, seed=0))
+        build_dlrm(model, dcfg)
+        strat = {}
+        for op in model.ops:
+            nd = op.outputs[0].num_dims if op.outputs else 0
+            if type(op).__name__ == "EmbeddingBagStacked":
+                strat[op.name] = ParallelConfig(
+                    (ndev, 1, 1), param_degree=ndev, overlap=overlap)
+            elif nd:
+                strat[op.name] = ParallelConfig.data_parallel(nd, ndev)
+        model.compile(ff.SGDOptimizer(lr=0.05), "mean_squared_error",
+                      ["mse"], mesh=make_mesh(devices=jax.devices()),
+                      strategies=strat)
+        model.init_layers()
+        batches = []
+        for i in range(4):
+            x, y = synthetic_batch(dcfg, batch, seed=i)
+            x["label"] = y
+            batches.append(model._device_batch(x))
+        jax.block_until_ready(batches)
+        times[label] = measure_step_time(model, batches,
+                                         steps=steps, windows=3)
+        del batches
+
+    # predicted exchange + window for the SAME plan, so the solved
+    # efficiency lands in the units exposed_exchange_time consumes
+    import jax.numpy as jnp
+    cost = CostModel(compute_dtype=model.config.jnp_compute_dtype)
+    emb = next(op for op in model.ops
+               if type(op).__name__ == "EmbeddingBagStacked")
+    plan = emb._row_plan
+    axis_sizes = tuple(plan.mesh.devices.shape) if plan is not None \
+        else (ndev,)
+    topo = [("ici", int(s)) for s in axis_sizes]
+    pc = ParallelConfig((ndev, 1, 1), param_degree=ndev)
+    itemsize = jnp.dtype(cost.compute_dtype).itemsize
+    axes = [topo[i] for i in param_axis_indices(ndev, axis_sizes)]
+    exchange = sum(
+        cost.alltoall_time_axes(b, axes)
+        for b in emb.alltoall_payload_bytes(ndev, itemsize, pc=pc))
+    window = 0.0
+    for op in model.ops:
+        if op is emb or not op.outputs:
+            continue
+        opc = ParallelConfig.data_parallel(op.outputs[0].num_dims, ndev)
+        window += cost.op_compute_time(op, opc)
+        window += cost.op_compute_time(op, opc, backward=True)
+    rounds = ndev - 1 if len(axes) == 1 else 4
+    per_round = cost.spec.overlap_round_overhead_s
+    hidden = times["serial"] - times["overlap"] + rounds * per_round
+    denom = max(min(window, exchange), 1e-12)
+    eff = max(0.0, min(0.99, hidden / denom))
+    return {
+        "overlap_efficiency": round(eff, 4),
+        "round_overhead_s": per_round,
+        "t_serial_ms": round(times["serial"] * 1e3, 4),
+        "t_overlap_ms": round(times["overlap"] * 1e3, 4),
+        "exchange_ms": round(exchange * 1e3, 4),
+        "window_ms": round(window * 1e3, 4),
+        "rounds": rounds,
+        "ndev": ndev,
+        "source": "calibrate_sim.measure_overlap_window",
+    }
+
+
 def main():
     from dlrm_flexflow_tpu.search.cost_model import CostModel
     from dlrm_flexflow_tpu.search.mcmc import default_strategy
@@ -377,6 +483,21 @@ def main():
                          if v["err"] is not None)
         print(f"skew expected-distinct worst |err|: {worst_skew:.1%} "
               f"-> {skew_out}")
+
+        # overlap-window calibration (ISSUE 19): serial vs pipelined
+        # row-shard exchange -> the hidden-fraction scalar the search
+        # prices overlapped plans with
+        ovl = measure_overlap_window(steps=min(steps, 60))
+        if ovl is not None:
+            ovl_out = os.path.join(os.path.dirname(out),
+                                   "overlap_calibration.json")
+            tmp = ovl_out + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(ovl, f, indent=1)
+            os.replace(tmp, ovl_out)
+            print(f"overlap window: eff {ovl['overlap_efficiency']:.2f} "
+                  f"(serial {ovl['t_serial_ms']:.3f} ms, overlap "
+                  f"{ovl['t_overlap_ms']:.3f} ms) -> {ovl_out}")
 
     if not rows:
         print("no calibration points matched (CAL_ONLY filter?)")

@@ -141,6 +141,16 @@ RULES = {
                "measured collective bytes in the lowered HLO drift "
                "beyond tolerance from the cost model's prediction "
                "(the search is pricing a different program)"),
+    "FLX514": ("serialized-exchange", "medium",
+               "a row-shard exchange whose transfer time exceeds the "
+               "step's exposed-compute window runs with overlap off: "
+               "the collective blocks the compute stream end-to-end "
+               "where the pipelined exchange would hide under it "
+               "(high when the exchange dwarfs the window)"),
+    "FLX515": ("interaction-materialized", "medium",
+               "the lowered HLO materializes the (B, F, F) pairwise-dot "
+               "interaction tensor in HBM (unfused gather→bmm→tril "
+               "chain where the fused Pallas kernel keeps it in VMEM)"),
 }
 
 

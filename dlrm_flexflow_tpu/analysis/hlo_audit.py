@@ -262,9 +262,18 @@ def audit_model(model, device_batch=None, *, tolerance: float = 0.25,
         "tolerance": tolerance,
     }
     drift: Dict[str, float] = {}
-    # all-to-all: the dense exchange is deterministic — symmetric drift
-    pred_a2a = predicted.get("all-to-all", 0.0)
+    # all-to-all: the dense exchange is deterministic — symmetric drift.
+    # An OVERLAPPED single-axis exchange lowers its S-1 pipelined rounds
+    # as collective-permutes, not one fused all-to-all (the bytes are the
+    # same exchange, just decomposed — and the ring's missing self-block
+    # is already out of the prediction via _exchange_buffer_blocks), so
+    # fold the measured permute bytes into the exchange bucket whenever
+    # any compiled row plan pipelines
     meas_a2a = measured.get("all-to-all", 0.0)
+    if any(getattr(getattr(op, "_row_plan", None), "overlap", False)
+           for op in model.ops):
+        meas_a2a += measured.get("collective-permute", 0.0)
+    pred_a2a = predicted.get("all-to-all", 0.0)
     if pred_a2a > 0:
         drift["all-to-all"] = abs(meas_a2a - pred_a2a) / pred_a2a
         if drift["all-to-all"] > tolerance:
@@ -308,6 +317,43 @@ def audit_model(model, device_batch=None, *, tolerance: float = 0.25,
         findings.extend(eval_findings)
         report["eval_collective_counts"] = dict(eval_audit.counts)
     return sort_findings(findings), report
+
+
+def audit_interaction_fusion(model, device_batch=None, *,
+                             path: str = "<model>") -> List[Finding]:
+    """FLX515: verify the fused dot-interaction actually fused.
+
+    For every FusedDotInteraction op, scan the lowered SERVING forward
+    (the fusion is a forward claim — the training backward re-derives
+    g_Z in plain XLA by design) for a rank-3 [*, F, F] buffer. The fused
+    Pallas lowering keeps Z in VMEM, so any such buffer means the op
+    fell back to the unfused jnp path (non-TPU backend, unsupported
+    width, multi-chip mesh, host offload) — silently giving back the
+    HBM round-trips the plan was priced without."""
+    from ..ops.interaction import FusedDotInteraction
+    fused = [op for op in model.ops
+             if isinstance(op, FusedDotInteraction)]
+    if not fused:
+        return []
+    text = model.lowered_eval_hlo(device_batch)
+    findings: List[Finding] = []
+    for op in fused:
+        F = op.num_tables + 1
+        pat = re.compile(r"[a-z]+\d*\[(\d+),%d,%d\]" % (F, F))
+        hits = {m.group(0) for m in pat.finditer(text)}
+        if not hits:
+            continue
+        shapes = ", ".join(sorted(hits)[:4])
+        findings.append(make_finding(
+            "FLX515", path, 0,
+            f"{op.name!r}: lowered serving HLO materializes the "
+            f"pairwise-dot interaction tensor ({shapes}) — the fused "
+            f"Pallas kernel fell back to the unfused gather→bmm→tril "
+            f"chain (non-TPU backend, dim % 128 != 0, multi-chip mesh, "
+            f"or host offload), paying the [B, F, F] HBM round-trips "
+            f"the fused plan was priced without",
+            scope=op.name, token="interaction-materialized"))
+    return sort_findings(findings)
 
 
 def audit_file(path: str, model_name: Optional[str] = None,

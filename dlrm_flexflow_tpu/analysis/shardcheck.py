@@ -314,6 +314,59 @@ def verify_plan(model, strategies, ndev: Optional[int] = None,
             f">HBM table then OOMs, a smaller one trains 66x slower)",
             scope=name, token=f"pd{pd}"))
 
+    # --- FLX514: serialized exchange a pipelined plan would hide -------
+    # A row-shard exchange with overlap off blocks the compute stream
+    # end-to-end (the fused all_to_all occupies every participating
+    # device). When the step's exposed-compute window — every other
+    # op's fwd+bwd work, which has no data dependence on THIS op's
+    # exchange — is at least exchange-sized, overlap=True would hide it
+    # (cost_model.exposed_exchange_time); running serial then leaves the
+    # whole transfer on the critical path.
+    from ..parallel.sharding import param_axis_indices
+    import jax.numpy as jnp
+    itemsize = jnp.dtype(cost.compute_dtype).itemsize
+    compute_of = {}
+    for op in ops:
+        opc = eff.get(op.name)
+        if opc is None or op.name in host_res:
+            continue
+        compute_of[op.name] = (
+            cost.op_compute_time(op, opc, backward=False)
+            + cost.op_compute_time(op, opc, backward=True))
+    window_all = sum(compute_of.values())
+    for name, pc in resolved.items():
+        pd = max(getattr(pc, "param_degree", 1), 1)
+        if pd <= 1 or getattr(pc, "overlap", False) or name in host_res:
+            continue
+        op = by_name.get(name)
+        if op is None or not hasattr(op, "alltoall_payload_bytes"):
+            continue
+        if row_shard_structural_reason(op, pc, axis_sizes) is not None:
+            continue            # FLX504 already owns the broken case
+        pidx = param_axis_indices(pd, axis_sizes)
+        axes = [topo[i] for i in (pidx or ())]
+        if not axes:
+            continue
+        req_b, rows_b, grad_b = op.alltoall_payload_bytes(
+            ndev, itemsize, pc=pc)
+        exch = sum(cost.alltoall_time_axes(b, axes)
+                   for b in (req_b, rows_b, grad_b))
+        window = window_all - compute_of.get(name, 0.0)
+        if exch <= 0 or exch <= window:
+            continue
+        kinds = "/".join(sorted({k for k, _ in axes}))
+        findings.append(make_finding(
+            "FLX514", path, 0,
+            f"{name!r} exchanges {_fmt_bytes(req_b + rows_b + grad_b)}"
+            f"/device/step over {kinds} serially: transfer "
+            f"~{exch * 1e3:.2f} ms exceeds the {window * 1e3:.2f} ms "
+            f"exposed-compute window, so the collective blocks the "
+            f"compute stream end-to-end — set overlap=True to pipeline "
+            f"the exchange under the dense MLPs",
+            scope=name, token="serialized-exchange",
+            severity="high" if exch >= 2.0 * max(window, 1e-12)
+            else "medium"))
+
     # --- FLX505: elastic clamp hazards ---------------------------------
     if survivor_ndev is not None and survivor_ndev >= 1 \
             and survivor_ndev < ndev:

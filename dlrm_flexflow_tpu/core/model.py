@@ -294,6 +294,20 @@ class FFModel:
         from ..ops.batch_matmul import BatchMatmul
         return BatchMatmul(self, a, b, trans_a, trans_b, name).outputs[0]
 
+    def fused_dot_interaction(self, sparse_idx, bottom, num_entries,
+                              out_dim, activation="relu",
+                              emb_initializer=None, kernel_initializer=None,
+                              bias_initializer=None, name=None):
+        """Fused gather→dot-interaction→first-top-MLP-layer (see
+        ops/interaction.FusedDotInteraction): on TPU the whole chain runs
+        in one Pallas kernel and the (B, F, F) interaction tensor never
+        reaches HBM."""
+        from ..ops.interaction import FusedDotInteraction
+        return FusedDotInteraction(self, sparse_idx, bottom, num_entries,
+                                   out_dim, activation, emb_initializer,
+                                   kernel_initializer, bias_initializer,
+                                   name).outputs[0]
+
     def _unary(self, op_type, x, name=None):
         from ..ops.elementwise import ElementUnary
         return ElementUnary(self, x, op_type, name).outputs[0]
@@ -504,9 +518,11 @@ class FFModel:
                         for pc in pcs) else "dense")
                     frac = max((getattr(pc, "hot_fraction", 0.0)
                                 for pc in pcs), default=0.0)
+                    ovl = any(getattr(pc, "overlap", False)
+                              for pc in pcs)
                     strategies[op.name] = ParallelConfig(
                         (ds, 1, 1), device_type=dtyp, param_degree=pd,
-                        exchange=exch, hot_fraction=frac)
+                        exchange=exch, hot_fraction=frac, overlap=ovl)
                     continue
                 strategies[op.name] = ParallelConfig(
                     (1, degree, 1), device_type=dtyp, memory_types=mem)

@@ -189,11 +189,18 @@ def interact_features(model: FFModel, bottom_out, embedding_outs_3d,
 
 
 def build_dlrm(model: FFModel, cfg: DLRMConfig,
-               fuse_embeddings: Optional[bool] = None
+               fuse_embeddings: Optional[bool] = None,
+               fuse_interaction: bool = False
                ) -> Tuple[Dict[str, tuple], "object"]:
     """Build the DLRM graph on `model` (reference top_level_task graph build,
     dlrm.cc:103-128). Returns (input_specs, output_tensor); input names:
-    'dense' float (batch, mlp_bot[0]), 'sparse' int (batch, T, bag)."""
+    'dense' float (batch, mlp_bot[0]), 'sparse' int (batch, T, bag).
+
+    ``fuse_interaction=True`` (dot interaction + uniform tables only)
+    replaces the gather→stack→bmm→tril→first-top-dense chain with ONE
+    FusedDotInteraction op (Pallas-fused on TPU — the (B, F, F)
+    interaction tensor never materializes). Default off: the op graph,
+    parameter names and strategies are unchanged unless asked for."""
     batch = model.config.batch_size
     T = len(cfg.embedding_size)
     d = cfg.sparse_feature_size
@@ -209,6 +216,34 @@ def build_dlrm(model: FFModel, cfg: DLRMConfig,
                         prefix="bot")
 
     emb_init = UniformInitializer(min_val=-0.05, max_val=0.05)
+    if fuse_interaction:
+        if cfg.arch_interaction_op != "dot":
+            raise ValueError("fuse_interaction=True needs "
+                             "--arch-interaction-op dot (the fused kernel "
+                             "computes the pairwise-dot interaction)")
+        if not uniform:
+            raise ValueError("fuse_interaction=True needs uniform table "
+                             "sizes (the fused gather stacks the tables "
+                             "row-wise)")
+        if len(cfg.mlp_top) < 2:
+            raise ValueError("fuse_interaction=True needs at least one "
+                             "top-MLP layer to fold into the kernel")
+        # the fused op IS the first top-MLP layer; it takes the sigmoid
+        # head when it is also the last
+        fused_last = len(cfg.mlp_top) == 2
+        fused = model.fused_dot_interaction(
+            sparse_in, bottom, cfg.embedding_size[0], cfg.mlp_top[1],
+            activation="sigmoid" if fused_last else "relu",
+            emb_initializer=emb_init, name="fused_interaction")
+        if fused_last:
+            out = fused
+        else:
+            out = create_mlp(model, fused,
+                             [cfg.mlp_top[1]] + cfg.mlp_top[2:],
+                             sigmoid_last=True, prefix="top")
+        inputs = {"dense": (batch, cfg.mlp_bot[0]),
+                  "sparse": (batch, T, cfg.embedding_bag_size)}
+        return inputs, out
     if fuse_embeddings and uniform:
         embs = [model.embedding_stacked(
             sparse_in, T, cfg.embedding_size[0], d, aggr="sum",

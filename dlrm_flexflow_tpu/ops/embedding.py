@@ -753,7 +753,9 @@ def configure_row_shard(op, raw_pc) -> None:
                 rows, pack, pd, HOT_QUANTUM_PACKS * max(pack, 1))
     if reason is None:
         plan = plan_row_shard(mesh, pd, rows - hot, pack, tables,
-                              dedup=dedup, hot_rows=hot)
+                              dedup=dedup, hot_rows=hot,
+                              overlap=bool(getattr(raw_pc, "overlap",
+                                                   False)))
         if plan is None:
             sizes = [int(mesh.shape[a]) for a in mesh.axis_names]
             reason = (f"{pd} row shards must factorize mesh axes {sizes} "
@@ -923,7 +925,10 @@ def _row_shard_candidates(op, num_devices, feasible_degrees, nd):
     # the skew variants enter the walk ONLY when an observed histogram
     # is attached: without one the cost model assumes uniform ids,
     # under which dedup/hybrid price at best ~dense (minus the sort
-    # overhead) — offering them would just dilute the walk
+    # overhead) — offering them would just dilute the walk. The
+    # pipelined-exchange overlap flag is never a candidate here for the
+    # same reason: it is a pure schedule toggle over the same bytes, so
+    # mcmc.optimize flips it greedily on the annealed winner instead
     skewed = op.name in getattr(op.model, "_id_histograms", {})
     out = []
     for pp in feasible_degrees:

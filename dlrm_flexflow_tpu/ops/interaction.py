@@ -1,0 +1,136 @@
+"""FusedDotInteraction operator — gather→X·Xᵀ→tril→first-top-MLP-layer
+as ONE op.
+
+DLRM's "dot" interaction builds as five graph ops (embedding gather,
+stack-concat, BatchMatmul, tril IndexSelect, first top-MLP Linear) whose
+(B, F, F) pairwise-dot tensor round-trips HBM between them. This op owns
+the whole chain — the stacked embedding table, the first top-MLP layer's
+weight/bias — and on TPU lowers it through the fused Pallas kernel
+(ops/pallas/interaction_kernel.py), so the interaction tensor lives only
+in VMEM (pinned by analysis/hlo_audit FLX515). Everywhere else (CPU mesh,
+unsupported width, multi-chip GSPMD, host offload) it falls back to the
+unfused jnp composition — same math, autodiff'd directly.
+
+Opt-in: build_dlrm(..., fuse_interaction=True) replaces the five-op chain
+with this op for uniform-table "dot" configs; the default graph is
+unchanged. Batch-data-parallel only — the table is replicated (this is
+the serving/small-table shape; row-sharded tables keep the unfused path
+with the overlapped exchange).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from ..core.initializers import (DEFAULT_BIAS_INIT, DEFAULT_KERNEL_INIT,
+                                 GlorotUniform)
+from ..core.op import Op, ParamDef
+from ..parallel.pconfig import ParallelConfig
+from .common import apply_activation
+from .pallas.interaction_kernel import (fused_interaction,
+                                        fused_interaction_reference,
+                                        supports, tril_pairs)
+
+
+class FusedDotInteraction(Op):
+    type_name = "FusedDotInteraction"
+
+    def __init__(self, model, sparse_idx, bottom, num_entries: int,
+                 out_dim: int, activation: str = "relu",
+                 emb_initializer=None, kernel_initializer=None,
+                 bias_initializer=None, name: Optional[str] = None):
+        """sparse_idx: (batch, T, bag) int; bottom: (batch, d) —
+        the bottom-MLP output. `num_entries` is rows PER TABLE (uniform
+        tables, stacked row-wise like EmbeddingBagStacked); `out_dim` is
+        the first top-MLP layer's width."""
+        super().__init__(model, [sparse_idx, bottom], name)
+        if sparse_idx.num_dims != 3:
+            raise ValueError("FusedDotInteraction expects (batch, T, bag) "
+                             "sparse indices")
+        if bottom.num_dims != 2:
+            raise ValueError("FusedDotInteraction expects a rank-2 "
+                             "bottom-MLP input")
+        batch, T, bag = sparse_idx.shape
+        if bottom.shape[0] != batch:
+            raise ValueError("batch dim mismatch between sparse and bottom")
+        self.num_tables = int(T)
+        self.num_entries = int(num_entries)
+        self.bag = int(bag)
+        self.in_dim = int(bottom.shape[1])          # d, the feature width
+        self.out_dim = int(out_dim)                 # H, first layer width
+        self.activation = activation
+        F = self.num_tables + 1
+        self.num_pairs = len(tril_pairs(F))
+        self.emb_initializer = emb_initializer or GlorotUniform()
+        self.kernel_initializer = kernel_initializer or DEFAULT_KERNEL_INIT()
+        self.bias_initializer = bias_initializer or DEFAULT_BIAS_INIT()
+        # tests flip this to route the Pallas kernel in interpreter mode
+        # on non-TPU backends (the gate below stays backend-honest)
+        self._interpret = False
+        self.outputs = [self._make_output((batch, self.out_dim))]
+
+    def param_defs(self) -> Dict[str, ParamDef]:
+        return {
+            "table": ParamDef(
+                (self.num_tables * self.num_entries, self.in_dim),
+                jnp.float32, self.emb_initializer),
+            "kernel": ParamDef(
+                (self.in_dim + self.num_pairs, self.out_dim),
+                jnp.float32, self.kernel_initializer),
+            "bias": ParamDef((self.out_dim,), jnp.float32,
+                             self.bias_initializer),
+        }
+
+    def _use_pallas(self) -> bool:
+        # same gate as the embedding kernels: opted in, TPU backend,
+        # supported width, single-chip (under a >1-device mesh the op
+        # runs inside GSPMD where a direct Pallas call cannot), not
+        # host-offloaded
+        from .embedding import _pallas_gate
+        return _pallas_gate(self.model, self.name, supports(self.in_dim))
+
+    def apply(self, params, xs, *, training=False, rng=None):
+        idx, bottom = xs
+        # per-table indices -> the concatenated row space (table t's rows
+        # live at [t*rows, (t+1)*rows))
+        gid = (idx.astype(jnp.int32)
+               + (jnp.arange(self.num_tables, dtype=jnp.int32)
+                  * self.num_entries)[None, :, None])
+        relu = self.activation == "relu"
+        if (self._use_pallas() or self._interpret) \
+                and self.activation in ("relu", "none", None):
+            out = fused_interaction(params["table"], gid, bottom,
+                                    params["kernel"], params["bias"],
+                                    relu, self._interpret)
+        else:
+            out = fused_interaction_reference(
+                params["table"], gid, bottom, params["kernel"],
+                params["bias"], relu=False)
+            out = apply_activation(out, self.activation)
+        return [out.astype(bottom.dtype)]
+
+    # -- parallelization ---------------------------------------------------
+    def candidate_parallel_configs(self, num_devices, feasible_degrees):
+        # batch-DP only: the fused chain keeps its table replicated
+        out = []
+        for d in feasible_degrees:
+            if d <= num_devices:
+                out.append(ParallelConfig((d, 1)))
+        return out
+
+    # -- cost model --------------------------------------------------------
+    def flops_per_sample(self) -> float:
+        F = self.num_tables + 1
+        return (2.0 * F * F * self.in_dim
+                + 2.0 * (self.in_dim + self.num_pairs) * self.out_dim)
+
+    def random_hbm_rows(self, backward: bool = False,
+                        raw: bool = False) -> float:
+        # the gather half: one random table-row read per lookup (the
+        # interaction/matmul half is covered by flops_per_sample)
+        if backward:
+            return 0.0
+        batch = self.inputs[0].shape[0]
+        return float(batch * self.num_tables * self.bag)

@@ -117,6 +117,12 @@ class RowShardPlan:
     dedup: bool = False           # unique-ids exchange
     hot_rows: int = 0             # replicated hot rows per table
     tables: int = 1
+    # pipelined exchange: decompose each fused all-to-all into
+    # independent rounds (a ppermute ring over a single row axis,
+    # capacity-chunked collectives over a factorized one) so XLA's
+    # async scheduler can hide them under the step's dense compute.
+    # Same blocks, same positions — bit-identical to the fused form.
+    overlap: bool = False
 
     @property
     def all_axes(self) -> Tuple[str, ...]:
@@ -194,13 +200,15 @@ def row_owners(ids, rows: int, nshards: int) -> np.ndarray:
 
 def plan_row_shard(mesh: Optional[Mesh], param_degree: int,
                    rows: int, pack: int, tables: int = 1,
-                   dedup: bool = False, hot_rows: int = 0
+                   dedup: bool = False, hot_rows: int = 0,
+                   overlap: bool = False
                    ) -> Optional[RowShardPlan]:
     """Build the RowShardPlan for `param_degree` row shards of a table
     whose COLD (routed) tail has `rows` logical rows stored
     `pack`-per-lane-tile, or None with the structural reason it cannot
     apply (caller logs it). `hot_rows` records the hybrid placement's
-    replicated per-table head (already excluded from `rows`)."""
+    replicated per-table head (already excluded from `rows`);
+    `overlap` selects the pipelined (decomposed) exchange."""
     if mesh is None or param_degree <= 1:
         return None
     sizes = [int(mesh.shape[a]) for a in mesh.axis_names]
@@ -219,7 +227,82 @@ def plan_row_shard(mesh: Optional[Mesh], param_degree: int,
                         rows_local=rows_local,
                         flat_rows_local=tables * rows_local,
                         dedup=bool(dedup), hot_rows=int(hot_rows),
-                        tables=int(tables))
+                        tables=int(tables), overlap=bool(overlap))
+
+
+# ---- the exchange collective (inside the shard_map body) -----------------
+
+# capacity-dim chunk count of the pipelined multi-axis exchange: enough
+# independent collectives for the scheduler to overlap send k+1 with
+# compute consuming chunk k, few enough that per-collective dispatch
+# overhead stays under the ~0.5 ms floor the calibration measures
+_OVERLAP_CHUNKS = 4
+
+
+def _ring_a2a(plan: RowShardPlan, x):
+    """Pipelined single-axis exchange: decompose the fused all-to-all
+    of one (S, C[, d]) buffer into S-1 `ppermute` rounds. Round `s`
+    sends block (me+s) mod S one hop of distance s and lands the block
+    received from peer (me-s) mod S in its slot; the self block never
+    leaves the device. Each round is an independent collective-permute,
+    so XLA's async scheduler (collective-permute-start/-done) hoists
+    them over whatever dense compute has no data dependence on the
+    received blocks — that is the whole overlap. The output buffer is
+    position-for-position the one `jax.lax.all_to_all` returns:
+    out[j] = x_of_peer_j[me]. No payload arithmetic, so bit-identity
+    with the fused exchange is by construction."""
+    axis = plan.row_axes[0]
+    S = plan.nshards
+    me = jax.lax.axis_index(axis)
+    out = x                         # keeps the self block at slot `me`
+    for s in range(1, S):
+        perm = [(i, (i + s) % S) for i in range(S)]
+        blk = jax.lax.dynamic_index_in_dim(x, (me + s) % S, axis=0,
+                                           keepdims=True)
+        recv = jax.lax.ppermute(blk, axis, perm)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, recv, (me + S - s) % S, axis=0)
+    return out
+
+
+def _chunked_a2a(plan: RowShardPlan, x):
+    """Pipelined multi-axis exchange: the ring form needs one linear
+    peer order, which a factorized row axis does not have — so chunk
+    the CAPACITY dim instead and issue one independent all-to-all per
+    chunk. Identical bytes, identical slots (the chunks concatenate
+    back in order); the scheduler overlaps chunk k+1's exchange with
+    compute consuming chunk k. Falls back to the fused collective when
+    the capacity has no usable divisor."""
+    C = x.shape[1]
+    k = 1
+    for cand in range(min(_OVERLAP_CHUNKS, C), 1, -1):
+        if C % cand == 0:
+            k = cand
+            break
+    if k <= 1:
+        return jax.lax.all_to_all(x, plan.row_axes, 0, 0)
+    step = C // k
+    parts = [jax.lax.all_to_all(
+        jax.lax.slice_in_dim(x, i * step, (i + 1) * step, axis=1),
+        plan.row_axes, 0, 0) for i in range(k)]
+    return jnp.concatenate(parts, axis=1)
+
+
+def _a2a(plan: RowShardPlan, x):
+    """THE row-shard exchange collective on one (S, C[, d]) send buffer
+    (block i addressed to shard i; returns the same layout with block j
+    received from shard j). Every exchange in this module routes
+    through here: serial plans lower the single fused
+    `jax.lax.all_to_all` (one blocking collective, reference behavior);
+    `plan.overlap` decomposes it into independent rounds the compiler
+    can hide under dense compute. All three forms move the same blocks
+    to the same slots — the bit-identity contract does not depend on
+    which one ran."""
+    if not plan.overlap or plan.nshards <= 1:
+        return jax.lax.all_to_all(x, plan.row_axes, 0, 0)
+    if len(plan.row_axes) == 1:
+        return _ring_a2a(plan, x)
+    return _chunked_a2a(plan, x)
 
 
 # ---- routing primitives (inside the shard_map body) ----------------------
@@ -278,8 +361,7 @@ def _route_ids(plan: RowShardPlan, owner_f, local_f, C: int):
     sentinel = jnp.int32(plan.flat_rows_local)
     send = jnp.full((plan.nshards * C,), sentinel, jnp.int32
                     ).at[slot].set(local_f, mode="drop")
-    recv = jax.lax.all_to_all(send.reshape(plan.nshards, C),
-                              plan.row_axes, 0, 0).reshape(-1)
+    recv = _a2a(plan, send.reshape(plan.nshards, C)).reshape(-1)
     return recv, recv < sentinel, rank
 
 
@@ -386,8 +468,7 @@ def _fwd_rows(plan: RowShardPlan, flat, of, lf, gf):
     safe = jnp.minimum(recv, plan.flat_rows_local - 1)
     rows = jnp.take(flat, safe, axis=0)
     rows = jnp.where(valid[:, None], rows, 0.0)
-    back = jax.lax.all_to_all(rows.reshape(plan.nshards, C, d),
-                              plan.row_axes, 0, 0)
+    back = _a2a(plan, rows.reshape(plan.nshards, C, d))
     idx = jnp.minimum(uof, plan.nshards - 1) * C + rank
     mine = jnp.take(back.reshape(plan.nshards * C, d),
                     jnp.minimum(idx, plan.nshards * C - 1), axis=0)
@@ -567,12 +648,9 @@ def _route_updates(plan: RowShardPlan, of, lf, gf, uf):
     send_upd = jnp.zeros((plan.nshards * C, d), jnp.float32
                          ).at[slot].set(s_upd.astype(jnp.float32),
                                         mode="drop")
-    rid = jax.lax.all_to_all(send_id.reshape(plan.nshards, C),
-                             plan.row_axes, 0, 0).reshape(-1)
-    rpos = jax.lax.all_to_all(send_pos.reshape(plan.nshards, C),
-                              plan.row_axes, 0, 0).reshape(-1)
-    rupd = jax.lax.all_to_all(send_upd.reshape(plan.nshards, C, d),
-                              plan.row_axes, 0, 0).reshape(-1, d)
+    rid = _a2a(plan, send_id.reshape(plan.nshards, C)).reshape(-1)
+    rpos = _a2a(plan, send_pos.reshape(plan.nshards, C)).reshape(-1)
+    rupd = _a2a(plan, send_upd.reshape(plan.nshards, C, d)).reshape(-1, d)
     # a row shard is replicated across the non-row axes, whose device
     # groups each saw a different batch slice: gather every group's
     # contributions so all replicas apply the full set (and stay
@@ -772,9 +850,30 @@ def row_sharded_opt_update(plan: RowShardPlan, table, slabs, table_spec,
 # ---- accounting ----------------------------------------------------------
 
 
+def _exchange_buffer_blocks(plan: RowShardPlan) -> int:
+    """Per-peer block count of the exchange buffers ONE device actually
+    SENDS: the fused all-to-all (and the chunked multi-axis pipelined
+    form, which moves identical bytes) ships all S blocks including the
+    device's own; the single-axis ppermute ring keeps the self block
+    local, so only S-1 blocks travel. The HLO byte predictions below
+    must match the lowered program instruction for instruction, so they
+    account for which form `_a2a` lowers."""
+    if plan.overlap and len(plan.row_axes) == 1 and plan.nshards > 1:
+        return plan.nshards - 1
+    return plan.nshards
+
+
+def _hlo_exchange_bytes(plan: RowShardPlan, C: int, d: int,
+                        table_itemsize: int) -> int:
+    S = _exchange_buffer_blocks(plan)
+    fwd = S * C * 4 + S * C * d * table_itemsize
+    bwd = S * C * 4 + S * C * 4 + S * C * d * 4
+    return int(fwd + bwd)
+
+
 def dense_exchange_hlo_bytes(plan: RowShardPlan, lookups_global: int,
                              d: int, table_itemsize: int = 4) -> int:
-    """All-to-all buffer bytes ONE device sends per step under the DENSE
+    """Exchange buffer bytes ONE device sends per step under the DENSE
     padded exchange this jax implementation actually lowers — what the
     HLO auditor must find in the partitioned program, instruction for
     instruction: request ids out (S x C int32), embedded rows back
@@ -782,29 +881,27 @@ def dense_exchange_hlo_bytes(plan: RowShardPlan, lookups_global: int,
     position + fp32 update-row exchanges. C (slot capacity per peer) is
     the full local lookup count n_local — the always-exact worst case —
     so the dense exchange moves S x the BALANCED bytes the cost model
-    prices (`exchange_bytes_per_step`); the drift report shows both."""
+    prices (`exchange_bytes_per_step`); the drift report shows both.
+    Under the single-axis pipelined exchange (`plan.overlap`) the self
+    block never travels, so S drops to nshards-1 and the bytes land in
+    the collective-permute bucket instead of all-to-all — the auditor
+    folds the buckets together (analysis/hlo_audit.py)."""
     n_local = int(lookups_global) // max(plan.ndev, 1)
-    S, C = plan.nshards, n_local
-    fwd = S * C * 4 + S * C * d * table_itemsize
-    bwd = S * C * 4 + S * C * 4 + S * C * d * 4
-    return int(fwd + bwd)
+    return _hlo_exchange_bytes(plan, n_local, d, table_itemsize)
 
 
 def dedup_exchange_hlo_bytes(plan: RowShardPlan, lookups_global: int,
                              d: int, table_itemsize: int = 4) -> int:
     """The dedup'd sibling of :func:`dense_exchange_hlo_bytes`: the
-    unique-ids exchange lowers the same four all-to-alls but at per-peer
+    unique-ids exchange lowers the same four exchanges but at per-peer
     capacity C = min(n_local, flat_rows_local) — after dedup an owner
     can never receive more DISTINCT requests than it has rows, so the
     padded buffers shrink exactly when duplicates are structurally
     guaranteed. Deterministic, so FLX513 can pin predicted == lowered
-    on the dedup plan too."""
+    on the dedup plan too (overlap-aware like the dense form)."""
     n_local = int(lookups_global) // max(plan.ndev, 1)
-    S = plan.nshards
-    C = plan.capacity(n_local)
-    fwd = S * C * 4 + S * C * d * table_itemsize
-    bwd = S * C * 4 + S * C * 4 + S * C * d * 4
-    return int(fwd + bwd)
+    return _hlo_exchange_bytes(plan, plan.capacity(n_local), d,
+                               table_itemsize)
 
 
 def exchange_bytes_per_step(plan: RowShardPlan, lookups_global: int,

@@ -71,6 +71,15 @@ class ParallelConfig:
     # quant.effective_policy so search, shardcheck, and serving agree.
     quant_dtype: str = ""
     quant_update: str = ""
+    # pipelined (double-buffered) row-shard exchange (param_degree > 1
+    # only): the lookup/row/gradient all-to-alls decompose into chunked
+    # ppermute/collective rounds so XLA's scheduler can hide them under
+    # independent dense compute (the bottom MLP), instead of the fused
+    # blocking all-to-all that serializes with the step. Bit-identical
+    # to the serial exchange — the same per-peer blocks arrive, the
+    # pipeline drains inside every step dispatch (no staleness). False
+    # keeps the legacy fused collective.
+    overlap: bool = False
 
     def __post_init__(self):
         object.__setattr__(self, "degrees", tuple(int(d) for d in self.degrees))
@@ -107,6 +116,11 @@ class ParallelConfig:
                 f"quant_update={self.quant_update!r} without a "
                 f"quant_dtype — the update rule refines a storage "
                 f"dtype, it cannot stand alone")
+        if not isinstance(self.overlap, (bool, int)):
+            raise ValueError(
+                f"invalid overlap flag {self.overlap!r} (expected a "
+                f"bool)")
+        object.__setattr__(self, "overlap", bool(self.overlap))
 
     @property
     def num_parts(self) -> int:

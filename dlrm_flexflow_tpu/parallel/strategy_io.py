@@ -78,7 +78,8 @@ def _encode_op(name: str, device_type: int, dims: List[int],
                device_ids: List[int],
                memory_types: List[int], param_dim: int = 1,
                hot_ppm: int = 0, exchange: int = 0,
-               quant_dtype: int = 0, quant_update: int = 0) -> bytes:
+               quant_dtype: int = 0, quant_update: int = 0,
+               overlap: int = 0) -> bytes:
     msg = bytearray()
     nb = name.encode()
     msg += b"\x0a" + _varint(len(nb)) + nb          # 1: name (len-delim)
@@ -107,6 +108,10 @@ def _encode_op(name: str, device_type: int, dims: List[int],
         msg += b"\x48" + _varint(quant_dtype)
     if quant_update > 0:                            # 10: quant update rule
         msg += b"\x50" + _varint(quant_update)
+    if overlap > 0:                                 # 11: pipelined exchange
+        # extension field like 6-10: omitted when off, so legacy files
+        # (and files without overlap) stay byte-identical
+        msg += b"\x58" + _varint(overlap)
     return bytes(msg)
 
 
@@ -161,7 +166,8 @@ def save_strategies_pb(path: str, strategies: StrategyMap) -> None:
             quant_dtype=_QUANT_DTYPE_ENUM[
                 getattr(pc, "quant_dtype", "") or ""],
             quant_update=_QUANT_UPDATE_ENUM[
-                getattr(pc, "quant_update", "") or ""])
+                getattr(pc, "quant_update", "") or ""],
+            overlap=1 if getattr(pc, "overlap", False) else 0)
         body += b"\x0a" + _varint(len(op)) + op     # Strategy.ops = 1
     with open(path, "wb") as f:
         f.write(bytes(body))
@@ -184,7 +190,7 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
         if field != 1 or wt != 2:
             continue
         name, dt, dims, dev_ids, mts, pd = "", 0, [], [], [], 1
-        hot_ppm, exch, qdt, qup = 0, 0, 0, 0
+        hot_ppm, exch, qdt, qup, ovl = 0, 0, 0, 0, 0
         for f2, wt2, v2 in _decode_message(v):
             if f2 == 1:
                 name = v2.decode()
@@ -206,6 +212,8 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
                 qdt = v2                   # quantized storage dtype
             elif f2 == 10:
                 qup = v2                   # quant update rule
+            elif f2 == 11:
+                ovl = v2                   # pipelined exchange (1 = on)
         if pd < 1:
             raise ValueError(
                 f"op {name!r}: parameter-axis degree {pd} < 1")
@@ -222,6 +230,9 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
         if qup not in _QUANT_UPDATE_NAME:
             raise ValueError(
                 f"op {name!r}: unknown quant update-rule enum {qup}")
+        if ovl not in (0, 1):
+            raise ValueError(
+                f"op {name!r}: unknown overlap flag {ovl}")
         out[name] = ParallelConfig(
             tuple(reversed(dims)), device_type="CPU" if dt == 1 else "TPU",
             device_ids=tuple(dev_ids),
@@ -229,7 +240,8 @@ def _decode_strategies(buf: bytes) -> StrategyMap:
             param_degree=pd, hot_fraction=hot_ppm / 1e6,
             exchange="dedup" if exch == 1 else "dense",
             quant_dtype=_QUANT_DTYPE_NAME[qdt],
-            quant_update=_QUANT_UPDATE_NAME[qup])
+            quant_update=_QUANT_UPDATE_NAME[qup],
+            overlap=bool(ovl))
     return out
 
 
@@ -307,14 +319,22 @@ def validate_strategies(strategies: StrategyMap,
                 f"exchange={exch!r} without row sharding "
                 f"(param_degree must be > 1 — there is no exchange "
                 f"to dedup on a replicated table)")
-        if (frac > 0 or exch != "dense") and row_shard_ops is not None \
+        ovl = getattr(pc, "overlap", False)
+        if ovl and pd0 <= 1:
+            raise StrategyValidationError(
+                path, str(name),
+                "overlap=True without row sharding (param_degree must "
+                "be > 1 — overlap pipelines the row-shard exchange, "
+                "and a replicated table has no exchange to overlap)")
+        if (frac > 0 or exch != "dense" or ovl) \
+                and row_shard_ops is not None \
                 and name not in row_shard_ops \
                 and not _GENERIC_KEY_RE.match(str(name)):
             raise StrategyValidationError(
                 path, str(name),
-                f"hot_fraction/exchange set on an op with no row-shard "
-                f"support (not one of the model's embedding ops: "
-                f"{sorted(row_shard_ops)[:8]}...)")
+                f"hot_fraction/exchange/overlap set on an op with no "
+                f"row-shard support (not one of the model's embedding "
+                f"ops: {sorted(row_shard_ops)[:8]}...)")
         if getattr(pc, "quant_dtype", "") and row_shard_ops is not None \
                 and name not in row_shard_ops \
                 and not _GENERIC_KEY_RE.match(str(name)):
@@ -415,6 +435,10 @@ def save_strategies(path: str, strategies: StrategyMap) -> None:
             entry["quant_dtype"] = pc.quant_dtype
         if getattr(pc, "quant_update", ""):
             entry["quant_update"] = pc.quant_update
+        if getattr(pc, "overlap", False):
+            # pipelined row-shard exchange (omitted when off so legacy
+            # files stay diff-identical)
+            entry["overlap"] = True
         ops.append(entry)
     doc = {"ops": ops}
     with open(path, "w") as f:
@@ -448,7 +472,8 @@ def load_strategies(path: str, num_devices: Optional[int] = None,
                     hot_fraction=float(entry.get("hot_frac", 0.0)),
                     exchange=str(entry.get("exchange", "dense")),
                     quant_dtype=str(entry.get("quant_dtype", "")),
-                    quant_update=str(entry.get("quant_update", "")))
+                    quant_update=str(entry.get("quant_update", "")),
+                    overlap=bool(entry.get("overlap", False)))
             except (KeyError, TypeError, ValueError) as e:
                 raise StrategyValidationError(
                     path, str(entry.get("name", "?")),

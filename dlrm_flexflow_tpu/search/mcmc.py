@@ -74,13 +74,66 @@ def optimize(model, budget: int = 1000, alpha: float = 1.2,
     rng = random.Random(seed)
     sim = Simulator(model, cost_model, topology=topology)
 
-    current = dict(start or default_strategy(model, ndev))
-    current_t = sim.simulate(current, ndev)
+    def _overlap_flip(pc: ParallelConfig) -> ParallelConfig:
+        return ParallelConfig(
+            pc.degrees, pc.device_type, pc.device_ids, pc.memory_types,
+            param_degree=getattr(pc, "param_degree", 1),
+            exchange=getattr(pc, "exchange", "dense"),
+            hot_fraction=getattr(pc, "hot_fraction", 0.0),
+            quant_dtype=getattr(pc, "quant_dtype", ""),
+            quant_update=getattr(pc, "quant_update", ""),
+            overlap=not getattr(pc, "overlap", False))
+
+    def _overlap_sweep(plan, plan_t):
+        """Greedy per-op minimization over the binary exchange-schedule
+        toggle, holding the sharding fixed."""
+        if plan_t is None:
+            plan_t = sim.simulate(plan, ndev)
+        improved = True
+        while improved:
+            improved = False
+            for op in model.ops:
+                if isinstance(op, InputOp):
+                    continue
+                pc = plan.get(op.name)
+                if pc is None or getattr(pc, "param_degree", 1) <= 1:
+                    continue
+                trial = dict(plan)
+                trial[op.name] = _overlap_flip(pc)
+                t = sim.simulate(trial, ndev)
+                if t < plan_t:
+                    plan, plan_t = trial, t
+                    improved = True
+        return plan, plan_t
+
+    # the warm start is schedule-minimized too: a replan handing in a
+    # serial row-sharded plan should not need the walk to rediscover
+    # the pipelined variant of the very shards it started with
+    current, current_t = _overlap_sweep(
+        dict(start or default_strategy(model, ndev)), None)
     best, best_t = dict(current), current_t
 
     for it in range(budget):
         proposal, changed = rewrite(model, current, ndev, feasible, rng)
         t = sim.simulate(proposal, ndev)
+        # nested schedule minimization: ParallelConfig.overlap moves the
+        # SAME bytes over the same shards and only changes the exchange
+        # schedule, so it is never a separate candidate in the proposal
+        # space (twin candidates would dilute the walk exactly where
+        # budgets are tight — see _row_shard_candidates' skew gating for
+        # the same reasoning). Instead each row-sharded move is priced
+        # under BOTH schedules and takes the better: the simulator's
+        # overlapped task graph decides, so plans with an exposed-compute
+        # window pipeline their exchange and window-less plans keep the
+        # fused collective (whose decomposition overhead overlap would
+        # pay for nothing).
+        pcc = proposal.get(changed)
+        if pcc is not None and getattr(pcc, "param_degree", 1) > 1:
+            alt = dict(proposal)
+            alt[changed] = _overlap_flip(pcc)
+            t_alt = sim.simulate(alt, ndev)
+            if t_alt < t:
+                proposal, t = alt, t_alt
         # reference acceptance: always if faster, else exp(-alpha * diff)
         # with diff in the simulator's time units (model.cc:1118-1126).
         # Infeasible (inf-cost) states need care: inf - inf is NaN, which
@@ -101,6 +154,10 @@ def optimize(model, budget: int = 1000, alpha: float = 1.2,
                 if verbose:
                     print(f"[search] iter {it}: {t * 1e3:.3f} ms "
                           f"(changed {changed})")
+    # final sweep of the same schedule toggle over ops the walk never
+    # revisited — joint windows only exist once ALL the accepted
+    # shardings are in place
+    best, best_t = _overlap_sweep(best, best_t)
     if verbose:
         print(f"[search] best simulated step: {best_t * 1e3:.3f} ms "
               f"vs DP {sim.simulate(default_strategy(model, ndev), ndev) * 1e3:.3f} ms")

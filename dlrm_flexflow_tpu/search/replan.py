@@ -111,6 +111,11 @@ def _project_op(op, pc: ParallelConfig, axis_sizes,
                   else "dense"),
         hot_fraction=(getattr(pc, "hot_fraction", 0.0) if pd_new > 1
                       else 0.0),
+        # the pipelined exchange follows the exchange too: it has no
+        # cross-step state (every dispatch drains), so a resharded
+        # survivor keeps pipelining — there is nothing to migrate
+        overlap=(bool(getattr(pc, "overlap", False)) if pd_new > 1
+                 else False),
         # the quantized-storage policy is layout-independent — it
         # survives ANY clamp (the stored rows just reshard)
         quant_dtype=getattr(pc, "quant_dtype", ""),

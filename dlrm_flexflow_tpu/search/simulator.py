@@ -242,20 +242,79 @@ class Simulator:
             return [(i, _axis_kind(topo[i][0]), topo[i][1])
                     for i in (pidx or ())]
 
-        def _a2a_chain(parents, bytes_per_dev, pd, label):
-            """Chain one all-to-all comm task per row axis (each on its
-            own channel, hierarchical like the allreduce chain) after
-            `parents`; returns the new frontier."""
-            for i, kind, size in _a2a_axes(pd):
+        def _a2a_chain(parents, bytes_per_dev, pd, label, pc=None,
+                       op=None, hide_under=None, tail=False):
+            """Chain one exchange task per row axis after `parents`;
+            returns the new frontier. The schedule shape depends on the
+            strategy's overlap flag — THE semantics that let the MCMC
+            walk discover pipelined plans unforced:
+
+            - overlap OFF (the fused `jax.lax.all_to_all`): a blocking
+              collective — every participating device sits in it, so
+              the exchange occupies the COMPUTE stream and independent
+              ops cannot run under it (one task per device, the
+              serialized-exchange reality FLX514 flags);
+            - overlap ON (the decomposed ppermute/chunked rounds): the
+              bytes ride the axis CHANNEL. The rounds interleave with
+              the op's OWN chunked compute — round r's ppermute DMA
+              flies while round r+1's local gather runs — so the
+              channel task starts with `hide_under` (the frontier the
+              compute itself starts from) rather than after it, and
+              downstream waits on max(compute, exchange). With `tail`
+              (the gradient direction) the consumer is the per-chunk
+              scatter update, which drains arrivals round by round: the
+              channel task gates the makespan (every task end does) but
+              not the update's start. The residual (1-efficiency)
+              fraction plus the per-round decomposition overhead still
+              blocks the compute stream (rounds cannot all leave the
+              critical path, and the extra collective launches are
+              real)."""
+            from ..parallel.alltoall import _OVERLAP_CHUNKS
+            overlap = bool(getattr(pc, "overlap", False)) \
+                if pc is not None else False
+            axes = _a2a_axes(pd)
+            devs = (self._participants(pc, ndev, op)
+                    if pc is not None else list(range(ndev)))
+            for i, kind, size in axes:
                 t_ax = self.cost.alltoall_time_axes(bytes_per_dev,
                                                     [(kind, size)])
                 if t_ax <= 0:
                     continue
+                if not overlap:
+                    step = [new_task(t_ax, d, f"{label}[{topo[i][0]}]")
+                            for d in devs]
+                    for p in parents:
+                        for s in step:
+                            p.add_next(s)
+                    parents = step
+                    continue
+                rounds = (size - 1) if len(axes) == 1 \
+                    else _OVERLAP_CHUNKS
+                resid = ((1.0 - self.cost.overlap_efficiency()) * t_ax
+                         + self.cost.overlap_round_overhead(rounds))
                 c = new_task(t_ax, self._channel(i),
                              f"{label}[{topo[i][0]}]")
-                for p in parents:
+                for p in (hide_under if hide_under is not None
+                          else parents):
                     p.add_next(c)
-                parents = [c]
+                # downstream waits on the compute frontier AND (unless
+                # the consumer drains per-round) the channel
+                frontier = list(parents) if hide_under is not None \
+                    else []
+                if not tail:
+                    frontier.append(c)
+                elif hide_under is None:
+                    frontier += list(parents)
+                if resid > 0:
+                    step = [new_task(resid, d,
+                                     f"{label}_resid[{topo[i][0]}]")
+                            for d in devs]
+                    for p in parents:
+                        for s in step:
+                            p.add_next(s)
+                    frontier += step
+                parents = frontier or [c]
+                hide_under = None
             return parents
 
         # forward tasks per op per participating device
@@ -284,12 +343,18 @@ class Simulator:
                         pre = [new_task(t_sort, d, f"dedup:{op.name}")
                                for d in self._participants(pc, ndev,
                                                            op)]
-                req = _a2a_chain(pre, req_b, pd, f"a2a_idx:{op.name}")
+                req = _a2a_chain(pre, req_b, pd, f"a2a_idx:{op.name}",
+                                 pc=pc, op=op)
                 for r in req:
                     for ft in fwd_of[op.name]:
                         r.add_next(ft)
+                # pipelined plans ship the first rounds' rows while the
+                # later rounds still gather: the rows exchange starts
+                # where the gather starts (the routed-ids frontier)
                 fwd_of[op.name] = _a2a_chain(fwd_of[op.name], rows_b,
-                                             pd, f"a2a_rows:{op.name}")
+                                             pd, f"a2a_rows:{op.name}",
+                                             pc=pc, op=op,
+                                             hide_under=req)
             # dependency + resharding comm from producers
             for src in op.inputs:
                 if src.owner_op is None or isinstance(src.owner_op, InputOp):
@@ -366,8 +431,12 @@ class Simulator:
                 # all-reduce — optimizer state stays shard-local
                 _, _, grad_b = op.alltoall_payload_bytes(ndev, itemsize,
                                                          pc=pc)
+                # pipelined plans scatter each arriving round while the
+                # next is in flight: the update drains the exchange
+                # per-round instead of waiting for the full buffer
                 parents = _a2a_chain(parents, grad_b, pd,
-                                     f"a2a_grad:{op.name}")
+                                     f"a2a_grad:{op.name}", pc=pc,
+                                     op=op, tail=True)
                 # hybrid placement: the replicated hot head applies its
                 # (small) update stream in lockstep from an all-gather —
                 # the allreduce-style cost the simulator already prices
@@ -504,31 +573,35 @@ class Simulator:
         by_name = {op.name: op for op in self.model.ops}
 
         def _skew(pc, pd):
-            """Skew policies survive a clamp only while the exchange
-            itself does (pd > 1) — a fully-replicated table has nothing
-            to dedup and no cold tail to split."""
+            """Skew/pipelining policies survive a clamp only while the
+            exchange itself does (pd > 1) — a fully-replicated table
+            has nothing to dedup, no cold tail to split, and no
+            exchange to overlap."""
             if pd > 1:
                 return (getattr(pc, "exchange", "dense"),
-                        getattr(pc, "hot_fraction", 0.0))
-            return "dense", 0.0
+                        getattr(pc, "hot_fraction", 0.0),
+                        bool(getattr(pc, "overlap", False)))
+            return "dense", 0.0, False
 
         for name, pc in strategies.items():
             op = by_name.get(name)
             pd = clamp_param_degree(getattr(pc, "param_degree", 1),
                                     axis_sizes)
-            exch, frac = _skew(pc, pd)
+            exch, frac, ovl = _skew(pc, pd)
             if (op is None or not op.outputs
                     or getattr(op, "raw_degree_semantics", False)):
                 if (pd != getattr(pc, "param_degree", 1)
                         or exch != getattr(pc, "exchange", "dense")
-                        or frac != getattr(pc, "hot_fraction", 0.0)):
+                        or frac != getattr(pc, "hot_fraction", 0.0)
+                        or ovl != bool(getattr(pc, "overlap", False))):
                     pc = ParallelConfig(
                         pc.degrees, pc.device_type,
                         pc.device_ids, pc.memory_types,
                         param_degree=pd, exchange=exch,
                         hot_fraction=frac,
                         quant_dtype=getattr(pc, "quant_dtype", ""),
-                        quant_update=getattr(pc, "quant_update", ""))
+                        quant_update=getattr(pc, "quant_update", ""),
+                        overlap=ovl)
                 out[name] = pc
                 continue
             shape = op.outputs[0].shape
@@ -536,7 +609,8 @@ class Simulator:
             degs += [1] * (len(shape) - len(degs))
             changed = (pd != getattr(pc, "param_degree", 1)
                        or exch != getattr(pc, "exchange", "dense")
-                       or frac != getattr(pc, "hot_fraction", 0.0))
+                       or frac != getattr(pc, "hot_fraction", 0.0)
+                       or ovl != bool(getattr(pc, "overlap", False)))
             for i, d in enumerate(degs):
                 d = min(d, shape[i])
                 while d > 1 and (shape[i] % d != 0 or d not in feas):
@@ -550,7 +624,8 @@ class Simulator:
                              param_degree=pd, exchange=exch,
                              hot_fraction=frac,
                              quant_dtype=getattr(pc, "quant_dtype", ""),
-                             quant_update=getattr(pc, "quant_update", ""))
+                             quant_update=getattr(pc, "quant_update", ""),
+                             overlap=ovl)
                          if changed else pc)
         return out
 

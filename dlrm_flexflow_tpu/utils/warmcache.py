@@ -143,7 +143,8 @@ def strategy_signature(strategies) -> str:
                    list(pc.memory_types),
                    int(getattr(pc, "param_degree", 1)),
                    getattr(pc, "exchange", "dense"),
-                   float(getattr(pc, "hot_fraction", 0.0))]
+                   float(getattr(pc, "hot_fraction", 0.0)),
+                   bool(getattr(pc, "overlap", False))]
             for name, pc in (strategies or {}).items()}
     return _sha1(json.dumps(desc, sort_keys=True))[:16]
 
@@ -157,7 +158,8 @@ def _pc_to_json(pc) -> Dict[str, Any]:
             "memory_types": list(pc.memory_types),
             "param_degree": int(getattr(pc, "param_degree", 1)),
             "exchange": getattr(pc, "exchange", "dense"),
-            "hot_fraction": float(getattr(pc, "hot_fraction", 0.0))}
+            "hot_fraction": float(getattr(pc, "hot_fraction", 0.0)),
+            "overlap": bool(getattr(pc, "overlap", False))}
 
 
 def _pc_from_json(d: Dict[str, Any]):
@@ -167,7 +169,8 @@ def _pc_from_json(d: Dict[str, Any]):
                           memory_types=tuple(d.get("memory_types", ())),
                           param_degree=int(d.get("param_degree", 1)),
                           exchange=d.get("exchange", "dense"),
-                          hot_fraction=float(d.get("hot_fraction", 0.0)))
+                          hot_fraction=float(d.get("hot_fraction", 0.0)),
+                          overlap=bool(d.get("overlap", False)))
 
 
 class PlanCache:

@@ -1,0 +1,474 @@
+"""Pipelined row-shard exchange — ParallelConfig.overlap (ISSUE 19).
+
+Everything runs on the 8-device virtual CPU mesh. Pinned contracts:
+
+- the overlapped exchange (ring ppermute rounds on a single mesh axis,
+  capacity-chunked all-to-alls across factorized axes) is BIT-IDENTICAL
+  to the serial fused ``lax.all_to_all`` — forward, routed backward and
+  optimizer update — for SGD/momentum/Adam, dense and dedup'd
+  exchanges, pd in {4, 8}, duplicate-heavy batches, K=4 fused
+  supersteps, and both decompositions (multi-axis chunked on the
+  factorized mesh, multi-round ring on a single-axis mesh). Overlap
+  changes WHEN bytes move, never what arrives;
+- elastic recovery drains the pipeline: a device drop mid-fit reshards
+  the overlapped tables across the survivors bit-identically to a
+  fresh clamped run, and the clamped plan KEEPS overlap while the
+  exchange survives (pd > 1) and drops it when the table de-shards;
+- strategy files round-trip the overlap flag (.json "overlap" / .pb
+  field 11); files without it stay byte-identical to the pre-overlap
+  encoder; validation rejects overlap without row sharding and on ops
+  with no row-shard support;
+- the cost model prices the pipelined exchange via
+  exposed_exchange_time (residual + per-round overhead, calibrated by
+  benchmarks/overlap_calibration.json); on the sharded DCN fixture the
+  simulator prices overlap >= 1.5x serial step time and the MCMC walk
+  flips it on unforced; shardcheck FLX514 flags serialized exchanges a
+  pipelined plan would hide and stays silent once overlap is on.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                           dlrm_strategy, synthetic_batch)
+from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+from dlrm_flexflow_tpu.parallel.pconfig import ParallelConfig
+from dlrm_flexflow_tpu.parallel import strategy_io
+from dlrm_flexflow_tpu.search.cost_model import CostModel
+from dlrm_flexflow_tpu.search.replan import clamp_strategies
+from dlrm_flexflow_tpu.search.simulator import Simulator
+from dlrm_flexflow_tpu.utils import faults
+from dlrm_flexflow_tpu.utils.checkpoint import restore_checkpoint
+
+ROWS, T, D, BS = 1024, 4, 8, 32
+
+DCFG = DLRMConfig(embedding_size=[ROWS] * T, sparse_feature_size=D,
+                  embedding_bag_size=2,
+                  mlp_bot=[D, 16, D], mlp_top=[D * (T + 1), 16, 1])
+
+
+def _opt(name):
+    if name == "adam":
+        return ff.AdamOptimizer(alpha=0.05)
+    if name == "momentum":
+        return ff.SGDOptimizer(lr=0.05, momentum=0.9)
+    return ff.SGDOptimizer(lr=0.05)
+
+
+def _build(ndev, pd, opt="sgd", overlap=False, exchange="dense",
+           hot=0.0, mesh=None, batch=BS, **cfg_kw):
+    model = ff.FFModel(ff.FFConfig(batch_size=batch, seed=3, **cfg_kw))
+    build_dlrm(model, DCFG)
+    strategies = {}
+    for op in model.ops:
+        tn = type(op).__name__
+        nd = op.outputs[0].num_dims if op.outputs else 0
+        if tn == "EmbeddingBagStacked":
+            strategies[op.name] = ParallelConfig(
+                (ndev, 1, 1), param_degree=pd, exchange=exchange,
+                hot_fraction=hot, overlap=overlap)
+        elif nd:
+            strategies[op.name] = ParallelConfig.data_parallel(nd, ndev)
+    model.compile(_opt(opt), "mean_squared_error", ["mse"],
+                  mesh=mesh or make_mesh(devices=jax.devices()[:ndev]),
+                  strategies=strategies)
+    model.init_layers()
+    return model
+
+
+def _emb(model):
+    return next(op for op in model.ops
+                if type(op).__name__ == "EmbeddingBagStacked")
+
+
+def _all_params(model):
+    return {f"{o}/{p}": np.asarray(v)
+            for o, pd_ in model.params.items() for p, v in pd_.items()}
+
+
+def _dup_heavy_batches(n, batch=BS):
+    """zipf(1.2) ids over 1024-row tables: duplicates guaranteed, so
+    any accumulation-order slip the decomposed exchange could introduce
+    would show immediately."""
+    out = []
+    for i in range(n):
+        x, y = synthetic_batch(DCFG, batch, seed=i, zipf_alpha=1.2)
+        x["label"] = y
+        out.append(x)
+    return out
+
+
+def _train_bitwise(m_a, m_b, batches, label=""):
+    for x in batches:
+        l_a = float(m_a.train_batch(dict(x))["loss"])
+        l_b = float(m_b.train_batch(dict(x))["loss"])
+        assert l_a == l_b, (label, l_a, l_b)
+    p_a, p_b = _all_params(m_a), _all_params(m_b)
+    assert set(p_a) == set(p_b)
+    for name in p_a:
+        np.testing.assert_array_equal(
+            p_a[name], p_b[name], err_msg=f"{label}: {name} diverged")
+
+
+class TestOverlapBitIdentity:
+    def test_plan_activates(self):
+        m = _build(8, 8, overlap=True)
+        emb = _emb(m)
+        assert emb._row_plan is not None
+        assert emb._row_plan.overlap
+        assert m.strategies[emb.name].overlap
+
+    @pytest.mark.parametrize("opt", ["sgd", "momentum", "adam"])
+    @pytest.mark.parametrize("pd", [4, 8])
+    def test_train_bit_identical_to_serial(self, opt, pd):
+        batches = _dup_heavy_batches(3)
+        m_ser = _build(8, pd, opt=opt)
+        m_ovl = _build(8, pd, opt=opt, overlap=True)
+        assert _emb(m_ovl)._row_plan.overlap
+        _train_bitwise(m_ser, m_ovl, batches, f"overlap {opt} pd{pd}")
+
+    @pytest.mark.parametrize("exchange,hot", [("dedup", 0.0),
+                                              ("dedup", 0.125)])
+    def test_composes_with_skew_exchange(self, exchange, hot):
+        """overlap rides the dedup'd (and hybrid hot/cold) exchange
+        unchanged — the decomposition wraps whatever payload the skew
+        policy routes."""
+        batches = _dup_heavy_batches(2)
+        m_ser = _build(8, 8, exchange=exchange, hot=hot)
+        m_ovl = _build(8, 8, exchange=exchange, hot=hot, overlap=True)
+        _train_bitwise(m_ser, m_ovl, batches, f"overlap {exchange}/{hot}")
+
+    def test_single_axis_ring_bit_identical(self):
+        """On a ONE-axis mesh the exchange decomposes into S-1 ppermute
+        ring rounds (the multi-axis runs above take the capacity-chunked
+        path) — same bitwise contract."""
+        devs = np.asarray(jax.devices()[:8])
+        mesh = Mesh(devs, ("f0",))
+        m_ser = _build(8, 8, mesh=Mesh(devs, ("f0",)))
+        m_ovl = _build(8, 8, overlap=True, mesh=mesh)
+        plan = _emb(m_ovl)._row_plan
+        assert plan.overlap and len(plan.row_axes) == 1
+        _train_bitwise(m_ser, m_ovl, _dup_heavy_batches(2), "ring")
+
+    def test_forward_bit_identical(self):
+        m_ser = _build(8, 8)
+        m_ovl = _build(8, 8, overlap=True)
+        x, _ = synthetic_batch(DCFG, BS, seed=0)
+        np.testing.assert_array_equal(
+            np.asarray(m_ser.forward_batch(dict(x))),
+            np.asarray(m_ovl.forward_batch(dict(x))))
+
+    @pytest.mark.slow
+    def test_superstep_k4_bit_identical(self):
+        """K=4 fused supersteps: the decomposed exchange inside the
+        scan stays bitwise the serial one."""
+        NB = 4
+        x, y = synthetic_batch(DCFG, BS * NB, seed=7, zipf_alpha=1.2)
+        m_ser = _build(8, 8, superstep=4)
+        m_ovl = _build(8, 8, overlap=True, superstep=4)
+        m_ser.fit(x, y, epochs=1, verbose=False)
+        m_ovl.fit(x, y, epochs=1, verbose=False)
+        p_a, p_b = _all_params(m_ser), _all_params(m_ovl)
+        for name in p_a:
+            np.testing.assert_array_equal(p_a[name], p_b[name])
+
+
+class TestElasticDrain:
+    def test_drop_mid_fit_drains_and_reshards(self, tmp_path):
+        """A device drop mid-fit under an OVERLAPPED plan drains the
+        pipeline and reshards 8 -> 4, bit-identical to a fresh 4-device
+        run from the same snapshot — and the clamped plan keeps
+        overlap=True (the surviving exchange still pipelines)."""
+        NB = 6
+        x, y = synthetic_batch(DCFG, BS * NB, seed=7)
+        k, drop = 4, 4
+
+        def strat_for(model, ndev):
+            s = dlrm_strategy(model, DCFG, ndev)
+            for op in model.ops:
+                if type(op).__name__ == "EmbeddingBagStacked":
+                    s[op.name] = ParallelConfig((ndev, 1, 1),
+                                                param_degree=ndev,
+                                                overlap=True)
+            return s
+
+        mA = ff.FFModel(ff.FFConfig(batch_size=BS, seed=2,
+                                    elastic="resume",
+                                    elastic_search_budget=0))
+        build_dlrm(mA, DCFG)
+        mA.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                   ["mse"], mesh=make_mesh(devices=jax.devices()[:8]),
+                   strategies=strat_for(mA, 8))
+        mA.init_layers()
+        with faults.active_plan(faults.FaultPlan(
+                drop_device_steps={k: drop})):
+            res = mA.fit(x, y, epochs=1, verbose=False,
+                         checkpoint_dir=str(tmp_path), save_every=2,
+                         keep_last=50)
+        assert res["recoveries"] == 1
+        assert mA.mesh.size == 4
+        embA = _emb(mA)
+        assert embA._row_plan is not None
+        assert embA._row_plan.nshards == 4
+        pcA = mA.strategies[embA.name]
+        assert pcA.param_degree == 4 and pcA.overlap
+
+        planner = ff.FFModel(ff.FFConfig(batch_size=BS, seed=2))
+        build_dlrm(planner, DCFG)
+        stratB = clamp_strategies(planner, strat_for(planner, 8), 4)
+        assert stratB[embA.name].param_degree == 4
+        assert stratB[embA.name].overlap
+        mB = ff.FFModel(ff.FFConfig(batch_size=BS, seed=2,
+                                    elastic="resume"))
+        build_dlrm(mB, DCFG)
+        mB.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error",
+                   ["mse"], mesh=make_mesh(devices=jax.devices()[:4]),
+                   strategies=stratB)
+        mB.init_layers()
+        snap = str(tmp_path / f"ckpt-{k:08d}.npz")
+        assert os.path.exists(snap), sorted(os.listdir(str(tmp_path)))
+        restore_checkpoint(mB, snap)
+        for b in range(k, NB):
+            batch = {kk: v[b * BS:(b + 1) * BS] for kk, v in x.items()}
+            batch["label"] = y[b * BS:(b + 1) * BS]
+            mB.train_batch(batch)
+        pA, pB = _all_params(mA), _all_params(mB)
+        assert set(pA) == set(pB)
+        for name in pA:
+            np.testing.assert_array_equal(
+                pA[name], pB[name],
+                err_msg=f"{name}: drained/resharded run diverged")
+
+    def test_clamp_drops_overlap_with_the_shard(self):
+        """overlap dies with the exchange: clamping to one device (no
+        row shards, nothing to pipeline) clears the flag, in both the
+        replan clamp and the simulator's projection."""
+        m = _build(8, 8, overlap=True)
+        emb = _emb(m)
+        strat = {op.name: m.strategies[op.name] for op in m.ops
+                 if op.outputs}
+        out = clamp_strategies(m, strat, 1)
+        assert out[emb.name].param_degree == 1
+        assert not out[emb.name].overlap
+        sim = Simulator(m, CostModel())
+        out2 = sim._clamp_strategies(
+            {emb.name: ParallelConfig((1, 1, 1), param_degree=8,
+                                      overlap=True)}, 1)
+        assert out2[emb.name].param_degree == 1
+        assert not out2[emb.name].overlap
+
+
+class TestOverlapStrategyIO:
+    def _strat(self):
+        return {"emb_stack": ParallelConfig((8, 1, 1), param_degree=8,
+                                            overlap=True),
+                "top_dense_0": ParallelConfig((8, 1))}
+
+    @pytest.mark.parametrize("ext", ["json", "pb"])
+    def test_overlap_round_trips(self, tmp_path, ext):
+        p = str(tmp_path / f"s.{ext}")
+        strategy_io.save_strategies(p, self._strat())
+        out = strategy_io.load_strategies(p, num_devices=8)
+        assert out["emb_stack"].overlap is True
+        assert out["emb_stack"].param_degree == 8
+        assert out["top_dense_0"].overlap is False
+
+    def test_legacy_files_byte_identical_without_overlap(self, tmp_path):
+        """overlap=False must not change the encoding: goldens written
+        before field 11 existed stay stable."""
+        legacy = {"emb": ParallelConfig((1, 8, 1), param_degree=8),
+                  "lin": ParallelConfig((8, 1))}
+        p1, p2 = str(tmp_path / "a.pb"), str(tmp_path / "b.pb")
+        strategy_io.save_strategies(p1, legacy)
+        strategy_io.save_strategies(p2, {
+            k: ParallelConfig(v.degrees, param_degree=v.param_degree,
+                              overlap=False)
+            for k, v in legacy.items()})
+        with open(p1, "rb") as a, open(p2, "rb") as b:
+            assert a.read() == b.read()
+
+    def test_validation_rejects_overlap_without_row_shard(self, tmp_path):
+        p = str(tmp_path / "bad.json")
+        with open(p, "w") as f:
+            json.dump({"ops": [{"name": "embedding0", "dims": [1, 1],
+                                "overlap": True}]}, f)
+        with pytest.raises(strategy_io.StrategyValidationError,
+                           match="without row sharding"):
+            strategy_io.load_strategies(p, num_devices=8)
+
+    def test_validation_rejects_overlap_on_non_embedding_op(
+            self, tmp_path):
+        p = str(tmp_path / "bad2.json")
+        strategy_io.save_strategies(p, {
+            "top_dense_0": ParallelConfig((8, 1), param_degree=8,
+                                          overlap=True)})
+        with pytest.raises(strategy_io.StrategyValidationError,
+                           match="no row-shard support"):
+            strategy_io.load_strategies(
+                p, num_devices=8, row_shard_ops={"emb_stack"})
+        strategy_io.load_strategies(
+            p, num_devices=8, row_shard_ops={"top_dense_0"})
+
+    def test_plan_cache_round_trips_overlap(self, tmp_path):
+        from dlrm_flexflow_tpu.utils.warmcache import (_pc_from_json,
+                                                       _pc_to_json,
+                                                       strategy_signature)
+        pc = ParallelConfig((8, 1, 1), param_degree=8, exchange="dedup",
+                            overlap=True)
+        out = _pc_from_json(_pc_to_json(pc))
+        assert out.overlap is True and out.param_degree == 8
+        # the signature keys the compile cache: flipping overlap MUST
+        # change it (the lowered exchange differs)
+        ser = ParallelConfig((8, 1, 1), param_degree=8, exchange="dedup")
+        assert strategy_signature({"e": pc}) != \
+            strategy_signature({"e": ser})
+
+
+# =====================================================================
+# cost model + search: the pipelined plan must WIN where it should and
+# be discovered unforced (ISSUE 19 search bar)
+# =====================================================================
+
+def _dcn_fixture_model(n=8):
+    """The sharded-DCN bar fixture (bench_shard._sim_overlap_dcn's
+    shape): multi-hot bag 64 over 4 x 1M x 384 tables, heavy dense MLPs
+    — a fat exchange with a fat compute window to hide under."""
+    dcfg = DLRMConfig(embedding_size=[1000000] * 4,
+                      embedding_bag_size=64, sparse_feature_size=384,
+                      mlp_bot=[64, 512, 512, 384],
+                      mlp_top=[384 * 5, 512, 512, 1])
+    model = ff.FFModel(ff.FFConfig(batch_size=2048 * n))
+    build_dlrm(model, dcfg)
+    model.optimizer = ff.SGDOptimizer(lr=0.1)
+    return model, n
+
+
+def _row_plan(model, n, pd=None, **kw):
+    from dlrm_flexflow_tpu.search.mcmc import default_strategy
+    emb = _emb(model)
+    s = default_strategy(model, n)
+    s[emb.name] = ParallelConfig((n, 1, 1),
+                                 param_degree=n if pd is None else pd,
+                                 **kw)
+    return s
+
+
+@pytest.fixture(scope="module")
+def dcn_fixture():
+    return _dcn_fixture_model()
+
+
+class TestOverlapCost:
+    def test_exposed_exchange_time(self):
+        cm = CostModel()
+        # serial pays everything, window or not
+        assert cm.exposed_exchange_time(1e-3, 5e-3, False) == 1e-3
+        # pipelined: hides eff * min(window, exchange), pays the rounds
+        eff = cm.overlap_efficiency()
+        t = cm.exposed_exchange_time(1e-3, 5e-3, True, rounds=7)
+        assert t == pytest.approx(
+            1e-3 - eff * 1e-3 + cm.overlap_round_overhead(7))
+        # no window to hide under -> overlap only ADDS overhead
+        t0 = cm.exposed_exchange_time(1e-3, 0.0, True, rounds=7)
+        assert t0 > 1e-3
+
+    def test_calibration_artifact_loads(self):
+        """The committed benchmarks/overlap_calibration.json is what the
+        cost model actually reads."""
+        from dlrm_flexflow_tpu.search.cost_model import (
+            load_overlap_calibration)
+        cal = load_overlap_calibration()
+        assert cal is not None
+        cm = CostModel()
+        assert cm.overlap_efficiency() == pytest.approx(
+            min(0.99, max(0.0, float(cal["overlap_efficiency"]))))
+        assert cm.overlap_round_overhead(7) == pytest.approx(
+            7 * float(cal["round_overhead_s"]))
+
+    def test_sim_1_5x_on_sharded_dcn(self, dcn_fixture):
+        """THE perf bar: >= 1.5x simulated step time vs the serial
+        exchange on the sharded DCN topology."""
+        model, n = dcn_fixture
+        sim = Simulator(model, CostModel(), topology=[("dcn", n)])
+        t_ser = sim.simulate(_row_plan(model, n), n)
+        t_ovl = sim.simulate(_row_plan(model, n, overlap=True), n)
+        assert np.isfinite(t_ser) and np.isfinite(t_ovl)
+        assert t_ser / t_ovl >= 1.5, (t_ser, t_ovl, t_ser / t_ovl)
+
+    def test_overlap_noop_without_exchange(self, dcn_fixture):
+        """On an UNSHARDED (pd=1, replicated-table) plan there is no
+        exchange to pipeline: the flag prices as an exact no-op —
+        overlap can never price below serial by accident."""
+        model, n = dcn_fixture
+        sim = Simulator(model, CostModel(), topology=[("dcn", n)])
+        t_ser = sim.simulate(_row_plan(model, n, pd=1), n)
+        t_ovl = sim.simulate(_row_plan(model, n, pd=1, overlap=True), n)
+        assert t_ovl == pytest.approx(t_ser)
+
+    def test_overlap_task_schedule(self, dcn_fixture):
+        """The task graph carries the pipelined exchange as channel
+        tasks plus a residual on the compute devices — not the serial
+        blocking tasks."""
+        model, n = dcn_fixture
+        sim = Simulator(model, CostModel(), topology=[("dcn", n)])
+        tasks = sim.build_task_graph(
+            sim._clamp_strategies(_row_plan(model, n, overlap=True), n),
+            n)
+        names = [t.name for t in tasks]
+        assert any(n_.startswith("a2a_rows:") and "resid" in n_
+                   for n_ in names), names
+
+    def test_mcmc_discovers_overlap(self, dcn_fixture):
+        """Unforced discovery: starting from the SERIAL row-sharded
+        plan, the search flips overlap on because the priced residual
+        beats the blocking exchange (same fp32 cost model as the grid
+        above — under it pd=8+overlap is the global optimum)."""
+        from dlrm_flexflow_tpu.search.mcmc import optimize
+        model, n = dcn_fixture
+        best = optimize(model, budget=80, ndev=n, seed=1,
+                        start=_row_plan(model, n),
+                        cost_model=CostModel(),
+                        topology=[("dcn", n)])
+        pc = best[_emb(model).name]
+        assert pc.param_degree > 1
+        assert pc.overlap, pc
+
+
+class TestFLX514:
+    def _plan_model(self, n=8):
+        """Exchange-heavy, window-poor: wide rows, thin MLPs — the
+        serial transfer dwarfs the compute it could hide under."""
+        dcfg = DLRMConfig(embedding_size=[1000000] * 8,
+                          sparse_feature_size=256,
+                          mlp_bot=[16, 32, 256], mlp_top=[2304, 64, 1])
+        model = ff.FFModel(ff.FFConfig(batch_size=8192))
+        build_dlrm(model, dcfg)
+        model.optimizer = ff.SGDOptimizer(lr=0.1)
+        return model, n
+
+    def test_fires_on_serialized_exchange(self):
+        from dlrm_flexflow_tpu.analysis.shardcheck import verify_plan
+        model, n = self._plan_model()
+        plan = _row_plan(model, n)
+        out = [f for f in verify_plan(model, plan, ndev=n,
+                                      topology=[("dcn", n)])
+               if f.rule == "FLX514"]
+        assert out, "expected FLX514 on the serialized DCN exchange"
+        assert out[0].severity == "high"
+        assert "overlap=True" in out[0].message
+
+    def test_silent_with_overlap_on(self):
+        from dlrm_flexflow_tpu.analysis.shardcheck import verify_plan
+        model, n = self._plan_model()
+        plan = _row_plan(model, n, overlap=True)
+        out = [f for f in verify_plan(model, plan, ndev=n,
+                                      topology=[("dcn", n)])
+               if f.rule == "FLX514"]
+        assert out == []
