@@ -349,6 +349,25 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         except Exception as exc:
             scenario = {"error": str(exc)[:200]}
 
+    # opt-in retrieval smoke (BENCH_RETRIEVE=1): recall@100 of the int8
+    # sharded MIPS top-k vs the fp32 exact scan (bar: >= 0.95), per-
+    # shard scoring throughput for 1/2/4 shards, and cascade QPS at a
+    # p99 SLO under open-loop Poisson load with a one-shard-dead chaos
+    # phase (bar: zero failed requests, degraded-flagged only)
+    retrieve = None
+    if os.environ.get("BENCH_RETRIEVE"):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from bench_retrieve import measure as _rtv_measure
+            retrieve = _rtv_measure(
+                requests=int(os.environ.get("BENCH_RETRIEVE_REQUESTS",
+                                            "128")),
+                slo_ms=float(os.environ.get("BENCH_RETRIEVE_SLO_MS",
+                                            "150")))
+        except Exception as exc:
+            retrieve = {"error": str(exc)[:200]}
+
     vs = 1.0
     base_file = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE")
     if os.path.exists(base_file):
@@ -394,6 +413,8 @@ def _run(jax, ff, DLRMConfig, build_dlrm, dlrm_strategy, synthetic_batch):
         out["obs"] = obs
     if scenario is not None:
         out["scenario"] = scenario
+    if retrieve is not None:
+        out["retrieve"] = retrieve
     print(json.dumps(out))
     return 0
 

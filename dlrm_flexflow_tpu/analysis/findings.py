@@ -151,6 +151,13 @@ RULES = {
                "the lowered HLO materializes the (B, F, F) pairwise-dot "
                "interaction tensor in HBM (unfused gather→bmm→tril "
                "chain where the fused Pallas kernel keeps it in VMEM)"),
+    "FLX516": ("retrieval-index-overreplicated", "medium",
+               "a retrieval MIPS index is replicated per ranker instead "
+               "of riding the sharded embedding tier: every ranker pays "
+               "the full codes+scales residency (high when the combined "
+               "ranker + index bytes exceed the --hbm-gb budget — the "
+               "cascade cannot boot) where the sharded index stores "
+               "each row once and answers local top-k in place"),
 }
 
 

@@ -63,6 +63,13 @@ baseline machinery):
   backoff serially (``--serving-rtt-ms``, defaulting to the
   transport's measured floor); a floor past the SLO means no load
   level can make it.
+- FLX516 retrieval-index-overreplicated: a retrieval MIPS index
+  (``retrieve/index.py``) replicated per ranker instead of riding the
+  sharded embedding tier (``--retrieve-index-rows N
+  [--retrieve-index-dim D --retrieve-index-quant DT
+  --retrieve-index-sharded]``) — every ranker pays the full
+  codes+scales residency; high severity when the combined ranker +
+  index bytes break the ``--hbm-gb`` budget.
 
 The lowered-HLO half of the PR lives in :mod:`.hlo_audit` (FLX51x).
 """
@@ -395,6 +402,7 @@ def verify_serving_plan(model, replicas: int,
                         serving_rtt_ms: Optional[float] = None,
                         lookup_retries: int = 2,
                         backoff_ms: float = 5.0,
+                        retrieve_index: Optional[Dict] = None,
                         path: str = "<serving>") -> List[Finding]:
     """Audit a SERVING deployment the way :func:`verify_plan` audits a
     training plan — statically, no devices needed.
@@ -416,6 +424,13 @@ def verify_serving_plan(model, replicas: int,
       a hand-edited or version-skewed plan can, and a gap serves
       default rows for ids nobody owns while an overlap double-serves
       (and double-publishes) rows.
+
+    ``retrieve_index`` (or the ``retrieve_index`` entry a cascade's
+    ``serving_plan()`` reports) describes a retrieval MIPS index —
+    ``{"rows": ..., "dim": ..., "quant": ..., "sharded": ...}``. An
+    index NOT riding the sharded tier replicates its codes+scales into
+    every ranker and is flagged under **FLX516** (high when the combined
+    per-ranker residency breaks the ``hbm_bytes`` budget).
 
     With ``serve_slo_ms`` set a third hazard is flagged under
     **FLX509** — an RTT budget the topology cannot meet. ``serving_rtt_ms``
@@ -501,6 +516,38 @@ def verify_serving_plan(model, replicas: int,
                " (a sharded tier would hold "
                f"{_fmt_bytes(fp['dense_bytes'])}/ranker)"),
             scope="<serving>", token="ranker-hbm"))
+
+    # --- FLX516: retrieval index riding (or not) the sharded tier ------
+    if retrieve_index is None and serving_plan:
+        retrieve_index = serving_plan.get("retrieve_index")
+    if retrieve_index:
+        rows = int(retrieve_index.get("rows", 0))
+        dim = int(retrieve_index.get("dim", 0))
+        quant = str(retrieve_index.get("quant", "int8"))
+        code_bytes = {"int8": 1, "fp8": 1, "fp16": 2,
+                      "fp32": 4}.get(quant, 1)
+        # codes + one fp32 scale per row — what QuantTable.nbytes counts
+        index_bytes = rows * dim * code_bytes + rows * 4
+        if not retrieve_index.get("sharded") and rows > 0:
+            over_hbm = (hbm_bytes is not None
+                        and fp["ranker_bytes"] + index_bytes
+                        > float(hbm_bytes))
+            findings.append(make_finding(
+                "FLX516", path, 0,
+                f"the retrieval index ({rows} x {dim} {quant}, "
+                f"{_fmt_bytes(float(index_bytes))}) is replicated into "
+                f"each of the {replicas} ranker(s) — "
+                f"{_fmt_bytes(float(index_bytes * max(replicas, 1)))} "
+                f"fleet-wide"
+                + (f"; per-ranker residency "
+                   f"{_fmt_bytes(fp['ranker_bytes'] + index_bytes)} "
+                   f"breaks the {_fmt_bytes(float(hbm_bytes))} budget — "
+                   f"the cascade cannot boot" if over_hbm else "")
+                + " — attach it to the sharded tier "
+                "(ShardedMIPSIndex.build on the EmbeddingShardSet) so "
+                "each row is stored once and scored in place",
+                scope="<serving>", token="retrieve-index",
+                severity="high" if over_hbm else "medium"))
 
     # --- FLX509: per-seam RTT budget vs the serve SLO ------------------
     if serve_slo_ms is not None and float(serve_slo_ms) > 0 \
@@ -907,6 +954,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="transient-retry budget the wire client is "
                          "configured with (FLX509 prices the serial "
                          "retry chain; default 2 = WireClient default)")
+    ap.add_argument("--retrieve-index-rows", type=int, default=None,
+                    metavar="N",
+                    help="also audit a retrieval MIPS index of N item "
+                         "rows in the serving deployment (FLX516: "
+                         "per-ranker replication of the codes+scales)")
+    ap.add_argument("--retrieve-index-dim", type=int, default=128,
+                    metavar="D",
+                    help="retrieval index embedding width (FLX516; "
+                         "default 128)")
+    ap.add_argument("--retrieve-index-quant", default="int8",
+                    choices=["int8", "fp8", "fp16", "fp32"],
+                    help="retrieval index code dtype (FLX516 residency "
+                         "pricing; default int8)")
+    ap.add_argument("--retrieve-index-sharded", action="store_true",
+                    help="the index rides the sharded embedding tier "
+                         "(FLX516 passes: rows stored once, scored in "
+                         "place)")
     ap.add_argument("--fail-on", default="high",
                     choices=["high", "medium", "low", "info", "never"])
     ap.add_argument("--baseline", default=DEFAULT_PLAN_BASELINE,
@@ -998,11 +1062,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             plan = {"nshards": args.serving_shards, "ranges": ranges,
                     "flat_rows": flat_rows,
                     "ranker_holds_tables": False}
+        ridx = None
+        if args.retrieve_index_rows is not None:
+            ridx = {"rows": args.retrieve_index_rows,
+                    "dim": args.retrieve_index_dim,
+                    "quant": args.retrieve_index_quant,
+                    "sharded": bool(args.retrieve_index_sharded)}
         findings.extend(verify_serving_plan(
             model, args.serving_replicas, plan, hbm_bytes=hbm,
             serve_slo_ms=args.serve_slo_ms,
             serving_rtt_ms=args.serving_rtt_ms,
             lookup_retries=args.serving_retries,
+            retrieve_index=ridx,
             path=f"<serving:{name}>"))
     findings = sort_findings(findings)
 

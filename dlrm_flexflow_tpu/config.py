@@ -292,6 +292,23 @@ class FFConfig:
     # --serve-transport tcp). 0 = all shards in-process. Set with
     # --serve-shard-procs N.
     serve_shard_procs: int = 0
+    # ---- retrieval cascade (dlrm_flexflow_tpu/retrieve/) --------------
+    # "on" puts the two-tower retrieve stage in front of the ranker:
+    # /predict answers USER requests (retrieve top-k, then rank the
+    # candidates) and POST /retrieve exposes the index directly. Set
+    # with --retrieve {off,on}.
+    retrieve: str = "off"
+    # candidates out of the retrieve stage per user. --retrieve-k N.
+    retrieve_k: int = 100
+    # retrieve-stage deadline feeding the per-request budget: the MIPS
+    # fan-out gets min(this, what's left of --serve-deadline-ms); the
+    # ranker gets the rest. --retrieve-deadline-ms MS.
+    retrieve_deadline_ms: float = 25.0
+    # how many index shards when the ranker tier is NOT sharded
+    # (--serve-shards 0): a standalone index-only shard set. With
+    # --serve-shards N the index rides those N shards and this knob
+    # must be 0 or equal to N. --retrieve-shards M.
+    retrieve_shards: int = 0
     # LRU cap on the eval-path AOT executable cache (_eval_step_execs):
     # serving many ad-hoc shapes must not leak executables. Evictions
     # are counted (FFModel.eval_exec_cache_stats / engine stats()). Set
@@ -537,6 +554,29 @@ class FFConfig:
                     raise ValueError(
                         f"--serve-shard-procs expects N >= 0, got "
                         f"{cfg.serve_shard_procs}")
+            elif a == "--retrieve":
+                v = take()
+                if v not in ("off", "on"):
+                    raise ValueError(f"--retrieve expects off|on, "
+                                     f"got {v!r}")
+                cfg.retrieve = v
+            elif a == "--retrieve-k":
+                cfg.retrieve_k = int(take())
+                if cfg.retrieve_k < 1:
+                    raise ValueError(f"--retrieve-k expects N >= 1, "
+                                     f"got {cfg.retrieve_k}")
+            elif a == "--retrieve-deadline-ms":
+                cfg.retrieve_deadline_ms = float(take())
+                if cfg.retrieve_deadline_ms < 0:
+                    raise ValueError(
+                        f"--retrieve-deadline-ms expects MS >= 0, got "
+                        f"{cfg.retrieve_deadline_ms}")
+            elif a == "--retrieve-shards":
+                cfg.retrieve_shards = int(take())
+                if cfg.retrieve_shards < 0:
+                    raise ValueError(
+                        f"--retrieve-shards expects N >= 0, got "
+                        f"{cfg.retrieve_shards}")
             elif a == "--eval-exec-cache":
                 cfg.eval_exec_cache = int(take())
             elif a == "--obs":

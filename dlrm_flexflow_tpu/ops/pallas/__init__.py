@@ -1,5 +1,10 @@
 from .embedding_kernel import (embedding_bag, embedding_bag_reference,
                                stacked_embedding_bag, supports)
+from .topk_kernel import (mips_topk, mips_topk_reference, quantize_query,
+                          score_rows_np, topk_select_np)
+from .topk_kernel import supports as topk_supports
 
 __all__ = ["embedding_bag", "embedding_bag_reference",
-           "stacked_embedding_bag", "supports"]
+           "stacked_embedding_bag", "supports",
+           "mips_topk", "mips_topk_reference", "quantize_query",
+           "score_rows_np", "topk_select_np", "topk_supports"]

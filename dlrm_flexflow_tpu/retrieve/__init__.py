@@ -1,0 +1,28 @@
+"""Retrieval stage: two-tower candidate generation + sharded MIPS
+top-k on the embedding substrate, cascaded into the existing ranker.
+
+ - model.py   : the two-tower model (train/user/item heads), trained
+                through the ordinary ``fit()`` path with in-batch
+                sampled softmax
+ - index.py   : the sharded MIPS index — int8 QuantTable codes on the
+                EmbeddingShard substrate, exact heap-merge at the ranker
+ - cascade.py : retrieve -> rank in one fleet behind one deadline budget
+"""
+
+from .cascade import (CascadeConfig, CascadeEngine, CascadePrediction,
+                      dlrm_candidate_features)
+from .index import (INDEX_DELTA_KEY, RetrievalResult, ShardedMIPSIndex,
+                    merge_partials)
+from .model import (TwoTowerConfig, build_two_tower, in_batch_labels,
+                    item_embeddings, synthetic_two_tower_batch,
+                    transfer_tower_params, two_tower_strategy)
+
+__all__ = [
+    "CascadeConfig", "CascadeEngine", "CascadePrediction",
+    "dlrm_candidate_features",
+    "INDEX_DELTA_KEY", "RetrievalResult", "ShardedMIPSIndex",
+    "merge_partials",
+    "TwoTowerConfig", "build_two_tower", "in_batch_labels",
+    "item_embeddings", "synthetic_two_tower_batch",
+    "transfer_tower_params", "two_tower_strategy",
+]

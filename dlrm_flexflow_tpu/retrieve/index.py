@@ -1,0 +1,225 @@
+"""Sharded MIPS index over the embedding-shard substrate.
+
+The index IS one more quantized table: item-tower output embeddings
+stored as PR-14 ``QuantTable`` int8 codes + fp32 row scales, attached to
+an :class:`~..serve.shardtier.EmbeddingShardSet` so each
+``EmbeddingShard`` owns a contiguous row range and answers LOCAL top-k
+over it (``EmbeddingShard.topk`` — the Pallas kernel on TPU, the
+bit-identical oracle elsewhere). This buys, for free, everything the
+ranking tables already have: per-shard delta chains (one publish
+advances ranking AND retrieval from one manifest), version-vector
+old-or-new-never-mixed, circuit breakers, warm-cache persistence.
+
+**The merge is exact.** Every shard scores the same quantized query
+codes with the same integer dot and the same fixed-order fp32 rescale,
+so a row's score is identical wherever it lives; each shard's partial
+is sorted (score desc, id asc) and the ranker k-way heap-merges them on
+the same key. The result is therefore bitwise-identical to a
+single-machine exact scan over the same codes — pinned by the golden
+tests across shard counts {1, 2, 4}, ties and all.
+
+**Degradation drops, never invents.** A dead shard's candidates are
+simply absent from the merge: the answer is a correct top-k over the
+rows that answered, flagged ``degraded`` with the dropped slots named —
+candidates are never fabricated from defaults the way ranking rows
+degrade (a made-up candidate id would be served downstream as real).
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..ops.pallas.topk_kernel import (mips_topk_reference, quantize_query,
+                                      topk_select_np)
+from ..quant.store import QuantTable
+from ..serve.shardtier import (EmbeddingShard, EmbeddingShardSet,
+                               ShardReplica, ShardTierConfig)
+
+# the delta-payload key template the index publishes under — the same
+# "hostparams/<op>/kernel" namespace split_host_rows_by_shard routes
+INDEX_DELTA_KEY = "hostparams/{op}/kernel"
+
+
+class RetrievalResult(NamedTuple):
+    """One merged retrieval answer. ``ids``/``scores`` are (B, k'),
+    ordered (score desc, id asc) per row; ``versions`` is the per-shard
+    version vector actually read; ``dropped_slots`` names the shards
+    whose candidates are absent (degraded)."""
+
+    ids: np.ndarray                 # (B, k') int64
+    scores: np.ndarray              # (B, k') float32
+    versions: Dict[int, int]
+    degraded: bool
+    dropped_slots: List[int]
+    latency_ms: float
+
+
+def merge_partials(scores_by_slot: Dict[int, np.ndarray],
+                   ids_by_slot: Dict[int, np.ndarray],
+                   k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact k-way heap-merge of per-shard sorted partials.
+
+    Each partial row is sorted by (score desc, id asc) — i.e. ascending
+    in the key ``(-score, id)`` — so ``heapq.merge`` on that key is the
+    textbook exact merge: the first k popped are the global top-k in
+    the same order a single-machine sort would produce. fp32 negation
+    is exact, so the key order is bit-faithful to the scores."""
+    slots = sorted(scores_by_slot)
+    if not slots:
+        return (np.empty((0, 0), np.int64), np.empty((0, 0), np.float32))
+    B = scores_by_slot[slots[0]].shape[0]
+    avail = sum(scores_by_slot[s].shape[1] for s in slots)
+    kk = min(int(k), avail)
+    out_i = np.empty((B, kk), np.int64)
+    out_s = np.empty((B, kk), np.float32)
+    for b in range(B):
+        streams = [
+            zip(-scores_by_slot[s][b], ids_by_slot[s][b],
+                scores_by_slot[s][b])
+            for s in slots]
+        for j, (_neg, rid, sc) in enumerate(heapq.merge(*streams)):
+            if j >= kk:
+                break
+            out_i[b, j] = rid
+            out_s[b, j] = sc
+    return out_i, out_s
+
+
+class ShardedMIPSIndex:
+    """The retrieval index: quantized item embeddings attached to a
+    shard set, queried by quantize-once → per-shard local top-k →
+    exact merge."""
+
+    def __init__(self, shard_set: EmbeddingShardSet, op_name: str,
+                 n_items: int, dim: int,
+                 table: Optional[QuantTable] = None):
+        self.shard_set = shard_set
+        self.op_name = op_name
+        self.n_items = int(n_items)
+        self.dim = int(dim)
+        # the full code table, kept (int8 — cheap) for the exact-scan
+        # oracle and recall benches; None on memory-tight deployments
+        self.table = table
+        self.queries = 0
+        self.degraded_queries = 0
+
+    # --- construction ---------------------------------------------------
+    @classmethod
+    def build(cls, shard_set: EmbeddingShardSet,
+              embeddings: np.ndarray, op_name: str = "retrieve_index",
+              keep_table: bool = True) -> "ShardedMIPSIndex":
+        """Quantize (n_items, d) fp32 item-tower outputs to int8 codes
+        and attach them to ``shard_set`` as the retrieval index."""
+        table = (embeddings if isinstance(embeddings, QuantTable)
+                 else QuantTable.from_dense(
+                     np.asarray(embeddings, np.float32), "int8"))
+        if table.dtype != "int8":
+            raise ValueError("the MIPS index scores int8 codes; build "
+                             "the QuantTable with dtype='int8'")
+        shard_set.attach_index(op_name, table)
+        return cls(shard_set, op_name, table.shape[0], table.shape[1],
+                   table=table if keep_table else None)
+
+    @staticmethod
+    def standalone_set(nshards: int,
+                       config: Optional[ShardTierConfig] = None
+                       ) -> EmbeddingShardSet:
+        """An index-only shard set (no ranking tables behind it) — the
+        ``--retrieve-shards`` deployment shape when the ranker fleet is
+        not itself sharded. Attach the index with :meth:`build`."""
+        config = config or ShardTierConfig(nshards=nshards)
+        if config.nshards != nshards:
+            config.nshards = nshards
+        shards = [ShardReplica(EmbeddingShard(slot, slot, {}, {}))
+                  for slot in range(nshards)]
+        return EmbeddingShardSet(shards, config, {}, {}, {}, {}, {},
+                                 fingerprint="retrieve-standalone")
+
+    # --- the query path -------------------------------------------------
+    def topk(self, user_emb: np.ndarray, k: int,
+             deadline_s: Optional[float] = None,
+             degrade: Optional[str] = None) -> RetrievalResult:
+        """Top-k MIPS over the sharded index for a (B, d) fp32 query
+        batch. The query is quantized ONCE; every shard scores the same
+        codes, so the merged answer is exactly the single-machine scan
+        over the rows that answered."""
+        t0 = time.perf_counter()
+        q_codes, q_scales = quantize_query(user_emb)
+        if q_codes.shape[1] != self.dim:
+            raise ValueError(
+                f"query dim {q_codes.shape[1]} != index dim {self.dim}")
+        parts = self.shard_set.topk_partials(
+            q_codes, q_scales, int(k), deadline_s=deadline_s,
+            degrade=degrade)
+        ids, scores = merge_partials(parts.scores, parts.ids, int(k))
+        if ids.shape[1] == 0 and q_codes.shape[0] and not parts.scores:
+            ids = np.empty((q_codes.shape[0], 0), np.int64)
+            scores = np.empty((q_codes.shape[0], 0), np.float32)
+        self.queries += 1
+        if parts.degraded:
+            self.degraded_queries += 1
+        return RetrievalResult(
+            ids, scores, parts.versions, parts.degraded,
+            parts.dropped_slots,
+            1e3 * (time.perf_counter() - t0))
+
+    def exact_scan(self, user_emb: np.ndarray, k: int
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """Single-machine exact scan over the SAME quantized codes —
+        the golden-test twin of :meth:`topk` (returns (scores, ids))."""
+        if self.table is None:
+            raise ValueError("exact_scan needs the kept code table "
+                             "(build(keep_table=True))")
+        q_codes, q_scales = quantize_query(user_emb)
+        return mips_topk_reference(
+            q_codes, q_scales, np.asarray(self.table.q),
+            self.table.scales, int(k))
+
+    def exact_scan_fp32(self, user_emb: np.ndarray,
+                        item_emb: np.ndarray, k: int
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+        """fp32 exact scan over UNQUANTIZED item embeddings — the
+        recall@k reference (what the int8 path is measured against)."""
+        scores = (np.asarray(user_emb, np.float32)
+                  @ np.asarray(item_emb, np.float32).T)
+        ids = np.arange(item_emb.shape[0], dtype=np.int64)
+        return topk_select_np(scores, ids, int(k))
+
+    # --- freshness (one publish, both stages) ---------------------------
+    def delta_key(self) -> str:
+        return INDEX_DELTA_KEY.format(op=self.op_name)
+
+    def augment_delta(self, payload: Dict[str, Any],
+                      ids: np.ndarray, embeddings: np.ndarray
+                      ) -> Dict[str, Any]:
+        """Fold re-encoded item rows into a delta-publish payload so ONE
+        publish advances ranking tables and the index together: the
+        shard set routes the added ``hostparams/<op>/kernel`` entry
+        through the same split/CRC/apply path as every table row, and
+        the kept oracle table is updated in lockstep (the exact-scan
+        twin must keep describing what the shards serve)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        vals = np.asarray(embeddings, np.float32)
+        if vals.shape != (ids.size, self.dim):
+            raise ValueError(
+                f"augment_delta: embeddings {vals.shape} != "
+                f"({ids.size}, {self.dim})")
+        rows = payload.setdefault("rows", {})
+        rows[self.delta_key()] = (ids, vals)
+        if self.table is not None:
+            self.table.set_rows(ids, vals)
+        return payload
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "op": self.op_name,
+            "n_items": self.n_items,
+            "dim": self.dim,
+            "queries": self.queries,
+            "degraded_queries": self.degraded_queries,
+            "version_vector": self.shard_set.version_vector(),
+        }

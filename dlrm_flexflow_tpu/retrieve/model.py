@@ -1,0 +1,246 @@
+"""Two-tower candidate-generation model (Covington et al.-style).
+
+The retrieval half of the cascade: a USER tower (dense + sparse user
+features through the existing MLP/attention ops) and an ITEM tower (one
+embedding table + a small MLP) meet in a shared d-dim space where
+relevance is an inner product — which is what makes serving a
+maximum-inner-product search over the item corpus (retrieve/index.py).
+
+Training uses in-batch sampled softmax: the (B, d) user and item
+embeddings of one batch multiply into a (B, B) logit matrix where row b
+treats item b as the positive and the other B-1 in-batch items as
+sampled negatives — so the existing ``sparse_categorical_crossentropy``
+loss with labels ``arange(B)`` IS the retrieval loss, and the whole
+thing trains through the ordinary ``fit()`` path. The item table is a
+plain ``Embedding`` op, so the existing SOAP machinery row-shards it at
+scale exactly like a ranking table (``two_tower_strategy``).
+
+One graph, three heads, shared op NAMES (``head=``):
+
+  train : user+item inputs -> (B, B) in-batch logits (fit() this)
+  user  : user inputs only -> (B, d) user embeddings (query encoder)
+  item  : item ids only    -> (B, d) item embeddings (index builder)
+
+Parameters move between heads by op name (``transfer_tower_params``) —
+the serving heads are separately-compiled models that hot-swap the
+trained weights in, the same way the serving engine swaps snapshots.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.model import FFModel
+from ..core.initializers import UniformInitializer
+from ..parallel.pconfig import StrategyMap
+
+
+@dataclass
+class TwoTowerConfig:
+    """Shapes for both towers. ``dim`` is the shared output width — the
+    MIPS scoring width; keep it a multiple of 128 when the Pallas
+    scoring kernel should route (ops/pallas/topk_kernel.supports)."""
+
+    n_items: int = 1000              # item vocabulary (index row count)
+    dim: int = 32                    # shared tower-output width
+    user_dense_dim: int = 8          # dense user feature width
+    user_embedding_size: List[int] = field(
+        default_factory=lambda: [100, 100])   # user sparse vocab sizes
+    user_sparse_dim: int = 16        # per-feature user embedding width
+    user_bag_size: int = 1
+    user_mlp: List[int] = field(default_factory=lambda: [64])
+    item_raw_dim: int = 32           # item embedding width before MLP
+    item_mlp: List[int] = field(default_factory=lambda: [64])
+    attention_heads: int = 0         # >0: self-attention over the user
+                                     # feature sequence before the MLP
+
+    @staticmethod
+    def bench() -> "TwoTowerConfig":
+        """The bench/recall config: lane-aligned dim so TPU runs route
+        the Pallas kernel; CPU runs take the identical-math oracle."""
+        return TwoTowerConfig(
+            n_items=20000, dim=128, user_dense_dim=16,
+            user_embedding_size=[5000, 2000, 500], user_sparse_dim=32,
+            user_mlp=[256, 128], item_raw_dim=64, item_mlp=[128],
+            attention_heads=4)
+
+
+def _user_tower(model: FFModel, cfg: TwoTowerConfig, batch: int):
+    """Dense + per-feature embeddings (+ optional self-attention over
+    the feature sequence) -> MLP -> (B, dim). Op names are shared across
+    heads so ``transfer_tower_params`` can match them."""
+    from ..models.dlrm import create_mlp
+    dense_in = model.create_tensor((batch, cfg.user_dense_dim),
+                                   name="user_dense")
+    T = len(cfg.user_embedding_size)
+    sparse_in = model.create_tensor((batch, T, cfg.user_bag_size),
+                                    dtype=jnp.int32, name="user_sparse")
+    init = UniformInitializer(min_val=-0.05, max_val=0.05)
+    cols = model.split(sparse_in, [1] * T, axis=1, name="user_split")
+    embs = []
+    for i, (rows, col) in enumerate(zip(cfg.user_embedding_size, cols)):
+        idx2d = model.reshape(col, (batch, cfg.user_bag_size),
+                              name=f"user_idx_{i}")
+        embs.append(model.embedding(
+            idx2d, rows, cfg.user_sparse_dim, aggr="sum",
+            kernel_initializer=init, name=f"user_emb_{i}"))
+    if cfg.attention_heads > 0 and T > 1:
+        seq = model.concat(
+            [model.reshape(e, (batch, 1, cfg.user_sparse_dim),
+                           name=f"user_seq_{i}")
+             for i, e in enumerate(embs)], axis=1, name="user_seq")
+        att = model.multihead_attention(
+            seq, num_heads=cfg.attention_heads, name="user_attn")
+        feats = model.reshape(att, (batch, T * cfg.user_sparse_dim),
+                              name="user_attn_flat")
+    else:
+        feats = model.concat(embs, axis=1, name="user_cat") if T > 1 \
+            else embs[0]
+    joined = model.concat([dense_in, feats], axis=1, name="user_join")
+    width = cfg.user_dense_dim + T * cfg.user_sparse_dim
+    hid = create_mlp(model, joined, [width] + cfg.user_mlp, prefix="user")
+    # the projection into the shared space is LINEAR: a relu head would
+    # clamp tower outputs non-negative and kill half the inner-product
+    # dims at init (create_mlp activates every layer)
+    return model.dense(hid, cfg.dim, activation=None,
+                       name=f"user_dense_{len(cfg.user_mlp)}")
+
+
+def _item_tower(model: FFModel, cfg: TwoTowerConfig, batch: int):
+    """Item-id embedding -> MLP -> (B, dim)."""
+    from ..models.dlrm import create_mlp
+    ids_in = model.create_tensor((batch, 1), dtype=jnp.int32,
+                                 name="item_ids")
+    init = UniformInitializer(min_val=-0.05, max_val=0.05)
+    raw = model.embedding(ids_in, cfg.n_items, cfg.item_raw_dim,
+                          aggr="sum", kernel_initializer=init,
+                          name="item_emb")
+    hid = create_mlp(model, raw, [cfg.item_raw_dim] + cfg.item_mlp,
+                     prefix="item")
+    # linear head, same reason as the user tower
+    return model.dense(hid, cfg.dim, activation=None,
+                       name=f"item_dense_{len(cfg.item_mlp)}")
+
+
+def build_two_tower(model: FFModel, cfg: TwoTowerConfig,
+                    head: str = "train"
+                    ) -> Tuple[Dict[str, tuple], "object"]:
+    """Build one head of the two-tower graph on ``model``. Returns
+    (input_specs, output_tensor) like ``build_dlrm``."""
+    batch = model.config.batch_size
+    T = len(cfg.user_embedding_size)
+    user_inputs = {"user_dense": (batch, cfg.user_dense_dim),
+                   "user_sparse": (batch, T, cfg.user_bag_size)}
+    if head == "user":
+        return dict(user_inputs), _user_tower(model, cfg, batch)
+    if head == "item":
+        return {"item_ids": (batch, 1)}, _item_tower(model, cfg, batch)
+    if head != "train":
+        raise ValueError(f"build_two_tower: unknown head {head!r} "
+                         f"(train|user|item)")
+    u = _user_tower(model, cfg, batch)
+    v = _item_tower(model, cfg, batch)
+    # (B, d) x (B, d) -> (B, B) in-batch logits: row b scores user b
+    # against every in-batch item (diagonal = the positive)
+    u3 = model.reshape(u, (1, batch, cfg.dim), name="logits_u3")
+    v3 = model.reshape(v, (1, batch, cfg.dim), name="logits_v3")
+    z = model.batch_matmul(u3, v3, trans_a=False, trans_b=True,
+                           name="logits_bmm")
+    logits = model.reshape(z, (batch, batch), name="logits")
+    inputs = dict(user_inputs)
+    inputs["item_ids"] = (batch, 1)
+    return inputs, logits
+
+
+def in_batch_labels(batch: int) -> np.ndarray:
+    """Labels for the in-batch sampled softmax: row b's positive is
+    column b."""
+    return np.arange(batch, dtype=np.int32).reshape(batch, 1)
+
+
+def synthetic_two_tower_batch(cfg: TwoTowerConfig, batch: int,
+                              seed: int = 0, zipf_alpha: float = 0.0):
+    """Synthetic (inputs, labels) for one train-head batch. Item ids
+    draw zipf-skewed (real catalogs are) and the user features carry a
+    deterministic signal correlated with the positive item so training
+    actually moves recall."""
+    from ..data.dataloader import zipf_indices
+    rng = np.random.RandomState(seed)
+    T = len(cfg.user_embedding_size)
+    items = zipf_indices(rng, cfg.n_items, (batch, 1),
+                         zipf_alpha).astype(np.int32)
+    dense = rng.rand(batch, cfg.user_dense_dim).astype(np.float32)
+    # plant signal: dense feature 0 tracks the positive item's id scale
+    dense[:, 0] = items[:, 0].astype(np.float32) / float(cfg.n_items)
+    sparse = np.stack(
+        [(items[:, 0] * (t + 3)) % rows
+         for t, rows in enumerate(cfg.user_embedding_size)],
+        axis=1).astype(np.int32)[:, :, None]
+    sparse = np.broadcast_to(
+        sparse, (batch, T, cfg.user_bag_size)).copy()
+    inputs = {"user_dense": dense, "user_sparse": sparse,
+              "item_ids": items}
+    return inputs, in_batch_labels(batch)
+
+
+def two_tower_strategy(model: FFModel, num_devices: int,
+                       row_shard: bool = False) -> StrategyMap:
+    """SOAP strategy for any two-tower head: the embedding-table rules
+    (row-shard at scale) and data-parallel defaults in ``dlrm_strategy``
+    never read the DLRM config, so the same generator covers this
+    graph."""
+    from ..models.dlrm import dlrm_strategy
+    return dlrm_strategy(model, None, num_devices, row_shard=row_shard)
+
+
+def transfer_tower_params(src: FFModel, dst: FFModel) -> int:
+    """Copy trained weights from one head to another BY OP NAME (the
+    towers share names across heads), installing atomically through
+    ``swap_params`` so a serving head hot-swaps like any snapshot.
+    Returns the number of ops transferred."""
+    moved = 0
+    new_params = {op: dict(d) for op, d in dst.params.items()}
+    for op_name, pdict in new_params.items():
+        if op_name in (src.params or {}):
+            for pname in pdict:
+                if pname in src.params[op_name]:
+                    pdict[pname] = src.params[op_name][pname]
+            moved += 1
+    new_host: Optional[Dict] = None
+    if dst.host_params:
+        new_host = {op: dict(d) for op, d in dst.host_params.items()}
+        for op_name, pdict in new_host.items():
+            if op_name in (src.host_params or {}):
+                for pname in pdict:
+                    if pname in src.host_params[op_name]:
+                        pdict[pname] = np.array(
+                            src.host_params[op_name][pname])
+                moved += 1
+    dst.swap_params(params=new_params, host_params=new_host)
+    return moved
+
+
+def item_embeddings(item_model: FFModel, cfg: TwoTowerConfig,
+                    ids: Optional[np.ndarray] = None) -> np.ndarray:
+    """Run the item head over ``ids`` (default: the whole catalog) in
+    compiled-batch chunks -> (n, dim) fp32. This is what the index
+    builder quantizes, and what a publish re-encodes for touched rows."""
+    batch = item_model.config.batch_size
+    if ids is None:
+        ids = np.arange(cfg.n_items, dtype=np.int32)
+    ids = np.asarray(ids, np.int32).reshape(-1)
+    out = np.empty((ids.shape[0], cfg.dim), np.float32)
+    for lo in range(0, ids.shape[0], batch):
+        chunk = ids[lo:lo + batch]
+        pad = batch - chunk.shape[0]
+        padded = np.concatenate(
+            [chunk, np.zeros(pad, np.int32)]) if pad else chunk
+        res = np.asarray(item_model.forward_batch(
+            {"item_ids": padded.reshape(-1, 1)}))
+        out[lo:lo + chunk.shape[0]] = res[:chunk.shape[0]]
+    return out

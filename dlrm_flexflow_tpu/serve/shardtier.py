@@ -157,6 +157,19 @@ class FetchResult(NamedTuple):
     defaults_used: int
 
 
+class TopKPartials(NamedTuple):
+    """One retrieval fan-out's outcome: each answering shard's LOCAL
+    top-k partial (globally-addressed ids), the version vector read,
+    and which slots degraded out (their candidates are simply absent —
+    degraded-not-failed)."""
+
+    scores: Dict[int, np.ndarray]        # slot -> (B, k') float32
+    ids: Dict[int, np.ndarray]           # slot -> (B, k') int64
+    versions: Dict[int, int]             # shard slot -> version read
+    degraded: bool
+    dropped_slots: List[int]
+
+
 def _table_bounds(op, flat_rows: int) -> List[Tuple[int, int]]:
     """Per-TABLE [lo, hi) regions of the op's flat row space (the
     per-table default rows are means over these regions)."""
@@ -308,6 +321,12 @@ class EmbeddingShard:
         self.publishes_applied = 0
         self.apply_rejects = 0
         self.last_reject = ""
+        # retrieval-index blocks riding this shard (attach_block):
+        # op names whose block answers topk(), plus the previous
+        # (block, version) snapshot a publish displaced — what the
+        # FF_FAULT_INDEX_STALE drill serves
+        self._index_ops: set = set()
+        self._prev_index: Dict[str, Tuple[Any, int]] = {}
 
     def _wrap_block(self, op_name: str, arr):
         """fp32 array -> QuantTable under the op's policy (arrays
@@ -373,6 +392,67 @@ class EmbeddingShard:
             self.rows_served += served
         return out, ver
 
+    # --- the retrieval-index surface (retrieve/index.py) ----------------
+    def attach_block(self, op_name: str, block, lo: int, hi: int) -> None:
+        """Install an EXTRA row block on this shard — the retrieval
+        index rides the ranking substrate here: the block is addressed,
+        published to, and versioned exactly like a table block (one
+        shard lock, one version, one chain), so a publish that touches
+        ranking rows AND index rows lands atomically on both."""
+        from ..quant.store import QuantTable
+        if "/" in op_name:
+            raise ValueError(f"attach_block: op name {op_name!r} may "
+                             f"not contain '/' (publish keys split on "
+                             f"it)")
+        if not isinstance(block, QuantTable) or block.dtype != "int8":
+            raise ValueError(
+                f"attach_block: the index block for {op_name!r} must be "
+                f"an int8 QuantTable (the MIPS kernel scores int8 "
+                f"codes), got {type(block).__name__}")
+        if block.shape[0] != int(hi) - int(lo):
+            raise ValueError(
+                f"attach_block: {op_name!r} block has {block.shape[0]} "
+                f"rows for range [{lo}, {hi})")
+        with self._lock:
+            self._blocks[op_name] = block
+            self._ranges[op_name] = (int(lo), int(hi))
+            self._index_ops.add(op_name)
+            self.quant[op_name] = "int8"
+
+    def topk(self, op_name: str, q_codes: np.ndarray,
+             q_scales: np.ndarray, k: int
+             ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Local MIPS top-k over this shard's [lo, hi) slice of the
+        index: ``((B, k') fp32 scores, (B, k') int64 GLOBAL ids,
+        version)``, ordered (score desc, id asc). One locked read — the
+        answer sees exactly one index version, so the ranker-side merge
+        never mixes versions within a shard."""
+        # fault hooks OUTSIDE the lock (same discipline as lookup)
+        faults.maybe_lookup_delay(self.sid)
+        if faults.take_shard_down(self.sid) or \
+                faults.take_topk_drop(self.sid):
+            raise ShardDown(self.sid, "fault injection")
+        stale = faults.take_index_stale(self.sid)
+        from ..ops.pallas.topk_kernel import mips_topk
+        with self._lock:
+            blk = self._blocks.get(op_name)
+            ver = self._version
+            if op_name not in self._index_ops or blk is None:
+                raise ValueError(f"shard {self.sid} has no retrieval "
+                                 f"index {op_name!r} attached")
+            lo, _hi = self._ranges[op_name]
+            if stale and op_name in self._prev_index:
+                # the stale drill: answer from the index the last
+                # publish displaced — degraded-not-garbage (candidates
+                # are real rows, just one version behind)
+                blk, ver = self._prev_index[op_name]
+            scores, ids = mips_topk(q_codes, q_scales,
+                                    np.asarray(blk.q), blk.scales,
+                                    k, base=lo)
+            self.lookups += 1
+            self.rows_served += int(ids.size)
+        return scores, ids, ver
+
     # --- write path (publishes) ----------------------------------------
     def apply_publish(self, sub: Optional[Dict[str, Any]],
                       version: int,
@@ -401,6 +481,16 @@ class EmbeddingShard:
             if int(version) <= self._version:
                 return False
             from ..quant.store import QuantTable
+            if sub is not None and self._index_ops:
+                # snapshot each touched index block BEFORE the publish
+                # lands: the FF_FAULT_INDEX_STALE drill answers from
+                # this displaced (block, version) pair
+                touched = {key.split("/")[1]
+                           for part in ("rows", "full")
+                           for key in sub.get(part, {})}
+                for op_name in touched & self._index_ops:
+                    self._prev_index[op_name] = (
+                        self._blocks[op_name].copy(), self._version)
             if sub is not None:
                 for key, (idx, vals) in sub.get("rows", {}).items():
                     op_name = key.split("/")[1]
@@ -455,8 +545,14 @@ class EmbeddingShard:
                 if k not in self._ranges:
                     raise ValueError(f"shard {self.sid} owns no range "
                                      f"of {k!r}")
-            self._blocks = {k: self._wrap_block(k, v)
-                            for k, v in blocks.items()}
+            new_blocks = {k: self._wrap_block(k, v)
+                          for k, v in blocks.items()}
+            # a full table reload does not evict an attached retrieval
+            # index the snapshot never carried
+            for k in self._index_ops:
+                if k not in new_blocks and k in self._blocks:
+                    new_blocks[k] = self._blocks[k]
+            self._blocks = new_blocks
             self._version = int(version)
             self._chain_crc = int(chain_crc) & 0xFFFFFFFF
         return True
@@ -602,6 +698,10 @@ class EmbeddingShardSet:
         self.replacements = 0
         self.replace_rejects = 0
         self.last_replace_reject = ""
+        # retrieval-index surface (attach_index / topk_partials)
+        self._index_op: Optional[str] = None
+        self._topk_queries = 0
+        self._topk_degraded = 0
 
     # --- construction --------------------------------------------------
     @classmethod
@@ -1017,6 +1117,125 @@ class EmbeddingShardSet:
             time.sleep(min((cfg.backoff_ms / 1e3) * (2 ** (attempt - 1)),
                            max(dl.remaining(), 0.0)))
 
+    # --- the retrieval-index surface (retrieve/index.py) ---------------
+    def attach_index(self, op_name: str, table) -> None:
+        """Attach a retrieval index to this shard set as ONE MORE
+        quantized table: rows split over the same slots by the same
+        owner math, published to through the same
+        ``split_host_rows_by_shard`` routing (delta key
+        ``hostparams/<op_name>/kernel``), versioned by the same
+        per-shard chain. One publish therefore advances ranking tables
+        AND the index from one manifest, and old-or-new-never-mixed
+        holds for retrieval because a shard's topk answer reads the
+        same single version its lookups do.
+
+        ``table`` is the full (n_items, d) index — an int8
+        ``QuantTable`` of item-tower output embeddings, or an fp32
+        array to quantize here."""
+        from ..quant.store import QuantTable
+        if not isinstance(table, QuantTable):
+            table = QuantTable.from_dense(
+                np.asarray(table, np.float32), "int8")
+        rows, dim = int(table.shape[0]), int(table.shape[1])
+        ranges = shard_row_ranges(rows, self.nshards)
+        with self._apply_lock:
+            by_slot = self._by_slot()
+            for slot, (lo, hi) in enumerate(ranges):
+                rep = by_slot.get(slot)
+                if rep is None:
+                    continue
+                from ..quant.store import QuantTable as QT
+                # .copy(), not ascontiguousarray: contiguous slices come
+                # back as VIEWS, and a shard must own its rows — the
+                # caller keeping (and mutating) the full table must not
+                # bleed into published shard state
+                block = QT(table.q[lo:hi].copy(),
+                           table.scales[lo:hi].copy(), "int8")
+                rep.shard.attach_block(op_name, block, lo, hi)
+            self._ranges[op_name] = [(int(lo), int(hi))
+                                     for lo, hi in ranges]
+            self._flat_rows[op_name] = rows
+            self._dims[op_name] = dim
+            self._bounds[op_name] = [(0, rows)]
+            self._defaults[op_name] = np.zeros((1, dim), np.float32)
+            self._quant[op_name] = "int8"
+            self._index_op = op_name
+            self._persist_all()
+
+    def topk_partials(self, q_codes: np.ndarray, q_scales: np.ndarray,
+                      k: int, deadline_s: Optional[float] = None,
+                      degrade: Optional[str] = None) -> TopKPartials:
+        """Fan one quantized query batch out to every shard's local
+        top-k and collect the partials the ranker-side merge consumes.
+        Same robustness discipline as :meth:`fetch` — per-shard
+        deadline, breaker feedback, ejection — but degradation DROPS
+        the dead shard's candidates (flagged) instead of substituting
+        defaults: a retrieval answer with a missing shard is a correct
+        top-k over the rows that answered."""
+        if self._index_op is None:
+            raise ShardTierUnavailable(
+                "no retrieval index attached (attach_index)")
+        op_name = self._index_op
+        cfg = self.config
+        if deadline_s is None:
+            deadline_s = cfg.lookup_deadline_ms / 1e3
+        degrade = degrade or cfg.degrade
+        scores: Dict[int, np.ndarray] = {}
+        ids: Dict[int, np.ndarray] = {}
+        versions: Dict[int, int] = {}
+        dropped: List[int] = []
+        futs = {}
+        for rep in list(self.shards):
+            if rep.state == HEALTHY and not self._closed:
+                futs[rep.slot] = (rep, self._pool.submit(
+                    rep.shard.topk, op_name, q_codes, q_scales, k))
+        for rep in list(self.shards):
+            slot = rep.slot
+            got = None
+            if slot in futs:
+                dl = Deadline(deadline_s)
+                _, fut = futs[slot]
+                done, _p = wait([fut], timeout=max(dl.remaining(), 0.0))
+                err: Optional[BaseException] = None
+                if done:
+                    err = fut.exception()
+                    if err is None:
+                        got = fut.result()
+                        rep.record_success()
+                else:
+                    with self._m_lock:
+                        self._timeouts += 1
+                    err = ShardLookupTimeout(
+                        f"shard {rep.sid} topk missed its "
+                        f"{dl.seconds * 1e3:.0f} ms deadline")
+                if err is not None:
+                    if rep.record_error(err, cfg.eject_after):
+                        rep.eject(f"{cfg.eject_after} consecutive "
+                                  f"lookup errors, last: {err}")
+                    if degrade == "fail":
+                        with self._m_lock:
+                            self._failed_fetches += 1
+                        raise ShardTierUnavailable(
+                            f"shard {rep.sid} (slot {slot}) topk failed "
+                            f"and --serve-degrade=fail: "
+                            f"{type(err).__name__}: {err}") from err
+            elif degrade == "fail":
+                with self._m_lock:
+                    self._failed_fetches += 1
+                raise ShardTierUnavailable(
+                    f"shard slot {slot} is {rep.state} and "
+                    f"--serve-degrade=fail")
+            if got is not None:
+                scores[slot], ids[slot], versions[slot] = got
+            else:
+                dropped.append(slot)
+        with self._m_lock:
+            self._topk_queries += 1
+            if dropped:
+                self._topk_degraded += 1
+        return TopKPartials(scores, ids, versions, bool(dropped),
+                            dropped)
+
     # --- publish fan-out (driven by the rankers' install paths) --------
     def apply_delta(self, payload: Dict[str, Any], version: int) -> int:
         """Route one delta publish's host-table updates to their owning
@@ -1290,7 +1509,7 @@ class EmbeddingShardSet:
         """The static description shardcheck's FLX507 audit consumes:
         shard count, per-op flat row counts and ranges, per-shard
         residency, and whether rankers still hold full tables."""
-        return {
+        out = {
             "nshards": self.nshards,
             "flat_rows": dict(self._flat_rows),
             "ranges": {k: list(v) for k, v in self._ranges.items()},
@@ -1299,6 +1518,15 @@ class EmbeddingShardSet:
             "domains": sorted({r.shard.domain for r in self.shards
                                if r.shard.domain}),
         }
+        if self._index_op is not None:
+            out["retrieve_index"] = {
+                "op": self._index_op,
+                "rows": int(self._flat_rows[self._index_op]),
+                "dim": int(self._dims[self._index_op]),
+                "quant": self._quant.get(self._index_op, "int8"),
+                "sharded": True,
+            }
+        return out
 
     def version_vector(self) -> Dict[int, int]:
         return {r.slot: r.shard.version for r in self.shards}
@@ -1332,6 +1560,8 @@ class EmbeddingShardSet:
                 "fetches": self._fetches,
                 "degraded_fetches": self._degraded_fetches,
                 "defaults_used": self._defaults_used,
+                "topk_queries": self._topk_queries,
+                "topk_degraded": self._topk_degraded,
                 "retries": self._retries,
                 "hedges": self._hedges,
                 "timeouts": self._timeouts,

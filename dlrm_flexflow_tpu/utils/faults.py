@@ -123,6 +123,15 @@ subprocess kill-test needs):
   LIVE id-frequency sketch by 10x (consume-once per op) — the online
   re-placement trigger reads a lying sketch and must still only ever
   install correct plans
+- ``FF_FAULT_INDEX_STALE=0:2``     shard 0 answers its next 2 retrieval
+  top-k calls from the PREVIOUS index version (the block the last
+  publish displaced) — strictly ``sid:n``, a bare sid is rejected; the
+  cascade must serve real-but-stale candidates with a truthful version
+  vector (degraded-not-garbage)
+- ``FF_FAULT_TOPK_DROP=1``         shard 1's retrieval top-k raises
+  ``ShardDown`` forever (lookups keep serving); ``1:3`` fails its next
+  3 topk calls then recovers — the cascade drops that shard's
+  candidates and flags ``degraded``, zero failed requests
 
 Unknown ``FF_FAULT_*`` keys are a WARNING, not a silent no-op: a typo'd
 key used to disable injection entirely, which made a passing resilience
@@ -204,6 +213,18 @@ class FaultPlan:
     # steadily slow shard); a bare value slows every shard
     lookup_delay_s: float = 0.0
     lookup_delay_shard: Dict[int, float] = field(default_factory=dict)
+    # embedding-shard id -> remaining STALE topk answers: the shard
+    # serves retrieval top-k from the index version the last publish
+    # displaced (serve/shardtier.py keeps the displaced block), so the
+    # cascade's degraded-not-garbage contract — real candidates, one
+    # version behind, version vector telling the truth — is drillable.
+    # Consume-once per answer; -1 = stale until the plan clears
+    index_stale: Dict[int, int] = field(default_factory=dict)
+    # embedding-shard id -> remaining failed topk calls: ONLY the
+    # retrieval surface of that shard dies (lookups keep serving) — the
+    # cascade must drop the shard's candidates and flag degraded, never
+    # fail the request. Same budget semantics as shard_down
+    topk_drop: Dict[int, int] = field(default_factory=dict)
     # number of future hot-reload snapshot loads whose params are scaled
     # by poison_reload_scale: the file is valid, the weights are garbage
     # — the bad deploy a canary must catch by score divergence
@@ -299,7 +320,8 @@ _KNOWN_ENV_KEYS = ("FF_FAULT_NAN_STEPS", "FF_FAULT_TRUNCATE_CKPTS",
                    "FF_FAULT_LOOKUP_DELAY", "FF_FAULT_QUANT_SCALE",
                    "FF_FAULT_NET_DROP", "FF_FAULT_NET_DUP",
                    "FF_FAULT_NET_REORDER", "FF_FAULT_NET_SLOW",
-                   "FF_FAULT_FEEDBACK_LOSS", "FF_FAULT_SKETCH_SKEW")
+                   "FF_FAULT_FEEDBACK_LOSS", "FF_FAULT_SKETCH_SKEW",
+                   "FF_FAULT_INDEX_STALE", "FF_FAULT_TOPK_DROP")
 
 
 # --- strict env parsing ----------------------------------------------
@@ -433,13 +455,15 @@ def plan_from_env() -> Optional[FaultPlan]:
     net_slow = os.environ.get("FF_FAULT_NET_SLOW", "")
     feedback_loss = os.environ.get("FF_FAULT_FEEDBACK_LOSS", "")
     sketch_skew = os.environ.get("FF_FAULT_SKETCH_SKEW", "")
+    index_stale = os.environ.get("FF_FAULT_INDEX_STALE", "")
+    topk_drop = os.environ.get("FF_FAULT_TOPK_DROP", "")
     if not any((nan, trunc, aborts, delay, ioerrs, drop, ret,
                 cache_corrupt, stall_coll,
                 serve_delay, corrupt_reload, replica_down,
                 poison_reload, delta_torn, publish_abort, delta_gap,
                 shard_down, lookup_delay, quant_scale,
                 net_drop, net_dup, net_reorder, net_slow,
-                feedback_loss, sketch_skew)):
+                feedback_loss, sketch_skew, index_stale, topk_drop)):
         return None
     plan = FaultPlan()
     if nan:
@@ -504,6 +528,18 @@ def plan_from_env() -> Optional[FaultPlan]:
             plan.lookup_delay_s = secs
         else:                                 # "sid:secs" — one shard
             plan.lookup_delay_shard[sid] = secs
+    # strict 'sid:n' ONLY (bare=None): a bare sid is ambiguous between
+    # "stale once" and "stale forever", and a half-guessed stale budget
+    # makes a freshness drill meaningless
+    for sid, n in _env_pairs("FF_FAULT_INDEX_STALE", index_stale,
+                             _env_int):
+        plan.index_stale[sid] = n
+    for sid, n in _env_pairs("FF_FAULT_TOPK_DROP", topk_drop,
+                             _env_int, bare=_env_int):
+        if sid is None:                       # bare sid — drop forever
+            plan.topk_drop[n] = -1
+        else:                                 # "sid:N" — N failed topks
+            plan.topk_drop[sid] = n
     for part in quant_scale.split(","):
         # 'op:factor' — op names are strings, so this cannot reuse
         # _env_pairs' int heads; strict all the same (missing ':' or a
@@ -794,6 +830,46 @@ def take_shard_down(shard_id: Optional[int]) -> bool:
             plan.shard_down[shard_id] = left - 1
         if ("shard_down", shard_id) not in plan.fired:
             plan._record("shard_down", shard_id)
+    return True
+
+
+def take_topk_drop(shard_id: Optional[int]) -> bool:
+    """True while a shard's RETRIEVAL surface is scheduled dead: its
+    ``topk`` raises ``ShardDown`` while ordinary lookups keep serving —
+    the cascade must drop that shard's candidates and flag ``degraded``,
+    never fail the request. Budget semantics mirror
+    :func:`take_shard_down` (``-1`` = dead until the plan clears)."""
+    plan = active()
+    if plan is None or shard_id is None:
+        return False
+    with plan._lock:
+        left = plan.topk_drop.get(shard_id)
+        if left is None or left == 0:
+            return False
+        if left > 0:
+            plan.topk_drop[shard_id] = left - 1
+        if ("topk_drop", shard_id) not in plan.fired:
+            plan._record("topk_drop", shard_id)
+    return True
+
+
+def take_index_stale(shard_id: Optional[int]) -> bool:
+    """True when this topk answer should come from the PREVIOUS index
+    version (the block the last publish displaced) — consume-once per
+    answer, so ``sid:n`` yields exactly n stale answers. The shard
+    reports the stale version in its answer: degraded-not-garbage means
+    the version vector tells the truth about what was read."""
+    plan = active()
+    if plan is None or shard_id is None:
+        return False
+    with plan._lock:
+        left = plan.index_stale.get(shard_id)
+        if left is None or left == 0:
+            return False
+        if left > 0:
+            plan.index_stale[shard_id] = left - 1
+        if ("index_stale", shard_id) not in plan.fired:
+            plan._record("index_stale", shard_id)
     return True
 
 

@@ -43,6 +43,20 @@ replaces it from the warm cache. Fault injection for drills:
 default ``--serve-transport inproc`` keeps today's in-process method
 calls bit-for-bit.
 
+``--retrieve on`` puts the RETRIEVAL CASCADE in front of the ranker
+(``retrieve/``): a two-tower user encoder feeds a sharded MIPS top-k
+index (int8 codes on the embedding-shard substrate — riding the
+``--serve-shards`` tier when one exists, or ``--retrieve-shards M``
+standalone index shards otherwise), and ``/predict`` answers USER
+requests — retrieve ``--retrieve-k`` candidates under
+``--retrieve-deadline-ms``, rank them through the engine/fleet with
+the remaining ``--serve-deadline-ms`` budget, and return the re-ranked
+candidate ids. ``POST /retrieve`` exposes the index stage alone. A
+dead index shard DROPS its candidates (``"degraded": true`` — never
+fabricated ids, never a failed request). Cascade mode needs the
+in-process transport (``--serve-transport tcp`` / ``--serve-shard-procs``
+are rejected at startup).
+
 No framework webserver: a stdlib ``http.server`` ThreadingHTTPServer is
 all the engine needs — every handler thread just submits into the
 engine's queue and blocks on its future, the batcher coalesces across
@@ -65,6 +79,14 @@ Endpoints:
                  {"scores": [...], "version": N, "latency_ms": ...}
                  429 on Overloaded, 504 on DeadlineExceeded,
                  503 when no replica can take the request
+                 (--retrieve on: the same request describes a USER;
+                 the response adds "candidates" — re-ranked item ids —
+                 plus "retrieve_versions", "stage_ms", and the OR'd
+                 "degraded" flag)
+  POST /retrieve {"dense": [...], "sparse": [...][, "k": N]}  ->
+                 {"ids": [[...]], "scores": [[...]], "versions": ...,
+                 "degraded": ..., "latency_ms": ...} — the retrieve
+                 stage alone (--retrieve on only; 404 otherwise)
   GET  /stats    engine stats() — or fleet-wide router stats() with
                  per-replica circuit-breaker state in fleet mode
   GET  /healthz  200 {"ok": true, ...} while the engine (fleet: at
@@ -109,9 +131,11 @@ def build_server_model(cfg, dcfg, mesh=None):
     return model
 
 
-def make_handler(serve, input_names):
+def make_handler(serve, input_names, cascade=None):
     """``serve`` is an InferenceEngine or a FleetRouter — both expose
-    predict()/stats()/healthz() with the same contract."""
+    predict()/stats()/healthz() with the same contract. ``cascade``
+    (a retrieve.CascadeEngine) switches /predict into cascade mode and
+    opens POST /retrieve."""
     from http.server import BaseHTTPRequestHandler
 
     class Handler(BaseHTTPRequestHandler):
@@ -143,7 +167,11 @@ def make_handler(serve, input_names):
                 # with ok:false would keep the traffic coming
                 self._reply(200 if hz["ok"] else 503, hz)
             elif self.path == "/stats":
-                self._reply(200, serve.stats())
+                st = serve.stats()
+                if cascade is not None:
+                    st = dict(st)
+                    st["cascade"] = cascade.stats()
+                self._reply(200, st)
             elif self.path == "/metrics":
                 # Prometheus text exposition of the obs registry; with
                 # --obs off the registry holds no instruments, so the
@@ -160,8 +188,12 @@ def make_handler(serve, input_names):
                 self._reply(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
-            if self.path != "/predict":
+            if self.path not in ("/predict", "/retrieve"):
                 self._reply(404, {"error": f"no route {self.path}"})
+                return
+            if self.path == "/retrieve" and cascade is None:
+                self._reply(404, {"error": "retrieval is off — restart "
+                                           "with --retrieve on"})
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
@@ -178,6 +210,40 @@ def make_handler(serve, input_names):
                 self._reply(400, {"error": str(e)})
                 return
             try:
+                if self.path == "/retrieve":
+                    k = int(req.get("k", cascade.config.k))
+                    r = cascade.index.topk(
+                        cascade.user_encoder(feats), k,
+                        deadline_s=cascade.config.retrieve_deadline_ms
+                        / 1e3)
+                    self._reply(200, {
+                        "ids": r.ids.tolist(),
+                        "scores": r.scores.tolist(),
+                        "versions": {str(s): int(v)
+                                     for s, v in r.versions.items()},
+                        "degraded": bool(r.degraded),
+                        "dropped_slots": list(r.dropped_slots),
+                        "latency_ms": round(r.latency_ms, 3)})
+                    return
+                if cascade is not None:
+                    cp = cascade.predict(feats)
+                    body = {
+                        "candidates": cp.ids.tolist(),
+                        "scores": cp.scores.tolist(),
+                        "version": cp.rank_version,
+                        "retrieve_versions": {
+                            str(s): int(v)
+                            for s, v in cp.retrieve_versions.items()},
+                        "degraded": bool(cp.degraded),
+                        "latency_ms": round(cp.latency_ms, 3),
+                        "stage_ms": {s: round(v, 3)
+                                     for s, v in cp.stage_ms.items()}}
+                    if cp.rank_versions is not None:
+                        body["versions"] = {
+                            str(s): int(v)
+                            for s, v in cp.rank_versions.items()}
+                    self._reply(200, body)
+                    return
                 pred = serve.predict(feats)
                 body = {
                     "scores": np.asarray(pred.scores).reshape(-1).tolist(),
@@ -319,6 +385,115 @@ def _build_shard_set(cfg, model, ckpt_dir):
     return shard_set
 
 
+def _validate_retrieve(cfg):
+    """Reject knob combinations the cascade cannot honor — at startup,
+    with the knob names in the message, not as a mid-request surprise."""
+    on = str(getattr(cfg, "retrieve", "off")) == "on"
+    rshards = int(getattr(cfg, "retrieve_shards", 0))
+    if not on:
+        if rshards > 0:
+            raise SystemExit(
+                "--retrieve-shards does nothing without --retrieve on — "
+                "refusing to silently ignore it")
+        return False
+    if str(getattr(cfg, "serve_transport", "inproc")) != "inproc":
+        raise SystemExit(
+            "--retrieve on requires --serve-transport inproc: the "
+            "cascade scores candidates through in-process shard calls "
+            "(the wire path for retrieval is not plumbed yet)")
+    if int(getattr(cfg, "serve_shard_procs", 0)) > 0:
+        raise SystemExit(
+            "--retrieve on is incompatible with --serve-shard-procs: "
+            "the index attaches to in-process shards")
+    nshards = int(getattr(cfg, "serve_shards", 0))
+    if nshards > 0 and rshards not in (0, nshards):
+        raise SystemExit(
+            f"--retrieve-shards {rshards} conflicts with "
+            f"--serve-shards {nshards}: with a sharded ranker tier the "
+            f"index rides THOSE shards (pass 0, or match the count)")
+    return True
+
+
+def _build_cascade(cfg, dcfg, serve, shard_set):
+    """Stand the retrieval stage up in front of the ranker: two-tower
+    user/item heads sized to the DLRM's own inputs (so /predict's
+    feature dict feeds both stages), the item catalog encoded and
+    attached as the MIPS index — to the ranker's shard set when one
+    exists, else to ``--retrieve-shards`` standalone index shards.
+    Returns ``(CascadeEngine, owned_set_or_None)``."""
+    from dlrm_flexflow_tpu.retrieve import (CascadeConfig, CascadeEngine,
+                                            ShardedMIPSIndex,
+                                            TwoTowerConfig,
+                                            build_two_tower,
+                                            dlrm_candidate_features,
+                                            item_embeddings,
+                                            transfer_tower_params)
+    tcfg = TwoTowerConfig(
+        n_items=int(dcfg.embedding_size[0]),
+        dim=32,
+        user_dense_dim=int(dcfg.mlp_bot[0]),
+        user_embedding_size=list(dcfg.embedding_size),
+        user_sparse_dim=8,
+        user_bag_size=int(dcfg.embedding_bag_size))
+
+    def build_head(head):
+        m = ff.FFModel(cfg)
+        build_two_tower(m, tcfg, head=head)
+        m.compile(ff.SGDOptimizer(lr=cfg.learning_rate),
+                  "mean_squared_error", ["mse"])
+        m.init_layers()
+        return m
+
+    user_model = build_head("user")
+    item_model = build_head("item")
+    # keep the untrained heads CONSISTENT: both serve the same init the
+    # way both serve the same snapshot after a real transfer (a trained
+    # two-tower checkpoint would restore here, then transfer the same
+    # way)
+    transfer_tower_params(user_model, item_model)
+
+    def encode(feats):
+        dense = np.asarray(feats["dense"], np.float32)
+        sparse = np.asarray(feats["sparse"], np.int32)
+        B = user_model.config.batch_size
+        n = dense.shape[0]
+        out = np.empty((n, tcfg.dim), np.float32)
+        for lo in range(0, n, B):
+            hi = min(lo + B, n)
+            pad = B - (hi - lo)
+            d, s = dense[lo:hi], sparse[lo:hi]
+            if pad:
+                d = np.concatenate(
+                    [d, np.zeros((pad,) + d.shape[1:], np.float32)])
+                s = np.concatenate(
+                    [s, np.zeros((pad,) + s.shape[1:], np.int32)])
+            res = np.asarray(user_model.forward_batch(
+                {"user_dense": d, "user_sparse": s}))
+            out[lo:hi] = res[:hi - lo]
+        return out
+
+    item_emb = item_embeddings(item_model, tcfg)
+    owned = None
+    if shard_set is not None:
+        index = ShardedMIPSIndex.build(shard_set, item_emb)
+        where = f"riding the {shard_set.nshards}-shard ranker tier"
+    else:
+        m = max(1, int(getattr(cfg, "retrieve_shards", 0)))
+        owned = ShardedMIPSIndex.standalone_set(m)
+        index = ShardedMIPSIndex.build(owned, item_emb)
+        where = f"{m} standalone index shard(s)"
+    cascade = CascadeEngine(
+        index, encode, serve,
+        dlrm_candidate_features(len(dcfg.embedding_size),
+                                list(dcfg.embedding_size)),
+        CascadeConfig.from_config(cfg))
+    log_app.info(
+        "retrieval cascade on: %d-item index (%s), k=%d, retrieve "
+        "deadline %.0f ms", index.n_items, where, cascade.config.k,
+        cascade.config.retrieve_deadline_ms)
+    return cascade, owned
+
+
 def _build_fleet(cfg, dcfg, n, ckpt_dir):
     """N replicas on disjoint device slices behind a FleetRouter."""
     scfg = ff.ServeConfig.from_config(cfg)
@@ -384,6 +559,8 @@ def main(argv=None):
 
     ckpt_dir = cfg.checkpoint_dir or None
     n = int(getattr(cfg, "serve_replicas", 1))
+    retrieve_on = _validate_retrieve(cfg)   # SystemExit on bad combos,
+    #                                         BEFORE any model compiles
     shard_set = None
     if n > 1:
         serve = _build_fleet(cfg, dcfg, n, ckpt_dir)
@@ -408,6 +585,11 @@ def main(argv=None):
                     "until the trainer publishes one", ckpt_dir)
     input_names = [t.name for t in model.input_tensors]
 
+    cascade = cascade_set = None
+    if retrieve_on:
+        cascade, cascade_set = _build_cascade(cfg, dcfg, serve,
+                                              shard_set)
+
     # SLO-driven autoscaling over the fleet (--serve-slo-ms + the
     # min/max replica bounds): grows on sustained p99/queue pressure,
     # replaces dead replicas, shrinks when idle. Fleet mode only — a
@@ -429,7 +611,8 @@ def main(argv=None):
         if scaler is not None:
             scaler.start()
         httpd = ThreadingHTTPServer(
-            ("0.0.0.0", port), make_handler(serve, input_names))
+            ("0.0.0.0", port),
+            make_handler(serve, input_names, cascade=cascade))
         log_app.info(
             "serving DLRM on :%d (%s%s)", port,
             f"{n} replicas" if n > 1 else
@@ -445,6 +628,8 @@ def main(argv=None):
             if shard_set is not None:
                 shard_set.stop_health()
                 shard_set.close()
+            if cascade_set is not None:
+                cascade_set.close()
             _stop_shard_procs()
             httpd.server_close()
             from dlrm_flexflow_tpu.obs import trace as obstrace

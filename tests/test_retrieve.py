@@ -1,0 +1,495 @@
+"""Retrieval-stage tests (ISSUE 20): two-tower candidate generation,
+the sharded MIPS index, and the retrieve→rank cascade.
+
+Pinned contracts (the acceptance bar):
+
+- the merged sharded top-k is BITWISE-IDENTICAL to a single-machine
+  exact scan over the same int8 codes, for shard counts {1, 2, 4},
+  ties included (ties break by ascending id on both paths — fp32
+  negation is exact, so the (-score, id) merge key is bit-faithful);
+- the Pallas kernel (interpret mode on CPU) matches the numpy oracle
+  bit-for-bit — compiled path and fallback are the same function;
+- a dead shard's candidates are DROPPED and flagged (``degraded``,
+  ``dropped_slots``), never fabricated, and zero requests fail; the
+  surviving answer is the exact top-k over the rows that answered;
+- ONE delta publish advances the ranking tables AND the retrieval
+  index from one manifest — both stages' version vectors move
+  together, and the exact-scan oracle stays the merge's twin;
+- ``FF_FAULT_INDEX_STALE`` parses strictly (``sid:n`` only) and is
+  consume-once; ``FF_FAULT_TOPK_DROP`` accepts a bare sid (dead until
+  the plan clears);
+- the cascade re-ranks by ranker score (retrieval scores stay aligned
+  to the reordered ids), ORs both stages' degradation, and overruns
+  raise the serving tier's own ``DeadlineExceeded``.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.models.dlrm import (DLRMConfig, build_dlrm,
+                                           synthetic_batch)
+from dlrm_flexflow_tpu.ops.pallas.topk_kernel import (mips_topk,
+                                                      mips_topk_reference,
+                                                      quantize_query)
+from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+from dlrm_flexflow_tpu.retrieve import (CascadeConfig, CascadeEngine,
+                                        ShardedMIPSIndex, TwoTowerConfig,
+                                        build_two_tower,
+                                        dlrm_candidate_features,
+                                        item_embeddings, merge_partials,
+                                        synthetic_two_tower_batch,
+                                        transfer_tower_params,
+                                        two_tower_strategy)
+from dlrm_flexflow_tpu.serve import EmbeddingShardSet, Prediction
+from dlrm_flexflow_tpu.serve.engine import DeadlineExceeded
+from dlrm_flexflow_tpu.serve.shardtier import ShardTierUnavailable
+from dlrm_flexflow_tpu.utils import faults
+
+DIM = 16
+N_ITEMS = 512
+DEADLINE = 30.0      # generous per-shard budget: these tests pin
+#                      exactness, not latency
+
+
+def _items(n=N_ITEMS, dim=DIM, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(n, dim).astype(np.float32)
+
+
+def _users(b=8, dim=DIM, seed=1):
+    rng = np.random.RandomState(seed)
+    return rng.randn(b, dim).astype(np.float32)
+
+
+def _index(items, nshards):
+    sset = ShardedMIPSIndex.standalone_set(nshards)
+    return ShardedMIPSIndex.build(sset, items), sset
+
+
+def _topk_drop(sid, n=-1):
+    plan = faults.FaultPlan()
+    plan.topk_drop[sid] = n
+    return faults.active_plan(plan)
+
+
+# ---------------------------------------------------------------------
+# merge exactness: the sharded answer IS the single-machine answer
+# ---------------------------------------------------------------------
+class TestMergeExactness:
+    @pytest.mark.parametrize("nshards", [1, 2, 4])
+    def test_bitwise_identical_to_exact_scan(self, nshards):
+        items = _items()
+        idx, sset = _index(items, nshards)
+        try:
+            r = idx.topk(_users(), 50, deadline_s=DEADLINE)
+            ref_s, ref_i = idx.exact_scan(_users(), 50)
+            np.testing.assert_array_equal(r.ids, ref_i)
+            np.testing.assert_array_equal(r.scores, ref_s)
+            assert not r.degraded and r.dropped_slots == []
+        finally:
+            sset.close()
+
+    @pytest.mark.parametrize("nshards", [2, 4])
+    def test_ties_break_by_id_across_shards(self, nshards):
+        # duplicate the first 32 rows across the whole corpus so exact
+        # score ties land on DIFFERENT shards — the merge must order
+        # them by ascending id exactly like the single-machine scan
+        items = _items(32)
+        items = np.tile(items, (N_ITEMS // 32, 1))
+        idx, sset = _index(items, nshards)
+        try:
+            r = idx.topk(_users(4), 64, deadline_s=DEADLINE)
+            ref_s, ref_i = idx.exact_scan(_users(4), 64)
+            np.testing.assert_array_equal(r.ids, ref_i)
+            np.testing.assert_array_equal(r.scores, ref_s)
+            for b in range(4):
+                s, i = r.scores[b], r.ids[b]
+                tied = s[:-1] == s[1:]
+                assert np.all(i[:-1][tied] < i[1:][tied])
+        finally:
+            sset.close()
+
+    def test_k_past_corpus_returns_all_rows(self):
+        items = _items(24)
+        idx, sset = _index(items, 4)
+        try:
+            r = idx.topk(_users(2), 100, deadline_s=DEADLINE)
+            assert r.ids.shape == (2, 24)
+            assert sorted(r.ids[0]) == list(range(24))
+        finally:
+            sset.close()
+
+    def test_merge_partials_empty(self):
+        out_i, out_s = merge_partials({}, {}, 10)
+        assert out_i.shape == (0, 0) and out_s.shape == (0, 0)
+
+    def test_query_dim_mismatch_raises(self):
+        idx, sset = _index(_items(), 2)
+        try:
+            with pytest.raises(ValueError, match="dim"):
+                idx.topk(_users(2, dim=DIM + 1), 8)
+        finally:
+            sset.close()
+
+
+class TestPallasParity:
+    def test_interpret_kernel_matches_oracle(self):
+        # lane-aligned width; interpret=True forces the kernel through
+        # the Pallas interpreter on CPU — must be bit-identical to the
+        # numpy oracle, ties included
+        items = np.tile(_items(16, dim=128), (8, 1))     # forced ties
+        codes, scales = quantize_query(items)            # reuse codec
+        q_codes, q_scales = quantize_query(_users(4, dim=128))
+        ks, ki = mips_topk(q_codes, q_scales, codes, scales, 8,
+                           interpret=True, chunk=32)
+        rs, ri = mips_topk_reference(q_codes, q_scales, codes, scales, 8)
+        np.testing.assert_array_equal(ki, ri)
+        np.testing.assert_array_equal(ks, rs)
+
+
+# ---------------------------------------------------------------------
+# the model half: train head fits through fit(), serving heads pick up
+# its weights by op name
+# ---------------------------------------------------------------------
+class TestTwoTower:
+    B = 16
+    CFG = TwoTowerConfig(
+        n_items=64, dim=8, user_dense_dim=4,
+        user_embedding_size=[32, 16], user_sparse_dim=4,
+        user_mlp=[16], item_raw_dim=8, item_mlp=[16],
+        attention_heads=0)
+
+    def _dataset(self, nbatches=4):
+        # fit() slices sequentially, so the dataset is whole batches of
+        # exactly B rows, each with its own arange(B) in-batch labels
+        xs, ys = [], []
+        for i in range(nbatches):
+            x, y = synthetic_two_tower_batch(self.CFG, self.B, seed=10 + i)
+            xs.append(x)
+            ys.append(y)
+        inputs = {k: np.concatenate([x[k] for x in xs]) for k in xs[0]}
+        return inputs, np.concatenate(ys)
+
+    def _head(self, head, src=None):
+        m = ff.FFModel(ff.FFConfig(batch_size=self.B, seed=3))
+        build_two_tower(m, self.CFG, head=head)
+        m.compile(ff.SGDOptimizer(lr=0.05), "mean_squared_error", ["mse"],
+                  mesh=make_mesh(num_devices=4),
+                  strategies=two_tower_strategy(m, 4))
+        m.init_layers(seed=3)
+        if src is not None:
+            transfer_tower_params(src, m)
+        return m
+
+    def test_train_head_fits_and_heads_agree(self):
+        train = ff.FFModel(ff.FFConfig(batch_size=self.B, seed=3))
+        build_two_tower(train, self.CFG, head="train")
+        train.compile(ff.SGDOptimizer(lr=0.2),
+                      "sparse_categorical_crossentropy", ["accuracy"],
+                      mesh=make_mesh(num_devices=4),
+                      strategies=two_tower_strategy(train, 4))
+        train.init_layers(seed=3)
+        inputs, labels = self._dataset()
+        res = train.fit(inputs, labels, epochs=80, verbose=False)
+        # random guessing among B in-batch candidates is 1/B = 6.25%;
+        # the planted dense signal must lift the positive well clear
+        assert res["metrics"]["accuracy"] > 0.5, res["metrics"]
+
+        user = self._head("user", src=train)
+        item = self._head("item", src=train)
+        batch = {k: v[:self.B] for k, v in inputs.items()}
+        logits = np.asarray(train.forward_batch(batch))
+        u = np.asarray(user.forward_batch(
+            {"user_dense": batch["user_dense"],
+             "user_sparse": batch["user_sparse"]}))
+        v = np.asarray(item.forward_batch({"item_ids": batch["item_ids"]}))
+        # the serving heads ARE the train head split in two: their
+        # inner product reproduces the train-head logit matrix
+        np.testing.assert_allclose(u @ v.T, logits, rtol=1e-5, atol=1e-5)
+        # and training made the positives (diagonal) dominate
+        diag = np.mean(np.diag(logits))
+        off = (np.sum(logits) - np.sum(np.diag(logits))) / \
+            (self.B * (self.B - 1))
+        assert diag > off, (diag, off)
+
+    def test_item_embeddings_full_catalog(self):
+        item = self._head("item")
+        emb = item_embeddings(item, self.CFG)   # catalog not a multiple
+        assert emb.shape == (self.CFG.n_items, self.CFG.dim)
+        assert emb.dtype == np.float32
+        # chunked/padded encode matches a direct forward on a full batch
+        direct = np.asarray(item.forward_batch(
+            {"item_ids": np.arange(self.B, dtype=np.int32).reshape(-1, 1)}))
+        np.testing.assert_array_equal(emb[:self.B], direct)
+
+
+# ---------------------------------------------------------------------
+# degradation: drop and flag, never fabricate, never fail
+# ---------------------------------------------------------------------
+class TestDegradation:
+    def test_dead_shard_drops_candidates_flagged(self):
+        items = _items()
+        idx, sset = _index(items, 2)
+        try:
+            mid = sset.serving_plan()["ranges"]["retrieve_index"][0][1]
+            with _topk_drop(1):
+                r = idx.topk(_users(), 32, deadline_s=DEADLINE)
+            assert r.degraded and r.dropped_slots == [1]
+            assert np.all(r.ids < mid)          # shard 0's rows only
+            # the degraded answer is the EXACT top-k over the rows
+            # that answered — nothing fabricated
+            sub, ssub = _index(items[:mid], 1)
+            try:
+                ref_s, ref_i = sub.exact_scan(_users(), 32)
+                np.testing.assert_array_equal(r.ids, ref_i)
+                np.testing.assert_array_equal(r.scores, ref_s)
+            finally:
+                ssub.close()
+            assert idx.degraded_queries == 1
+            # the plan cleared: full bitwise answers come back
+            r2 = idx.topk(_users(), 32, deadline_s=DEADLINE)
+            ref_s, ref_i = idx.exact_scan(_users(), 32)
+            assert not r2.degraded
+            np.testing.assert_array_equal(r2.ids, ref_i)
+            np.testing.assert_array_equal(r2.scores, ref_s)
+        finally:
+            sset.close()
+
+    def test_degrade_fail_raises(self):
+        idx, sset = _index(_items(), 2)
+        try:
+            with _topk_drop(0):
+                with pytest.raises(ShardTierUnavailable, match="topk"):
+                    idx.topk(_users(), 8, deadline_s=DEADLINE,
+                             degrade="fail")
+        finally:
+            sset.close()
+
+
+# ---------------------------------------------------------------------
+# fault-plan env parsing (the FLX401 convention: strict, named)
+# ---------------------------------------------------------------------
+class TestFaultEnvParsing:
+    def test_topk_drop_bare_sid_is_forever(self, monkeypatch):
+        monkeypatch.setenv("FF_FAULT_TOPK_DROP", "1")
+        assert faults.plan_from_env().topk_drop == {1: -1}
+
+    def test_topk_drop_sid_n(self, monkeypatch):
+        monkeypatch.setenv("FF_FAULT_TOPK_DROP", "0:3")
+        assert faults.plan_from_env().topk_drop == {0: 3}
+
+    def test_topk_drop_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("FF_FAULT_TOPK_DROP", "x:3")
+        with pytest.raises(ValueError, match="FF_FAULT_TOPK_DROP"):
+            faults.plan_from_env()
+
+    def test_index_stale_requires_sid_n(self, monkeypatch):
+        # strict 'sid:n' ONLY: a bare sid is ambiguous between "stale
+        # once" and "stale forever"
+        monkeypatch.setenv("FF_FAULT_INDEX_STALE", "1")
+        with pytest.raises(ValueError, match="FF_FAULT_INDEX_STALE"):
+            faults.plan_from_env()
+
+    def test_index_stale_sid_n(self, monkeypatch):
+        monkeypatch.setenv("FF_FAULT_INDEX_STALE", "0:2")
+        assert faults.plan_from_env().index_stale == {0: 2}
+
+
+# ---------------------------------------------------------------------
+# freshness: one publish advances BOTH stages
+# ---------------------------------------------------------------------
+DCFG = DLRMConfig(embedding_size=[64] * 4, sparse_feature_size=8,
+                  mlp_bot=[4, 16, 8], mlp_top=[40, 16, 1])
+
+
+def _ranker_model(seed=2):
+    model = ff.FFModel(ff.FFConfig(batch_size=16, seed=seed,
+                                   host_resident_tables=True,
+                                   host_tables_async=False))
+    build_dlrm(model, DCFG)
+    model.compile(ff.SGDOptimizer(lr=0.1), "mean_squared_error", ["mse"])
+    model.init_layers()
+    return model
+
+
+class TestFreshness:
+    def test_one_publish_advances_both_stages(self):
+        m = _ranker_model()
+        sset = EmbeddingShardSet.build(m, 2)
+        items = _items(64, dim=8)
+        idx = ShardedMIPSIndex.build(sset, items)
+        try:
+            assert sset.version_vector() == {0: 0, 1: 0}
+            # one payload: ranking rows for emb_stack AND re-encoded
+            # index rows, routed through the same split/CRC/apply path
+            boosted = np.full((1, 8), 9.0, np.float32)
+            payload = {"rows": {"hostparams/emb_stack/kernel":
+                                (np.asarray([3], np.int64),
+                                 np.full((1, 8), 5.5, np.float32))},
+                       "full": {}}
+            idx.augment_delta(payload, np.asarray([5]), boosted)
+            assert sset.apply_delta(payload, 10) >= 1
+            # both stages moved together, from ONE manifest
+            assert sset.version_vector() == {0: 10, 1: 10}
+            got = sset.fetch({"emb_stack": np.asarray([3], np.int64)})
+            assert np.all(got.rows["emb_stack"] == 5.5)
+            q = np.ones((1, 8), np.float32)        # aligned with boost
+            r = idx.topk(q, 5, deadline_s=DEADLINE)
+            assert r.versions == {0: 10, 1: 10}
+            assert r.ids[0, 0] == 5
+            # the oracle table was updated in lockstep: merge and
+            # exact scan still bitwise twins AFTER the publish
+            ref_s, ref_i = idx.exact_scan(q, 5)
+            np.testing.assert_array_equal(r.ids, ref_i)
+            np.testing.assert_array_equal(r.scores, ref_s)
+        finally:
+            sset.close()
+
+    def test_stale_fault_serves_previous_version_once(self):
+        m = _ranker_model()
+        sset = EmbeddingShardSet.build(m, 2)
+        items = _items(64, dim=8)
+        idx = ShardedMIPSIndex.build(sset, items)
+        try:
+            payload = {"rows": {}, "full": {}}
+            idx.augment_delta(payload, np.asarray([5]),
+                              np.full((1, 8), 9.0, np.float32))
+            sset.apply_delta(payload, 7)
+            plan = faults.FaultPlan()
+            plan.index_stale[0] = 1
+            q = np.ones((1, 8), np.float32)
+            with faults.active_plan(plan):
+                stale = idx.topk(q, 5, deadline_s=DEADLINE)
+                # shard 0 answered from the displaced block and SAYS so
+                assert stale.versions[0] == 0
+                assert stale.versions[1] == 7
+                fresh = idx.topk(q, 5, deadline_s=DEADLINE)  # consumed
+            assert fresh.versions == {0: 7, 1: 7}
+            assert fresh.ids[0, 0] == 5
+        finally:
+            sset.close()
+
+
+# ---------------------------------------------------------------------
+# cascade: retrieve -> expand -> rank -> re-rank
+# ---------------------------------------------------------------------
+class _StubRanker:
+    """Serving-shaped ranker: scores each expanded row by a fixed
+    function of its candidate id (deterministic re-rank oracle)."""
+
+    def __init__(self, degraded=False, units=1):
+        self.degraded = degraded
+        self.units = units
+
+    def predict(self, features, timeout=None):
+        ids = features["cand_ids"].reshape(-1)
+        scores = ((ids % 7).astype(np.float32)
+                  .reshape(-1, 1).repeat(self.units, 1))
+        return Prediction(scores=scores, version=42, latency_ms=0.1,
+                          versions={0: 42}, degraded=self.degraded)
+
+
+def _cascade(idx, ranker=None, **cfg_kw):
+    cfg_kw.setdefault("k", 16)
+    cfg_kw.setdefault("retrieve_deadline_ms", DEADLINE * 1e3)
+    return CascadeEngine(
+        idx, lambda feats: feats["user"], ranker or _StubRanker(),
+        lambda feats, ids: {"cand_ids": ids.copy()},
+        CascadeConfig(**cfg_kw))
+
+
+class TestCascade:
+    def test_rerank_orders_by_ranker_score(self):
+        idx, sset = _index(_items(), 2)
+        try:
+            eng = _cascade(idx)
+            p = eng.predict({"user": _users(4)})
+            assert p.ids.shape == (4, 16)
+            assert np.all(np.diff(p.scores, axis=1) <= 0)   # desc
+            np.testing.assert_array_equal(
+                p.scores, (p.ids % 7).astype(np.float32))
+            # retrieval scores stay ALIGNED with the re-ordered ids
+            r = idx.topk(_users(4), 16, deadline_s=DEADLINE)
+            for b in range(4):
+                lut = dict(zip(r.ids[b], r.scores[b]))
+                for j in range(16):
+                    assert p.retrieve_scores[b, j] == lut[p.ids[b, j]]
+            assert p.rank_version == 42 and not p.degraded
+            assert set(p.stage_ms) == {"retrieve", "rank"}
+        finally:
+            sset.close()
+
+    def test_multiunit_head_uses_unit_zero(self):
+        idx, sset = _index(_items(), 1)
+        try:
+            p1 = _cascade(idx).predict({"user": _users(2)})
+            p2 = _cascade(idx, _StubRanker(units=3)).predict(
+                {"user": _users(2)})
+            np.testing.assert_array_equal(p1.ids, p2.ids)
+            np.testing.assert_array_equal(p1.scores, p2.scores)
+        finally:
+            sset.close()
+
+    def test_degradation_is_or_of_both_stages(self):
+        idx, sset = _index(_items(), 2)
+        try:
+            eng = _cascade(idx, _StubRanker(degraded=True))
+            p = eng.predict({"user": _users(2)})
+            assert p.degraded and p.dropped_slots == []
+            with _topk_drop(1):
+                p2 = _cascade(idx).predict({"user": _users(2)})
+            assert p2.degraded and p2.dropped_slots == [1]
+            assert np.all(np.diff(p2.scores, axis=1) <= 0)
+        finally:
+            sset.close()
+
+    def test_all_shards_dead_returns_empty_degraded(self):
+        idx, sset = _index(_items(), 2)
+        try:
+            eng = _cascade(idx)
+            plan = faults.FaultPlan()
+            plan.topk_drop[0] = plan.topk_drop[1] = -1
+            with faults.active_plan(plan):
+                p = eng.predict({"user": _users(2)})
+            assert p.degraded and p.ids.shape == (2, 0)
+            assert p.rank_version == -1
+            assert sorted(p.dropped_slots) == [0, 1]
+        finally:
+            sset.close()
+
+    def test_spent_budget_raises_deadline_exceeded(self):
+        idx, sset = _index(_items(), 1)
+        try:
+            eng = _cascade(idx)
+            with pytest.raises(DeadlineExceeded):
+                eng.predict({"user": _users(2)}, timeout=1e-9)
+            assert eng.deadline_misses == 1
+        finally:
+            sset.close()
+
+    def test_config_validates(self):
+        with pytest.raises(ValueError, match="k"):
+            CascadeConfig(k=0)
+        with pytest.raises(ValueError, match="deadline"):
+            CascadeConfig(retrieve_deadline_ms=-1.0)
+
+    def test_dlrm_candidate_features_expand(self):
+        x, _ = synthetic_batch(DCFG, 2, seed=0)
+        ids = np.asarray([[3, 70], [5, 1]], np.int64)
+        expand = dlrm_candidate_features(4, DCFG.embedding_size)
+        out = expand({k: v[:2] for k, v in x.items()}, ids)
+        assert out["dense"].shape == (4, DCFG.mlp_bot[0])
+        assert out["sparse"].shape == (4, 4, 1)
+        # candidate id written into slot 0, mod the table's vocab
+        np.testing.assert_array_equal(
+            out["sparse"][:, 0, 0], (ids.reshape(-1) % 64))
+        # the other slots are the tiled user row, untouched
+        np.testing.assert_array_equal(out["sparse"][0, 1:],
+                                      out["sparse"][1, 1:])

@@ -707,6 +707,102 @@ class TestServingPlanAudit:
         assert rc == 0
 
 
+class TestRetrievalIndexAudit:
+    """FLX516: a retrieval MIPS index replicated per ranker instead of
+    riding the sharded embedding tier."""
+
+    _RIDX = {"rows": 1 << 20, "dim": 128, "quant": "int8",
+             "sharded": False}
+
+    def test_replicated_index_flagged_medium(self):
+        model = _graph()
+        fs = shardcheck.verify_serving_plan(
+            model, replicas=4, retrieve_index=dict(self._RIDX))
+        f = next(f for f in fs if f.rule == "FLX516")
+        assert f.severity == "medium" and f.token == "retrieve-index"
+        assert "ShardedMIPSIndex.build" in f.message
+
+    def test_sharded_index_clean(self):
+        model = _graph()
+        fs = shardcheck.verify_serving_plan(
+            model, replicas=4,
+            retrieve_index=dict(self._RIDX, sharded=True))
+        assert "FLX516" not in _rules(fs)
+
+    def test_over_hbm_escalates_to_high(self):
+        model = _graph()
+        from dlrm_flexflow_tpu.serve.shardtier import serving_footprint
+        fp = serving_footprint(model, 2)
+        # budget fits the ranker alone but not ranker + index codes
+        budget = fp["ranker_bytes"] + (1 << 20)
+        fs = shardcheck.verify_serving_plan(
+            model, replicas=2, retrieve_index=dict(self._RIDX),
+            hbm_bytes=budget)
+        f = next(f for f in fs if f.rule == "FLX516")
+        assert f.severity == "high"
+        assert "cannot boot" in f.message
+
+    def test_fp32_codes_priced_4x(self):
+        model = _graph()
+        med = shardcheck.verify_serving_plan(
+            model, replicas=1, retrieve_index=dict(self._RIDX))
+        hi = shardcheck.verify_serving_plan(
+            model, replicas=1,
+            retrieve_index=dict(self._RIDX, quant="fp32"))
+        b = lambda fs: next(f for f in fs if f.rule == "FLX516").message
+        assert b(med) != b(hi)     # the dtype reprices the residency
+
+    def test_live_indexed_shard_set_plan_audits_clean(self):
+        """The plan a shard set with an ATTACHED index emits carries
+        ``retrieve_index.sharded=True`` and passes its own audit."""
+        import numpy as np
+        import dlrm_flexflow_tpu as ff_mod
+        from dlrm_flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+        from dlrm_flexflow_tpu.retrieve import ShardedMIPSIndex
+        from dlrm_flexflow_tpu.serve.shardtier import EmbeddingShardSet
+        dcfg = DLRMConfig(embedding_size=[64] * 4,
+                          sparse_feature_size=8,
+                          mlp_bot=[4, 16, 8], mlp_top=[40, 16, 1])
+        model = ff_mod.FFModel(ff_mod.FFConfig(
+            batch_size=16, seed=0, host_resident_tables=True))
+        build_dlrm(model, dcfg)
+        model.compile(ff_mod.SGDOptimizer(lr=0.1),
+                      "mean_squared_error", ["mse"])
+        model.init_layers()
+        sset = EmbeddingShardSet.build(model, 2)
+        ShardedMIPSIndex.build(
+            sset, np.random.RandomState(0).randn(64, 8)
+            .astype(np.float32))
+        plan = sset.serving_plan()
+        plan["ranker_holds_tables"] = False
+        assert plan["retrieve_index"]["sharded"] is True
+        fs = shardcheck.verify_serving_plan(model, replicas=2,
+                                            serving_plan=plan)
+        assert fs == []
+        sset.close()
+
+    def test_cli_retrieve_index_flags(self, capsys):
+        rc = shardcheck.main(
+            ["--serving-replicas", "2", "--serving-shards", "4",
+             "--model", "dlrm_kaggle", "--hbm-gb", "16",
+             "--retrieve-index-rows", str(1 << 20), "--fail-on",
+             "medium"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "FLX516" in out
+        rc = shardcheck.main(
+            ["--serving-replicas", "2", "--serving-shards", "4",
+             "--model", "dlrm_kaggle", "--hbm-gb", "16",
+             "--retrieve-index-rows", str(1 << 20),
+             "--retrieve-index-sharded", "--fail-on", "medium"])
+        assert rc == 0
+
+    def test_rule_registered(self):
+        name, sev, doc = RULES["FLX516"]
+        assert name == "retrieval-index-overreplicated"
+        assert sev == "medium" and "sharded" in doc
+
+
 class TestRttBudgetAudit:
     """FLX509: the per-seam wire RTT floor vs the serve SLO. The retry
     chain is serial (RTT x (1+retries) + exponential backoff); the
